@@ -1,0 +1,53 @@
+"""factorvae_tpu — a TPU-native (JAX/XLA/Flax/pjit) FactorVAE framework.
+
+A ground-up re-design of the capabilities of the reference PyTorch
+implementation (x7jeon8gi/FactorVAE, "FactorVAE: A Probabilistic Dynamic
+Factor Model Based on Variational Autoencoder for Predicting Cross-Sectional
+Stock Returns", Duan et al., AAAI 2022) for TPU hardware:
+
+- static padded cross-sections + validity masks instead of variable-size
+  per-day batches (reference: dataset.py:207-238)
+- one batched einsum for the K attention heads instead of a Python loop of
+  K modules (reference: module.py:172-178)
+- GRU as a `lax.scan` with the input projection hoisted into one big matmul
+- whole-epoch `lax.scan` training with on-device metrics (no per-step host
+  sync; reference syncs every step at train_model.py:28)
+- day-level data parallelism + optional cross-section model parallelism over
+  a `jax.sharding.Mesh`, gradients reduced by XLA collectives over ICI
+"""
+
+from factorvae_tpu.config import (
+    Config,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from factorvae_tpu.models import (
+    AlphaLayer,
+    BetaLayer,
+    FactorDecoder,
+    FactorEncoder,
+    FactorPredictor,
+    FactorVAE,
+    FactorVAEOutput,
+    FeatureExtractor,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config",
+    "DataConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "TrainConfig",
+    "AlphaLayer",
+    "BetaLayer",
+    "FactorDecoder",
+    "FactorEncoder",
+    "FactorPredictor",
+    "FactorVAE",
+    "FactorVAEOutput",
+    "FeatureExtractor",
+]

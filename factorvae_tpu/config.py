@@ -1,0 +1,185 @@
+"""Typed configuration for the whole framework.
+
+Replaces the reference's argparse namespace (main.py:90-114), the
+`DataArgument` dataclass (utils.py:19-53) and the `test_args` dataclass
+(utils.py:95-111) with a single serializable config tree that covers
+model / data / training / mesh, and that is embedded into checkpoints and
+score filenames.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyper-parameters.
+
+    Mirrors the knobs of the reference model assembly (main.py:27-33):
+    ``num_latent`` -> ``num_features`` (C), ``hidden_size`` (H),
+    ``num_factor`` -> ``num_factors`` (K), ``num_portfolio`` ->
+    ``num_portfolios`` (M).
+    """
+
+    num_features: int = 158      # C: Alpha158 features  (main.py:95 --num_latent)
+    hidden_size: int = 64        # H                     (main.py:100)
+    num_factors: int = 96        # K                     (main.py:99)
+    num_portfolios: int = 128    # M                     (main.py:96)
+    seq_len: int = 20            # T: look-back window   (main.py:98)
+    gru_layers: int = 1          # reference uses a 1-layer GRU (module.py:20)
+    dropout_rate: float = 0.1    # attention-score dropout (module.py:132)
+    leaky_relu_slope: float = 0.01  # torch nn.LeakyReLU default
+    # Reconstruction loss. 'mse' is reference-faithful (module.py:261:
+    # F.mse_loss on ONE reparameterized sample). 'nll' is the paper's
+    # Gaussian negative log-likelihood (BASELINE.json north star); both are
+    # provided, flag-selected, so parity can be measured against 'mse'.
+    recon_loss: str = "mse"
+    # Reference-faithful inference draws a reparameterized sample even in
+    # `prediction()` (module.py:123). `stochastic_inference=False` uses the
+    # distribution mean instead (deterministic scores).
+    stochastic_inference: bool = True
+    # Compute dtype for the heavy linear algebra ("float32" | "bfloat16").
+    # Parameters, softmax/softplus statistics and losses stay float32.
+    compute_dtype: str = "float32"
+    # Use torch-style U(+-1/sqrt(fan_in)) initializers so training dynamics
+    # match the reference's scale. False -> flax defaults (lecun_normal).
+    torch_init: bool = True
+
+    @property
+    def dtype(self):
+        import jax.numpy as jnp
+
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[self.compute_dtype]
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Data-split configuration (reference utils.py:19-53 DataArgument)."""
+
+    dataset_path: str = "./data/csi_data.pkl"
+    start_time: str = "2009-01-01"       # main.py:103
+    fit_end_time: str = "2017-12-31"     # main.py:104
+    val_start_time: str = "2018-01-01"   # main.py:105
+    val_end_time: str = "2018-12-31"     # main.py:106
+    end_time: str = "2020-12-31"         # main.py:107
+    seq_len: int = 20
+    normalize: bool = True
+    select_feature: Optional[Sequence[str]] = None
+    # Cross-section padding size (N_max). None -> inferred from the panel
+    # (max instruments per day, rounded up to `pad_multiple`).
+    max_stocks: Optional[int] = None
+    # Round N_max up to a multiple of this for TPU-friendly tiling (the MXU
+    # operates on 128-lane tiles) and for even sharding over a 'stock' axis.
+    pad_multiple: int = 8
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimization configuration (reference main.py:52,60-61,92-93)."""
+
+    num_epochs: int = 30
+    lr: float = 1e-4
+    seed: int = 42
+    # Number of trading days whose gradients are averaged per optimizer
+    # update. 1 is reference-faithful (one day = one SGD step,
+    # train_model.py:17-32). >1 enables day-level data parallelism: with a
+    # d-device mesh each device takes days_per_step/d days and gradients are
+    # all-reduced over ICI.
+    days_per_step: int = 1
+    # Cosine schedule over total update count (main.py:52,61).
+    cosine_schedule: bool = True
+    run_name: str = "VAE-Revision2"
+    save_dir: str = "./best_models"
+    wandb: bool = False
+    # Checkpoint every N epochs for fault tolerance (0 = best-val only,
+    # which is all the reference ever saved; main.py:73-80).
+    checkpoint_every: int = 1
+    keep_checkpoints: int = 3
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout.
+
+    Axes: 'data' shards trading days (gradient all-reduce over ICI);
+    'stock' shards the cross-section (masked-softmax/portfolio reductions
+    become psum collectives) — the TPU analogue of sequence/context
+    parallelism for this model family, where the long axis is the stock
+    universe, not time (SURVEY.md §5).
+    """
+
+    data_axis: int = -1   # -1: use all remaining devices
+    stock_axis: int = 1
+
+    def shape(self, n_devices: int) -> tuple:
+        stock = max(1, self.stock_axis)
+        if n_devices % stock != 0:
+            raise ValueError(f"{n_devices} devices not divisible by stock axis {stock}")
+        data = self.data_axis if self.data_axis > 0 else n_devices // stock
+        if data * stock != n_devices:
+            raise ValueError(
+                f"mesh {data}x{stock} != {n_devices} devices")
+        return (data, stock)
+
+
+@dataclass(frozen=True)
+class Config:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    # ---- serialization ----------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self, **kw: Any) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Config":
+        def _load(tp, sub):
+            known = {f.name for f in dataclasses.fields(tp)}
+            return tp(**{k: v for k, v in (sub or {}).items() if k in known})
+
+        return cls(
+            model=_load(ModelConfig, d.get("model")),
+            data=_load(DataConfig, d.get("data")),
+            train=_load(TrainConfig, d.get("train")),
+            mesh=_load(MeshConfig, d.get("mesh")),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls.from_dict(json.loads(s))
+
+    def checkpoint_name(self) -> str:
+        """Parameter-encoding checkpoint name.
+
+        Same scheme as the reference filename
+        ``{run_name}_factor_{K}_hdn_{H}_port_{M}_seed_{seed}`` (main.py:78).
+        """
+        return (
+            f"{self.train.run_name}_factor_{self.model.num_factors}"
+            f"_hdn_{self.model.hidden_size}_port_{self.model.num_portfolios}"
+            f"_seed_{self.train.seed}"
+        )
+
+    def score_name(self) -> str:
+        """Score-CSV naming scheme from the reference scores/readme.md:2-8:
+        ``{run_name}_{num_factor}_{normalize}_{select_feature}_{num_latent}_{hidden_size}``.
+        """
+        sel = (
+            "None"
+            if self.data.select_feature is None
+            else str(len(self.data.select_feature))
+        )
+        return (
+            f"{self.train.run_name}_{self.model.num_factors}_{self.data.normalize}"
+            f"_{sel}_{self.model.num_features}_{self.model.hidden_size}"
+        )

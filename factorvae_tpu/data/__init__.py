@@ -1,0 +1,23 @@
+from factorvae_tpu.data.loader import PanelDataset
+from factorvae_tpu.data.panel import Panel, build_panel, load_frame, panel_to_frame
+from factorvae_tpu.data.synthetic import synthetic_frame, synthetic_panel
+from factorvae_tpu.data.windows import (
+    compute_fill_maps,
+    fill_indices_host,
+    gather_day,
+    window_fill_indices,
+)
+
+__all__ = [
+    "Panel",
+    "PanelDataset",
+    "build_panel",
+    "compute_fill_maps",
+    "fill_indices_host",
+    "gather_day",
+    "load_frame",
+    "panel_to_frame",
+    "synthetic_frame",
+    "synthetic_panel",
+    "window_fill_indices",
+]

@@ -1,0 +1,123 @@
+"""Device-resident day-batch dataset.
+
+Replaces the reference's TSDatasetH + DateGroupedBatchSampler + DataLoader
+assembly (dataset.py:187-274). The semantic is identical — one batch =
+one trading day's full cross-section, optionally day-shuffled
+(dataset.py:227-234) — but the mechanics are TPU-first: the whole panel
+sits in HBM as static-shape arrays, a "batch" is just a day index, and the
+window gather runs inside the jitted train step (windows.py). There are no
+worker processes, no host->device copies per step, and no variable batch
+shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from factorvae_tpu.data.panel import Panel
+from factorvae_tpu.data.windows import compute_fill_maps, gather_day
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class PanelDataset:
+    """HBM-resident panel + split bookkeeping.
+
+    The cross-section is padded to ``n_max`` (a multiple of `pad_multiple`
+    for MXU tiling / even 'stock'-axis sharding); padded instruments are
+    permanently invalid.
+    """
+
+    def __init__(
+        self,
+        panel: Panel,
+        seq_len: int = 20,
+        max_stocks: Optional[int] = None,
+        pad_multiple: int = 8,
+    ):
+        self.panel = panel
+        self.seq_len = seq_len
+        n_inst = panel.num_instruments
+        n_max = max_stocks or _round_up(n_inst, pad_multiple)
+        if n_max < n_inst:
+            raise ValueError(f"max_stocks={n_max} < {n_inst} instruments")
+        self.n_max = n_max
+
+        d = panel.num_days
+        values = np.full((n_max, d, panel.values.shape[-1]), np.nan, np.float32)
+        values[:n_inst] = panel.values
+        valid = np.zeros((d, n_max), bool)
+        valid[:, :n_inst] = panel.valid
+        last_valid, next_valid = compute_fill_maps(valid)
+
+        # Ship to the default device once; everything downstream indexes it.
+        self.values = jnp.asarray(values)
+        self.last_valid = jnp.asarray(last_valid)
+        self.next_valid = jnp.asarray(next_valid)
+        self.valid = valid
+        self.dates = panel.dates
+        self.instruments = panel.instruments
+
+    # ---- splits ----------------------------------------------------------
+
+    def split_days(self, start: Optional[str], end: Optional[str]) -> np.ndarray:
+        """Day indices whose date lies in [start, end] — the analogue of the
+        reference's slice_locs sample restriction (dataset.py:97-99). The
+        look-back windows of early split days still reach into earlier
+        days, exactly as in the reference (the sampler holds the full
+        frame and only restricts sample positions)."""
+        lo, hi = self.panel.locate(start, end)
+        days = np.arange(lo, hi, dtype=np.int32)
+        # Drop days with an empty cross-section (can happen on synthetic
+        # panels; reference days always have rows).
+        return days[self.valid[days].any(axis=1)]
+
+    # ---- batching --------------------------------------------------------
+
+    def day_batch(self, day) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """(x, y, mask) for one day; usable eagerly or under jit."""
+        return gather_day(
+            self.values, self.last_valid, self.next_valid, day, self.seq_len
+        )
+
+    def iter_days(
+        self, days: np.ndarray, shuffle: bool = False, seed: int = 0
+    ) -> Iterator[int]:
+        """Host-side day iterator (eval/debug path). Training uses the
+        fully on-device epoch scan in train/loop.py instead."""
+        order = np.array(days)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        yield from order.tolist()
+
+    def epoch_order(
+        self, days: np.ndarray, shuffle: bool, seed: int, epoch: int, pad_to: int = 0
+    ) -> np.ndarray:
+        """Day order for one epoch, optionally padded (by repeating the
+        final day with a zero-weight marker handled by the loop) so the
+        epoch length is a multiple of `days_per_step * data_axis`."""
+        order = np.array(days)
+        if shuffle:
+            np.random.default_rng((seed, epoch)).shuffle(order)
+        if pad_to:
+            rem = (-len(order)) % pad_to
+            if rem:
+                order = np.concatenate([order, np.full(rem, -1, order.dtype)])
+        return order
+
+    def index_frame(self, days: np.ndarray) -> pd.MultiIndex:
+        """(datetime, instrument) MultiIndex of valid samples in day order —
+        the analogue of TSDataSampler.get_index() (dataset.py:124-125),
+        used to align exported scores."""
+        tuples = []
+        for d in days:
+            for i in np.nonzero(self.valid[d])[0]:
+                tuples.append((self.dates[d], self.instruments[i]))
+        return pd.MultiIndex.from_tuples(tuples, names=["datetime", "instrument"])

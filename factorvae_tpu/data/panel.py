@@ -1,0 +1,135 @@
+"""Dense panel construction from the reference's pickle schema.
+
+The reference consumes a pandas DataFrame with MultiIndex
+``(datetime, instrument)``, 158 Alpha158 feature columns + 1 label column
+(reference main.py:36-37 keeps ``.iloc[:, :159]`` and renames the last
+column to 'LABEL0'; data/make_dataset.py:66-83 writes the pickle).
+
+TPU-first re-design: instead of a per-sample sampler + Python DataLoader
+(reference dataset.py:41-274), the whole panel is densified ONCE into
+
+    values: (I, D, C+1) float32, NaN where an (instrument, day) row is absent
+    valid:  (D, I) bool — row exists for that trading day
+    dates / instruments: the calendar-grid axes
+
+then windows are *gathered on device* per step via a precomputed
+ffill+bfill index map (see windows.py). At CSI300 scale the whole train
+split is ~0.5 GB and lives in HBM for the entire run (SURVEY.md §7.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+@dataclasses.dataclass
+class Panel:
+    """A dense (instrument, day, column) view of a stock panel."""
+
+    values: np.ndarray        # (I, D, C+1) float32; [..., -1] is the label
+    valid: np.ndarray         # (D, I) bool
+    dates: pd.DatetimeIndex   # (D,)
+    instruments: np.ndarray   # (I,) str
+
+    @property
+    def num_days(self) -> int:
+        return len(self.dates)
+
+    @property
+    def num_instruments(self) -> int:
+        return len(self.instruments)
+
+    @property
+    def num_features(self) -> int:
+        return self.values.shape[-1] - 1
+
+    def date_slice(self, start: Optional[str], end: Optional[str]) -> "Panel":
+        """Restrict to trading days in [start, end] (both inclusive, like
+        pandas .slice_locs as used at reference dataset.py:97-99)."""
+        lo, hi = self.dates.slice_locs(
+            start=pd.Timestamp(start) if start else None,
+            end=pd.Timestamp(end) if end else None,
+        )
+        return Panel(
+            values=self.values[:, lo:hi],
+            valid=self.valid[lo:hi],
+            dates=self.dates[lo:hi],
+            instruments=self.instruments,
+        )
+
+    def locate(self, start: Optional[str], end: Optional[str]) -> tuple:
+        """Day-index range [lo, hi) for a date range."""
+        return self.dates.slice_locs(
+            start=pd.Timestamp(start) if start else None,
+            end=pd.Timestamp(end) if end else None,
+        )
+
+
+def load_frame(
+    path: str,
+    select_feature: Optional[Sequence[str]] = None,
+    max_columns: int = 159,
+) -> pd.DataFrame:
+    """Read a reference-schema pickle and normalize its columns.
+
+    Mirrors reference main.py:36-37: keep the first 159 columns (drop any
+    market-info extras) and rename the last kept column to 'LABEL0'.
+    """
+    df = pd.read_pickle(path)
+    if isinstance(df.columns, pd.MultiIndex):
+        # qlib writes (col_set, name) MultiIndex columns; flatten to names.
+        df.columns = [c[-1] for c in df.columns]
+    df = df.iloc[:, :max_columns]
+    df = df.rename(columns={df.columns[-1]: "LABEL0"})
+    if select_feature is not None:
+        df = df[list(select_feature) + ["LABEL0"]]
+    return df
+
+
+def build_panel(df: pd.DataFrame) -> Panel:
+    """Densify a MultiIndex (datetime, instrument) frame to a Panel.
+
+    Equivalent information to the reference's date x instrument index grid
+    (dataset.py:127-137) but materialized as one dense float array rather
+    than an object-dtype frame of row indices.
+    """
+    if list(df.index.names) != ["datetime", "instrument"]:
+        raise ValueError(f"expected (datetime, instrument) index, got {df.index.names}")
+    df = df.sort_index()
+    dates = df.index.get_level_values(0).unique().sort_values()
+    instruments = df.index.get_level_values(1).unique().sort_values()
+    d, i, c = len(dates), len(instruments), df.shape[1]
+
+    date_pos = pd.Series(np.arange(d), index=dates)
+    inst_pos = pd.Series(np.arange(i), index=instruments)
+    rows = date_pos.loc[df.index.get_level_values(0)].to_numpy()
+    cols = inst_pos.loc[df.index.get_level_values(1)].to_numpy()
+
+    values = np.full((i, d, c), np.nan, dtype=np.float32)
+    values[cols, rows] = df.to_numpy(dtype=np.float32)
+    valid = np.zeros((d, i), dtype=bool)
+    valid[rows, cols] = True
+    return Panel(
+        values=values,
+        valid=valid,
+        dates=pd.DatetimeIndex(dates),
+        instruments=np.asarray(instruments),
+    )
+
+
+def panel_to_frame(panel: Panel) -> pd.DataFrame:
+    """Inverse of `build_panel` (drops absent rows); used by tests."""
+    i, d, c = panel.values.shape
+    mask = panel.valid.T.reshape(-1)  # (I*D,) instrument-major
+    idx = pd.MultiIndex.from_product(
+        [panel.dates, panel.instruments], names=["datetime", "instrument"]
+    )
+    # values is instrument-major; reorder to (D, I, C) date-major flat
+    flat = np.swapaxes(panel.values, 0, 1).reshape(d * i, c)
+    keep = panel.valid.reshape(-1)
+    del mask
+    return pd.DataFrame(flat[keep], index=idx[keep])

@@ -1,0 +1,50 @@
+"""Synthetic panels for tests and benchmarks.
+
+Produces the same schema the reference consumes (MultiIndex
+(datetime, instrument) frame, C feature columns + LABEL0) with
+controllable missingness and a plantable linear signal so the
+overfit-integration test (SURVEY.md §4) has something learnable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from factorvae_tpu.data.panel import Panel, build_panel
+
+
+def synthetic_frame(
+    num_days: int = 30,
+    num_instruments: int = 12,
+    num_features: int = 16,
+    missing_prob: float = 0.1,
+    signal: float = 0.5,
+    seed: int = 0,
+) -> pd.DataFrame:
+    """Reference-schema frame with random (day, instrument) dropout."""
+    rng = np.random.default_rng(seed)
+    dates = pd.bdate_range("2020-01-01", periods=num_days)
+    instruments = np.array([f"SH{600000 + k}" for k in range(num_instruments)])
+    w = rng.normal(size=(num_features,)) / np.sqrt(num_features)
+
+    rows, feats, labels = [], [], []
+    for d in dates:
+        for inst in instruments:
+            if rng.random() < missing_prob:
+                continue
+            f = rng.normal(size=(num_features,)).astype(np.float32)
+            y = signal * float(f @ w) + (1 - signal) * float(rng.normal())
+            rows.append((d, inst))
+            feats.append(f)
+            labels.append(y)
+    idx = pd.MultiIndex.from_tuples(rows, names=["datetime", "instrument"])
+    df = pd.DataFrame(
+        np.asarray(feats), index=idx, columns=[f"F{i}" for i in range(num_features)]
+    )
+    df["LABEL0"] = np.asarray(labels, dtype=np.float32)
+    return df
+
+
+def synthetic_panel(**kw) -> Panel:
+    return build_panel(synthetic_frame(**kw))

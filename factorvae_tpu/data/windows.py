@@ -1,0 +1,114 @@
+"""Look-back-window construction with ffill+bfill semantics.
+
+Reference semantics being reproduced (dataset.py:139-151 `_get_indices`
+with ``fillna_type='ffill+bfill'`` as wired at dataset.py:266): a sample
+(day d, instrument i) is a `T`-row window over trading days
+[d-T+1 .. d]; a day on which the instrument has no row — or a position
+before the start of the calendar — takes the nearest *preceding* valid row
+within the window, and leading gaps take the nearest *following* valid row
+within the window. Only window-local rows are used for filling.
+
+TPU-first re-design: the reference gathers rows per sample on host inside
+DataLoader workers. Here two tiny int32 maps
+
+    last_valid[d, i] = most recent day <= d with a row (-1 if none)
+    next_valid[d, i] = earliest day  >= d with a row ( D if none)
+
+are precomputed once on host (O(D*I)); the actual `(I, T, C)` window
+gather happens **on device inside the jitted step** via
+`take_along_axis`, so the full windowed tensor (which would be tens of GB
+materialized) never exists — only the dense panel (~0.5 GB) lives in HBM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def compute_fill_maps(valid: np.ndarray):
+    """valid: (D, I) bool -> (last_valid, next_valid), both (D, I) int32.
+
+    last_valid[d,i] is the largest d' <= d with valid[d',i] (-1 if none);
+    next_valid[d,i] the smallest d' >= d (D if none).
+    """
+    d, i = valid.shape
+    idx = np.arange(d, dtype=np.int32)[:, None]
+    last_valid = np.maximum.accumulate(np.where(valid, idx, -1), axis=0)
+    rev = valid[::-1]
+    nv_rev = np.maximum.accumulate(np.where(rev, idx, -1), axis=0)
+    next_valid = np.where(nv_rev[::-1] >= 0, d - 1 - nv_rev[::-1], d)
+    return last_valid.astype(np.int32), next_valid.astype(np.int32)
+
+
+def fill_indices_host(valid: np.ndarray, day: int, step_len: int) -> np.ndarray:
+    """Host oracle: per-instrument day indices for day `day`'s window,
+    (I, T) int32; -1 marks an unresolvable position (no valid row in the
+    window — the reference would produce its all-NaN sentinel row there,
+    dataset.py:81-84). Used by tests to pin the device gather's semantics.
+    """
+    d_total, n_inst = valid.shape
+    t = step_len
+    out = np.full((n_inst, t), -1, dtype=np.int32)
+    for i in range(n_inst):
+        pos = np.arange(day - t + 1, day + 1)
+        vals = np.full(t, np.nan)
+        for k, p in enumerate(pos):
+            if 0 <= p < d_total and valid[p, i]:
+                vals[k] = p
+        # ffill then bfill (reference dataset.py:148 applied to index
+        # positions, which carry whole rows)
+        for k in range(1, t):
+            if np.isnan(vals[k]):
+                vals[k] = vals[k - 1]
+        for k in range(t - 2, -1, -1):
+            if np.isnan(vals[k]):
+                vals[k] = vals[k + 1]
+        out[i] = np.where(np.isnan(vals), -1, vals).astype(np.int32)
+    return out
+
+
+def window_fill_indices(
+    last_valid: jnp.ndarray, next_valid: jnp.ndarray, day, step_len: int
+) -> jnp.ndarray:
+    """Device-side fill indices for one day: (I, T) int32.
+
+    `day` may be a traced scalar. Positions with no valid row anywhere in
+    the window resolve to `day` (clamped gather; such instruments are
+    masked out of the batch anyway since valid[day, i] is False for them).
+    """
+    d_total = last_valid.shape[0]
+    t = step_len
+    p = day - t + 1 + jnp.arange(t)                      # (T,) window days
+    pc = jnp.clip(p, 0, d_total - 1)
+    lv = last_valid[pc]                                   # (T, I)
+    w_start = day - t + 1
+    ff_ok = (p >= 0)[:, None] & (lv >= w_start)
+    fv = next_valid[jnp.clip(w_start, 0, d_total - 1)]    # (I,)
+    bf_ok = fv <= day
+    fallback = jnp.where(bf_ok, fv, day)[None, :]
+    fill = jnp.where(ff_ok, lv, fallback)                 # (T, I)
+    return fill.T.astype(jnp.int32)                       # (I, T)
+
+
+def gather_day(
+    values: jnp.ndarray,
+    last_valid: jnp.ndarray,
+    next_valid: jnp.ndarray,
+    day,
+    step_len: int,
+):
+    """Gather one day's padded cross-section from the HBM-resident panel.
+
+    values: (I, D, C+1). Returns (x, y, mask):
+      x    (I, T, C)  features, NaN-free (padded/missing -> 0)
+      y    (I,)       day-`day` labels (may be NaN on inference panels)
+      mask (I,)       instrument has a row on `day`
+    """
+    fill = window_fill_indices(last_valid, next_valid, day, step_len)  # (I, T)
+    window = jnp.take_along_axis(values, fill[:, :, None], axis=1)     # (I, T, C+1)
+    x = jnp.nan_to_num(window[:, :, :-1], nan=0.0)
+    y = values[:, day, -1]  # label = the day-d row's last column
+    mask = last_valid[day] == day  # valid[day, i] <=> last_valid[day,i]==day
+    return x, y, mask

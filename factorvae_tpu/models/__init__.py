@@ -1,0 +1,20 @@
+from factorvae_tpu.models.decoder import AlphaLayer, BetaLayer, FactorDecoder
+from factorvae_tpu.models.encoder import FactorEncoder
+from factorvae_tpu.models.extractor import FeatureExtractor
+from factorvae_tpu.models.factorvae import FactorVAE, FactorVAEOutput, day_batched
+from factorvae_tpu.models.layers import GRU, Dense
+from factorvae_tpu.models.predictor import FactorPredictor
+
+__all__ = [
+    "AlphaLayer",
+    "BetaLayer",
+    "Dense",
+    "FactorDecoder",
+    "FactorEncoder",
+    "FactorPredictor",
+    "FactorVAE",
+    "FactorVAEOutput",
+    "FeatureExtractor",
+    "GRU",
+    "day_batched",
+]

@@ -1,0 +1,77 @@
+"""Alpha/beta heads and the factor decoder.
+
+Capability parity with reference module.py:69-123 (`AlphaLayer`,
+`BetaLayer`, `FactorDecoder`): idiosyncratic return head (alpha), factor
+exposures (beta), and the combination
+
+    mu    = alpha_mu + beta @ factor_mu
+    sigma = sqrt(alpha_sigma^2 + beta^2 @ factor_sigma^2 + 1e-6)
+
+with the zero-sigma guard (module.py:117, a `where` here instead of the
+in-place masked write) and a reparameterized sample mu + eps*sigma
+(module.py:103-105,123). The reference samples even at inference; that
+behavior is preserved behind ``ModelConfig.stochastic_inference``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from factorvae_tpu.config import ModelConfig
+from factorvae_tpu.models.layers import Dense
+
+
+class AlphaLayer(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray):
+        """latent: (N, H) -> (alpha_mu, alpha_sigma), each (N,)."""
+        cfg = self.cfg
+        h = Dense(cfg.hidden_size, torch_init=cfg.torch_init, name="proj")(latent)
+        h = nn.leaky_relu(h, negative_slope=cfg.leaky_relu_slope)   # module.py:80-81
+        mu = Dense(1, torch_init=cfg.torch_init, name="mu")(h)[:, 0]
+        sigma = nn.softplus(Dense(1, torch_init=cfg.torch_init, name="sigma")(h))[:, 0]
+        return mu, sigma
+
+
+class BetaLayer(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray) -> jnp.ndarray:
+        """latent: (N, H) -> factor exposures beta (N, K)  (module.py:92-94)."""
+        return Dense(
+            self.cfg.num_factors, torch_init=self.cfg.torch_init, name="beta"
+        )(latent)
+
+
+class FactorDecoder(nn.Module):
+    cfg: ModelConfig
+
+    def setup(self):
+        self.alpha_layer = AlphaLayer(self.cfg)
+        self.beta_layer = BetaLayer(self.cfg)
+
+    def distribution(self, latent, factor_mu, factor_sigma):
+        """Per-stock return distribution (mu, sigma), each (N,)."""
+        alpha_mu, alpha_sigma = self.alpha_layer(latent)
+        beta = self.beta_layer(latent)
+        factor_sigma = jnp.where(factor_sigma == 0.0, 1e-6, factor_sigma)  # :117
+        mu = alpha_mu + beta @ factor_mu                                   # :120
+        sigma = jnp.sqrt(alpha_sigma**2 + (beta**2) @ (factor_sigma**2) + 1e-6)  # :121
+        return mu, sigma
+
+    def __call__(self, latent, factor_mu, factor_sigma, *, sample: bool = True):
+        """Returns a reparameterized sample (and the distribution).
+
+        sample=False returns the mean as the prediction (deterministic
+        inference mode; the reference always samples, module.py:123).
+        """
+        mu, sigma = self.distribution(latent, factor_mu, factor_sigma)
+        if sample:
+            eps = jax.random.normal(self.make_rng("sample"), sigma.shape)  # :103-105
+            return mu + eps * sigma, (mu, sigma)
+        return mu, (mu, sigma)

@@ -1,0 +1,41 @@
+"""Posterior factor encoder.
+
+Capability parity with reference module.py:33-67 (`FactorEncoder`):
+stock latents -> M portfolio weights via Linear + softmax over the *stock*
+axis (the reference's annotated "BUG Fixed: dim=1 -> dim=0" at
+module.py:38), portfolio returns y_p = W^T y, then mu/sigma heads with
+Softplus -> posterior (mu_post, sigma_post) in (K,).
+
+The softmax over stocks becomes a masked softmax so padded stocks carry
+exactly zero portfolio weight; the portfolio matmul then needs no separate
+masking.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from factorvae_tpu.config import ModelConfig
+from factorvae_tpu.models.layers import Dense
+from factorvae_tpu.ops.masked import masked_softmax
+
+
+class FactorEncoder(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray, returns: jnp.ndarray, mask: jnp.ndarray):
+        """latent: (N, H), returns: (N,), mask: (N,) -> ((K,), (K,))."""
+        cfg = self.cfg
+        w = Dense(cfg.num_portfolios, torch_init=cfg.torch_init, name="portfolio")(
+            latent
+        )                                                     # module.py:56
+        w = masked_softmax(w, mask[:, None], axis=0)          # module.py:57 (dim=0)
+        returns = jnp.where(mask, returns, 0.0)
+        y_p = w.T @ returns                                   # module.py:64, (M,)
+        mu = Dense(cfg.num_factors, torch_init=cfg.torch_init, name="mu")(y_p)
+        sigma = nn.softplus(
+            Dense(cfg.num_factors, torch_init=cfg.torch_init, name="sigma")(y_p)
+        )                                                     # module.py:44-50
+        return mu, sigma

@@ -1,0 +1,39 @@
+"""Per-stock feature extractor.
+
+Capability parity with reference module.py:10-31 (`FeatureExtractor`):
+LayerNorm(C) -> Linear(C->C) -> LeakyReLU -> 1-layer GRU over T ->
+last hidden state, giving the per-stock latent e in (N, H).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from factorvae_tpu.config import ModelConfig
+from factorvae_tpu.models.layers import GRU, Dense, layer_norm
+
+
+class FeatureExtractor(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """x: (N, T, C) firm characteristics -> (N, H) stock latents.
+
+        Padded stocks produce garbage latents that downstream masked
+        reductions ignore; keeping them in the batch keeps every matmul a
+        full, static-shape MXU op.
+        """
+        cfg = self.cfg
+        dtype = cfg.dtype
+        x = x.astype(dtype)
+        x = layer_norm(x, dtype=dtype)                       # module.py:26
+        x = Dense(
+            cfg.num_features, torch_init=cfg.torch_init, dtype=dtype, name="proj"
+        )(x)                                                 # module.py:27
+        x = nn.leaky_relu(x, negative_slope=cfg.leaky_relu_slope)  # module.py:28
+        latent = GRU(
+            cfg.hidden_size, torch_init=cfg.torch_init, dtype=dtype, name="gru"
+        )(x)                                                 # module.py:30-31
+        return latent.astype(jnp.float32)

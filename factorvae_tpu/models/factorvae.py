@@ -1,0 +1,151 @@
+"""The FactorVAE top-level model.
+
+Capability parity with reference module.py:234-278 (`FactorVAE`): wires
+extractor, posterior encoder, decoder and prior predictor; the training
+loss is reconstruction + KL(posterior || prior) summed over K. The model
+operates on ONE trading day's padded cross-section; day batching is done
+with `nn.vmap` (see `day_batched`) so the per-day cross-stock reductions
+stay local to a day.
+
+Loss parity notes (SURVEY.md §7 hard-parts):
+- 'mse' mode reproduces module.py:261 exactly: MSE between the single
+  reparameterized sample and the labels (a mean over stocks), while the KL
+  is a *sum* over K — the scale imbalance is intentional.
+- 'nll' mode is the paper's analytic Gaussian reconstruction likelihood.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.struct
+import jax.numpy as jnp
+from flax import linen as nn
+
+from factorvae_tpu.config import ModelConfig
+from factorvae_tpu.models.decoder import FactorDecoder
+from factorvae_tpu.models.encoder import FactorEncoder
+from factorvae_tpu.models.extractor import FeatureExtractor
+from factorvae_tpu.models.predictor import FactorPredictor
+from factorvae_tpu.ops.kl import gaussian_kl_sum
+from factorvae_tpu.ops.masked import masked_gaussian_nll, masked_mse
+
+
+@flax.struct.dataclass
+class FactorVAEOutput:
+    """Everything the reference forward returns (module.py:270), plus the
+    loss decomposition."""
+
+    loss: jnp.ndarray
+    recon_loss: jnp.ndarray
+    kl: jnp.ndarray
+    reconstruction: jnp.ndarray      # (N,) sampled returns
+    factor_mu: jnp.ndarray           # (K,) posterior mean
+    factor_sigma: jnp.ndarray        # (K,) posterior std
+    pred_mu: jnp.ndarray             # (K,) prior mean
+    pred_sigma: jnp.ndarray          # (K,) prior std
+
+
+class FactorVAE(nn.Module):
+    cfg: ModelConfig
+
+    def setup(self):
+        self.feature_extractor = FeatureExtractor(self.cfg)
+        self.factor_encoder = FactorEncoder(self.cfg)
+        self.factor_decoder = FactorDecoder(self.cfg)
+        self.factor_predictor = FactorPredictor(self.cfg)
+
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        returns: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        *,
+        train: bool = False,
+    ) -> FactorVAEOutput:
+        """One day's padded cross-section.
+
+        x: (N, T, C) characteristics; returns: (N,) next-period returns;
+        mask: (N,) validity (None -> all valid). Needs rngs: 'sample'
+        (reparameterization) and, when train=True, 'dropout'.
+        """
+        cfg = self.cfg
+        if mask is None:
+            mask = jnp.ones(x.shape[0], dtype=bool)
+        # Labels can be NaN on inference panels (forward-looking label
+        # missing); zero them for the encoder's portfolio matmul and
+        # exclude them from the loss below — the ETL's DropnaLabel
+        # guarantees the reference never sees one in training
+        # (data/make_dataset.py:55).
+        loss_mask = mask & jnp.isfinite(returns)
+        returns = jnp.where(loss_mask, returns, 0.0)
+
+        latent = self.feature_extractor(x)                          # module.py:254
+        factor_mu, factor_sigma = self.factor_encoder(latent, returns, mask)
+        sample, (recon_mu, recon_sigma) = self.factor_decoder(
+            latent, factor_mu, factor_sigma, sample=True
+        )                                                           # module.py:256
+        pred_mu, pred_sigma = self.factor_predictor(latent, mask, train=train)
+
+        if cfg.recon_loss == "mse":
+            recon = masked_mse(sample, returns, loss_mask)          # module.py:261
+        elif cfg.recon_loss == "nll":
+            recon = masked_gaussian_nll(recon_mu, recon_sigma, returns, loss_mask)
+        else:
+            raise ValueError(f"unknown recon_loss {cfg.recon_loss!r}")
+        kl = gaussian_kl_sum(factor_mu, factor_sigma, pred_mu, pred_sigma)
+        #                                                           module.py:264-268
+        return FactorVAEOutput(
+            loss=recon + kl,
+            recon_loss=recon,
+            kl=kl,
+            reconstruction=jnp.where(mask, sample, 0.0),
+            factor_mu=factor_mu,
+            factor_sigma=factor_sigma,
+            pred_mu=pred_mu,
+            pred_sigma=pred_sigma,
+        )
+
+    def prediction(
+        self,
+        x: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        *,
+        stochastic: Optional[bool] = None,
+    ) -> jnp.ndarray:
+        """Inference path (module.py:273-278): extractor -> prior ->
+        decoder(prior), i.e. predicts without future returns.
+
+        stochastic=True reproduces the reference's sample-at-inference
+        behavior (module.py:123; needs the 'sample' rng); False returns the
+        distribution mean. Default comes from the config.
+        """
+        cfg = self.cfg
+        if mask is None:
+            mask = jnp.ones(x.shape[0], dtype=bool)
+        if stochastic is None:
+            stochastic = cfg.stochastic_inference
+        latent = self.feature_extractor(x)
+        pred_mu, pred_sigma = self.factor_predictor(latent, mask, train=False)
+        y_pred, _ = self.factor_decoder(
+            latent, pred_mu, pred_sigma, sample=stochastic
+        )
+        return jnp.where(mask, y_pred, jnp.nan)
+
+
+def day_batched(module_cls=FactorVAE, methods=("__call__", "prediction")):
+    """Lift a per-day module over a leading day axis.
+
+    Parameters are shared across days; the 'sample' and 'dropout' rngs are
+    split per day so each day draws independent noise — the vmapped
+    equivalent of the reference looping days in its hot loop
+    (train_model.py:17-32).
+    """
+    return nn.vmap(
+        module_cls,
+        in_axes=0,
+        out_axes=0,
+        variable_axes={"params": None},
+        split_rngs={"params": False, "sample": True, "dropout": True},
+        methods=list(methods),
+    )

@@ -1,0 +1,118 @@
+"""Shared building blocks: initializers, Dense, LayerNorm, GRU.
+
+The GRU is the TPU-idiomatic replacement for the reference's cuDNN
+``nn.GRU`` (module.py:20): the input-side projection for *all* T steps is
+hoisted out of the recurrence into one large matmul (MXU-friendly), and the
+recurrence itself is a `lax.scan` whose per-step work is a single
+(N,H)x(H,3H) matmul — T is only 20-60, so the scan is cheap and XLA
+unrolls/fuses it well.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def torch_uniform_init(fan_in: int) -> Callable:
+    """U(-1/sqrt(fan_in), +1/sqrt(fan_in)).
+
+    The scale torch uses for both nn.Linear (kaiming_uniform(a=sqrt(5)) on
+    the weight plus U(+-1/sqrt(fan_in)) on the bias) and nn.GRU parameters,
+    so training dynamics start from the same parameter scale as the
+    reference without copying any code.
+    """
+    bound = 1.0 / (fan_in**0.5)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init
+
+
+class Dense(nn.Module):
+    """nn.Dense with torch-scale init (see `torch_uniform_init`)."""
+
+    features: int
+    use_bias: bool = True
+    torch_init: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        fan_in = x.shape[-1]
+        if self.torch_init:
+            kinit = torch_uniform_init(fan_in)
+            binit = torch_uniform_init(fan_in)
+        else:
+            kinit = nn.initializers.lecun_normal()
+            binit = nn.initializers.zeros_init()
+        return nn.Dense(
+            self.features,
+            use_bias=self.use_bias,
+            kernel_init=kinit,
+            bias_init=binit,
+            dtype=self.dtype,
+        )(x)
+
+
+def layer_norm(x, dtype=None):
+    """LayerNorm with torch defaults (eps=1e-5, elementwise affine)."""
+    return nn.LayerNorm(epsilon=1e-5, dtype=dtype)(x)
+
+
+class GRU(nn.Module):
+    """Single-layer GRU over the time axis, returning the last hidden state.
+
+    Gate equations and weight layout follow the standard (torch) GRU:
+
+        r = sigmoid(x W_ir + b_ir + h W_hr + b_hr)
+        z = sigmoid(x W_iz + b_iz + h W_hz + b_hz)
+        n = tanh  (x W_in + b_in + r * (h W_hn + b_hn))
+        h' = (1 - z) * n + z * h
+
+    Input: (N, T, C). Output: (N, H) — the hidden state after the last
+    step, i.e. the reference's ``stock_latent[:, -1, :]`` (module.py:30-31).
+    """
+
+    hidden_size: int
+    torch_init: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        n, t, c = x.shape
+        h_dim = self.hidden_size
+        init = (
+            torch_uniform_init(h_dim)
+            if self.torch_init
+            else nn.initializers.lecun_normal()
+        )
+        # Input projection for all T steps in one matmul (N*T, C)x(C, 3H).
+        xi = Dense(
+            3 * h_dim, torch_init=self.torch_init, dtype=self.dtype, name="input_proj"
+        )(x)
+        w_h = self.param("hidden_kernel", init, (h_dim, 3 * h_dim))
+        b_h = self.param(
+            "hidden_bias",
+            init if self.torch_init else nn.initializers.zeros_init(),
+            (3 * h_dim,),
+        )
+        dtype = self.dtype or x.dtype
+        w_h = w_h.astype(dtype)
+        b_h = b_h.astype(dtype)
+
+        def step(h, xi_t):
+            gh = h @ w_h + b_h
+            r = jax.nn.sigmoid(xi_t[:, :h_dim] + gh[:, :h_dim])
+            z = jax.nn.sigmoid(xi_t[:, h_dim : 2 * h_dim] + gh[:, h_dim : 2 * h_dim])
+            nn_ = jnp.tanh(xi_t[:, 2 * h_dim :] + r * gh[:, 2 * h_dim :])
+            h_new = (1.0 - z) * nn_ + z * h
+            return h_new, None
+
+        h0 = jnp.zeros((n, h_dim), dtype=dtype)
+        h_last, _ = jax.lax.scan(step, h0, jnp.swapaxes(xi, 0, 1))
+        return h_last

@@ -1,0 +1,67 @@
+"""Prior factor predictor: K-head attention over the stock cross-section.
+
+Capability parity with reference module.py:125-188 (`AttentionLayer` x K +
+`FactorPredictor`), re-designed for the MXU: the reference iterates K
+independent single-head attention modules in a Python loop
+(module.py:172-178) — K up to 96 sequential kernel launches, its single
+worst accelerator-utilization sin (SURVEY.md §3.5). Here all K heads run
+as three batched einsums over a (K, H, H) weight stack; the math per head
+is identical because the reference heads share nothing but their input.
+
+Faithfully preserved quirks:
+- scores = q . K^T / sqrt(H + 1e-6)  (module.py:140-142)
+- the odd op order dropout(0.1) -> ReLU -> softmax-over-stocks
+  (module.py:144-146)
+- NaN/Inf guard: a head whose attention weights go non-finite contributes
+  a zero context vector (module.py:149-150)
+- a single learned query vector per head, init ~ N(0,1) (module.py:129)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from factorvae_tpu.config import ModelConfig
+from factorvae_tpu.models.layers import Dense, torch_uniform_init
+from factorvae_tpu.ops.masked import masked_softmax
+
+
+class FactorPredictor(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, latent: jnp.ndarray, mask: jnp.ndarray, *, train: bool = False):
+        """latent: (N, H), mask: (N,) -> prior (mu_prior, sigma_prior), each (K,)."""
+        cfg = self.cfg
+        k, h = cfg.num_factors, cfg.hidden_size
+
+        query = self.param("query", nn.initializers.normal(1.0), (k, h))
+        init = torch_uniform_init(h) if cfg.torch_init else nn.initializers.lecun_normal()
+        w_key = self.param("key_kernel", init, (k, h, h))
+        b_key = self.param("key_bias", init, (k, h))
+        w_val = self.param("value_kernel", init, (k, h, h))
+        b_val = self.param("value_bias", init, (k, h))
+
+        # All K per-head Linears at once: (N,H) x (K,H,H) -> (K,N,H).
+        keys = jnp.einsum("nh,khj->knj", latent, w_key) + b_key[:, None, :]
+        values = jnp.einsum("nh,khj->knj", latent, w_val) + b_val[:, None, :]
+
+        scores = jnp.einsum("kh,knh->kn", query, keys)
+        scores = scores / jnp.sqrt(jnp.float32(h) + 1e-6)       # module.py:142
+        scores = nn.Dropout(cfg.dropout_rate)(scores, deterministic=not train)
+        scores = nn.relu(scores)                                # module.py:145
+        attn = masked_softmax(scores, mask[None, :], axis=-1)   # module.py:146
+
+        # Per-head NaN/Inf guard -> zero context (module.py:149-150).
+        bad = jnp.any(~jnp.isfinite(attn), axis=-1, keepdims=True)
+        attn = jnp.where(bad, 0.0, attn)
+        context = jnp.einsum("kn,knh->kh", attn, values)        # (K, H)
+
+        h_multi = Dense(h, torch_init=cfg.torch_init, name="proj")(context)
+        h_multi = nn.leaky_relu(h_multi, negative_slope=cfg.leaky_relu_slope)
+        mu = Dense(1, torch_init=cfg.torch_init, name="mu")(h_multi)[:, 0]
+        sigma = nn.softplus(Dense(1, torch_init=cfg.torch_init, name="sigma")(h_multi))[
+            :, 0
+        ]                                                       # module.py:181-187
+        return mu, sigma

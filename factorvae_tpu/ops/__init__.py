@@ -1,0 +1,20 @@
+from factorvae_tpu.ops.kl import gaussian_kl, gaussian_kl_sum
+from factorvae_tpu.ops.masked import (
+    masked_mean,
+    masked_mse,
+    masked_softmax,
+    masked_gaussian_nll,
+)
+from factorvae_tpu.ops.stats import masked_rank, masked_spearman, rank_ic_series
+
+__all__ = [
+    "gaussian_kl",
+    "gaussian_kl_sum",
+    "masked_mean",
+    "masked_mse",
+    "masked_softmax",
+    "masked_gaussian_nll",
+    "masked_rank",
+    "masked_spearman",
+    "rank_ic_series",
+]

@@ -1,0 +1,42 @@
+"""Closed-form Gaussian KL divergence.
+
+Matches the reference's static ``FactorVAE.KL_Divergence`` exactly
+(module.py:242-248):
+
+    KL = sum_K [ log(sigma2/sigma1) + (sigma1^2 + (mu1-mu2)^2) / (2 sigma2^2) - 1/2 ]
+
+i.e. KL(N(mu1,sigma1) || N(mu2,sigma2)) summed over the factor axis. Note
+the reference *sums* over K while the reconstruction loss is a *mean* over
+stocks — the scale imbalance is faithful-to-reference (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gaussian_kl(
+    mu1: jnp.ndarray, sigma1: jnp.ndarray, mu2: jnp.ndarray, sigma2: jnp.ndarray
+) -> jnp.ndarray:
+    """Elementwise KL(N(mu1, sigma1) || N(mu2, sigma2))."""
+    return (
+        jnp.log(sigma2 / sigma1)
+        + (sigma1**2 + (mu1 - mu2) ** 2) / (2.0 * sigma2**2)
+        - 0.5
+    )
+
+
+def gaussian_kl_sum(
+    mu1: jnp.ndarray,
+    sigma1: jnp.ndarray,
+    mu2: jnp.ndarray,
+    sigma2: jnp.ndarray,
+    guard: float = 1e-6,
+) -> jnp.ndarray:
+    """KL summed over all elements, with the reference's zero-sigma guard on
+    the *second* (prior) distribution (module.py:264-265). The in-place
+    masked write of the reference becomes a `where` (gradient-equivalent for
+    sigma2 != 0; documented deviation for the measure-zero sigma2 == 0 case).
+    """
+    sigma2 = jnp.where(sigma2 == 0.0, guard, sigma2)
+    return jnp.sum(gaussian_kl(mu1, sigma1, mu2, sigma2))

@@ -1,0 +1,68 @@
+"""Masked reductions over padded cross-sections.
+
+The central TPU design decision (SURVEY.md §7.1): the reference feeds
+variable-size per-day batches (N ~= 300 stocks, varying day to day;
+reference dataset.py:207-238). XLA wants static shapes, so every day is
+padded to ``N_max`` with a boolean validity mask, and every cross-stock
+reduction in the model — the two softmaxes over the stock axis
+(reference module.py:38,57,146), the portfolio matmul (module.py:64) and
+the loss means (module.py:261) — becomes a masked reduction defined here.
+
+On a day with no padding and an all-true mask each op is exactly its
+unmasked counterpart, which is what the parity tests assert.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def masked_softmax(x: jnp.ndarray, mask: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Softmax over `axis` restricted to positions where `mask` is True.
+
+    Padded positions get probability exactly 0 and never receive gradient
+    mass. If a slice is fully masked the output is all zeros (not NaN).
+    """
+    mask = jnp.broadcast_to(mask, x.shape)
+    x = jnp.where(mask, x, _NEG_INF)
+    x = x - jnp.max(x, axis=axis, keepdims=True)  # stable; fully-masked -> 0
+    ex = jnp.where(mask, jnp.exp(x), 0.0)
+    denom = jnp.sum(ex, axis=axis, keepdims=True)
+    return jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    """Mean of `x` over valid positions; 0 if nothing is valid."""
+    mask = jnp.broadcast_to(mask, x.shape)
+    total = jnp.sum(jnp.where(mask, x, 0.0), axis=axis)
+    count = jnp.sum(mask, axis=axis)
+    return jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+
+
+def masked_mse(pred: jnp.ndarray, target: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked mean-squared error.
+
+    With an all-true mask this equals ``F.mse_loss`` as used by the
+    reference on its one reparameterized sample (module.py:261).
+    """
+    return masked_mean((pred - target) ** 2, mask)
+
+
+def masked_gaussian_nll(
+    mu: jnp.ndarray,
+    sigma: jnp.ndarray,
+    target: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps: float = 1e-12,
+) -> jnp.ndarray:
+    """Masked mean Gaussian negative log-likelihood.
+
+    The paper's reconstruction term (the reference approximates it with a
+    single-sample MSE; BASELINE.json's north star asks for the analytic
+    NLL — both are provided, selected by ``ModelConfig.recon_loss``).
+    """
+    var = sigma**2 + eps
+    nll = 0.5 * (jnp.log(2.0 * jnp.pi * var) + (target - mu) ** 2 / var)
+    return masked_mean(nll, mask)

@@ -1,0 +1,78 @@
+"""On-device ranking statistics (Rank-IC).
+
+The reference computes Rank-IC on host with scipy: per-day Spearman rank
+correlation of prediction vs label, then mean and IR = mean/std
+(utils.py:113-129, backtest.ipynb cell 9). Here the same statistic runs
+on device over the padded ``(D, N_max)`` score/label arrays.
+
+Ties are resolved by *average ranks*, matching ``scipy.stats.spearmanr``;
+this uses an O(N^2) pairwise comparison which is a trivially small
+vectorized op at N_max <= 1024 and maps well onto the VPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from factorvae_tpu.ops.masked import masked_mean
+
+
+def masked_rank(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Average ranks (1-based, scipy convention) of `x` over valid entries.
+
+    Invalid entries get rank 0 and must be excluded downstream.
+    x, mask: (..., N)
+    """
+    m = mask.astype(x.dtype)
+    xi = x[..., :, None]
+    xj = x[..., None, :]
+    mj = m[..., None, :]
+    less = jnp.sum((xj < xi) * mj, axis=-1)
+    equal = jnp.sum((xj == xi) * mj, axis=-1)
+    rank = less + 0.5 * (equal + 1.0)
+    return rank * m
+
+
+def masked_pearson(
+    x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, eps: float = 1e-12
+) -> jnp.ndarray:
+    """Pearson correlation over valid entries of the trailing axis."""
+    mx = masked_mean(x, mask, axis=-1)[..., None]
+    my = masked_mean(y, mask, axis=-1)[..., None]
+    dx = jnp.where(mask, x - mx, 0.0)
+    dy = jnp.where(mask, y - my, 0.0)
+    cov = jnp.sum(dx * dy, axis=-1)
+    vx = jnp.sum(dx * dx, axis=-1)
+    vy = jnp.sum(dy * dy, axis=-1)
+    return cov / jnp.sqrt(vx * vy + eps)
+
+
+def masked_spearman(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Spearman rank correlation = Pearson on average ranks (scipy semantics,
+    reference utils.py:120)."""
+    return masked_pearson(masked_rank(x, mask), masked_rank(y, mask), mask)
+
+
+def rank_ic_series(
+    scores: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-day Rank-IC over a (D, N_max) panel; returns (D,).
+
+    Entries with non-finite labels (e.g. the trailing days of an inference
+    panel, where the forward-looking label does not exist) are excluded via
+    the mask before calling this.
+    """
+    return masked_spearman(scores, labels, mask)
+
+
+def rank_ic_summary(ic: jnp.ndarray, day_mask: jnp.ndarray):
+    """Mean Rank-IC and information ratio over valid days.
+
+    Matches reference utils.py:126-129: IR = mean/std with the *population*
+    std (numpy default ddof=0).
+    """
+    mean = masked_mean(ic, day_mask)
+    var = masked_mean((ic - mean) ** 2, day_mask)
+    std = jnp.sqrt(var)
+    ir = jnp.where(std > 0, mean / jnp.where(std > 0, std, 1.0), jnp.nan)
+    return mean, ir

@@ -1,0 +1,3 @@
+from factorvae_tpu.utils.testing import force_host_devices, host_device_count
+
+__all__ = ["force_host_devices", "host_device_count"]

@@ -1,0 +1,45 @@
+"""Host-CPU multi-device test rig.
+
+`force_host_devices(n)` makes `jax.devices()` return `n` virtual CPU
+devices — the TPU-world answer to "test multi-node without a cluster"
+(SURVEY.md §4): sharding/collective code paths run unchanged against a
+CPU mesh, exactly how the driver dry-runs the multi-chip path.
+
+Must be called BEFORE any JAX backend is initialized. It also neutralizes
+sandbox TPU-plugin shims (which pin ``jax_platforms`` at the config level,
+so setting the JAX_PLATFORMS env var alone is not enough) by removing
+their backend factory before first use.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_host_devices(n: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        from jax._src import xla_bridge as xb
+
+        for plugin in ("axon", "neuron"):
+            xb._backend_factories.pop(plugin, None)
+    except Exception:
+        pass
+
+
+def host_device_count() -> int:
+    import jax
+
+    return len(jax.devices())

@@ -1,0 +1,33 @@
+"""Test rig: force a virtual 8-device CPU platform BEFORE jax initializes.
+
+This is the TPU-world answer to "test multi-node without a cluster"
+(SURVEY.md §4): all sharding/collective tests run against a host CPU mesh
+with 8 virtual devices, exactly how the driver dry-runs the multi-chip
+path. `force_host_devices` also handles sandboxes whose TPU plugin pins
+``jax_platforms`` at the config level.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from factorvae_tpu.utils.testing import force_host_devices  # noqa: E402
+
+force_host_devices(8)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu"
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,239 @@
+"""Model unit tests: shapes, masking invariance, GRU semantics vs torch,
+and reference-quirk parity (torch CPU is available as an oracle; no
+reference code is imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factorvae_tpu.config import ModelConfig
+from factorvae_tpu.models import FactorVAE, FeatureExtractor, day_batched
+from factorvae_tpu.models.layers import GRU
+
+CFG = ModelConfig(
+    num_features=12, hidden_size=8, num_factors=5, num_portfolios=7, seq_len=6
+)
+
+
+def make_batch(rng, n=10, t=6, c=12, valid=None):
+    x = jnp.asarray(rng.normal(size=(n, t, c)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+    mask = jnp.ones(n, bool) if valid is None else jnp.asarray(valid)
+    return x, y, mask
+
+
+def init_model(rng_key=0, cfg=CFG, n=10):
+    model = FactorVAE(cfg)
+    k = jax.random.PRNGKey(rng_key)
+    x = jnp.zeros((n, cfg.seq_len, cfg.num_features))
+    y = jnp.zeros((n,))
+    params = model.init(
+        {"params": k, "sample": k, "dropout": k}, x, y, jnp.ones(n, bool)
+    )
+    return model, params
+
+
+class TestShapes:
+    def test_forward_shapes(self, rng):
+        model, params = init_model()
+        x, y, mask = make_batch(rng)
+        out = model.apply(
+            params, x, y, mask,
+            rngs={"sample": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)},
+            train=True,
+        )
+        assert out.reconstruction.shape == (10,)
+        for f in (out.factor_mu, out.factor_sigma, out.pred_mu, out.pred_sigma):
+            assert f.shape == (CFG.num_factors,)
+        assert out.loss.shape == ()
+        assert np.isfinite(float(out.loss))
+        assert np.all(np.asarray(out.factor_sigma) > 0)
+        assert np.all(np.asarray(out.pred_sigma) > 0)
+
+    def test_prediction_shapes(self, rng):
+        model, params = init_model()
+        x, _, mask = make_batch(rng)
+        y_pred = model.apply(
+            params, x, mask, rngs={"sample": jax.random.PRNGKey(3)},
+            method=FactorVAE.prediction,
+        )
+        assert y_pred.shape == (10,)
+
+    def test_deterministic_prediction_reproducible(self, rng):
+        model, params = init_model()
+        x, _, mask = make_batch(rng)
+        p1 = model.apply(params, x, mask, stochastic=False,
+                         method=FactorVAE.prediction)
+        p2 = model.apply(params, x, mask, stochastic=False,
+                         method=FactorVAE.prediction)
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+class TestMaskingInvariance:
+    def test_padding_does_not_change_outputs(self, rng):
+        """THE core static-shape property: a day padded from N=8 to N=12
+        must produce identical posteriors/priors and identical valid-stock
+        predictions as the unpadded day."""
+        cfg = CFG
+        model, params = init_model(cfg=cfg, n=8)
+        x, y, _ = make_batch(rng, n=8)
+        pad_x = jnp.concatenate([x, jnp.full((4, 6, 12), 777.0)], axis=0)
+        pad_y = jnp.concatenate([y, jnp.full((4,), -55.0)])
+        pad_mask = jnp.asarray([True] * 8 + [False] * 4)
+
+        rngs = {"sample": jax.random.PRNGKey(7), "dropout": jax.random.PRNGKey(8)}
+        out_small = model.apply(params, x, y, jnp.ones(8, bool), rngs=rngs)
+        out_pad = model.apply(params, pad_x, pad_y, pad_mask, rngs=rngs)
+
+        np.testing.assert_allclose(
+            out_small.factor_mu, out_pad.factor_mu, rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            out_small.pred_mu, out_pad.pred_mu, rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(out_small.kl), float(out_pad.kl), rtol=1e-5
+        )
+        # deterministic prediction path: valid entries equal, padded are NaN
+        p_small = model.apply(params, x, jnp.ones(8, bool), stochastic=False,
+                              method=FactorVAE.prediction)
+        p_pad = model.apply(params, pad_x, pad_mask, stochastic=False,
+                            method=FactorVAE.prediction)
+        np.testing.assert_allclose(p_small, p_pad[:8], rtol=1e-5, atol=1e-6)
+        assert np.all(np.isnan(np.asarray(p_pad[8:])))
+
+    def test_loss_gradients_finite_with_padding(self, rng):
+        model, params = init_model()
+        x, y, _ = make_batch(rng)
+        mask = jnp.asarray([True] * 6 + [False] * 4)
+
+        def loss_fn(p):
+            out = model.apply(
+                p, x, y, mask,
+                rngs={"sample": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+                train=True,
+            )
+            return out.loss
+
+        from jax.flatten_util import ravel_pytree
+
+        grads = jax.grad(loss_fn)(params)
+        flat, _ = ravel_pytree(grads)
+        assert np.all(np.isfinite(np.asarray(flat)))
+
+
+class TestGRUSemantics:
+    def test_matches_torch_gru(self, rng):
+        """Golden test: our scan GRU must be numerically the same function
+        as torch's nn.GRU given identical weights (torch runs on CPU purely
+        as an independent oracle)."""
+        torch = pytest.importorskip("torch")
+        n, t, c, h = 4, 5, 3, 6
+        x = rng.normal(size=(n, t, c)).astype(np.float32)
+
+        gru = GRU(hidden_size=h)
+        params = gru.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+        tg = torch.nn.GRU(c, h, 1, batch_first=True)
+        p = params["params"]
+        w_ih = np.asarray(p["input_proj"]["Dense_0"]["kernel"]).T  # (3H, C)
+        b_ih = np.asarray(p["input_proj"]["Dense_0"]["bias"])
+        w_hh = np.asarray(p["hidden_kernel"]).T                    # (3H, H)
+        b_hh = np.asarray(p["hidden_bias"])
+        with torch.no_grad():
+            tg.weight_ih_l0.copy_(torch.from_numpy(w_ih))
+            tg.bias_ih_l0.copy_(torch.from_numpy(b_ih))
+            tg.weight_hh_l0.copy_(torch.from_numpy(w_hh))
+            tg.bias_hh_l0.copy_(torch.from_numpy(b_hh))
+            want, _ = tg(torch.from_numpy(x))
+        got = gru.apply(params, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(got), want[:, -1, :].numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestExtractor:
+    def test_output_shape_and_dtype(self, rng):
+        fe = FeatureExtractor(CFG)
+        x = jnp.asarray(rng.normal(size=(9, CFG.seq_len, CFG.num_features)), jnp.float32)
+        params = fe.init(jax.random.PRNGKey(0), x)
+        out = fe.apply(params, x)
+        assert out.shape == (9, CFG.hidden_size)
+        assert out.dtype == jnp.float32
+
+    def test_bfloat16_compute(self, rng):
+        cfg = ModelConfig(
+            num_features=12, hidden_size=8, num_factors=5, num_portfolios=7,
+            seq_len=6, compute_dtype="bfloat16",
+        )
+        fe = FeatureExtractor(cfg)
+        x = jnp.asarray(rng.normal(size=(4, 6, 12)), jnp.float32)
+        params = fe.init(jax.random.PRNGKey(0), x)
+        out = fe.apply(params, x)
+        assert out.dtype == jnp.float32  # cast back at the boundary
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestLossSemantics:
+    def test_mse_loss_decomposition(self, rng):
+        """loss == masked MSE(sample, y) + KL summed over K, recomputed
+        from the returned pieces (reference module.py:261-268)."""
+        model, params = init_model()
+        x, y, mask = make_batch(rng)
+        out = model.apply(
+            params, x, y, mask,
+            rngs={"sample": jax.random.PRNGKey(5), "dropout": jax.random.PRNGKey(6)},
+        )
+        recon = np.mean((np.asarray(out.reconstruction) - np.asarray(y)) ** 2)
+        np.testing.assert_allclose(float(out.recon_loss), recon, rtol=1e-5)
+        s1, s2 = np.asarray(out.factor_sigma), np.asarray(out.pred_sigma)
+        m1, m2 = np.asarray(out.factor_mu), np.asarray(out.pred_mu)
+        kl = np.sum(np.log(s2 / s1) + (s1**2 + (m1 - m2) ** 2) / (2 * s2**2) - 0.5)
+        np.testing.assert_allclose(float(out.kl), kl, rtol=1e-5)
+        np.testing.assert_allclose(float(out.loss), recon + kl, rtol=1e-5)
+
+    def test_nll_mode(self, rng):
+        cfg = ModelConfig(
+            num_features=12, hidden_size=8, num_factors=5, num_portfolios=7,
+            seq_len=6, recon_loss="nll",
+        )
+        model, params = init_model(cfg=cfg)
+        x, y, mask = make_batch(rng)
+        out = model.apply(
+            params, x, y, mask,
+            rngs={"sample": jax.random.PRNGKey(5), "dropout": jax.random.PRNGKey(6)},
+        )
+        assert np.isfinite(float(out.loss))
+
+    def test_nan_labels_excluded(self, rng):
+        model, params = init_model()
+        x, y, mask = make_batch(rng)
+        y = y.at[0].set(jnp.nan)
+        out = model.apply(
+            params, x, y, mask,
+            rngs={"sample": jax.random.PRNGKey(5), "dropout": jax.random.PRNGKey(6)},
+        )
+        assert np.isfinite(float(out.loss))
+
+
+class TestDayBatched:
+    def test_vmapped_days(self, rng):
+        DayModel = day_batched()
+        model = DayModel(CFG)
+        d, n = 3, 10
+        x = jnp.asarray(rng.normal(size=(d, n, CFG.seq_len, CFG.num_features)),
+                        jnp.float32)
+        y = jnp.asarray(rng.normal(size=(d, n)), jnp.float32)
+        mask = jnp.ones((d, n), bool)
+        k = jax.random.PRNGKey(0)
+        params = model.init({"params": k, "sample": k, "dropout": k}, x, y, mask)
+        out = model.apply(
+            params, x, y, mask,
+            rngs={"sample": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)},
+            train=True,
+        )
+        assert out.loss.shape == (d,)
+        assert out.factor_mu.shape == (d, CFG.num_factors)
+        # per-day sample rngs differ -> reconstructions differ across days
+        assert not np.allclose(out.reconstruction[0], out.reconstruction[1])

@@ -1,0 +1,137 @@
+"""Unit tests for masked ops, KL, and ranking stats.
+
+Oracles: torch/scipy-free numpy recomputation, plus scipy.stats.spearmanr
+for Rank-IC (the reference's own oracle, utils.py:120).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import spearmanr
+
+from factorvae_tpu.ops import (
+    gaussian_kl_sum,
+    masked_mean,
+    masked_mse,
+    masked_softmax,
+    masked_rank,
+    masked_spearman,
+    rank_ic_series,
+)
+from factorvae_tpu.ops.stats import rank_ic_summary
+
+
+class TestMaskedSoftmax:
+    def test_matches_unmasked_when_all_valid(self, rng):
+        x = jnp.asarray(rng.normal(size=(7, 5)), jnp.float32)
+        mask = jnp.ones((7, 1), bool)
+        got = masked_softmax(x, mask, axis=0)
+        want = jax.nn.softmax(x, axis=0)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_padded_positions_zero_and_renormalized(self, rng):
+        x = jnp.asarray(rng.normal(size=(6,)), jnp.float32)
+        mask = jnp.asarray([True, True, False, True, False, True])
+        got = masked_softmax(x, mask, axis=0)
+        assert float(got[2]) == 0.0 and float(got[4]) == 0.0
+        np.testing.assert_allclose(float(got.sum()), 1.0, rtol=1e-6)
+        # equals softmax over the compacted valid subset
+        sub = jax.nn.softmax(x[np.array([0, 1, 3, 5])])
+        np.testing.assert_allclose(got[np.array([0, 1, 3, 5])], sub, rtol=1e-6)
+
+    def test_fully_masked_gives_zeros_not_nan(self):
+        x = jnp.ones((4,))
+        got = masked_softmax(x, jnp.zeros((4,), bool), axis=0)
+        np.testing.assert_array_equal(np.asarray(got), np.zeros(4))
+
+    def test_gradient_through_padding_is_zero(self):
+        def f(x):
+            return masked_softmax(x, jnp.asarray([True, True, False]), axis=0).sum()
+
+        g = jax.grad(f)(jnp.asarray([0.3, -0.2, 100.0]))
+        assert float(g[2]) == 0.0 and np.all(np.isfinite(np.asarray(g)))
+
+
+class TestMaskedMoments:
+    def test_masked_mean(self, rng):
+        x = rng.normal(size=(10,)).astype(np.float32)
+        m = rng.random(10) > 0.4
+        got = masked_mean(jnp.asarray(x), jnp.asarray(m))
+        np.testing.assert_allclose(float(got), x[m].mean(), rtol=1e-6)
+
+    def test_masked_mse_matches_mse_when_valid(self, rng):
+        a = rng.normal(size=(8,)).astype(np.float32)
+        b = rng.normal(size=(8,)).astype(np.float32)
+        got = masked_mse(jnp.asarray(a), jnp.asarray(b), jnp.ones(8, bool))
+        np.testing.assert_allclose(float(got), ((a - b) ** 2).mean(), rtol=1e-6)
+
+
+class TestKL:
+    def test_closed_form(self, rng):
+        mu1 = rng.normal(size=(5,)).astype(np.float32)
+        mu2 = rng.normal(size=(5,)).astype(np.float32)
+        s1 = rng.random(5).astype(np.float32) + 0.1
+        s2 = rng.random(5).astype(np.float32) + 0.1
+        got = gaussian_kl_sum(*map(jnp.asarray, (mu1, s1, mu2, s2)))
+        want = np.sum(
+            np.log(s2 / s1) + (s1**2 + (mu1 - mu2) ** 2) / (2 * s2**2) - 0.5
+        )
+        np.testing.assert_allclose(float(got), want, rtol=1e-5)
+
+    def test_zero_kl_for_identical(self):
+        mu = jnp.asarray([0.1, 0.2])
+        s = jnp.asarray([0.5, 1.5])
+        assert abs(float(gaussian_kl_sum(mu, s, mu, s))) < 1e-6
+
+    def test_sigma2_zero_guard(self):
+        got = gaussian_kl_sum(
+            jnp.asarray([0.0]), jnp.asarray([1.0]), jnp.asarray([0.0]), jnp.asarray([0.0])
+        )
+        assert np.isfinite(float(got))
+
+
+class TestRanking:
+    def test_rank_matches_scipy_average_ranks(self, rng):
+        x = rng.normal(size=(20,)).astype(np.float32)
+        x[3] = x[11]  # force a tie
+        from scipy.stats import rankdata
+
+        got = masked_rank(jnp.asarray(x), jnp.ones(20, bool))
+        np.testing.assert_allclose(np.asarray(got), rankdata(x), rtol=1e-6)
+
+    def test_spearman_matches_scipy(self, rng):
+        x = rng.normal(size=(50,)).astype(np.float32)
+        y = (0.4 * x + rng.normal(size=(50,))).astype(np.float32)
+        got = float(masked_spearman(jnp.asarray(x), jnp.asarray(y), jnp.ones(50, bool)))
+        want, _ = spearmanr(x, y)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_spearman_respects_mask(self, rng):
+        x = rng.normal(size=(30,)).astype(np.float32)
+        y = rng.normal(size=(30,)).astype(np.float32)
+        m = rng.random(30) > 0.3
+        got = float(masked_spearman(jnp.asarray(x), jnp.asarray(y), jnp.asarray(m)))
+        want, _ = spearmanr(x[m], y[m])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_rank_ic_series_and_summary(self, rng):
+        d, n = 6, 40
+        scores = rng.normal(size=(d, n)).astype(np.float32)
+        labels = (0.3 * scores + rng.normal(size=(d, n))).astype(np.float32)
+        mask = np.ones((d, n), bool)
+        ic = np.asarray(rank_ic_series(*map(jnp.asarray, (scores, labels, mask))))
+        want = [spearmanr(scores[i], labels[i])[0] for i in range(d)]
+        np.testing.assert_allclose(ic, want, rtol=1e-4)
+        mean, ir = rank_ic_summary(jnp.asarray(ic), jnp.ones(d, bool))
+        np.testing.assert_allclose(float(mean), np.mean(want), rtol=1e-5)
+        np.testing.assert_allclose(float(ir), np.mean(want) / np.std(want), rtol=1e-4)
+
+
+@pytest.mark.parametrize("axis", [0, -1])
+def test_masked_softmax_axis_variants(rng, axis):
+    x = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
+    mask = jnp.ones_like(x, bool)
+    np.testing.assert_allclose(
+        masked_softmax(x, mask, axis=axis), jax.nn.softmax(x, axis=axis), rtol=1e-6
+    )
