@@ -1,0 +1,92 @@
+"""Benchmark: training throughput (windows/sec) on the flagship config.
+
+Flagship = the reference CLI's default architecture (main.py:92-113):
+Alpha158 (C=158), T=20, H=64, K=96, M=128, CSI300-scale cross-section
+(N_max=360), training on synthetic data of that exact shape. A "window"
+is one (stock, day) sample — one (T, C) look-back matrix — matching the
+north-star metric "training windows/sec/chip" (BASELINE.json).
+
+The reference publishes NO throughput numbers ("not measured anywhere",
+BASELINE.md), so `vs_baseline` is computed against a documented estimate
+of the reference's single-A100 rate: ~100 day-steps/sec (~10 ms/step:
+Python-level K=96 sequential attention modules -> hundreds of small
+kernel launches, per-step host sync at train_model.py:28) x ~300
+stocks/day = 3.0e4 windows/sec. Replace with a measured number if one
+ever lands in BASELINE.md.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REF_A100_WINDOWS_PER_SEC = 3.0e4  # documented estimate; see module docstring
+
+# CSI300-flagship shapes
+NUM_FEATURES = 158
+SEQ_LEN = 20
+HIDDEN = 64
+FACTORS = 96
+PORTFOLIOS = 128
+N_STOCKS = 356            # instruments in the reference score CSVs
+NUM_DAYS = 256
+DAYS_PER_STEP = 8         # day-level batching for MXU utilization
+EPOCHS_TIMED = 3
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg = Config(
+        model=ModelConfig(
+            num_features=NUM_FEATURES, hidden_size=HIDDEN, num_factors=FACTORS,
+            num_portfolios=PORTFOLIOS, seq_len=SEQ_LEN,
+        ),
+        data=DataConfig(seq_len=SEQ_LEN, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(
+            num_epochs=EPOCHS_TIMED, days_per_step=DAYS_PER_STEP, seed=0,
+            checkpoint_every=0, save_dir="/tmp/factorvae_bench",
+        ),
+    )
+    panel = synthetic_panel_dense(
+        num_days=NUM_DAYS, num_instruments=N_STOCKS, num_features=NUM_FEATURES
+    )
+    ds = PanelDataset(panel, seq_len=SEQ_LEN, pad_multiple=8)
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+
+    order = trainer._epoch_orders(0)
+
+    # warmup: compile + one full epoch
+    state, m = trainer._train_epoch(state, order)
+    jax.block_until_ready(m["loss"])
+
+    windows_per_epoch = float(m["days"]) * N_STOCKS
+    t0 = time.time()
+    for epoch in range(1, EPOCHS_TIMED + 1):
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(epoch))
+    jax.block_until_ready(m["loss"])
+    dt = time.time() - t0
+
+    value = EPOCHS_TIMED * windows_per_epoch / dt
+    print(json.dumps({
+        "metric": "train_throughput_flagship_K96_H64_Alpha158",
+        "value": round(value, 1),
+        "unit": "windows/sec/chip",
+        "vs_baseline": round(value / REF_A100_WINDOWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

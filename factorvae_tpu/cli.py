@@ -1,0 +1,190 @@
+"""Command-line experiment driver.
+
+Flag-for-flag parity with the reference CLI (main.py:90-114) — every
+reference flag is accepted with the same name and default — plus the
+TPU-framework extensions (mesh/data-parallel knobs, resume, recon-loss
+selection, bf16 compute, score export). ``--num_workers`` is accepted for
+compatibility and ignored: there are no loader workers in this design
+(the reference parses it and never wires it either, main.py:112).
+
+Usage:
+    python -m factorvae_tpu.cli --num_epochs 30 --dataset ./data/csi_data.pkl
+    python -m factorvae_tpu.cli --score_only --resume ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from factorvae_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, TrainConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train a FactorVAE model on stock data (TPU-native)")
+    # --- reference flags (main.py:92-113) ---
+    p.add_argument("--num_epochs", type=int, default=30)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--num_latent", type=int, default=158,
+                   help="number of input features C (reference --num_latent)")
+    p.add_argument("--num_portfolio", type=int, default=128)
+    p.add_argument("--seq_len", type=int, default=20)
+    p.add_argument("--num_factor", type=int, default=96)
+    p.add_argument("--hidden_size", type=int, default=64)
+    p.add_argument("--dataset", type=str, default="./data/csi_data.pkl")
+    p.add_argument("--start_time", type=str, default="2009-01-01")
+    p.add_argument("--fit_end_time", type=str, default="2017-12-31")
+    p.add_argument("--val_start_time", type=str, default="2018-01-01")
+    p.add_argument("--val_end_time", type=str, default="2018-12-31")
+    p.add_argument("--end_time", type=str, default="2020-12-31")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--run_name", type=str, default="VAE-Revision2")
+    p.add_argument("--save_dir", type=str, default="./best_models")
+    p.add_argument("--num_workers", type=int, default=4,
+                   help="accepted for reference parity; unused (no loader workers)")
+    p.add_argument("--wandb", action="store_true")
+    # --- TPU-framework extensions ---
+    p.add_argument("--days_per_step", type=int, default=1,
+                   help="days whose grads are averaged per update (1 = reference-faithful)")
+    p.add_argument("--mesh", action="store_true",
+                   help="shard over all visible devices (data x stock mesh)")
+    p.add_argument("--mesh_stock", type=int, default=1,
+                   help="size of the 'stock' (cross-section) mesh axis")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest full-state checkpoint")
+    p.add_argument("--recon_loss", choices=["mse", "nll"], default="mse",
+                   help="mse = reference-faithful single-sample MSE; nll = Gaussian NLL")
+    p.add_argument("--bf16", action="store_true", help="bfloat16 compute dtype")
+    p.add_argument("--max_stocks", type=int, default=None,
+                   help="cross-section padding N_max (default: inferred)")
+    p.add_argument("--score_only", action="store_true",
+                   help="skip training; score [--score_start, --score_end] from the best checkpoint")
+    p.add_argument("--score_start", type=str, default="2019-01-01")
+    p.add_argument("--score_end", type=str, default="2020-12-31")
+    p.add_argument("--score_dir", type=str, default="./scores")
+    p.add_argument("--stochastic_scores", action="store_true",
+                   help="sample at inference like the reference (module.py:123)")
+    p.add_argument("--metrics_jsonl", type=str, default=None)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    return Config(
+        model=ModelConfig(
+            num_features=args.num_latent,
+            hidden_size=args.hidden_size,
+            num_factors=args.num_factor,
+            num_portfolios=args.num_portfolio,
+            seq_len=args.seq_len,
+            recon_loss=args.recon_loss,
+            compute_dtype="bfloat16" if args.bf16 else "float32",
+            stochastic_inference=bool(args.stochastic_scores),
+        ),
+        data=DataConfig(
+            dataset_path=args.dataset,
+            start_time=args.start_time,
+            fit_end_time=args.fit_end_time,
+            val_start_time=args.val_start_time,
+            val_end_time=args.val_end_time,
+            end_time=args.end_time,
+            seq_len=args.seq_len,
+            max_stocks=args.max_stocks,
+        ),
+        train=TrainConfig(
+            num_epochs=args.num_epochs,
+            lr=args.lr,
+            seed=args.seed,
+            days_per_step=args.days_per_step,
+            run_name=args.run_name,
+            save_dir=args.save_dir,
+            wandb=args.wandb,
+        ),
+        mesh=MeshConfig(stock_axis=args.mesh_stock),
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+
+    from factorvae_tpu.data import PanelDataset, build_panel, load_frame
+    from factorvae_tpu.train import Trainer, load_params
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    logger = MetricsLogger(
+        jsonl_path=args.metrics_jsonl,
+        use_wandb=cfg.train.wandb,
+        run_name=cfg.train.run_name,
+        config=cfg.to_dict(),
+    )
+    logger.log("config", **{"json": cfg.to_json()})
+
+    import os
+
+    if not os.path.exists(cfg.data.dataset_path):
+        print(
+            f"error: dataset not found: {cfg.data.dataset_path} "
+            f"(see data/README.md for the qlib ETL recipe)",
+            file=sys.stderr,
+        )
+        return 2
+
+    frame = load_frame(cfg.data.dataset_path, cfg.data.select_feature)
+    dataset = PanelDataset(
+        build_panel(frame),
+        seq_len=cfg.data.seq_len,
+        max_stocks=cfg.data.max_stocks,
+        pad_multiple=cfg.data.pad_multiple,
+    )
+
+    if args.score_only:
+        # Scoring needs no training split — build a param template
+        # directly (the analogue of reference utils.load_model,
+        # utils.py:57-67) and restore the best-val weights.
+        import jax
+        import jax.numpy as jnp
+
+        from factorvae_tpu.models.factorvae import day_forward
+
+        model = day_forward(cfg.model, train=False)
+        key = jax.random.PRNGKey(cfg.train.seed)
+        x = jnp.zeros((1, dataset.n_max, cfg.data.seq_len, cfg.model.num_features))
+        template = model.init(
+            {"params": key, "sample": key, "dropout": key},
+            x, jnp.zeros((1, dataset.n_max)), jnp.ones((1, dataset.n_max), bool),
+        )
+        path = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
+        if not os.path.isdir(path):
+            print(f"error: no checkpoint at {path}; train first", file=sys.stderr)
+            return 2
+        params = load_params(path, template)
+    else:
+        trainer = Trainer(cfg, dataset, logger=logger, use_mesh=args.mesh)
+        state, _ = trainer.fit(resume=args.resume)
+        # Score with the best-validation weights (what the reference's
+        # backtest loads, backtest.ipynb cell 2), not the final step.
+        best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
+        params = load_params(best, state.params) if os.path.isdir(best) else state.params
+
+    from factorvae_tpu.eval import RankIC, export_scores, generate_prediction_scores
+
+    scores = generate_prediction_scores(
+        params, cfg, dataset,
+        start=args.score_start, end=args.score_end,
+        stochastic=None,  # defer to cfg.model.stochastic_inference
+        with_labels=True,
+    )
+    path = export_scores(scores, cfg, args.score_dir)
+    ic = RankIC(scores.dropna(), "LABEL0", "score")
+    logger.log(
+        "scores",
+        path=path,
+        rank_ic=float(ic["RankIC"].iloc[0]),
+        rank_ic_ir=float(ic["RankIC_IR"].iloc[0]),
+    )
+    logger.finish()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

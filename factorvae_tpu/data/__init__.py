@@ -1,6 +1,10 @@
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.data.panel import Panel, build_panel, load_frame, panel_to_frame
-from factorvae_tpu.data.synthetic import synthetic_frame, synthetic_panel
+from factorvae_tpu.data.synthetic import (
+    synthetic_frame,
+    synthetic_panel,
+    synthetic_panel_dense,
+)
 from factorvae_tpu.data.windows import (
     compute_fill_maps,
     fill_indices_host,
@@ -19,5 +23,6 @@ __all__ = [
     "panel_to_frame",
     "synthetic_frame",
     "synthetic_panel",
+    "synthetic_panel_dense",
     "window_fill_indices",
 ]

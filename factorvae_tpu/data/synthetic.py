@@ -48,3 +48,28 @@ def synthetic_frame(
 
 def synthetic_panel(**kw) -> Panel:
     return build_panel(synthetic_frame(**kw))
+
+
+def synthetic_panel_dense(
+    num_days: int,
+    num_instruments: int,
+    num_features: int,
+    signal: float = 0.3,
+    seed: int = 0,
+) -> Panel:
+    """Fast array-native Panel (no pandas row loop) for benchmarks: full
+    cross-section every day, features ~ N(0,1), label = planted linear
+    signal + noise."""
+    rng = np.random.default_rng(seed)
+    feats = rng.normal(size=(num_instruments, num_days, num_features)).astype(np.float32)
+    w = (rng.normal(size=(num_features,)) / np.sqrt(num_features)).astype(np.float32)
+    label = signal * feats @ w + (1 - signal) * rng.normal(
+        size=(num_instruments, num_days)
+    ).astype(np.float32)
+    values = np.concatenate([feats, label[..., None]], axis=-1)
+    return Panel(
+        values=values,
+        valid=np.ones((num_days, num_instruments), bool),
+        dates=pd.bdate_range("2015-01-01", periods=num_days),
+        instruments=np.array([f"SH{600000 + k}" for k in range(num_instruments)]),
+    )

@@ -84,7 +84,9 @@ def window_fill_indices(
     pc = jnp.clip(p, 0, d_total - 1)
     lv = last_valid[pc]                                   # (T, I)
     w_start = day - t + 1
-    ff_ok = (p >= 0)[:, None] & (lv >= w_start)
+    # lv == -1 means "no valid row ever"; the clamp to 0 also keeps it from
+    # passing the in-window check when w_start is negative (early days).
+    ff_ok = (p >= 0)[:, None] & (lv >= jnp.maximum(w_start, 0))
     fv = next_valid[jnp.clip(w_start, 0, d_total - 1)]    # (I,)
     bf_ok = fv <= day
     fallback = jnp.where(bf_ok, fv, day)[None, :]

@@ -1,0 +1,15 @@
+from factorvae_tpu.eval.metrics import RankIC, daily_rank_ic, rank_ic_frame
+from factorvae_tpu.eval.predict import (
+    export_scores,
+    generate_prediction_scores,
+    predict_panel,
+)
+
+__all__ = [
+    "RankIC",
+    "daily_rank_ic",
+    "export_scores",
+    "generate_prediction_scores",
+    "predict_panel",
+    "rank_ic_frame",
+]

@@ -1,0 +1,101 @@
+"""Inference scoring: per-day predictions over a date range.
+
+Capability parity with reference utils.py:70-93 / backtest.ipynb cell 1
+(`generate_prediction_scores`): run `prediction()` day by day and emit a
+(datetime, instrument)-indexed `score` DataFrame aligned via the sampler's
+index. Here the per-day loop is a chunked, jitted day-batched apply over
+the HBM-resident panel; scores come back as one (D, N_max) array and are
+flattened against the validity mask.
+
+The reference's predictions are stochastic at inference (module.py:123
+draws a reparameterized sample; SURVEY.md §3.3) — reproduced when
+`stochastic=True`; `stochastic=False` (default from the config) scores
+with the distribution mean, which is deterministic and what you want for
+a reproducible backtest.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from factorvae_tpu.config import Config
+from factorvae_tpu.data.loader import PanelDataset
+from factorvae_tpu.models.factorvae import day_prediction
+
+
+def predict_panel(
+    params,
+    config: Config,
+    dataset: PanelDataset,
+    days: np.ndarray,
+    stochastic: Optional[bool] = None,
+    seed: int = 0,
+    chunk: int = 32,
+) -> np.ndarray:
+    """(len(days), N_max) float scores; padded/absent entries are NaN."""
+    model = day_prediction(config.model, stochastic=stochastic)
+    seq_len = config.data.seq_len
+
+    from factorvae_tpu.data.windows import gather_day
+
+    @jax.jit
+    def score_chunk(day_idx, key):
+        def one(d):
+            return gather_day(
+                dataset.values, dataset.last_valid, dataset.next_valid, d, seq_len
+            )
+
+        x, _, mask = jax.vmap(one)(jnp.maximum(day_idx, 0))
+        mask = mask & (day_idx >= 0)[:, None]
+        return model.apply(params, x, mask, rngs={"sample": key})
+
+    out = np.full((len(days), dataset.n_max), np.nan, np.float32)
+    base = jax.random.PRNGKey(seed)
+    for c0 in range(0, len(days), chunk):
+        sel = days[c0 : c0 + chunk]
+        padded = np.full(chunk, -1, np.int32)
+        padded[: len(sel)] = sel
+        scores = score_chunk(jnp.asarray(padded), jax.random.fold_in(base, c0))
+        out[c0 : c0 + len(sel)] = np.asarray(scores)[: len(sel)]
+    return out
+
+
+def generate_prediction_scores(
+    params,
+    config: Config,
+    dataset: PanelDataset,
+    start: Optional[str] = None,
+    end: Optional[str] = None,
+    stochastic: Optional[bool] = None,
+    seed: int = 0,
+    with_labels: bool = False,
+) -> pd.DataFrame:
+    """Scores DataFrame with MultiIndex (datetime, instrument) and a
+    'score' column (plus 'LABEL0' when with_labels=True, matching the
+    merge the backtest notebook performs in cell 5)."""
+    days = dataset.split_days(start, end)
+    scores = predict_panel(params, config, dataset, days, stochastic, seed)
+    idx = dataset.index_frame(days)
+    valid = dataset.valid[days]                      # (D, N_max)
+    flat_scores = scores[valid]
+    df = pd.DataFrame({"score": flat_scores}, index=idx)
+    if with_labels:
+        labels = np.asarray(dataset.values[:, :, -1]).T[days]  # (D, N_max)
+        df["LABEL0"] = labels[valid]
+    return df
+
+
+def export_scores(df: pd.DataFrame, config: Config, out_dir: str = "./scores") -> str:
+    """CSV export under the reference's score naming scheme
+    (scores/readme.md:2-8; see Config.score_name)."""
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, config.score_name() + ".csv")
+    df.reset_index().to_csv(path, index=False)
+    return path

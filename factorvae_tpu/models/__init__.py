@@ -1,7 +1,12 @@
 from factorvae_tpu.models.decoder import AlphaLayer, BetaLayer, FactorDecoder
 from factorvae_tpu.models.encoder import FactorEncoder
 from factorvae_tpu.models.extractor import FeatureExtractor
-from factorvae_tpu.models.factorvae import FactorVAE, FactorVAEOutput, day_batched
+from factorvae_tpu.models.factorvae import (
+    FactorVAE,
+    FactorVAEOutput,
+    day_forward,
+    day_prediction,
+)
 from factorvae_tpu.models.layers import GRU, Dense
 from factorvae_tpu.models.predictor import FactorPredictor
 
@@ -16,5 +21,6 @@ __all__ = [
     "FactorVAEOutput",
     "FeatureExtractor",
     "GRU",
-    "day_batched",
+    "day_forward",
+    "day_prediction",
 ]

@@ -4,8 +4,8 @@ Capability parity with reference module.py:234-278 (`FactorVAE`): wires
 extractor, posterior encoder, decoder and prior predictor; the training
 loss is reconstruction + KL(posterior || prior) summed over K. The model
 operates on ONE trading day's padded cross-section; day batching is done
-with `nn.vmap` (see `day_batched`) so the per-day cross-stock reductions
-stay local to a day.
+with `nn.vmap` (see `day_forward` / `day_prediction`) so the per-day
+cross-stock reductions stay local to a day.
 
 Loss parity notes (SURVEY.md §7 hard-parts):
 - 'mse' mode reproduces module.py:261 exactly: MSE between the single
@@ -133,19 +133,54 @@ class FactorVAE(nn.Module):
         return jnp.where(mask, y_pred, jnp.nan)
 
 
-def day_batched(module_cls=FactorVAE, methods=("__call__", "prediction")):
-    """Lift a per-day module over a leading day axis.
+class _DayForward(nn.Module):
+    """Per-day forward wrapper with the train flag baked in as an attribute
+    (flax's nn.vmap does not thread call kwargs, so `train` cannot be a
+    kwarg of the vmapped call)."""
 
-    Parameters are shared across days; the 'sample' and 'dropout' rngs are
-    split per day so each day draws independent noise — the vmapped
-    equivalent of the reference looping days in its hot loop
-    (train_model.py:17-32).
-    """
+    cfg: ModelConfig
+    train_mode: bool = False
+
+    @nn.compact
+    def __call__(self, x, returns, mask):
+        return FactorVAE(self.cfg, name="model")(
+            x, returns, mask, train=self.train_mode
+        )
+
+
+class _DayPrediction(nn.Module):
+    cfg: ModelConfig
+    stochastic: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, mask):
+        return FactorVAE(self.cfg, name="model").prediction(
+            x, mask, stochastic=self.stochastic
+        )
+
+
+def _lift(module_cls):
+    """Lift a per-day wrapper over a leading day axis: parameters are
+    shared across days; the 'sample' and 'dropout' rngs are split per day
+    so each day draws independent noise — the vmapped equivalent of the
+    reference looping days in its hot loop (train_model.py:17-32)."""
     return nn.vmap(
         module_cls,
         in_axes=0,
         out_axes=0,
         variable_axes={"params": None},
         split_rngs={"params": False, "sample": True, "dropout": True},
-        methods=list(methods),
     )
+
+
+def day_forward(cfg: ModelConfig, train: bool):
+    """Day-batched training/eval forward: apply(params, x, y, mask) with
+    leading day axis on all three. Parameters are interchangeable between
+    the train/eval variants and with `day_prediction` (same inner module
+    name)."""
+    return _lift(_DayForward)(cfg, train_mode=train)
+
+
+def day_prediction(cfg: ModelConfig, stochastic: Optional[bool] = None):
+    """Day-batched inference: apply(params, x, mask) -> (D, N) scores."""
+    return _lift(_DayPrediction)(cfg, stochastic=stochastic)

@@ -1,0 +1,27 @@
+from factorvae_tpu.parallel.mesh import (
+    DATA_AXIS,
+    STOCK_AXIS,
+    make_mesh,
+    single_device_mesh,
+)
+from factorvae_tpu.parallel.sharding import (
+    batch_sharding,
+    make_batch_constraint,
+    order_sharding,
+    panel_shardings,
+    replicated,
+    shard_dataset,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "STOCK_AXIS",
+    "batch_sharding",
+    "make_batch_constraint",
+    "make_mesh",
+    "order_sharding",
+    "panel_shardings",
+    "replicated",
+    "shard_dataset",
+    "single_device_mesh",
+]

@@ -1,0 +1,49 @@
+"""Device-mesh construction.
+
+The distributed backend of this framework is XLA's collectives over
+ICI/DCN, reached through `jax.sharding` — the TPU-native replacement for
+the NCCL/MPI layer a GPU framework would hand-roll (the reference has no
+distributed support at all; SURVEY.md §2.3 specifies this surface).
+
+Mesh axes:
+- 'data'  — trading days. Each device takes a slice of every update's
+  day-batch; gradients are all-reduced over ICI by GSPMD.
+- 'stock' — the cross-section. Shards the padded instrument axis of the
+  panel and every per-stock activation; the cross-stock reductions
+  (masked softmaxes, portfolio matmul, loss means) become psum-style
+  collectives inserted by GSPMD. This is the model's analogue of
+  sequence/context parallelism: the "long axis" of this model family is
+  the stock universe (N up to ~800 for CSI800), not time (T=20-60), per
+  SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from factorvae_tpu.config import MeshConfig
+
+DATA_AXIS = "data"
+STOCK_AXIS = "stock"
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = cfg.shape(len(devices))
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, (DATA_AXIS, STOCK_AXIS))
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1), (DATA_AXIS, STOCK_AXIS))

@@ -1,0 +1,71 @@
+"""Partition specs and sharding helpers for the FactorVAE training step.
+
+Layout summary (see mesh.py for the axes):
+
+    panel values (N, D, C+1)   -> P('stock', None, None)   HBM-resident shards
+    fill maps    (D, N)        -> P(None, 'stock')
+    day order    (S, B)        -> P(None, 'data')
+    batch x      (B, N, T, C)  -> P('data', 'stock')
+    batch y/mask (B, N)        -> P('data', 'stock')
+    params / opt state         -> replicated P()
+
+GSPMD then inserts the collectives: gradient all-reduce over 'data'
+(day-level data parallelism) and max/sum reductions over 'stock' for the
+masked softmaxes (module.py:38,57,146 semantics) and the portfolio matmul
+(module.py:64).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from factorvae_tpu.parallel.mesh import DATA_AXIS, STOCK_AXIS
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def panel_shardings(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding, NamedSharding]:
+    """(values, last_valid, next_valid) placements."""
+    return (
+        NamedSharding(mesh, P(STOCK_AXIS, None, None)),
+        NamedSharding(mesh, P(None, STOCK_AXIS)),
+        NamedSharding(mesh, P(None, STOCK_AXIS)),
+    )
+
+
+def order_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS, STOCK_AXIS))
+
+
+def make_batch_constraint(mesh: Mesh) -> Callable:
+    """Constraint applied inside the jitted step right after the day-batch
+    gather, pinning the (B, N, ...) layout so GSPMD doesn't re-replicate
+    the batch."""
+    x_s = NamedSharding(mesh, P(DATA_AXIS, STOCK_AXIS, None, None))
+    v_s = NamedSharding(mesh, P(DATA_AXIS, STOCK_AXIS))
+
+    def constrain(x, y, mask):
+        return (
+            jax.lax.with_sharding_constraint(x, x_s),
+            jax.lax.with_sharding_constraint(y, v_s),
+            jax.lax.with_sharding_constraint(mask, v_s),
+        )
+
+    return constrain
+
+
+def shard_dataset(mesh: Mesh, dataset) -> None:
+    """Re-place a PanelDataset's device arrays onto the mesh in-place."""
+    v_s, lv_s, nv_s = panel_shardings(mesh)
+    dataset.values = jax.device_put(dataset.values, v_s)
+    dataset.last_valid = jax.device_put(dataset.last_valid, lv_s)
+    dataset.next_valid = jax.device_put(dataset.next_valid, nv_s)

@@ -1,0 +1,22 @@
+from factorvae_tpu.train.checkpoint import Checkpointer, load_params, save_params
+from factorvae_tpu.train.loop import StepFns, make_step_fns
+from factorvae_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    learning_rate_at,
+    make_optimizer,
+)
+from factorvae_tpu.train.trainer import Trainer
+
+__all__ = [
+    "Checkpointer",
+    "StepFns",
+    "TrainState",
+    "Trainer",
+    "create_train_state",
+    "learning_rate_at",
+    "load_params",
+    "make_optimizer",
+    "make_step_fns",
+    "save_params",
+]

@@ -1,0 +1,86 @@
+"""Orbax checkpointing with full resume.
+
+The reference only ever writes the best-validation model weights
+(main.py:73-80); optimizer/scheduler state and the RNG are lost, so a
+crashed run cannot resume (SURVEY.md §5 "Failure detection"). Here every
+checkpoint carries the complete `TrainState` (params, optimizer state,
+step, threaded PRNG key) plus a JSON metadata blob (epoch, best-val,
+config), making resume deterministic: a run killed at epoch k continues
+exactly as if it had never died.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from factorvae_tpu.train.state import TrainState
+
+
+class Checkpointer:
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True, enable_async_checkpointing=False
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, meta: dict) -> None:
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, template: TrainState, step: Optional[int] = None
+    ) -> Tuple[TrainState, dict]:
+        """`template` supplies the pytree structure/shapes (an abstract
+        eval_shape of the state works)."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        out = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        return out["state"], out["meta"]
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_params(directory: str, name: str, params: Any) -> str:
+    """Best-model weights-only export under a parameter-encoding name —
+    the analogue of the reference's torch.save(state_dict) filename scheme
+    (main.py:78-79)."""
+    path = os.path.join(os.path.abspath(directory), name)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    return path
+
+
+def load_params(path: str, template: Any) -> Any:
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    ckptr = ocp.StandardCheckpointer()
+    out = ckptr.restore(os.path.abspath(path), abstract)
+    ckptr.close()
+    return out

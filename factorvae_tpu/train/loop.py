@@ -1,0 +1,137 @@
+"""Jitted train/eval step and whole-epoch device loops.
+
+Re-design of the reference's hot loop (train_model.py:11-60). The
+reference pays a host->device copy and a `loss.item()` device sync every
+step (train_model.py:21-28, SURVEY.md §3.5). Here an *entire epoch* is one
+`lax.scan` under jit: the day order goes in as an int32 array, every step
+gathers its day-batch from the HBM-resident panel, computes grads, applies
+the optimizer update, and accumulates metrics on device; the host fetches
+one scalar pair per epoch.
+
+Semantics knobs:
+- days_per_step=1 reproduces the reference exactly: one trading day = one
+  SGD step, scheduler advanced per step (train_model.py:31-32).
+- days_per_step=B>1 averages gradients over B days per update — the
+  day-level data-parallel mode; with a ('data',) mesh the B axis is
+  sharded and XLA all-reduces the gradients over ICI.
+- day index -1 marks epoch padding (so the scan length is static and
+  divisible); padded days get loss weight 0 and contribute no gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from factorvae_tpu.data.windows import gather_day
+from factorvae_tpu.train.state import TrainState
+
+
+class StepFns(NamedTuple):
+    train_step: Callable        # (state, days) -> (state, (loss_sum, day_count))
+    train_epoch: Callable       # (state, order (S,B)) -> (state, metrics dict)
+    eval_epoch: Callable        # (params, order (S,B), key) -> metrics dict
+    batch_for: Callable         # (days (B,)) -> (x, y, mask)
+
+
+def make_step_fns(
+    model_train: Any,
+    model_eval: Any,
+    tx: optax.GradientTransformation,
+    values: jnp.ndarray,
+    last_valid: jnp.ndarray,
+    next_valid: jnp.ndarray,
+    seq_len: int,
+    shard_batch: Any = None,
+) -> StepFns:
+    """`model_train` / `model_eval` are the day-batched forward variants
+    (models.day_forward with train=True/False; they share one param tree).
+
+    `shard_batch`, when given (parallel.make_batch_constraint), pins the
+    gathered (B, N, ...) batch to the ('data', 'stock') mesh layout inside
+    the jitted step."""
+
+    def batch_for(days: jnp.ndarray):
+        safe = jnp.maximum(days, 0)
+        x, y, mask = jax.vmap(
+            lambda d: gather_day(values, last_valid, next_valid, d, seq_len)
+        )(safe)
+        mask = mask & (days >= 0)[:, None]
+        if shard_batch is not None:
+            x, y, mask = shard_batch(x, y, mask)
+        return x, y, mask
+
+    def weighted_day_loss(params, days, key, train: bool):
+        x, y, mask = batch_for(days)
+        day_w = (days >= 0).astype(jnp.float32)
+        k_sample, k_drop = jax.random.split(key)
+        model = model_train if train else model_eval
+        out = model.apply(
+            params, x, y, mask, rngs={"sample": k_sample, "dropout": k_drop}
+        )
+        loss_sum = jnp.sum(out.loss * day_w)
+        count = jnp.sum(day_w)
+        # mean over real days this step; padded days carry zero weight
+        loss = loss_sum / jnp.maximum(count, 1.0)
+        aux = {
+            "loss_sum": loss_sum,
+            "recon_sum": jnp.sum(out.recon_loss * day_w),
+            "kl_sum": jnp.sum(out.kl * day_w),
+            "days": count,
+        }
+        return loss, aux
+
+    def train_step(state: TrainState, days: jnp.ndarray):
+        state, key = state.advance_rng()
+        (_, aux), grads = jax.value_and_grad(weighted_day_loss, has_aux=True)(
+            state.params, days, key, True
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt
+        )
+        return state, aux
+
+    def train_epoch(state: TrainState, order: jnp.ndarray):
+        """order: (S, B) int32 day indices (-1 = pad)."""
+        def body(st, days):
+            st, aux = train_step(st, days)
+            return st, aux
+
+        state, auxes = jax.lax.scan(body, state, order)
+        days = jnp.maximum(jnp.sum(auxes["days"]), 1.0)
+        metrics = {
+            "loss": jnp.sum(auxes["loss_sum"]) / days,
+            "recon": jnp.sum(auxes["recon_sum"]) / days,
+            "kl": jnp.sum(auxes["kl_sum"]) / days,
+            "days": jnp.sum(auxes["days"]),
+        }
+        return state, metrics
+
+    def eval_epoch(params, order: jnp.ndarray, key: jax.Array):
+        """Validation mean loss (reference validate(), train_model.py:40-60:
+        dropout off, reconstruction still sampled)."""
+        def body(k, days):
+            k, sub = jax.random.split(k)
+            _, aux = weighted_day_loss(params, days, sub, False)
+            return k, aux
+
+        _, auxes = jax.lax.scan(body, key, order)
+        days = jnp.maximum(jnp.sum(auxes["days"]), 1.0)
+        return {
+            "loss": jnp.sum(auxes["loss_sum"]) / days,
+            "recon": jnp.sum(auxes["recon_sum"]) / days,
+            "kl": jnp.sum(auxes["kl_sum"]) / days,
+            "days": jnp.sum(auxes["days"]),
+        }
+
+    return StepFns(
+        train_step=train_step,
+        train_epoch=train_epoch,
+        eval_epoch=eval_epoch,
+        batch_for=batch_for,
+    )

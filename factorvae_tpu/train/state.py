@@ -1,0 +1,67 @@
+"""Train state and optimizer assembly.
+
+Optimizer parity with reference main.py:60-61: Adam(lr=1e-4) with a
+cosine-annealing schedule whose horizon is (steps-per-epoch x epochs) and
+which advances once per optimizer update (the reference steps its
+scheduler once per batch, train_model.py:31-32). torch's
+CosineAnnealingLR with eta_min=0 is exactly optax's
+cosine_decay_schedule(alpha=0).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from factorvae_tpu.config import TrainConfig
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Everything needed to resume a run bit-for-bit (the reference saves
+    only model weights, main.py:78-79 — optimizer/scheduler state is lost
+    on crash; this is the fix called out in SURVEY.md §5)."""
+
+    step: jnp.ndarray            # optimizer updates taken
+    params: Any
+    opt_state: Any
+    rng: jax.Array               # threaded PRNG key (sample/dropout noise)
+
+    def advance_rng(self):
+        new_rng, sub = jax.random.split(self.rng)
+        return self.replace(rng=new_rng), sub
+
+
+def make_optimizer(
+    cfg: TrainConfig, total_steps: Optional[int] = None
+) -> optax.GradientTransformation:
+    if cfg.cosine_schedule and total_steps:
+        schedule = optax.cosine_decay_schedule(
+            init_value=cfg.lr, decay_steps=total_steps, alpha=0.0
+        )
+    else:
+        schedule = cfg.lr
+    return optax.adam(schedule)
+
+
+def create_train_state(params, tx: optax.GradientTransformation, seed: int) -> TrainState:
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
+def learning_rate_at(cfg: TrainConfig, total_steps: int, step: int) -> float:
+    """Host-side LR readback for logging (reference logs
+    scheduler.get_last_lr(), main.py:83)."""
+    if cfg.cosine_schedule and total_steps:
+        import math
+
+        return 0.5 * cfg.lr * (1 + math.cos(math.pi * min(step, total_steps) / total_steps))
+    return cfg.lr

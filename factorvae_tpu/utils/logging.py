@@ -1,0 +1,66 @@
+"""Structured metrics stream.
+
+Replaces the reference's print + tqdm + optional wandb combo
+(main.py:63-87) with a JSONL metric stream (one line per epoch/event)
+plus the same optional wandb hookup, gated so the framework runs without
+wandb installed or configured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Optional
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        jsonl_path: Optional[str] = None,
+        use_wandb: bool = False,
+        wandb_project: str = "factorvae-tpu",
+        run_name: Optional[str] = None,
+        config: Optional[dict] = None,
+        echo: bool = True,
+    ):
+        self.jsonl_path = jsonl_path
+        self.echo = echo
+        self._fh = None
+        if jsonl_path:
+            os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)), exist_ok=True)
+            self._fh = open(jsonl_path, "a")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb  # type: ignore
+
+                self._wandb = wandb
+                wandb.init(project=wandb_project, name=run_name, config=config or {})
+            except Exception as e:  # wandb absent or offline — degrade to JSONL
+                print(f"[metrics] wandb unavailable ({e}); JSONL only", file=sys.stderr)
+                self._wandb = None
+
+    def log(self, event: str, **fields: Any) -> None:
+        rec = {"ts": time.time(), "event": event, **fields}
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self._wandb is not None and event == "epoch":
+            self._wandb.log({k: v for k, v in fields.items() if isinstance(v, (int, float))})
+        if self.echo:
+            shown = ", ".join(
+                f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in fields.items()
+            )
+            print(f"[{event}] {shown}")
+
+    def finish(self, **fields: Any) -> None:
+        if fields:
+            self.log("final", **fields)
+        if self._wandb is not None:
+            self._wandb.finish()
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,177 @@
+"""Data-pipeline tests: dense panel construction, ffill+bfill window
+semantics (property-tested against a brute-force host oracle that encodes
+the reference sampler's documented behavior), split ranges, padding."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from factorvae_tpu.data import (
+    PanelDataset,
+    build_panel,
+    compute_fill_maps,
+    fill_indices_host,
+    gather_day,
+    panel_to_frame,
+    synthetic_frame,
+    synthetic_panel,
+    window_fill_indices,
+)
+
+
+class TestPanel:
+    def test_roundtrip(self, rng):
+        df = synthetic_frame(num_days=12, num_instruments=5, num_features=4, seed=1)
+        panel = build_panel(df)
+        assert panel.num_days == 12
+        assert panel.num_instruments == 5
+        assert panel.num_features == 4
+        back = panel_to_frame(panel)
+        np.testing.assert_allclose(
+            back.to_numpy(), df.sort_index().to_numpy(), rtol=1e-6
+        )
+        assert (back.index == df.sort_index().index).all()
+
+    def test_valid_matches_presence(self):
+        df = synthetic_frame(num_days=10, num_instruments=6, missing_prob=0.3, seed=2)
+        panel = build_panel(df)
+        present = set(zip(df.index.get_level_values(0), df.index.get_level_values(1)))
+        for d, date in enumerate(panel.dates):
+            for i, inst in enumerate(panel.instruments):
+                assert panel.valid[d, i] == ((date, inst) in present)
+
+    def test_date_slice_and_locate(self):
+        panel = synthetic_panel(num_days=20, num_instruments=4, seed=3)
+        start, end = str(panel.dates[5].date()), str(panel.dates[14].date())
+        lo, hi = panel.locate(start, end)
+        assert (lo, hi) == (5, 15)  # inclusive end, like pandas slice_locs
+        sub = panel.date_slice(start, end)
+        assert sub.num_days == 10
+
+
+class TestFillMaps:
+    def test_fill_maps(self):
+        valid = np.array(
+            [[1, 0], [0, 0], [1, 1], [0, 0], [0, 1]], dtype=bool
+        )
+        lv, nv = compute_fill_maps(valid)
+        np.testing.assert_array_equal(lv[:, 0], [0, 0, 2, 2, 2])
+        np.testing.assert_array_equal(lv[:, 1], [-1, -1, 2, 2, 4])
+        np.testing.assert_array_equal(nv[:, 0], [0, 2, 2, 5, 5])
+        np.testing.assert_array_equal(nv[:, 1], [2, 2, 2, 4, 4])
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("step_len", [1, 3, 7])
+    def test_window_indices_match_host_oracle(self, seed, step_len):
+        """Device fill indices == brute-force ffill+bfill oracle for every
+        (day, instrument) that has a row (= every real sample)."""
+        rng = np.random.default_rng(seed)
+        d, i = 15, 6
+        valid = rng.random((d, i)) > 0.35
+        lv, nv = compute_fill_maps(valid)
+        for day in range(d):
+            want = fill_indices_host(valid, day, step_len)           # (I, T)
+            got = np.asarray(
+                window_fill_indices(jnp.asarray(lv), jnp.asarray(nv), day, step_len)
+            )
+            sample_ok = valid[day]  # only these (day, i) exist as samples
+            np.testing.assert_array_equal(got[sample_ok], want[sample_ok])
+
+    def test_gather_day_values(self, rng):
+        """Window rows carry the filled day's full feature row; label is the
+        sample day's own last column (reference train_model.py:18-22)."""
+        d, i, c = 10, 4, 3
+        valid = rng.random((d, i)) > 0.3
+        valid[7] = True  # ensure day 7 fully valid
+        values = rng.normal(size=(i, d, c + 1)).astype(np.float32)
+        values[:, :, :][~valid.T] = np.nan
+        lv, nv = compute_fill_maps(valid)
+        t = 4
+        x, y, mask = gather_day(
+            jnp.asarray(values), jnp.asarray(lv), jnp.asarray(nv), 7, t
+        )
+        assert x.shape == (i, t, c) and y.shape == (i,) and mask.shape == (i,)
+        np.testing.assert_array_equal(np.asarray(mask), valid[7])
+        fill = fill_indices_host(valid, 7, t)
+        for ii in range(i):
+            for tt in range(t):
+                src = fill[ii, tt]
+                np.testing.assert_allclose(
+                    np.asarray(x[ii, tt]), values[ii, src, :-1], rtol=1e-6
+                )
+        np.testing.assert_allclose(np.asarray(y), values[:, 7, -1], rtol=1e-6)
+
+    def test_traced_day_index(self, rng):
+        """gather_day must work with a traced day index (used inside the
+        epoch lax.scan)."""
+        import jax
+
+        valid = rng.random((8, 3)) > 0.3
+        values = rng.normal(size=(3, 8, 5)).astype(np.float32)
+        lv, nv = compute_fill_maps(valid)
+
+        @jax.jit
+        def f(day):
+            return gather_day(jnp.asarray(values), jnp.asarray(lv), jnp.asarray(nv), day, 3)
+
+        x0, _, _ = f(jnp.int32(5))
+        x1, _, _ = f(jnp.int32(6))
+        assert x0.shape == (3, 3, 4)
+        assert not np.allclose(np.asarray(x0), np.asarray(x1))
+
+
+class TestPanelDataset:
+    def test_padding_and_splits(self):
+        panel = synthetic_panel(num_days=25, num_instruments=10, seed=4)
+        ds = PanelDataset(panel, seq_len=5, pad_multiple=8)
+        assert ds.n_max == 16
+        days = ds.split_days(None, None)
+        assert len(days) == 25
+        start = str(panel.dates[10].date())
+        days2 = ds.split_days(start, None)
+        assert days2[0] == 10
+        x, y, mask = ds.day_batch(12)
+        assert x.shape == (16, 5, panel.num_features)
+        assert not np.asarray(mask)[10:].any()  # padded instruments invalid
+        assert np.isfinite(np.asarray(x)).all()
+
+    def test_lookback_crosses_split_boundary(self):
+        """A val-split day's window must reach back into train-period days
+        (the reference sampler holds the full frame; only sample positions
+        are restricted, dataset.py:97-99)."""
+        panel = synthetic_panel(num_days=30, num_instruments=6, missing_prob=0.0, seed=5)
+        ds = PanelDataset(panel, seq_len=10)
+        days = ds.split_days(str(panel.dates[20].date()), None)
+        x, _, mask = ds.day_batch(int(days[0]))
+        # window rows [20-10+1 .. 20] include day 11..19 < split start
+        ref = panel.values[0, 11, :-1]
+        np.testing.assert_allclose(np.asarray(x[0, 0]), ref, rtol=1e-6)
+
+    def test_index_frame_alignment(self):
+        panel = synthetic_panel(num_days=8, num_instruments=5, missing_prob=0.2, seed=6)
+        ds = PanelDataset(panel, seq_len=3)
+        days = ds.split_days(None, None)
+        idx = ds.index_frame(days)
+        assert idx.names == ["datetime", "instrument"]
+        assert len(idx) == panel.valid.sum()
+
+    def test_epoch_order_shuffle_deterministic(self):
+        panel = synthetic_panel(num_days=12, num_instruments=4, seed=7)
+        ds = PanelDataset(panel, seq_len=3)
+        days = ds.split_days(None, None)
+        o1 = ds.epoch_order(days, shuffle=True, seed=1, epoch=3)
+        o2 = ds.epoch_order(days, shuffle=True, seed=1, epoch=3)
+        o3 = ds.epoch_order(days, shuffle=True, seed=1, epoch=4)
+        np.testing.assert_array_equal(o1, o2)
+        assert not np.array_equal(o1, o3)
+        assert sorted(o1.tolist()) == sorted(days.tolist())
+
+    def test_epoch_order_padding(self):
+        panel = synthetic_panel(num_days=10, num_instruments=4, seed=8)
+        ds = PanelDataset(panel, seq_len=3)
+        days = ds.split_days(None, None)
+        order = ds.epoch_order(days, shuffle=False, seed=0, epoch=0, pad_to=8)
+        assert len(order) == 16
+        assert (order[10:] == -1).all()

@@ -1,0 +1,135 @@
+"""Eval tests: score generation/alignment, CSV export naming, RankIC
+DataFrame API vs scipy, CLI end-to-end on a synthetic pickle."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+from scipy.stats import spearmanr
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, build_panel, synthetic_frame, synthetic_panel
+from factorvae_tpu.eval import (
+    RankIC,
+    daily_rank_ic,
+    export_scores,
+    generate_prediction_scores,
+)
+from factorvae_tpu.train import Trainer
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+def tiny_cfg(tmp_path, **model_kw):
+    m = dict(num_features=8, hidden_size=8, num_factors=4, num_portfolios=6, seq_len=5)
+    m.update(model_kw)
+    return Config(
+        model=ModelConfig(**m),
+        data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(num_epochs=1, seed=0, save_dir=str(tmp_path),
+                          checkpoint_every=0),
+    )
+
+
+@pytest.fixture
+def trained(tmp_path):
+    panel = synthetic_panel(num_days=18, num_instruments=6, num_features=8,
+                            missing_prob=0.15, seed=0)
+    ds = PanelDataset(panel, seq_len=5)
+    cfg = tiny_cfg(tmp_path)
+    tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state, _ = tr.fit()
+    return cfg, ds, state
+
+
+class TestScores:
+    def test_alignment_and_shape(self, trained):
+        cfg, ds, state = trained
+        df = generate_prediction_scores(state.params, cfg, ds, with_labels=True)
+        assert list(df.columns) == ["score", "LABEL0"]
+        assert df.index.names == ["datetime", "instrument"]
+        assert len(df) == ds.valid.sum()
+        assert np.isfinite(df["score"]).all()
+        # label values must match the source panel rows
+        d0, i0 = df.index[0]
+        day = list(ds.dates).index(d0)
+        inst = list(ds.instruments).index(i0)
+        want = float(np.asarray(ds.values[inst, day, -1]))
+        np.testing.assert_allclose(df["LABEL0"].iloc[0], want, rtol=1e-6)
+
+    def test_deterministic_scores_stable(self, trained):
+        cfg, ds, state = trained
+        a = generate_prediction_scores(state.params, cfg, ds, stochastic=False)
+        b = generate_prediction_scores(state.params, cfg, ds, stochastic=False)
+        np.testing.assert_array_equal(a["score"].values, b["score"].values)
+
+    def test_stochastic_scores_vary_by_seed(self, trained):
+        cfg, ds, state = trained
+        a = generate_prediction_scores(state.params, cfg, ds, stochastic=True, seed=0)
+        b = generate_prediction_scores(state.params, cfg, ds, stochastic=True, seed=1)
+        assert not np.allclose(a["score"].values, b["score"].values)
+
+    def test_export_naming(self, trained, tmp_path):
+        cfg, ds, state = trained
+        df = generate_prediction_scores(state.params, cfg, ds)
+        path = export_scores(df, cfg, str(tmp_path / "scores"))
+        # {run_name}_{K}_{normalize}_{select}_{C}_{H}.csv (scores/readme.md)
+        assert os.path.basename(path) == "VAE-Revision2_4_True_None_8_8.csv"
+        back = pd.read_csv(path)
+        assert list(back.columns) == ["datetime", "instrument", "score"]
+        assert len(back) == len(df)
+
+
+class TestRankICAPI:
+    def test_matches_scipy_per_day(self, rng):
+        days = pd.bdate_range("2020-01-01", periods=5)
+        rows, s, l = [], [], []
+        for d in days:
+            n = int(rng.integers(8, 14))
+            for k in range(n):
+                rows.append((d, f"I{k}"))
+                s.append(float(rng.normal()))
+                l.append(float(rng.normal()))
+        df = pd.DataFrame(
+            {"score": s, "LABEL0": l},
+            index=pd.MultiIndex.from_tuples(rows, names=["datetime", "instrument"]),
+        )
+        ic = daily_rank_ic(df, "LABEL0", "score")
+        for d in days:
+            day = df.loc[d]
+            want, _ = spearmanr(day["LABEL0"], day["score"])
+            np.testing.assert_allclose(ic[d], want, rtol=1e-4)
+        out = RankIC(df, "LABEL0", "score")
+        np.testing.assert_allclose(out["RankIC"].iloc[0], ic.values.mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            out["RankIC_IR"].iloc[0], ic.values.mean() / ic.values.std(), rtol=1e-4
+        )
+
+
+class TestCLI:
+    def test_end_to_end_on_synthetic_pickle(self, tmp_path):
+        """Full reference workflow: pickle -> train -> score CSV + RankIC."""
+        df = synthetic_frame(num_days=16, num_instruments=6, num_features=8, seed=3)
+        pkl = tmp_path / "panel.pkl"
+        df.to_pickle(pkl)
+        from factorvae_tpu.cli import main
+
+        rc = main([
+            "--dataset", str(pkl),
+            "--num_epochs", "1",
+            "--num_latent", "8", "--hidden_size", "8", "--num_factor", "4",
+            "--num_portfolio", "6", "--seq_len", "5",
+            "--start_time", "2020-01-01", "--fit_end_time", "2020-01-14",
+            "--val_start_time", "2020-01-15", "--val_end_time", "2020-01-18",
+            "--score_start", "2020-01-10", "--score_end", "2020-01-22",
+            "--save_dir", str(tmp_path / "models"),
+            "--score_dir", str(tmp_path / "scores"),
+            "--metrics_jsonl", str(tmp_path / "metrics.jsonl"),
+            "--run_name", "clitest",
+        ])
+        assert rc == 0
+        assert (tmp_path / "scores" / "clitest_4_True_None_8_8.csv").exists()
+        lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+        events = [pd.io.json.ujson_loads(l)["event"] for l in lines]
+        assert "epoch" in events and "scores" in events

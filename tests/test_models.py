@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from factorvae_tpu.config import ModelConfig
-from factorvae_tpu.models import FactorVAE, FeatureExtractor, day_batched
+from factorvae_tpu.models import FactorVAE, FeatureExtractor, day_forward, day_prediction
 from factorvae_tpu.models.layers import GRU
 
 CFG = ModelConfig(
@@ -219,8 +219,7 @@ class TestLossSemantics:
 
 class TestDayBatched:
     def test_vmapped_days(self, rng):
-        DayModel = day_batched()
-        model = DayModel(CFG)
+        model = day_forward(CFG, train=True)
         d, n = 3, 10
         x = jnp.asarray(rng.normal(size=(d, n, CFG.seq_len, CFG.num_features)),
                         jnp.float32)
@@ -231,9 +230,48 @@ class TestDayBatched:
         out = model.apply(
             params, x, y, mask,
             rngs={"sample": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)},
-            train=True,
         )
         assert out.loss.shape == (d,)
         assert out.factor_mu.shape == (d, CFG.num_factors)
         # per-day sample rngs differ -> reconstructions differ across days
         assert not np.allclose(out.reconstruction[0], out.reconstruction[1])
+
+    def test_train_eval_share_params_and_dropout_differs(self, rng):
+        """train=True must actually apply attention-score dropout (the
+        reference drops out scores pre-ReLU, module.py:144); eval must be
+        dropout-free and deterministic given the sample key."""
+        m_train = day_forward(CFG, train=True)
+        m_eval = day_forward(CFG, train=False)
+        d, n = 2, 10
+        x = jnp.asarray(rng.normal(size=(d, n, CFG.seq_len, CFG.num_features)),
+                        jnp.float32)
+        y = jnp.asarray(rng.normal(size=(d, n)), jnp.float32)
+        mask = jnp.ones((d, n), bool)
+        k = jax.random.PRNGKey(0)
+        params = m_train.init({"params": k, "sample": k, "dropout": k}, x, y, mask)
+
+        rngs1 = {"sample": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)}
+        rngs2 = {"sample": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(3)}
+        t1 = m_train.apply(params, x, y, mask, rngs=rngs1)
+        t2 = m_train.apply(params, x, y, mask, rngs=rngs2)
+        # different dropout keys -> different prior stats in train mode
+        assert not np.allclose(t1.pred_mu, t2.pred_mu)
+        e1 = m_eval.apply(params, x, y, mask, rngs=rngs1)
+        e2 = m_eval.apply(params, x, y, mask, rngs=rngs2)
+        # eval ignores dropout key entirely
+        np.testing.assert_allclose(e1.pred_mu, e2.pred_mu, rtol=1e-6)
+
+    def test_day_prediction(self, rng):
+        model = day_prediction(CFG, stochastic=False)
+        d, n = 3, 10
+        x = jnp.asarray(rng.normal(size=(d, n, CFG.seq_len, CFG.num_features)),
+                        jnp.float32)
+        mask = jnp.ones((d, n), bool)
+        # params from the forward variant are interchangeable
+        fwd = day_forward(CFG, train=False)
+        k = jax.random.PRNGKey(0)
+        y = jnp.zeros((d, n))
+        params = fwd.init({"params": k, "sample": k, "dropout": k}, x, y, mask)
+        scores = model.apply(params, x, mask)
+        assert scores.shape == (d, n)
+        assert np.isfinite(np.asarray(scores)).all()

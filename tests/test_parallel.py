@@ -1,0 +1,93 @@
+"""Mesh-parallel tests on the virtual 8-device CPU platform: sharded
+training step correctness vs single-device, the graft dryrun, and
+sharding of the panel arrays."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from factorvae_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+from factorvae_tpu.parallel import make_mesh
+from factorvae_tpu.train import Trainer
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+def cfg_for(tmp_path, days_per_step=8):
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=4),
+        data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(num_epochs=2, lr=1e-3, seed=0, days_per_step=days_per_step,
+                          save_dir=str(tmp_path), checkpoint_every=0),
+    )
+
+
+@pytest.fixture
+def dense_ds():
+    return PanelDataset(
+        synthetic_panel_dense(num_days=24, num_instruments=14, num_features=8),
+        seq_len=4,
+        pad_multiple=16,
+    )
+
+
+class TestMesh:
+    def test_make_mesh_shapes(self, devices):
+        mesh = make_mesh(MeshConfig(stock_axis=2))
+        assert dict(mesh.shape) == {"data": 4, "stock": 2}
+        mesh1 = make_mesh(MeshConfig(stock_axis=1))
+        assert dict(mesh1.shape) == {"data": 8, "stock": 1}
+
+    def test_mesh_training_matches_single_device(self, dense_ds, tmp_path):
+        """The dp=4 x sp=2 sharded run must compute the same losses as the
+        unsharded run (same day order, same rng) — numerics modulo
+        reduction order."""
+        losses = {}
+        for name, mesh in [
+            ("single", None),
+            ("mesh", make_mesh(MeshConfig(stock_axis=2))),
+        ]:
+            cfg = cfg_for(tmp_path / name)
+            tr = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
+            _, out = tr.fit()
+            losses[name] = [h["train_loss"] for h in out["history"]]
+        np.testing.assert_allclose(losses["single"], losses["mesh"], rtol=2e-3)
+
+    def test_gradient_sync_over_data_axis(self, dense_ds, tmp_path):
+        """After one sharded update the params must be identical on every
+        device (gradient all-reduce happened)."""
+        mesh = make_mesh(MeshConfig(stock_axis=1))
+        cfg = cfg_for(tmp_path, days_per_step=8)
+        tr = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
+        state = tr.init_state()
+        order = jnp.asarray(tr.train_days[:8].reshape(1, 8))
+        state, _ = tr._train_epoch(state, order)
+        leaf = jax.tree_util.tree_leaves(state.params)[0]
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip(self):
+        import sys, os
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        import __graft_entry__ as ge
+
+        ge.dryrun_multichip(8)
+
+    def test_entry_compiles_small(self):
+        """entry() targets the flagship shape; here we only check the
+        callable is jittable on a reduced clone to keep CI fast."""
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        jitted = jax.jit(fn)
+        loss = jitted(*args)
+        assert np.isfinite(float(loss))

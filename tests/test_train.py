@@ -1,0 +1,129 @@
+"""Training-loop tests: single-step mechanics, epoch scan, overfit
+integration (SURVEY.md §4's prescription), checkpoint/resume determinism."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.train import Trainer
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+def small_config(tmp_path, **train_kw) -> Config:
+    defaults = dict(
+        num_epochs=2, lr=1e-3, seed=0, save_dir=str(tmp_path), checkpoint_every=1
+    )
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(
+            num_features=8, hidden_size=8, num_factors=4, num_portfolios=6, seq_len=5
+        ),
+        data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(**defaults),
+    )
+
+
+@pytest.fixture
+def tiny_dataset():
+    panel = synthetic_panel(
+        num_days=20, num_instruments=6, num_features=8, missing_prob=0.1, seed=0
+    )
+    return panel, PanelDataset(panel, seq_len=5)
+
+
+class TestTrainerMechanics:
+    def test_fit_runs_and_logs(self, tiny_dataset, tmp_path):
+        _, ds = tiny_dataset
+        cfg = small_config(tmp_path)
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state, out = tr.fit()
+        assert len(out["history"]) == 2
+        assert int(state.step) == tr.steps_per_epoch * 2
+        assert np.isfinite(out["history"][0]["train_loss"])
+        assert np.isfinite(out["best_val"])
+
+    def test_loss_decreases_on_learnable_signal(self, tmp_path):
+        """Overfit test: strong planted linear signal, loss must drop."""
+        panel = synthetic_panel(
+            num_days=24, num_instruments=8, num_features=8,
+            missing_prob=0.0, signal=0.9, seed=1,
+        )
+        ds = PanelDataset(panel, seq_len=4)
+        cfg = Config(
+            model=ModelConfig(
+                num_features=8, hidden_size=16, num_factors=4,
+                num_portfolios=6, seq_len=4,
+            ),
+            data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
+                            val_start_time=None, val_end_time=None),
+            train=TrainConfig(num_epochs=15, lr=3e-3, seed=0,
+                              save_dir=str(tmp_path), checkpoint_every=0),
+        )
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        _, out = tr.fit()
+        losses = [h["train_loss"] for h in out["history"]]
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_days_per_step_batching(self, tiny_dataset, tmp_path):
+        _, ds = tiny_dataset
+        cfg = small_config(tmp_path, days_per_step=4, checkpoint_every=0)
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        assert tr.steps_per_epoch == -(-len(tr.train_days) // 4)
+        state, out = tr.fit()
+        assert np.isfinite(out["history"][-1]["train_loss"])
+
+    def test_determinism_same_seed(self, tiny_dataset, tmp_path):
+        _, ds = tiny_dataset
+        losses = []
+        for run in range(2):
+            cfg = small_config(tmp_path / f"r{run}", checkpoint_every=0)
+            tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+            _, out = tr.fit()
+            losses.append([h["train_loss"] for h in out["history"]])
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+class TestCheckpointResume:
+    def test_resume_continues_exactly(self, tiny_dataset, tmp_path):
+        """Full-state resume: train 4 epochs straight vs 2 + resume 2 —
+        identical final losses (the determinism the reference cannot
+        provide, SURVEY.md §5)."""
+        _, ds = tiny_dataset
+
+        cfg_full = small_config(tmp_path / "full", num_epochs=4)
+        tr_full = Trainer(cfg_full, ds, logger=MetricsLogger(echo=False))
+        _, out_full = tr_full.fit()
+
+        cfg_half = small_config(tmp_path / "half", num_epochs=4)
+        tr_half = Trainer(cfg_half, ds, logger=MetricsLogger(echo=False))
+        tr_half.fit(num_epochs=2)
+        tr_half2 = Trainer(cfg_half, ds, logger=MetricsLogger(echo=False))
+        _, out_resumed = tr_half2.fit(resume=True)
+
+        full_losses = [h["train_loss"] for h in out_full["history"]]
+        resumed = {h["epoch"]: h["train_loss"] for h in out_resumed["history"]}
+        assert set(resumed) == {2, 3}
+        np.testing.assert_allclose(
+            [full_losses[2], full_losses[3]], [resumed[2], resumed[3]], rtol=1e-4
+        )
+
+    def test_best_params_exported(self, tiny_dataset, tmp_path):
+        _, ds = tiny_dataset
+        cfg = small_config(tmp_path)
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state, _ = tr.fit()
+        import os
+
+        assert os.path.isdir(os.path.join(str(tmp_path), cfg.checkpoint_name()))
+        from factorvae_tpu.train import load_params
+
+        params = load_params(
+            os.path.join(str(tmp_path), cfg.checkpoint_name()), state.params
+        )
+        chex_like = jax.tree_util.tree_structure(params)
+        assert chex_like == jax.tree_util.tree_structure(state.params)
