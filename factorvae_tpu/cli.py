@@ -23,28 +23,28 @@ from factorvae_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, Tr
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="Train a FactorVAE model on stock data (TPU-native)")
     # --- reference flags (main.py:92-113) ---
-    p.add_argument("--num_epochs", type=int, default=30)
-    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--num_epochs", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
     p.add_argument("--num_latent", type=int, default=158,
                    help="number of input features C (reference --num_latent)")
     p.add_argument("--num_portfolio", type=int, default=128)
     p.add_argument("--seq_len", type=int, default=20)
     p.add_argument("--num_factor", type=int, default=96)
     p.add_argument("--hidden_size", type=int, default=64)
-    p.add_argument("--dataset", type=str, default="./data/csi_data.pkl")
-    p.add_argument("--start_time", type=str, default="2009-01-01")
-    p.add_argument("--fit_end_time", type=str, default="2017-12-31")
-    p.add_argument("--val_start_time", type=str, default="2018-01-01")
-    p.add_argument("--val_end_time", type=str, default="2018-12-31")
-    p.add_argument("--end_time", type=str, default="2020-12-31")
-    p.add_argument("--seed", type=int, default=42)
-    p.add_argument("--run_name", type=str, default="VAE-Revision2")
-    p.add_argument("--save_dir", type=str, default="./best_models")
+    p.add_argument("--dataset", type=str, default=None)
+    p.add_argument("--start_time", type=str, default=None)
+    p.add_argument("--fit_end_time", type=str, default=None)
+    p.add_argument("--val_start_time", type=str, default=None)
+    p.add_argument("--val_end_time", type=str, default=None)
+    p.add_argument("--end_time", type=str, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--run_name", type=str, default=None)
+    p.add_argument("--save_dir", type=str, default=None)
     p.add_argument("--num_workers", type=int, default=4,
                    help="accepted for reference parity; unused (no loader workers)")
     p.add_argument("--wandb", action="store_true")
     # --- TPU-framework extensions ---
-    p.add_argument("--days_per_step", type=int, default=1,
+    p.add_argument("--days_per_step", type=int, default=None,
                    help="days whose grads are averaged per update (1 = reference-faithful)")
     p.add_argument("--mesh", action="store_true",
                    help="shard over all visible devices (data x stock mesh)")
@@ -65,10 +65,68 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stochastic_scores", action="store_true",
                    help="sample at inference like the reference (module.py:123)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--preset", type=str, default=None,
+                   help="named config preset (see factorvae_tpu.presets). The "
+                        "preset fixes the model architecture; explicitly "
+                        "passed data/training flags (--dataset, date ranges, "
+                        "--num_epochs, --lr, --seed, --run_name, --save_dir, "
+                        "--days_per_step, --wandb) override its values")
+    p.add_argument("--profile", type=str, default=None,
+                   help="capture a jax.profiler trace of training into this dir")
     return p
 
 
+# Reference CLI defaults (main.py:92-113), applied when a flag is neither
+# passed explicitly nor supplied by a preset. Flags that may override a
+# preset use default=None sentinels in build_parser.
+_DEFAULTS = dict(
+    num_epochs=30, lr=1e-4, dataset="./data/csi_data.pkl",
+    start_time="2009-01-01", fit_end_time="2017-12-31",
+    val_start_time="2018-01-01", val_end_time="2018-12-31",
+    end_time="2020-12-31", seed=42, run_name="VAE-Revision2",
+    save_dir="./best_models", days_per_step=1,
+)
+
+
 def config_from_args(args: argparse.Namespace) -> Config:
+    import dataclasses
+
+    def resolve(name, preset_value=None):
+        """Explicit flag > preset value > reference default."""
+        v = getattr(args, name)
+        if v is not None:
+            return v
+        return preset_value if preset_value is not None else _DEFAULTS[name]
+
+    if args.preset:
+        from factorvae_tpu.presets import get_preset
+
+        try:
+            cfg = get_preset(args.preset)
+        except KeyError as e:
+            raise SystemExit(f"error: {e.args[0]}")
+        return dataclasses.replace(
+            cfg,
+            data=dataclasses.replace(
+                cfg.data,
+                dataset_path=resolve("dataset", cfg.data.dataset_path),
+                start_time=resolve("start_time", cfg.data.start_time),
+                fit_end_time=resolve("fit_end_time", cfg.data.fit_end_time),
+                val_start_time=resolve("val_start_time", cfg.data.val_start_time),
+                val_end_time=resolve("val_end_time", cfg.data.val_end_time),
+                end_time=resolve("end_time", cfg.data.end_time),
+            ),
+            train=dataclasses.replace(
+                cfg.train,
+                num_epochs=resolve("num_epochs", cfg.train.num_epochs),
+                lr=resolve("lr", cfg.train.lr),
+                seed=resolve("seed", cfg.train.seed),
+                run_name=resolve("run_name", cfg.train.run_name),
+                save_dir=resolve("save_dir", cfg.train.save_dir),
+                days_per_step=resolve("days_per_step", cfg.train.days_per_step),
+                wandb=args.wandb,
+            ),
+        )
     return Config(
         model=ModelConfig(
             num_features=args.num_latent,
@@ -81,22 +139,22 @@ def config_from_args(args: argparse.Namespace) -> Config:
             stochastic_inference=bool(args.stochastic_scores),
         ),
         data=DataConfig(
-            dataset_path=args.dataset,
-            start_time=args.start_time,
-            fit_end_time=args.fit_end_time,
-            val_start_time=args.val_start_time,
-            val_end_time=args.val_end_time,
-            end_time=args.end_time,
+            dataset_path=resolve("dataset"),
+            start_time=resolve("start_time"),
+            fit_end_time=resolve("fit_end_time"),
+            val_start_time=resolve("val_start_time"),
+            val_end_time=resolve("val_end_time"),
+            end_time=resolve("end_time"),
             seq_len=args.seq_len,
             max_stocks=args.max_stocks,
         ),
         train=TrainConfig(
-            num_epochs=args.num_epochs,
-            lr=args.lr,
-            seed=args.seed,
-            days_per_step=args.days_per_step,
-            run_name=args.run_name,
-            save_dir=args.save_dir,
+            num_epochs=resolve("num_epochs"),
+            lr=resolve("lr"),
+            seed=resolve("seed"),
+            days_per_step=resolve("days_per_step"),
+            run_name=resolve("run_name"),
+            save_dir=resolve("save_dir"),
             wandb=args.wandb,
         ),
         mesh=MeshConfig(stock_axis=args.mesh_stock),
@@ -136,6 +194,14 @@ def main(argv=None) -> int:
         max_stocks=cfg.data.max_stocks,
         pad_multiple=cfg.data.pad_multiple,
     )
+    if dataset.panel.num_features != cfg.model.num_features:
+        print(
+            f"error: model expects {cfg.model.num_features} features "
+            f"(--num_latent/preset) but {cfg.data.dataset_path} has "
+            f"{dataset.panel.num_features}",
+            file=sys.stderr,
+        )
+        return 2
 
     if args.score_only:
         # Scoring needs no training split — build a param template
@@ -159,8 +225,23 @@ def main(argv=None) -> int:
             return 2
         params = load_params(path, template)
     else:
-        trainer = Trainer(cfg, dataset, logger=logger, use_mesh=args.mesh)
-        state, _ = trainer.fit(resume=args.resume)
+        from factorvae_tpu.utils.profiling import trace
+
+        try:
+            trainer = Trainer(cfg, dataset, logger=logger, use_mesh=args.mesh)
+        except ValueError as e:
+            if "empty training split" in str(e):
+                print(
+                    f"error: no trading days in [{cfg.data.start_time}, "
+                    f"{cfg.data.fit_end_time}] — the dataset covers "
+                    f"[{dataset.dates[0].date()}, {dataset.dates[-1].date()}]; "
+                    f"adjust --start_time/--fit_end_time",
+                    file=sys.stderr,
+                )
+                return 2
+            raise
+        with trace(args.profile):
+            state, _ = trainer.fit(resume=args.resume)
         # Score with the best-validation weights (what the reference's
         # backtest loads, backtest.ipynb cell 2), not the final step.
         best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())

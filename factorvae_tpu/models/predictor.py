@@ -43,20 +43,32 @@ class FactorPredictor(nn.Module):
         w_val = self.param("value_kernel", init, (k, h, h))
         b_val = self.param("value_bias", init, (k, h))
 
-        # All K per-head Linears at once: (N,H) x (K,H,H) -> (K,N,H).
-        keys = jnp.einsum("nh,khj->knj", latent, w_key) + b_key[:, None, :]
-        values = jnp.einsum("nh,khj->knj", latent, w_val) + b_val[:, None, :]
+        if cfg.use_pallas_attention and not train:
+            # Fused Pallas kernel (inference path): never materializes the
+            # (K, N, H) key/value stacks in HBM. Dropout is inactive here
+            # (train=False), so the math is identical to the XLA path.
+            from factorvae_tpu.ops.pallas.attention import (
+                multihead_cross_section_attention,
+            )
 
-        scores = jnp.einsum("kh,knh->kn", query, keys)
-        scores = scores / jnp.sqrt(jnp.float32(h) + 1e-6)       # module.py:142
-        scores = nn.Dropout(cfg.dropout_rate)(scores, deterministic=not train)
-        scores = nn.relu(scores)                                # module.py:145
-        attn = masked_softmax(scores, mask[None, :], axis=-1)   # module.py:146
+            context = multihead_cross_section_attention(
+                latent, mask, query, w_key, b_key, w_val, b_val
+            )
+        else:
+            # All K per-head Linears at once: (N,H) x (K,H,H) -> (K,N,H).
+            keys = jnp.einsum("nh,khj->knj", latent, w_key) + b_key[:, None, :]
+            values = jnp.einsum("nh,khj->knj", latent, w_val) + b_val[:, None, :]
 
-        # Per-head NaN/Inf guard -> zero context (module.py:149-150).
-        bad = jnp.any(~jnp.isfinite(attn), axis=-1, keepdims=True)
-        attn = jnp.where(bad, 0.0, attn)
-        context = jnp.einsum("kn,knh->kh", attn, values)        # (K, H)
+            scores = jnp.einsum("kh,knh->kn", query, keys)
+            scores = scores / jnp.sqrt(jnp.float32(h) + 1e-6)   # module.py:142
+            scores = nn.Dropout(cfg.dropout_rate)(scores, deterministic=not train)
+            scores = nn.relu(scores)                            # module.py:145
+            attn = masked_softmax(scores, mask[None, :], axis=-1)  # module.py:146
+
+            # Per-head NaN/Inf guard -> zero context (module.py:149-150).
+            bad = jnp.any(~jnp.isfinite(attn), axis=-1, keepdims=True)
+            attn = jnp.where(bad, 0.0, attn)
+            context = jnp.einsum("kn,knh->kh", attn, values)    # (K, H)
 
         h_multi = Dense(h, torch_init=cfg.torch_init, name="proj")(context)
         h_multi = nn.leaky_relu(h_multi, negative_slope=cfg.leaky_relu_slope)

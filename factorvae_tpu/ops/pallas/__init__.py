@@ -1,0 +1,3 @@
+from factorvae_tpu.ops.pallas.attention import multihead_cross_section_attention
+
+__all__ = ["multihead_cross_section_attention"]

@@ -1,0 +1,104 @@
+"""Fused K-head cross-section attention as a Pallas TPU kernel.
+
+The FactorPredictor's hot op (reference module.py:134-153 per head,
+module.py:172-178 looped K times): for each of K heads,
+
+    key_k   = latent @ Wk[k] + bk[k]            (N, H)
+    value_k = latent @ Wv[k] + bv[k]            (N, H)
+    s_k     = key_k @ q[k] / sqrt(H + 1e-6)     (N,)
+    a_k     = masked_softmax(relu(s_k))         (N,)   [quirk order kept]
+    ctx_k   = a_k @ value_k                     (H,)
+
+The XLA path (models/predictor.py) materializes the (K, N, H) key/value
+stacks in HBM (e.g. K=96, N=360, H=64 -> 2 x 8.8 MB per day per
+direction). This kernel blocks over heads: each grid step loads only the
+shared (N, H) latent (resident across steps) plus one head's (H, H)
+weights, computes everything in VMEM, and writes just the (1, H) context
+— the intermediate stacks never touch HBM.
+
+Inference-path only for now (no dropout, no custom VJP); selected via
+``ModelConfig.use_pallas_attention``. The softmax here is the masked
+variant with the reference's NaN guard semantics folded in (a fully
+masked or non-finite row yields a zero context).
+"""
+
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _head_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
+                 out_ref):
+    """One head per grid step. latent: (N, H), maskf: (1, N) float {0,1},
+    q/bk/bv: (1, H), wk/wv: (H, H), out: (1, H)."""
+    latent = latent_ref[:]                                   # (N, H)
+    maskf = maskf_ref[0, :]                                  # (N,)
+    key = jnp.dot(latent, wk_ref[0], preferred_element_type=jnp.float32)
+    key = key + bk_ref[0, :][None, :]
+    h_dim = key.shape[1]
+    scores = jnp.dot(key, q_ref[0, :][:, None],
+                     preferred_element_type=jnp.float32)[:, 0]  # (N,)
+    scores = scores / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
+    scores = jnp.maximum(scores, 0.0)                        # ReLU (module.py:145)
+    scores = jnp.where(maskf > 0, scores, _NEG_INF)
+    m = jnp.max(scores)
+    ex = jnp.where(maskf > 0, jnp.exp(scores - m), 0.0)
+    denom = jnp.sum(ex)
+    attn = jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
+    value = jnp.dot(latent, wv_ref[0], preferred_element_type=jnp.float32)
+    value = value + bv_ref[0, :][None, :]
+    out_ref[0, :] = jnp.dot(attn[None, :], value,
+                            preferred_element_type=jnp.float32)[0]
+
+
+def multihead_cross_section_attention(
+    latent: jnp.ndarray,   # (N, H)
+    mask: jnp.ndarray,     # (N,) bool
+    query: jnp.ndarray,    # (K, H)
+    w_key: jnp.ndarray,    # (K, H, H)
+    b_key: jnp.ndarray,    # (K, H)
+    w_val: jnp.ndarray,    # (K, H, H)
+    b_val: jnp.ndarray,    # (K, H)
+    interpret: bool = None,
+) -> jnp.ndarray:
+    """Returns the (K, H) context stack (reference h_multi, module.py:178).
+
+    interpret=None auto-selects the Pallas interpreter off-TPU (the CPU
+    test rig), the compiled kernel on TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, h = latent.shape
+    k = query.shape[0]
+    maskf = mask.astype(jnp.float32)[None, :]                # (1, N)
+    grid = (k,)
+    return pl.pallas_call(
+        _head_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, h), jnp.float32),
+        interpret=interpret,
+    )(
+        latent.astype(jnp.float32),
+        maskf,
+        query.astype(jnp.float32),
+        w_key.astype(jnp.float32),
+        b_key.astype(jnp.float32),
+        w_val.astype(jnp.float32),
+        b_val.astype(jnp.float32),
+    )

@@ -1,0 +1,75 @@
+"""Explicit-collective implementations of the cross-stock reductions.
+
+The GSPMD path (sharding.py) lets XLA insert collectives automatically.
+These are the same ops written *explicitly* against a named mesh axis for
+use under `jax.shard_map` — the framework's hand-built distributed
+communication layer (the TPU-native analogue of a NCCL allreduce library;
+the reference has no distributed layer at all, SURVEY.md §2.3). They ride
+ICI within a slice and DCN across slices, as laid out by the mesh.
+
+Every cross-stock reduction in the model family is covered:
+  - `pmax_masked_softmax` — the stock-axis softmaxes (reference
+    module.py:38,57,146): global max via `lax.pmax`, global denominator
+    via `lax.psum`.
+  - `psum_matvec` — the portfolio aggregation W^T y (module.py:64):
+    shard-local partial products, `lax.psum` across shards.
+  - `psum_masked_mean` — masked loss means over the sharded cross-section.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def pmax_masked_softmax(
+    x: jnp.ndarray, mask: jnp.ndarray, axis_name: str, axis: int = 0
+) -> jnp.ndarray:
+    """Masked softmax over an axis that is sharded across `axis_name`.
+
+    x, mask are the shard-local slices; the result equals the unsharded
+    `ops.masked.masked_softmax` on the gathered array.
+    """
+    mask = jnp.broadcast_to(mask, x.shape)
+    x = jnp.where(mask, x, _NEG_INF)
+    local_max = jnp.max(x, axis=axis, keepdims=True)
+    global_max = lax.pmax(local_max, axis_name)
+    ex = jnp.where(mask, jnp.exp(x - global_max), 0.0)
+    local_denom = jnp.sum(ex, axis=axis, keepdims=True)
+    denom = lax.psum(local_denom, axis_name)
+    return jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def psum_matvec(
+    weights: jnp.ndarray, vec: jnp.ndarray, axis_name: str
+) -> jnp.ndarray:
+    """(N_local, M)^T @ (N_local,) summed over all shards -> (M,) replicated.
+
+    The distributed portfolio-return reduction (module.py:64 semantics)."""
+    partial = weights.T @ vec
+    return lax.psum(partial, axis_name)
+
+
+def psum_masked_mean(
+    x: jnp.ndarray, mask: jnp.ndarray, axis_name: str
+) -> jnp.ndarray:
+    """Masked mean over a fully sharded array -> replicated scalar."""
+    mask = jnp.broadcast_to(mask, x.shape)
+    total = lax.psum(jnp.sum(jnp.where(mask, x, 0.0)), axis_name)
+    count = lax.psum(jnp.sum(mask.astype(x.dtype)), axis_name)
+    return jnp.where(count > 0, total / jnp.maximum(count, 1.0), 0.0)
+
+
+def psum_masked_mse(
+    pred: jnp.ndarray, target: jnp.ndarray, mask: jnp.ndarray, axis_name: str
+) -> jnp.ndarray:
+    return psum_masked_mean((pred - target) ** 2, mask, axis_name)
+
+
+def all_gather_stocks(x: jnp.ndarray, axis_name: str, axis: int = 0) -> jnp.ndarray:
+    """Gather the sharded stock axis (e.g. to export full cross-section
+    scores from a sharded prediction step)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)

@@ -1,3 +1,14 @@
+from factorvae_tpu.utils.logging import MetricsLogger
+from factorvae_tpu.utils.profiling import debug_nans, step_annotation, trace
+from factorvae_tpu.utils.rng import set_seed
 from factorvae_tpu.utils.testing import force_host_devices, host_device_count
 
-__all__ = ["force_host_devices", "host_device_count"]
+__all__ = [
+    "MetricsLogger",
+    "debug_nans",
+    "force_host_devices",
+    "host_device_count",
+    "set_seed",
+    "step_annotation",
+    "trace",
+]

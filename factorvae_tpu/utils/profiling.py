@@ -1,0 +1,47 @@
+"""Tracing / profiling utilities.
+
+The reference has no tracing at all — only tqdm progress bars
+(SURVEY.md §5 "Tracing/profiling"). Here: `jax.profiler` trace capture
+around training epochs (viewable in TensorBoard / Perfetto), named step
+annotations, and a NaN-debug mode replacing the reference's scattered
+runtime NaN guards (module.py:149-150) with a framework-level switch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a device trace into `log_dir` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(name: str):
+    """Named region that shows up on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True) -> Iterator[None]:
+    """Raise on any NaN produced inside jitted code while active — the
+    debugging replacement for the reference's silent NaN guards."""
+    old = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", old)

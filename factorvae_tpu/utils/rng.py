@@ -1,0 +1,24 @@
+"""Seeding.
+
+Reference parity for `set_seed` (utils.py:10-17) minus the CUDA/cudnn
+knobs, which have no TPU analogue: JAX computation is deterministic by
+construction because all randomness flows through explicit `jax.random`
+keys threaded by the trainer (SURVEY.md §5 "Race detection"). The host
+seeds only affect host-side numpy/python use (e.g. day-order shuffles use
+their own seeded Generators and don't depend on these globals).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+import jax
+
+
+def set_seed(seed: int) -> jax.Array:
+    """Seed host RNGs and return the root jax PRNG key."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)

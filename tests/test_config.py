@@ -1,0 +1,48 @@
+"""Config serialization, presets, naming schemes."""
+
+import json
+
+from factorvae_tpu.config import Config, ModelConfig
+from factorvae_tpu.presets import PRESETS, get_preset
+
+
+def test_json_roundtrip():
+    cfg = Config()
+    back = Config.from_json(cfg.to_json())
+    assert back == cfg
+
+
+def test_checkpoint_and_score_names():
+    cfg = Config()
+    assert cfg.checkpoint_name() == "VAE-Revision2_factor_96_hdn_64_port_128_seed_42"
+    assert cfg.score_name() == "VAE-Revision2_96_True_None_158_64"
+
+
+def test_presets_cover_baseline_configs():
+    assert set(PRESETS) >= {
+        "flagship", "csi300-k20", "csi300-k48", "csi300-k60",
+        "csi800-k60", "alpha360-k60",
+    }
+    k20 = get_preset("csi300-k20")
+    assert k20.model.num_factors == 20 and k20.model.hidden_size == 20
+    a360 = get_preset("alpha360-k60")
+    assert a360.model.num_features == 360 and a360.model.seq_len == 60
+    csi800 = get_preset("csi800-k60")
+    assert csi800.data.max_stocks == 1024
+
+
+def test_from_dict_ignores_unknown_keys():
+    d = json.loads(Config().to_json())
+    d["model"]["bogus_future_field"] = 1
+    cfg = Config.from_dict(d)
+    assert isinstance(cfg.model, ModelConfig)
+
+
+def test_mesh_shape_validation():
+    import pytest
+    from factorvae_tpu.config import MeshConfig
+
+    assert MeshConfig(stock_axis=2).shape(8) == (4, 2)
+    assert MeshConfig().shape(1) == (1, 1)
+    with pytest.raises(ValueError):
+        MeshConfig(stock_axis=3).shape(8)
