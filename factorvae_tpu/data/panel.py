@@ -109,8 +109,13 @@ def build_panel(df: pd.DataFrame) -> Panel:
     rows = date_pos.loc[df.index.get_level_values(0)].to_numpy()
     cols = inst_pos.loc[df.index.get_level_values(1)].to_numpy()
 
-    values = np.full((i, d, c), np.nan, dtype=np.float32)
-    values[cols, rows] = df.to_numpy(dtype=np.float32)
+    from factorvae_tpu import native
+
+    data = df.to_numpy(dtype=np.float32)
+    values = native.scatter_panel(data, rows, cols, d, i)
+    if values is None:
+        values = np.full((i, d, c), np.nan, dtype=np.float32)
+        values[cols, rows] = data
     valid = np.zeros((d, i), dtype=bool)
     valid[rows, cols] = True
     return Panel(

@@ -32,7 +32,15 @@ def compute_fill_maps(valid: np.ndarray):
 
     last_valid[d,i] is the largest d' <= d with valid[d',i] (-1 if none);
     next_valid[d,i] the smallest d' >= d (D if none).
+
+    Uses the native C++ pass (factorvae_tpu/native) when available;
+    numpy otherwise (identical outputs, tested against each other).
     """
+    from factorvae_tpu import native
+
+    nat = native.fill_maps(np.asarray(valid))
+    if nat is not None:
+        return nat
     d, i = valid.shape
     idx = np.arange(d, dtype=np.int32)[:, None]
     last_valid = np.maximum.accumulate(np.where(valid, idx, -1), axis=0)

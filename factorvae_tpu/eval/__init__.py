@@ -1,15 +1,20 @@
+from factorvae_tpu.eval.backtest import BacktestResult, topk_dropout_backtest
 from factorvae_tpu.eval.metrics import RankIC, daily_rank_ic, rank_ic_frame
 from factorvae_tpu.eval.predict import (
     export_scores,
     generate_prediction_scores,
     predict_panel,
 )
+from factorvae_tpu.eval.sweep import seed_sweep
 
 __all__ = [
+    "BacktestResult",
     "RankIC",
     "daily_rank_ic",
     "export_scores",
     "generate_prediction_scores",
     "predict_panel",
     "rank_ic_frame",
+    "seed_sweep",
+    "topk_dropout_backtest",
 ]

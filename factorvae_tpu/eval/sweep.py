@@ -1,0 +1,66 @@
+"""Multi-seed Rank-IC sweep harness.
+
+Bitwise RNG parity with the torch reference is impossible (different
+PRNGs), so parity is *statistical*: the same Rank-IC within tolerance
+across seeds (SURVEY.md §7 hard-part 3). This harness trains S seeds of a
+config, scores each deterministically, and reports per-seed Rank-IC plus
+the mean ± std the parity comparison needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from factorvae_tpu.config import Config
+from factorvae_tpu.data.loader import PanelDataset
+from factorvae_tpu.eval.metrics import rank_ic_frame
+from factorvae_tpu.eval.predict import generate_prediction_scores
+from factorvae_tpu.train.trainer import Trainer
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+def seed_sweep(
+    config: Config,
+    dataset: PanelDataset,
+    seeds: Sequence[int],
+    score_start: Optional[str] = None,
+    score_end: Optional[str] = None,
+    logger: Optional[MetricsLogger] = None,
+) -> pd.DataFrame:
+    """Returns a frame indexed by seed with columns
+    [rank_ic, rank_ic_ir, best_val]; .attrs['summary'] holds mean/std."""
+    logger = logger or MetricsLogger(echo=False)
+    records = []
+    for seed in seeds:
+        cfg = dataclasses.replace(
+            config, train=dataclasses.replace(config.train, seed=int(seed))
+        )
+        trainer = Trainer(cfg, dataset, logger=logger)
+        state, out = trainer.fit()
+        scores = generate_prediction_scores(
+            state.params, cfg, dataset, start=score_start, end=score_end,
+            stochastic=False, with_labels=True,
+        )
+        ic = rank_ic_frame(scores.dropna(), "LABEL0", "score")
+        rec = {
+            "seed": int(seed),
+            "rank_ic": float(ic["RankIC"].iloc[0]),
+            "rank_ic_ir": float(ic["RankIC_IR"].iloc[0]),
+            "best_val": float(out["best_val"]),
+        }
+        records.append(rec)
+        logger.log("sweep_seed", **rec)
+
+    df = pd.DataFrame(records).set_index("seed")
+    df.attrs["summary"] = {
+        "rank_ic_mean": float(df["rank_ic"].mean()),
+        "rank_ic_std": float(df["rank_ic"].std(ddof=0)),
+        "rank_ic_ir_mean": float(df["rank_ic_ir"].mean()),
+        "num_seeds": len(df),
+    }
+    logger.log("sweep_summary", **df.attrs["summary"])
+    return df

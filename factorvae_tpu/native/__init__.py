@@ -1,0 +1,113 @@
+"""ctypes binding for the native panel ops, with transparent fallback.
+
+Compiles panelops.cpp with g++ on first use (cached as _build/panelops.so
+next to this file); if no compiler is available — or
+``FACTORVAE_NATIVE=0`` is set — callers get ``None`` from `load()` and
+use their numpy fallbacks. No pybind11 (not in the image); the ABI is
+plain C (see panelops.cpp header comment).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "panelops.cpp")
+_SO = os.path.join(_DIR, "_build", "panelops.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # compile to a process-unique temp path and rename atomically so a
+    # concurrent first-use in another process never dlopens a half-written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded library, or None if unavailable/disabled."""
+    global _lib, _tried
+    if os.environ.get("FACTORVAE_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _compile():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.fill_maps.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.scatter_panel.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+        return _lib
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def fill_maps(valid: np.ndarray):
+    """Native last_valid/next_valid (see windows.compute_fill_maps for the
+    semantics and the numpy fallback). Returns None if native is off."""
+    lib = load()
+    if lib is None:
+        return None
+    d, i = valid.shape
+    v = np.ascontiguousarray(valid, dtype=np.uint8)
+    last = np.empty((d, i), np.int32)
+    nxt = np.empty((d, i), np.int32)
+    lib.fill_maps(
+        _ptr(v, ctypes.c_uint8), d, i,
+        _ptr(last, ctypes.c_int32), _ptr(nxt, ctypes.c_int32),
+    )
+    return last, nxt
+
+
+def scatter_panel(values: np.ndarray, rows: np.ndarray, cols: np.ndarray,
+                  d_total: int, n_inst: int):
+    """Native COO -> dense (I, D, C) scatter with NaN background.
+    Returns None if native is off."""
+    lib = load()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    cols = np.ascontiguousarray(cols, dtype=np.int64)
+    n_rows, c = values.shape
+    out = np.full((n_inst, d_total, c), np.nan, np.float32)
+    lib.scatter_panel(
+        _ptr(values, ctypes.c_float), _ptr(rows, ctypes.c_int64),
+        _ptr(cols, ctypes.c_int64), n_rows, d_total, c,
+        _ptr(out, ctypes.c_float),
+    )
+    return out

@@ -1,0 +1,50 @@
+// Native panel ops for the host-side data pipeline.
+//
+// The reference's data layer is pure pandas/python (dataset.py:41-184);
+// this framework's host-side preprocessing is numpy-vectorized already,
+// but the two hot O(D*I) index passes — the ffill/bfill fill maps
+// (windows.py) and the COO->dense panel scatter (panel.py) — are also
+// provided natively for large panels (CSI800 x 20y x Alpha360). Built as
+// a plain shared object, bound via ctypes (no pybind11 dependency);
+// factorvae_tpu/native/__init__.py compiles it on first use and falls
+// back to the numpy implementations when no compiler is available.
+//
+// Layout contracts (all row-major, C-contiguous):
+//   valid:       (D, I) uint8
+//   last_valid:  (D, I) int32   largest d' <= d with valid[d',i], else -1
+//   next_valid:  (D, I) int32   smallest d' >= d with valid[d',i], else D
+//   scatter: values (n_rows, C) float32 -> out (I, D, C) float32 at
+//            (cols[k], rows[k], :); out must be pre-filled with NaN.
+
+#include <cstdint>
+
+extern "C" {
+
+void fill_maps(const uint8_t* valid, int64_t d_total, int64_t n_inst,
+               int32_t* last_valid, int32_t* next_valid) {
+  for (int64_t i = 0; i < n_inst; ++i) {
+    int32_t last = -1;
+    for (int64_t d = 0; d < d_total; ++d) {
+      if (valid[d * n_inst + i]) last = static_cast<int32_t>(d);
+      last_valid[d * n_inst + i] = last;
+    }
+    int32_t next = static_cast<int32_t>(d_total);
+    for (int64_t d = d_total - 1; d >= 0; --d) {
+      if (valid[d * n_inst + i]) next = static_cast<int32_t>(d);
+      next_valid[d * n_inst + i] = next;
+    }
+  }
+}
+
+void scatter_panel(const float* values, const int64_t* rows,
+                   const int64_t* cols, int64_t n_rows, int64_t d_total,
+                   int64_t n_cols_panel, float* out) {
+  // out: (I, D, C); values: (n_rows, C); (rows[k]=day, cols[k]=instrument)
+  for (int64_t k = 0; k < n_rows; ++k) {
+    const float* src = values + k * n_cols_panel;
+    float* dst = out + (cols[k] * d_total + rows[k]) * n_cols_panel;
+    for (int64_t c = 0; c < n_cols_panel; ++c) dst[c] = src[c];
+  }
+}
+
+}  // extern "C"

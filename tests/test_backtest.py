@@ -1,0 +1,122 @@
+"""Backtest simulator tests: hand-computable cases + invariants."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from factorvae_tpu.eval.backtest import topk_dropout_backtest
+
+
+def make_scores(num_days=6, num_inst=8, seed=0, perfect=False):
+    rng = np.random.default_rng(seed)
+    dates = pd.bdate_range("2020-01-01", periods=num_days)
+    rows, sc, lb = [], [], []
+    for d in dates:
+        for k in range(num_inst):
+            rows.append((d, f"I{k}"))
+            label = float(rng.normal(0, 0.02))
+            lb.append(label)
+            sc.append(label if perfect else float(rng.normal()))
+    idx = pd.MultiIndex.from_tuples(rows, names=["datetime", "instrument"])
+    return pd.DataFrame({"score": sc, "LABEL0": lb}, index=idx)
+
+
+class TestTopkDropout:
+    def test_perfect_foresight_beats_random(self):
+        perfect = make_scores(num_days=40, num_inst=20, seed=1, perfect=True)
+        random_ = make_scores(num_days=40, num_inst=20, seed=1, perfect=False)
+        rp = topk_dropout_backtest(perfect, topk=5, n_drop=5, open_cost=0,
+                                   close_cost=0)
+        rr = topk_dropout_backtest(random_, topk=5, n_drop=5, open_cost=0,
+                                   close_cost=0)
+        assert rp.cumulative_return > rr.cumulative_return
+
+    def test_hand_computed_two_days(self):
+        """Day 1: buy top-2. Day 2: n_drop=1 swaps the worst holding."""
+        dates = pd.bdate_range("2020-01-01", periods=2)
+        idx = pd.MultiIndex.from_tuples(
+            [(d, i) for d in dates for i in ["A", "B", "C"]],
+            names=["datetime", "instrument"],
+        )
+        df = pd.DataFrame(
+            {
+                #        A1   B1   C1   A2   B2   C2
+                "score":  [3,   2,   1,   1,   2,   3],
+                "LABEL0": [0.1, 0.2, 0.3, 0.3, 0.2, 0.1],
+            },
+            index=idx[[0, 1, 2, 3, 4, 5]],
+        )
+        r = topk_dropout_backtest(df, topk=2, n_drop=1, open_cost=0.01,
+                                  close_cost=0.02)
+        # day1: buy {A,B}: gross=(0.1+0.2)/2=0.15; buys=2, sells=0
+        #   cost = 2*0.01/2 = 0.01 -> net 0.14
+        # day2: ranked C>B>A; drop worst held (A), add C -> {B,C}
+        #   gross=(0.2+0.1)/2=0.15; buys=1, sells=1
+        #   cost = (0.01 + 0.02)/2 = 0.015 -> net 0.135
+        np.testing.assert_allclose(r.daily_return.values, [0.14, 0.135], rtol=1e-9)
+        np.testing.assert_allclose(r.daily_return_wo_cost.values, [0.15, 0.15],
+                                   rtol=1e-9)
+        np.testing.assert_allclose(r.turnover.values, [1.0, 0.5], rtol=1e-9)
+
+    def test_n_drop_limits_turnover(self):
+        df = make_scores(num_days=30, num_inst=30, seed=2)
+        r = topk_dropout_backtest(df, topk=10, n_drop=2, open_cost=0, close_cost=0)
+        # after the initial buy-in, per-day turnover <= n_drop/topk
+        assert (r.turnover.iloc[1:] <= 0.2 + 1e-9).all()
+
+    def test_costs_reduce_returns(self):
+        df = make_scores(num_days=30, num_inst=20, seed=3)
+        free = topk_dropout_backtest(df, topk=5, n_drop=3, open_cost=0, close_cost=0)
+        costly = topk_dropout_backtest(df, topk=5, n_drop=3)
+        assert costly.cumulative_return < free.cumulative_return
+        np.testing.assert_allclose(
+            costly.cumulative_return_wo_cost, free.cumulative_return_wo_cost,
+            rtol=1e-12,
+        )
+
+    def test_benchmark_excess(self):
+        df = make_scores(num_days=10, num_inst=10, seed=4)
+        bench = pd.Series(
+            0.001, index=df.index.get_level_values(0).unique().sort_values()
+        )
+        r = topk_dropout_backtest(df, topk=3, n_drop=1, benchmark=bench)
+        assert r.excess_return is not None
+        bench_cum = (1.001) ** 10 - 1
+        np.testing.assert_allclose(
+            r.excess_return, r.cumulative_return - bench_cum, rtol=1e-9
+        )
+
+    def test_max_drawdown_negative_or_zero(self):
+        df = make_scores(num_days=25, num_inst=12, seed=5)
+        r = topk_dropout_backtest(df, topk=4, n_drop=2)
+        assert r.max_drawdown <= 0.0
+
+    def test_missing_instruments_handled(self):
+        """Held names can vanish from the universe (delisting); slots are
+        refilled without crashing."""
+        dates = pd.bdate_range("2020-01-01", periods=3)
+        rows = []
+        for i, d in enumerate(dates):
+            names = ["A", "B", "C", "D"] if i != 1 else ["C", "D"]
+            rows += [(d, n) for n in names]
+        idx = pd.MultiIndex.from_tuples(rows, names=["datetime", "instrument"])
+        rng = np.random.default_rng(0)
+        df = pd.DataFrame(
+            {"score": rng.normal(size=len(idx)), "LABEL0": rng.normal(size=len(idx))},
+            index=idx,
+        )
+        r = topk_dropout_backtest(df, topk=2, n_drop=1)
+        assert len(r.daily_return) == 3
+
+    def test_drawdown_from_inception(self):
+        """A first-day loss must count as drawdown from the initial capital."""
+        dates = pd.bdate_range("2020-01-01", periods=2)
+        idx = pd.MultiIndex.from_tuples(
+            [(d, i) for d in dates for i in ["A", "B"]],
+            names=["datetime", "instrument"],
+        )
+        df = pd.DataFrame(
+            {"score": [1, 2, 1, 2], "LABEL0": [-0.5, -0.5, 0.0, 0.0]}, index=idx
+        )
+        r = topk_dropout_backtest(df, topk=2, n_drop=0, open_cost=0, close_cost=0)
+        np.testing.assert_allclose(r.max_drawdown, -0.5, rtol=1e-9)

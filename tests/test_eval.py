@@ -133,3 +133,22 @@ class TestCLI:
         lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
         events = [pd.io.json.ujson_loads(l)["event"] for l in lines]
         assert "epoch" in events and "scores" in events
+
+
+class TestSeedSweep:
+    def test_two_seed_sweep(self, tmp_path):
+        from factorvae_tpu.data import PanelDataset, synthetic_panel
+        from factorvae_tpu.eval import seed_sweep
+
+        panel = synthetic_panel(num_days=14, num_instruments=6, num_features=8,
+                                missing_prob=0.0, seed=11)
+        ds = PanelDataset(panel, seq_len=4)
+        cfg = tiny_cfg(tmp_path, seq_len=4)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, data=dataclasses.replace(cfg.data, seq_len=4))
+        df = seed_sweep(cfg, ds, seeds=[0, 1])
+        assert list(df.index) == [0, 1]
+        assert np.isfinite(df["rank_ic"]).all()
+        assert df.attrs["summary"]["num_seeds"] == 2
+        # different seeds -> different models -> different ICs
+        assert df["rank_ic"].iloc[0] != df["rank_ic"].iloc[1]
