@@ -12,6 +12,25 @@ shapes.
 
 from __future__ import annotations
 
+if __name__ == "__main__":
+    # Plain-script invocation (`python factorvae_tpu/data/loader.py`):
+    # bootstrap the repo root onto sys.path and force host-CPU devices so
+    # the smoke entry below works in sandboxes whose TPU plugin pins
+    # jax_platforms (see utils/testing.py) — must happen before the
+    # package imports under this line.
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0,
+        _os.path.dirname(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        ),
+    )
+    from factorvae_tpu.utils.testing import force_host_devices as _fhd
+
+    _fhd(1)
+
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -121,3 +140,24 @@ class PanelDataset:
             for i in np.nonzero(self.valid[d])[0]:
                 tuples.append((self.dates[d], self.instruments[i]))
         return pd.MultiIndex.from_tuples(tuples, names=["datetime", "instrument"])
+
+
+if __name__ == "__main__":
+    # Smoke entry mirroring the reference's only runnable "test"
+    # (dataset.py:276-292): iterate a few day-batches and print shapes.
+    import sys
+
+    from factorvae_tpu.data.panel import build_panel, load_frame
+    from factorvae_tpu.data.synthetic import synthetic_frame
+
+    if len(sys.argv) > 1:
+        frame = load_frame(sys.argv[1])
+    else:
+        frame = synthetic_frame(num_days=12, num_instruments=8, num_features=6)
+    ds = PanelDataset(build_panel(frame), seq_len=5)
+    days = ds.split_days(None, None)
+    for d in list(days[:3]):
+        x, y, mask = ds.day_batch(int(d))
+        print(f"day {ds.dates[int(d)].date()}: x{tuple(x.shape)} "
+              f"y{tuple(y.shape)} valid={int(mask.sum())}/{ds.n_max}")
+    print("Done")

@@ -4,6 +4,7 @@ from factorvae_tpu.parallel.mesh import (
     make_mesh,
     single_device_mesh,
 )
+from factorvae_tpu.parallel.ring import ring_cross_section_attention
 from factorvae_tpu.parallel.sharding import (
     batch_sharding,
     make_batch_constraint,
@@ -22,6 +23,7 @@ __all__ = [
     "order_sharding",
     "panel_shardings",
     "replicated",
+    "ring_cross_section_attention",
     "shard_dataset",
     "single_device_mesh",
 ]

@@ -1,0 +1,91 @@
+"""Ring attention over the sharded stock cross-section.
+
+For this model family the "long axis" is the stock universe, not time
+(SURVEY.md §5: T=20-60 while N reaches ~800 on CSI800 and beyond on
+bigger universes), so the ring/context-parallel treatment applies to the
+cross-section: shard the N stocks over a mesh axis and compute the
+FactorPredictor's K-head attention (reference module.py:140-153
+semantics: scaled scores -> ReLU -> softmax over stocks -> weighted
+values) without ever gathering the full cross-section on one device.
+
+Mechanics (flash-attention-style online softmax around the ring):
+each device holds its local (n_local, H) key/value/mask chunk; the K
+query vectors are replicated. At every ring step a device computes the
+partial scores against its current chunk, folds them into running
+(max, denominator, weighted-accumulator) statistics with the usual
+rescaling, and passes the chunk to its ring neighbour via
+`lax.ppermute`. After `ring_size` steps every device holds the exact
+(K, H) context — identical (up to fp reassociation) to the dense masked
+softmax, which is what the test asserts.
+
+At CSI-scale N this is a teaching/validation path (one chip holds the
+whole cross-section easily); it becomes the real mechanism when the
+universe or feature width outgrows a single chip's HBM.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def ring_cross_section_attention(
+    query: jnp.ndarray,       # (K, H) replicated
+    key_local: jnp.ndarray,   # (n_local, H) this shard's keys
+    value_local: jnp.ndarray, # (n_local, H)
+    mask_local: jnp.ndarray,  # (n_local,) bool
+    axis_name: str,
+    relu_scores: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact masked softmax attention over the ring; returns (K, H).
+
+    relu_scores=True keeps the reference's quirky ReLU-before-softmax
+    (module.py:145); scale defaults to 1/sqrt(H + 1e-6) (module.py:142).
+    """
+    k_heads, h_dim = query.shape
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
+    ring_size = lax.psum(1, axis_name)
+    right = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def scores_for(chunk_k, chunk_mask):
+        s = (query @ chunk_k.T) * scale                      # (K, n_local)
+        if relu_scores:
+            s = jnp.maximum(s, 0.0)
+        return jnp.where(chunk_mask[None, :], s, _NEG_INF)
+
+    def fold(stats, ck, cv, cm):
+        m, l, acc = stats
+        s = scores_for(ck, cm)                               # (K, n)
+        chunk_max = jnp.max(s, axis=-1)                      # (K,)
+        m_new = jnp.maximum(m, chunk_max)
+        corr = jnp.exp(m - m_new)                            # rescale old stats
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(cm[None, :], p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + p @ cv               # (K, H)
+        return (m_new, l_new, acc_new)
+
+    def body(carry, _):
+        (ck, cv, cm), stats = carry
+        stats = fold(stats, ck, cv, cm)
+        ck = lax.ppermute(ck, axis_name, right)
+        cv = lax.ppermute(cv, axis_name, right)
+        cm = lax.ppermute(cm, axis_name, right)
+        return ((ck, cv, cm), stats), None
+
+    m0 = jnp.full((k_heads,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((k_heads,), jnp.float32)
+    acc0 = jnp.zeros((k_heads, h_dim), jnp.float32)
+    init = ((key_local, value_local, mask_local), (m0, l0, acc0))
+    # rotate only between folds: R-1 fold+rotate steps, final fold outside
+    ((ck, cv, cm), stats), _ = lax.scan(body, init, None, length=ring_size - 1)
+    m, l, acc = fold(stats, ck, cv, cm)
+    # fully-masked cross-section -> zero context (reference NaN-guard
+    # semantics, module.py:149-150)
+    safe = l > 0
+    return jnp.where(safe[:, None], acc / jnp.where(safe, l, 1.0)[:, None], 0.0)
