@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--recon_loss", choices=["mse", "nll"], default="mse",
                    help="mse = reference-faithful single-sample MSE; nll = Gaussian NLL")
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute dtype")
+    p.add_argument("--pallas", action="store_true",
+                   help="use the fused Pallas attention kernel on the "
+                        "inference path (ops/pallas/attention.py)")
     p.add_argument("--max_stocks", type=int, default=None,
                    help="cross-section padding N_max (default: inferred)")
     p.add_argument("--score_only", action="store_true",
@@ -137,6 +140,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             recon_loss=args.recon_loss,
             compute_dtype="bfloat16" if args.bf16 else "float32",
             stochastic_inference=bool(args.stochastic_scores),
+            use_pallas_attention=bool(args.pallas),
         ),
         data=DataConfig(
             dataset_path=resolve("dataset"),
@@ -204,26 +208,15 @@ def main(argv=None) -> int:
         return 2
 
     if args.score_only:
-        # Scoring needs no training split — build a param template
-        # directly (the analogue of reference utils.load_model,
-        # utils.py:57-67) and restore the best-val weights.
-        import jax
-        import jax.numpy as jnp
+        # Scoring needs no training split — restore the best-val weights
+        # through the model factory (reference utils.load_model analogue).
+        from factorvae_tpu.models.factorvae import load_model
 
-        from factorvae_tpu.models.factorvae import day_forward
-
-        model = day_forward(cfg.model, train=False)
-        key = jax.random.PRNGKey(cfg.train.seed)
-        x = jnp.zeros((1, dataset.n_max, cfg.data.seq_len, cfg.model.num_features))
-        template = model.init(
-            {"params": key, "sample": key, "dropout": key},
-            x, jnp.zeros((1, dataset.n_max)), jnp.ones((1, dataset.n_max), bool),
-        )
         path = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
         if not os.path.isdir(path):
             print(f"error: no checkpoint at {path}; train first", file=sys.stderr)
             return 2
-        params = load_params(path, template)
+        _, params = load_model(cfg, checkpoint_path=path, n_max=dataset.n_max)
     else:
         from factorvae_tpu.utils.profiling import trace
 

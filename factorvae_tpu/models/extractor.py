@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from factorvae_tpu.config import ModelConfig
-from factorvae_tpu.models.layers import GRU, Dense, layer_norm
+from factorvae_tpu.models.layers import GRU, Dense, StackedGRU, layer_norm
 
 
 class FeatureExtractor(nn.Module):
@@ -33,7 +33,20 @@ class FeatureExtractor(nn.Module):
             cfg.num_features, torch_init=cfg.torch_init, dtype=dtype, name="proj"
         )(x)                                                 # module.py:27
         x = nn.leaky_relu(x, negative_slope=cfg.leaky_relu_slope)  # module.py:28
-        latent = GRU(
-            cfg.hidden_size, torch_init=cfg.torch_init, dtype=dtype, name="gru"
-        )(x)                                                 # module.py:30-31
+        # Single-layer (the reference default, module.py:20) keeps the flat
+        # gru/{input_proj,hidden_kernel,hidden_bias} param layout so
+        # existing checkpoints restore unchanged; L>1 nests per-layer.
+        if cfg.gru_layers == 1:
+            gru = GRU(
+                cfg.hidden_size, torch_init=cfg.torch_init, dtype=dtype, name="gru"
+            )
+        else:
+            gru = StackedGRU(
+                cfg.hidden_size,
+                num_layers=cfg.gru_layers,
+                torch_init=cfg.torch_init,
+                dtype=dtype,
+                name="gru",
+            )
+        latent = gru(x)                                      # module.py:30-31
         return latent.astype(jnp.float32)

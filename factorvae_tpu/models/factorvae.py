@@ -184,3 +184,39 @@ def day_forward(cfg: ModelConfig, train: bool):
 def day_prediction(cfg: ModelConfig, stochastic: Optional[bool] = None):
     """Day-batched inference: apply(params, x, mask) -> (D, N) scores."""
     return _lift(_DayPrediction)(cfg, stochastic=stochastic)
+
+
+def load_model(config, checkpoint_path=None, n_max: int = 8):
+    """Inference-model factory + optional weight restore — the analogue of
+    reference utils.load_model (utils.py:57-67), which mirrors main.py's
+    module assembly for the scoring path.
+
+    `config` is a full Config (or a ModelConfig via Config(model=...)).
+    Returns (model, params): the day-batched *prediction* module
+    (apply(params, x, mask) -> (D, N) scores; no future returns needed)
+    and either freshly initialized params or the checkpoint's weights.
+    The parameter template is initialized through the full forward variant
+    so the tree covers every submodule (including the posterior encoder,
+    which the prediction path itself never touches) and matches saved
+    training checkpoints exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from factorvae_tpu.config import Config
+
+    if not isinstance(config, Config):
+        config = Config(model=config)
+    cfg = config.model
+    template_model = day_forward(cfg, train=False)
+    key = jax.random.PRNGKey(config.train.seed)
+    x = jnp.zeros((1, n_max, cfg.seq_len, cfg.num_features))
+    params = template_model.init(
+        {"params": key, "sample": key, "dropout": key},
+        x, jnp.zeros((1, n_max)), jnp.ones((1, n_max), bool),
+    )
+    if checkpoint_path is not None:
+        from factorvae_tpu.train.checkpoint import load_params
+
+        params = load_params(checkpoint_path, params)
+    return day_prediction(cfg), params

@@ -64,6 +64,33 @@ def layer_norm(x, dtype=None):
     return nn.LayerNorm(epsilon=1e-5, dtype=dtype)(x)
 
 
+class StackedGRU(nn.Module):
+    """Multi-layer GRU (torch nn.GRU(num_layers=L) semantics): each layer
+    consumes the full hidden sequence of the previous one; returns the top
+    layer's last hidden state. The reference always uses L=1
+    (module.py:20) but exposes num_layers; parity for L>1 is kept here.
+    """
+
+    hidden_size: int
+    num_layers: int = 1
+    torch_init: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for layer in range(self.num_layers):
+            last = layer == self.num_layers - 1
+            gru = GRU(
+                self.hidden_size,
+                torch_init=self.torch_init,
+                dtype=self.dtype,
+                return_sequence=not last,
+                name=f"layer_{layer}",
+            )
+            x = gru(x)
+        return x
+
+
 class GRU(nn.Module):
     """Single-layer GRU over the time axis, returning the last hidden state.
 
@@ -75,12 +102,15 @@ class GRU(nn.Module):
         h' = (1 - z) * n + z * h
 
     Input: (N, T, C). Output: (N, H) — the hidden state after the last
-    step, i.e. the reference's ``stock_latent[:, -1, :]`` (module.py:30-31).
+    step, i.e. the reference's ``stock_latent[:, -1, :]`` (module.py:30-31)
+    — or the full (N, T, H) hidden sequence with return_sequence=True
+    (used by StackedGRU's intermediate layers).
     """
 
     hidden_size: int
     torch_init: bool = True
     dtype: Optional[jnp.dtype] = None
+    return_sequence: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -111,8 +141,10 @@ class GRU(nn.Module):
             z = jax.nn.sigmoid(xi_t[:, h_dim : 2 * h_dim] + gh[:, h_dim : 2 * h_dim])
             nn_ = jnp.tanh(xi_t[:, 2 * h_dim :] + r * gh[:, 2 * h_dim :])
             h_new = (1.0 - z) * nn_ + z * h
-            return h_new, None
+            return h_new, h_new if self.return_sequence else None
 
         h0 = jnp.zeros((n, h_dim), dtype=dtype)
-        h_last, _ = jax.lax.scan(step, h0, jnp.swapaxes(xi, 0, 1))
+        h_last, seq = jax.lax.scan(step, h0, jnp.swapaxes(xi, 0, 1))
+        if self.return_sequence:
+            return jnp.swapaxes(seq, 0, 1)  # (N, T, H)
         return h_last

@@ -76,11 +76,18 @@ def make_step_fns(
         count = jnp.sum(day_w)
         # mean over real days this step; padded days carry zero weight
         loss = loss_sum / jnp.maximum(count, 1.0)
+        n_valid = jnp.sum(mask, axis=-1).astype(jnp.float32) * day_w
         aux = {
             "loss_sum": loss_sum,
             "recon_sum": jnp.sum(out.recon_loss * day_w),
             "kl_sum": jnp.sum(out.kl * day_w),
             "days": count,
+            # sample-weighted numerator/denominator: the (fixed) intent of
+            # the reference's dead `test` loop (train_model.py:62-82 weights
+            # by batch size but divides by batch count — we divide by the
+            # sample count)
+            "wloss_sum": jnp.sum(out.loss * n_valid),
+            "samples": jnp.sum(n_valid),
         }
         return loss, aux
 
@@ -127,6 +134,10 @@ def make_step_fns(
             "recon": jnp.sum(auxes["recon_sum"]) / days,
             "kl": jnp.sum(auxes["kl_sum"]) / days,
             "days": jnp.sum(auxes["days"]),
+            # per-sample weighted mean (row 19 of SURVEY §2; see
+            # weighted_day_loss)
+            "loss_sample_weighted": jnp.sum(auxes["wloss_sum"])
+            / jnp.maximum(jnp.sum(auxes["samples"]), 1.0),
         }
 
     return StepFns(

@@ -275,3 +275,95 @@ class TestDayBatched:
         scores = model.apply(params, x, mask)
         assert scores.shape == (d, n)
         assert np.isfinite(np.asarray(scores)).all()
+
+
+class TestStackedGRU:
+    def test_two_layer_matches_torch(self, rng):
+        """L=2 stacked GRU vs torch nn.GRU(num_layers=2) with copied weights."""
+        torch = pytest.importorskip("torch")
+        from factorvae_tpu.models.layers import StackedGRU
+
+        n, t, c, h = 3, 4, 5, 6
+        x = rng.normal(size=(n, t, c)).astype(np.float32)
+        gru = StackedGRU(hidden_size=h, num_layers=2)
+        params = gru.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+        tg = torch.nn.GRU(c, h, 2, batch_first=True)
+        p = params["params"]
+        with torch.no_grad():
+            for layer in (0, 1):
+                lp = p[f"layer_{layer}"]
+                w_ih = np.asarray(lp["input_proj"]["Dense_0"]["kernel"]).T
+                b_ih = np.asarray(lp["input_proj"]["Dense_0"]["bias"])
+                w_hh = np.asarray(lp["hidden_kernel"]).T
+                b_hh = np.asarray(lp["hidden_bias"])
+                getattr(tg, f"weight_ih_l{layer}").copy_(torch.from_numpy(w_ih))
+                getattr(tg, f"bias_ih_l{layer}").copy_(torch.from_numpy(b_ih))
+                getattr(tg, f"weight_hh_l{layer}").copy_(torch.from_numpy(w_hh))
+                getattr(tg, f"bias_hh_l{layer}").copy_(torch.from_numpy(b_hh))
+            want, _ = tg(torch.from_numpy(x.copy()))
+        got = gru.apply(params, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(got), want[:, -1, :].numpy(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_extractor_respects_gru_layers(self, rng):
+        cfg2 = ModelConfig(num_features=12, hidden_size=8, num_factors=5,
+                           num_portfolios=7, seq_len=6, gru_layers=2)
+        fe = FeatureExtractor(cfg2)
+        x = jnp.asarray(rng.normal(size=(4, 6, 12)), jnp.float32)
+        params = fe.init(jax.random.PRNGKey(0), x)
+        assert "layer_1" in params["params"]["gru"]
+        assert fe.apply(params, x).shape == (4, 8)
+
+
+class TestBf16Training:
+    def test_bf16_end_to_end(self, rng, tmp_path):
+        """A full fit in bfloat16 compute must stay finite and learn."""
+        from factorvae_tpu.config import Config, DataConfig, TrainConfig
+        from factorvae_tpu.data import PanelDataset, synthetic_panel
+        from factorvae_tpu.train import Trainer
+        from factorvae_tpu.utils.logging import MetricsLogger
+
+        panel = synthetic_panel(num_days=16, num_instruments=8, num_features=8,
+                                missing_prob=0.0, signal=0.8, seed=2)
+        ds = PanelDataset(panel, seq_len=4)
+        cfg = Config(
+            model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                              num_portfolios=6, seq_len=4,
+                              compute_dtype="bfloat16"),
+            data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
+                            val_start_time=None, val_end_time=None),
+            train=TrainConfig(num_epochs=2, lr=1e-3, seed=0,
+                              save_dir=str(tmp_path), checkpoint_every=0),
+        )
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        _, out = tr.fit()
+        assert np.isfinite([h["train_loss"] for h in out["history"]]).all()
+
+
+class TestLoadModelFactory:
+    def test_factory_and_restore(self, rng, tmp_path):
+        from factorvae_tpu.config import Config, DataConfig, TrainConfig
+        from factorvae_tpu.models.factorvae import load_model
+        from factorvae_tpu.train.checkpoint import save_params
+
+        cfg = Config(
+            model=CFG,
+            data=DataConfig(seq_len=CFG.seq_len),
+            train=TrainConfig(save_dir=str(tmp_path)),
+        )
+        model, params = load_model(cfg, n_max=10)
+        x = jnp.asarray(rng.normal(size=(2, 10, CFG.seq_len, CFG.num_features)),
+                        jnp.float32)
+        scores = model.apply(
+            params, x, jnp.ones((2, 10), bool),
+            rngs={"sample": jax.random.PRNGKey(0)},
+        )
+        assert scores.shape == (2, 10)
+        # save then restore through the factory
+        path = save_params(str(tmp_path), "factory_test", params)
+        _, restored = load_model(cfg, checkpoint_path=path, n_max=10)
+        a = jax.tree_util.tree_leaves(params)[0]
+        b = jax.tree_util.tree_leaves(restored)[0]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
