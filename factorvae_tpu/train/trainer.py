@@ -174,12 +174,15 @@ class Trainer:
                 best_val = float(meta.get("best_val", best_val))
                 self.logger.log("resume", epoch=start_epoch, best_val=best_val)
 
+        from factorvae_tpu.utils.profiling import step_annotation
+
         val_order = self._val_order()
         history = []
         for epoch in range(start_epoch, epochs):
             t0 = time.time()
             order = self._epoch_orders(epoch)
-            state, train_m = self._train_epoch(state, order)
+            with step_annotation(f"train_epoch_{epoch}"):
+                state, train_m = self._train_epoch(state, order)
             train_loss = float(train_m["loss"])
             if val_order is not None:
                 eval_key = jax.random.fold_in(

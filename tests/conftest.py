@@ -22,6 +22,14 @@ import pytest  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu"
 
+# Persistent compilation cache: repeat suite runs skip most XLA compiles
+# (the dominant cost on a 1-core host).
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/factorvae_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 
 @pytest.fixture(scope="session")
 def devices():

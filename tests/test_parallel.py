@@ -91,3 +91,53 @@ class TestGraftEntry:
         jitted = jax.jit(fn)
         loss = jitted(*args)
         assert np.isfinite(float(loss))
+
+
+class TestMeshIntegration:
+    def test_scoring_after_mesh_training(self, dense_ds, tmp_path):
+        """generate_prediction_scores must work on a dataset whose arrays
+        were re-placed onto a mesh by the trainer."""
+        from factorvae_tpu.config import MeshConfig
+        from factorvae_tpu.eval import generate_prediction_scores
+
+        mesh = make_mesh(MeshConfig(stock_axis=2))
+        cfg = cfg_for(tmp_path)
+        tr = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
+        state, _ = tr.fit(num_epochs=1)
+        df = generate_prediction_scores(
+            state.params, cfg, dense_ds, stochastic=False, with_labels=True
+        )
+        assert len(df) == dense_ds.valid.sum()
+        assert np.isfinite(df["score"]).all()
+
+    def test_mesh_checkpoint_resume(self, dense_ds, tmp_path):
+        """Full-state resume under a mesh: losses continue exactly."""
+        import dataclasses
+
+        from factorvae_tpu.config import MeshConfig
+
+        mesh = make_mesh(MeshConfig(stock_axis=1))
+        base = cfg_for(tmp_path)
+        cfg = dataclasses.replace(
+            base,
+            train=dataclasses.replace(base.train, num_epochs=2,
+                                      checkpoint_every=1),
+        )
+        tr1 = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
+        _, full = tr1.fit()
+
+        # fresh save dir for the split run
+        cfg_b = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, save_dir=str(tmp_path / "b"))
+        )
+        tr_b1 = Trainer(cfg_b, dense_ds, mesh=make_mesh(MeshConfig(stock_axis=1)),
+                        logger=MetricsLogger(echo=False))
+        tr_b1.fit(num_epochs=1)
+        tr_b2 = Trainer(cfg_b, dense_ds, mesh=make_mesh(MeshConfig(stock_axis=1)),
+                        logger=MetricsLogger(echo=False))
+        _, resumed = tr_b2.fit(resume=True)
+
+        full_losses = {h["epoch"]: h["train_loss"] for h in full["history"]}
+        res_losses = {h["epoch"]: h["train_loss"] for h in resumed["history"]}
+        assert set(res_losses) == {1}
+        np.testing.assert_allclose(full_losses[1], res_losses[1], rtol=1e-4)
