@@ -63,7 +63,7 @@ class TestFillMaps:
         np.testing.assert_array_equal(nv[:, 1], [2, 2, 2, 4, 4])
 
     @pytest.mark.parametrize("seed", [0, 1, 2])
-    @pytest.mark.parametrize("step_len", [1, 3, 7])
+    @pytest.mark.parametrize("step_len", [1, 3, 7, 20])
     def test_window_indices_match_host_oracle(self, seed, step_len):
         """Device fill indices == brute-force ffill+bfill oracle for every
         (day, instrument) that has a row (= every real sample)."""

@@ -367,3 +367,28 @@ class TestLoadModelFactory:
         a = jax.tree_util.tree_leaves(params)[0]
         b = jax.tree_util.tree_leaves(restored)[0]
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestBaselineConfigShapes:
+    """Forward-pass smoke at the BASELINE.json config shapes (reduced
+    cross-section; the model's parameter shapes depend only on C/H/K/M)."""
+
+    @pytest.mark.parametrize("name", ["csi300-k60", "alpha360-k60"])
+    def test_preset_forward(self, rng, name):
+        from factorvae_tpu.presets import get_preset
+
+        cfg = get_preset(name).model
+        n = 8
+        x = jnp.asarray(rng.normal(size=(n, cfg.seq_len, cfg.num_features)),
+                        jnp.float32)
+        y = jnp.asarray(rng.normal(size=(n,)), jnp.float32)
+        model = FactorVAE(cfg)
+        k = jax.random.PRNGKey(0)
+        params = model.init({"params": k, "sample": k, "dropout": k}, x, y,
+                            jnp.ones(n, bool))
+        out = model.apply(
+            params, x, y, jnp.ones(n, bool),
+            rngs={"sample": k, "dropout": k},
+        )
+        assert out.pred_mu.shape == (cfg.num_factors,)
+        assert np.isfinite(float(out.loss))
