@@ -1,0 +1,155 @@
+"""Same-host head-to-head: reference PyTorch implementation vs factorvae_tpu.
+
+Imports the reference code from its read-only mount (running it as a
+baseline; nothing is copied) and times per-day training steps of both
+frameworks on identical synthetic data and flagship shapes, on this
+host's CPU. This pins a *measured* architectural speedup (batched einsum
+heads + whole-epoch scan vs K sequential module calls + per-step host
+sync) independent of accelerator hardware; the TPU bench (bench.py) then
+adds the hardware factor.
+
+Usage: python scripts/bench_reference_cpu.py [--days 8] [--stocks 300] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = os.environ.get("REFERENCE_PATH", "/root/reference")
+
+
+def bench_reference(args, x, y):
+    """Per-day-step seconds for the reference torch implementation."""
+    sys.path.insert(0, REFERENCE)
+    import torch
+    from module import (
+        AlphaLayer,
+        BetaLayer,
+        FactorDecoder,
+        FactorEncoder,
+        FactorPredictor,
+        FactorVAE,
+        FeatureExtractor,
+    )
+
+    torch.manual_seed(0)
+    fe = FeatureExtractor(num_latent=args.features, hidden_size=args.hidden)
+    enc = FactorEncoder(num_factors=args.factors, num_portfolio=args.portfolios,
+                        hidden_size=args.hidden)
+    dec = FactorDecoder(AlphaLayer(args.hidden),
+                        BetaLayer(args.hidden, args.factors))
+    pred = FactorPredictor(args.hidden, args.factors)
+    model = FactorVAE(fe, enc, dec, pred)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-4)
+
+    xs = [torch.from_numpy(x[d]) for d in range(args.days)]
+    ys = [torch.from_numpy(y[d]).reshape(-1, 1) for d in range(args.days)]
+
+    def step(d):
+        opt.zero_grad()
+        loss, *_ = model(xs[d], ys[d])
+        loss.backward()
+        opt.step()
+
+    for d in range(min(2, args.days)):  # warmup
+        step(d)
+    t0 = time.time()
+    for _ in range(args.reps):
+        for d in range(args.days):
+            step(d)
+    dt = time.time() - t0
+    return dt / (args.reps * args.days)
+
+
+def bench_ours(args, x, y):
+    """Per-day-step seconds for factorvae_tpu on the JAX CPU backend."""
+    sys.path.insert(0, REPO)
+    from factorvae_tpu.utils.testing import force_host_devices
+
+    force_host_devices(1)
+
+    import numpy as np
+
+    from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from factorvae_tpu.data import PanelDataset
+    from factorvae_tpu.data.panel import Panel
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    import pandas as pd
+
+    feats = np.swapaxes(x[:, :, -1, :], 0, 1)  # (N, D, C): last window row
+    labels = np.swapaxes(y, 0, 1)[..., None]   # (N, D, 1)
+    values = np.concatenate([feats, labels], axis=-1)
+    panel = Panel(
+        values=values.astype(np.float32),
+        valid=np.ones((args.days, args.stocks), bool),
+        dates=pd.bdate_range("2020-01-01", periods=args.days),
+        instruments=np.array([f"I{i}" for i in range(args.stocks)]),
+    )
+    ds = PanelDataset(panel, seq_len=args.seq_len, pad_multiple=4)
+    cfg = Config(
+        model=ModelConfig(num_features=args.features, hidden_size=args.hidden,
+                          num_factors=args.factors,
+                          num_portfolios=args.portfolios, seq_len=args.seq_len),
+        data=DataConfig(seq_len=args.seq_len, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(num_epochs=1 + args.reps, days_per_step=1, seed=0,
+                          checkpoint_every=0, save_dir="/tmp/factorvae_cmp"),
+    )
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+    import jax
+
+    order = trainer._epoch_orders(0)
+    state, m = trainer._train_epoch(state, order)  # warmup/compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    for e in range(1, 1 + args.reps):
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(e))
+    jax.block_until_ready(m["loss"])
+    dt = time.time() - t0
+    return dt / (args.reps * args.days)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--days", type=int, default=8)
+    p.add_argument("--stocks", type=int, default=300)
+    p.add_argument("--features", type=int, default=158)
+    p.add_argument("--seq_len", type=int, default=20)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--factors", type=int, default=96)
+    p.add_argument("--portfolios", type=int, default=128)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--skip", choices=["none", "reference", "ours"], default="none")
+    args = p.parse_args()
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    # windows for torch path: (D, N, T, C); flat panel features for ours
+    x = rng.normal(size=(args.days, args.stocks, args.seq_len, args.features)
+                   ).astype(np.float32)
+    y = rng.normal(size=(args.days, args.stocks)).astype(np.float32) * 0.02
+
+    out = {"shapes": vars(args)}
+    if args.skip != "reference":
+        out["reference_torch_cpu_sec_per_day_step"] = bench_reference(args, x, y)
+    if args.skip != "ours":
+        out["factorvae_tpu_jax_cpu_sec_per_day_step"] = bench_ours(args, x, y)
+    if args.skip == "none":
+        out["speedup_same_host_cpu"] = (
+            out["reference_torch_cpu_sec_per_day_step"]
+            / out["factorvae_tpu_jax_cpu_sec_per_day_step"]
+        )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
