@@ -76,6 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "--days_per_step, --wandb) override its values")
     p.add_argument("--profile", type=str, default=None,
                    help="capture a jax.profiler trace of training into this dir")
+    p.add_argument("--debug_nans", action="store_true",
+                   help="raise on any NaN inside jitted code (replaces the "
+                        "reference's silent runtime NaN guards while debugging)")
     return p
 
 
@@ -233,7 +236,12 @@ def main(argv=None) -> int:
                 )
                 return 2
             raise
-        with trace(args.profile):
+        import contextlib
+
+        from factorvae_tpu.utils.profiling import debug_nans
+
+        nan_ctx = debug_nans() if args.debug_nans else contextlib.nullcontext()
+        with trace(args.profile), nan_ctx:
             state, _ = trainer.fit(resume=args.resume)
         # Score with the best-validation weights (what the reference's
         # backtest loads, backtest.ipynb cell 2), not the final step.

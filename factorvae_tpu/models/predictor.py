@@ -66,9 +66,18 @@ class FactorPredictor(nn.Module):
             attn = masked_softmax(scores, mask[None, :], axis=-1)  # module.py:146
 
             # Per-head NaN/Inf guard -> zero context (module.py:149-150).
-            bad = jnp.any(~jnp.isfinite(attn), axis=-1, keepdims=True)
+            # Keyed off the *scores*: a non-finite score makes the
+            # reference's softmax weights non-finite for the whole head;
+            # our masked softmax zeroes them silently, so without this the
+            # NaN would re-enter through 0 * NaN in the value contraction.
+            bad = jnp.any(
+                ~jnp.isfinite(jnp.where(mask[None, :], scores, 0.0)),
+                axis=-1, keepdims=True,
+            )
             attn = jnp.where(bad, 0.0, attn)
-            context = jnp.einsum("kn,knh->kh", attn, values)    # (K, H)
+            context = jnp.where(
+                bad, 0.0, jnp.einsum("kn,knh->kh", attn, jnp.nan_to_num(values))
+            )                                                   # (K, H)
 
         h_multi = Dense(h, torch_init=cfg.torch_init, name="proj")(context)
         h_multi = nn.leaky_relu(h_multi, negative_slope=cfg.leaky_relu_slope)

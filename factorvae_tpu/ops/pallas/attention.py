@@ -46,6 +46,9 @@ def _head_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
                      preferred_element_type=jnp.float32)[:, 0]  # (N,)
     scores = scores / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
     scores = jnp.maximum(scores, 0.0)                        # ReLU (module.py:145)
+    # reference NaN guard (module.py:149-150): any non-finite valid score
+    # zeroes this head's context entirely
+    bad = jnp.any(~jnp.isfinite(jnp.where(maskf > 0, scores, 0.0)))
     scores = jnp.where(maskf > 0, scores, _NEG_INF)
     m = jnp.max(scores)
     ex = jnp.where(maskf > 0, jnp.exp(scores - m), 0.0)
@@ -53,8 +56,9 @@ def _head_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
     attn = jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
     value = jnp.dot(latent, wv_ref[0], preferred_element_type=jnp.float32)
     value = value + bv_ref[0, :][None, :]
-    out_ref[0, :] = jnp.dot(attn[None, :], value,
-                            preferred_element_type=jnp.float32)[0]
+    ctx = jnp.dot(attn[None, :], jnp.nan_to_num(value),
+                  preferred_element_type=jnp.float32)[0]
+    out_ref[0, :] = jnp.where(bad, 0.0, ctx)
 
 
 def multihead_cross_section_attention(

@@ -175,3 +175,42 @@ class TestPanelDataset:
         order = ds.epoch_order(days, shuffle=False, seed=0, epoch=0, pad_to=8)
         assert len(order) == 16
         assert (order[10:] == -1).all()
+
+
+class TestLoadFrame:
+    def test_select_feature(self, tmp_path, rng):
+        """select_feature restricts columns like reference dataset.py:263-264."""
+        from factorvae_tpu.data.panel import load_frame
+
+        df = synthetic_frame(num_days=6, num_instruments=4, num_features=6, seed=13)
+        pkl = tmp_path / "p.pkl"
+        df.to_pickle(pkl)
+        out = load_frame(str(pkl), select_feature=["F1", "F3"])
+        assert list(out.columns) == ["F1", "F3", "LABEL0"]
+        np.testing.assert_allclose(out["F1"].to_numpy(), df["F1"].to_numpy())
+
+    def test_multiindex_columns_flattened(self, tmp_path):
+        """qlib writes (col_set, name) MultiIndex columns; loader flattens."""
+        from factorvae_tpu.data.panel import load_frame
+
+        df = synthetic_frame(num_days=5, num_instruments=3, num_features=4, seed=14)
+        df.columns = pd.MultiIndex.from_tuples(
+            [("feature", c) for c in df.columns[:-1]] + [("label", "LABEL0")]
+        )
+        pkl = tmp_path / "q.pkl"
+        df.to_pickle(pkl)
+        out = load_frame(str(pkl))
+        assert list(out.columns) == ["F0", "F1", "F2", "F3", "LABEL0"]
+
+    def test_extra_columns_truncated_to_159(self, tmp_path, rng):
+        """Reference keeps .iloc[:, :159] (drops market-info extras)."""
+        from factorvae_tpu.data.panel import load_frame
+
+        df = synthetic_frame(num_days=4, num_instruments=3, num_features=160,
+                             seed=15)
+        # 160 features + LABEL0 = 161 cols; loader keeps first 159 and renames
+        pkl = tmp_path / "r.pkl"
+        df.to_pickle(pkl)
+        out = load_frame(str(pkl))
+        assert out.shape[1] == 159
+        assert out.columns[-1] == "LABEL0"
