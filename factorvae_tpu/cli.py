@@ -172,6 +172,11 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
 
+    # multi-host pods: wire processes together before any backend use
+    from factorvae_tpu.parallel.multihost import maybe_initialize
+
+    maybe_initialize()
+
     from factorvae_tpu.data import PanelDataset, build_panel, load_frame
     from factorvae_tpu.train import Trainer, load_params
     from factorvae_tpu.utils.logging import MetricsLogger

@@ -1,0 +1,89 @@
+"""Score-file parity comparison — the BASELINE protocol tool.
+
+BASELINE.md: "The scores/*.csv artifacts are the reproducible ground
+truth: join them with regenerated labels and run RankIC to pin the exact
+parity number." This module does exactly that for any two score files
+(e.g. a reference `scores/free20_*.csv` and this framework's export):
+join each with labels, compute per-day Rank-IC, and report the parity
+delta against the ±0.002 target.
+
+CLI:
+    python -m factorvae_tpu.eval.compare REF.csv OURS.csv \
+        --labels panel.pkl [--tolerance 0.002]
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from factorvae_tpu.eval.metrics import daily_rank_ic
+
+
+def load_scores(path: str) -> pd.DataFrame:
+    """Read a score CSV (reference schema: datetime,instrument,score)."""
+    df = pd.read_csv(path, parse_dates=["datetime"])
+    return df.set_index(["datetime", "instrument"]).sort_index()
+
+
+def labels_from_panel(path: str) -> pd.Series:
+    """LABEL0 series from a reference-schema pickle."""
+    from factorvae_tpu.data.panel import load_frame
+
+    return load_frame(path)["LABEL0"]
+
+
+def compare_scores(
+    ref: pd.DataFrame,
+    ours: pd.DataFrame,
+    labels: pd.Series,
+    tolerance: float = 0.002,
+) -> dict:
+    """Rank-IC of both score sets against shared labels + parity verdict.
+
+    Only (datetime, instrument) pairs present in a score file AND the
+    labels contribute to that file's Rank-IC (the reference notebook's
+    inner merge, backtest.ipynb cell 5).
+    """
+    out = {}
+    for name, scores in (("reference", ref), ("ours", ours)):
+        joined = scores.join(labels.rename("LABEL0"), how="inner").dropna()
+        ic = daily_rank_ic(joined, "LABEL0", "score")
+        out[f"{name}_rank_ic"] = float(ic.mean())
+        std = float(ic.std(ddof=0))
+        out[f"{name}_rank_ic_ir"] = float(ic.mean() / std) if std else np.nan
+        out[f"{name}_days"] = int(len(ic))
+    out["delta_rank_ic"] = out["ours_rank_ic"] - out["reference_rank_ic"]
+    out["tolerance"] = tolerance
+    out["within_tolerance"] = bool(abs(out["delta_rank_ic"]) <= tolerance)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("reference_csv")
+    p.add_argument("ours_csv")
+    p.add_argument("--labels", required=True,
+                   help="reference-schema panel pickle supplying LABEL0")
+    p.add_argument("--tolerance", type=float, default=0.002)
+    args = p.parse_args(argv)
+
+    result = compare_scores(
+        load_scores(args.reference_csv),
+        load_scores(args.ours_csv),
+        labels_from_panel(args.labels),
+        tolerance=args.tolerance,
+    )
+    print(json.dumps(result, indent=2))
+    return 0 if result["within_tolerance"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

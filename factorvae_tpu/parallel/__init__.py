@@ -4,6 +4,11 @@ from factorvae_tpu.parallel.mesh import (
     make_mesh,
     single_device_mesh,
 )
+from factorvae_tpu.parallel.multihost import (
+    in_multihost_env,
+    maybe_initialize,
+    process_info,
+)
 from factorvae_tpu.parallel.ring import ring_cross_section_attention
 from factorvae_tpu.parallel.sharding import (
     batch_sharding,
@@ -18,8 +23,11 @@ __all__ = [
     "DATA_AXIS",
     "STOCK_AXIS",
     "batch_sharding",
+    "in_multihost_env",
     "make_batch_constraint",
     "make_mesh",
+    "maybe_initialize",
+    "process_info",
     "order_sharding",
     "panel_shardings",
     "replicated",

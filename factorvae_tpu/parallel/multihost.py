@@ -1,0 +1,77 @@
+"""Multi-host (multi-process) initialization.
+
+A TPU pod slice runs one JAX process per host; `jax.distributed.initialize`
+wires them into a single logical device set, after which the framework's
+mesh code (mesh.py) spans all hosts transparently: `jax.devices()` returns
+the global device list, GSPMD gradient all-reduce rides ICI within a slice
+and DCN across slices, and every Trainer/collective path works unchanged
+(they only ever reference mesh axes, never host boundaries). This is the
+multi-host story a GPU framework gets from NCCL+MPI ranks; here the
+runtime already speaks the collectives, so the only job is process wiring.
+
+Data layout under multi-host: the panel is small (O(1) GB), so every host
+builds the same HBM-resident panel and the day order is identical on all
+processes (it is derived from seeded host RNG with the same seed) — each
+process then owns the shards GSPMD assigns to its local devices. No
+per-host input pipeline divergence exists to manage.
+
+Usage:
+    from factorvae_tpu.parallel.multihost import maybe_initialize
+    maybe_initialize()            # no-op on single host
+    # ... build mesh over jax.devices() as usual
+
+The CLI calls this automatically when the standard cluster env is present.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def in_multihost_env() -> bool:
+    """True when a multi-process cluster environment is detected (the
+    standard JAX coordinator variables, or a TPU pod's own metadata that
+    `jax.distributed.initialize()` can auto-discover)."""
+    return bool(
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+
+
+def maybe_initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed when configured; returns True if it ran.
+
+    With no arguments and no cluster env, this is a no-op (single-host) —
+    safe to call unconditionally.
+    """
+    import jax
+
+    if coordinator_address is None and not in_multihost_env():
+        return False
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return True
+
+
+def process_info() -> dict:
+    """Host/process layout for logging."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -172,6 +172,19 @@ class Trainer:
                 state, meta = ckpt.restore(state)
                 start_epoch = int(meta.get("epoch", 0)) + 1
                 best_val = float(meta.get("best_val", best_val))
+                saved_cfg = meta.get("config")
+                if saved_cfg is not None and saved_cfg != cfg.to_dict():
+                    diff = {
+                        k
+                        for k in set(saved_cfg) | set(cfg.to_dict())
+                        if saved_cfg.get(k) != cfg.to_dict().get(k)
+                    }
+                    self.logger.log(
+                        "resume_config_mismatch",
+                        sections=sorted(diff),
+                        note="resuming with a different config than the "
+                             "checkpoint was written with",
+                    )
                 self.logger.log("resume", epoch=start_epoch, best_val=best_val)
 
         from factorvae_tpu.utils.profiling import step_annotation

@@ -168,3 +168,53 @@ class TestChunkInvariance:
         np.testing.assert_allclose(
             a[np.isfinite(a)], b[np.isfinite(b)], rtol=1e-5, atol=1e-7
         )
+
+
+class TestCompareTool:
+    def test_compare_scores_and_cli(self, tmp_path, rng):
+        """Parity-comparison protocol: two score sets vs shared labels."""
+        from factorvae_tpu.data import synthetic_frame
+        from factorvae_tpu.eval.compare import compare_scores, load_scores, main
+
+        df = synthetic_frame(num_days=10, num_instruments=8, num_features=4,
+                             missing_prob=0.0, seed=21)
+        pkl = tmp_path / "labels.pkl"
+        df.to_pickle(pkl)
+
+        # "reference" scores = labels + noise; "ours" = same + tiny jitter
+        base = df["LABEL0"] + rng.normal(0, 0.5, len(df))
+        for name, noise in (("ref", 0.0), ("ours", 1e-4)):
+            s = pd.DataFrame({
+                "datetime": df.index.get_level_values(0),
+                "instrument": df.index.get_level_values(1),
+                "score": base + rng.normal(0, noise, len(df)),
+            })
+            s.to_csv(tmp_path / f"{name}.csv", index=False)
+
+        ref = load_scores(str(tmp_path / "ref.csv"))
+        ours = load_scores(str(tmp_path / "ours.csv"))
+        out = compare_scores(ref, ours, df["LABEL0"])
+        assert out["reference_days"] == 10
+        assert abs(out["delta_rank_ic"]) < 0.05
+        # CLI exit code encodes the verdict
+        rc = main([str(tmp_path / "ref.csv"), str(tmp_path / "ours.csv"),
+                   "--labels", str(pkl), "--tolerance", "1.0"])
+        assert rc == 0
+
+
+class TestMultihostHelper:
+    def test_noop_on_single_host(self, monkeypatch):
+        from factorvae_tpu.parallel.multihost import (
+            in_multihost_env,
+            maybe_initialize,
+            process_info,
+        )
+
+        for var in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                    "MEGASCALE_COORDINATOR_ADDRESS"):
+            monkeypatch.delenv(var, raising=False)
+        assert not in_multihost_env()
+        assert maybe_initialize() is False
+        info = process_info()
+        assert info["process_count"] == 1
+        assert info["global_devices"] == 8
