@@ -127,3 +127,24 @@ class TestCheckpointResume:
         )
         chex_like = jax.tree_util.tree_structure(params)
         assert chex_like == jax.tree_util.tree_structure(state.params)
+
+
+class TestMetricsLogger:
+    def test_jsonl_stream_and_wandb_degrade(self, tmp_path, monkeypatch):
+        """JSONL lines are appended per event; wandb failure degrades
+        gracefully to JSONL-only (the reference hard-depends on wandb when
+        --wandb is set; we must not)."""
+        import json
+
+        from factorvae_tpu.utils.logging import MetricsLogger
+
+        monkeypatch.setenv("WANDB_MODE", "disabled")
+        path = tmp_path / "m.jsonl"
+        lg = MetricsLogger(jsonl_path=str(path), use_wandb=True, echo=False)
+        lg.log("epoch", train_loss=1.0, val_loss=2.0)
+        lg.log("custom", note="x")
+        lg.finish(best_val=2.0)
+        lines = [json.loads(l) for l in path.read_text().strip().splitlines()]
+        events = [l["event"] for l in lines]
+        assert events == ["epoch", "custom", "final"]
+        assert lines[0]["train_loss"] == 1.0
