@@ -45,6 +45,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
     from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
     from factorvae_tpu.train import Trainer

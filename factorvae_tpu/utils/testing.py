@@ -43,3 +43,18 @@ def host_device_count() -> int:
     import jax
 
     return len(jax.devices())
+
+
+def enable_persistent_compile_cache(
+    cache_dir: str = "/tmp/factorvae_jax_cache",
+) -> None:
+    """Persistent XLA compilation cache (shared by tests and bench): repeat
+    runs skip recompiles — the dominant fixed cost on slow hosts and under
+    remote compilation. No-op on JAX versions without the flags."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass

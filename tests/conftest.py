@@ -22,13 +22,9 @@ import pytest  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu"
 
-# Persistent compilation cache: repeat suite runs skip most XLA compiles
-# (the dominant cost on a 1-core host).
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/factorvae_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+from factorvae_tpu.utils.testing import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
 
 
 @pytest.fixture(scope="session")
