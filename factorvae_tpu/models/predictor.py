@@ -43,16 +43,18 @@ class FactorPredictor(nn.Module):
         w_val = self.param("value_kernel", init, (k, h, h))
         b_val = self.param("value_bias", init, (k, h))
 
-        if cfg.use_pallas_attention and not train:
-            # Fused Pallas kernel (inference path): never materializes the
-            # (K, N, H) key/value stacks in HBM. Dropout is inactive here
-            # (train=False), so the math is identical to the XLA path.
-            from factorvae_tpu.ops.pallas.attention import (
-                multihead_cross_section_attention,
-            )
+        if cfg.use_pallas_attention and (not train or cfg.dropout_rate == 0.0):
+            # Fused Pallas kernel: never materializes the (K, N, H)
+            # key/value stacks in HBM, and is differentiable (custom VJP
+            # with flash-style recompute backward), so it serves both the
+            # inference path and dropout-free training. Train-time dropout
+            # (the reference's score dropout, module.py:144) stays on the
+            # XLA path below.
+            from factorvae_tpu.ops.pallas.attention_grad import fused_attention
 
-            context = multihead_cross_section_attention(
-                latent, mask, query, w_key, b_key, w_val, b_val
+            context = fused_attention(
+                latent, mask.astype(jnp.float32), query, w_key, b_key,
+                w_val, b_val,
             )
         else:
             # All K per-head Linears at once: (N,H) x (K,H,H) -> (K,N,H).

@@ -1,0 +1,166 @@
+"""Differentiable fused cross-section attention (custom VJP).
+
+Makes the Pallas kernel in attention.py usable on the *training* path:
+`fused_attention` is a `jax.custom_vjp` whose forward is the fused
+per-head kernel and whose backward is a second per-head kernel that
+recomputes keys/values/scores from the inputs (flash-attention-style —
+nothing but the (K, H) context ever hits HBM between the passes) and
+emits gradients for latent, query and all per-head weights.
+
+Per-head backward math (mirrors reference module.py:140-153 semantics:
+scores -> ReLU -> masked softmax -> context):
+
+    key = L Wk + bk;  z = key q;  s = z*sc;  r = relu(s);  a = softmax_m(r)
+    V = L Wv + bv;    ctx = a^T V
+
+    dV   = a (x) dctx            dWv = L^T dV   dbv = sum_n dV
+    da   = V dctx
+    dr   = a . (da - sum(a.da))          (masked entries have a = 0)
+    dz   = 1[s>0] . dr * sc
+    dq   = key^T dz              dkey = dz (x) q
+    dWk  = L^T dkey              dbk = sum_n dkey
+    dL   = dkey Wk^T + dV Wv^T   (accumulated over heads)
+
+The reference's NaN guard (module.py:149-150) zeroes a poisoned head's
+context in the forward; the backward mirrors it by zeroing that head's
+gradients. Dropout is NOT fused (the XLA path handles train-time
+dropout); the predictor uses this op when dropout is inactive.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from factorvae_tpu.ops.pallas.attention import (
+    _NEG_INF,
+    multihead_cross_section_attention,
+)
+
+
+def _bwd_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
+                dctx_ref, dlatent_ref, dq_ref, dwk_ref, dbk_ref, dwv_ref,
+                dbv_ref):
+    latent = latent_ref[:]                                   # (N, H)
+    maskf = maskf_ref[0, :]                                  # (N,)
+    q = q_ref[0, :]                                          # (H,)
+    dctx = dctx_ref[0, :]                                    # (H,)
+
+    key = jnp.dot(latent, wk_ref[0], preferred_element_type=jnp.float32)
+    key = key + bk_ref[0, :][None, :]
+    h_dim = key.shape[1]
+    sc = 1.0 / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
+    z = jnp.dot(key, q[:, None], preferred_element_type=jnp.float32)[:, 0]
+    s = z * sc
+    r = jnp.maximum(s, 0.0)
+    bad = jnp.any(~jnp.isfinite(jnp.where(maskf > 0, r, 0.0)))
+    rm = jnp.where(maskf > 0, r, _NEG_INF)
+    m = jnp.max(rm)
+    ex = jnp.where(maskf > 0, jnp.exp(rm - m), 0.0)
+    denom = jnp.sum(ex)
+    a = jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+    value = jnp.dot(latent, wv_ref[0], preferred_element_type=jnp.float32)
+    value = value + bv_ref[0, :][None, :]
+    value = jnp.nan_to_num(value)
+
+    zero_head = jnp.where(bad, 0.0, 1.0)
+    dv = (a[:, None] * dctx[None, :]) * zero_head            # (N, H)
+    da = jnp.dot(value, dctx[:, None],
+                 preferred_element_type=jnp.float32)[:, 0] * zero_head
+    t = a * da
+    dr = t - a * jnp.sum(t)
+    dz = jnp.where(s > 0, dr, 0.0) * sc                      # (N,)
+    dkey = dz[:, None] * q[None, :]                          # (N, H)
+
+    dq_ref[0, :] = jnp.dot(key.T, dz[:, None],
+                           preferred_element_type=jnp.float32)[:, 0] * zero_head
+    dkey = dkey * zero_head
+    dwk_ref[0] = jnp.dot(latent.T, dkey, preferred_element_type=jnp.float32)
+    dbk_ref[0, :] = jnp.sum(dkey, axis=0)
+    dwv_ref[0] = jnp.dot(latent.T, dv, preferred_element_type=jnp.float32)
+    dbv_ref[0, :] = jnp.sum(dv, axis=0)
+
+    dl = jnp.dot(dkey, wk_ref[0].T, preferred_element_type=jnp.float32)
+    dl = dl + jnp.dot(dv, wv_ref[0].T, preferred_element_type=jnp.float32)
+
+    # TPU grid steps run sequentially: accumulate dlatent across heads
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dlatent_ref[:] = jnp.zeros_like(dlatent_ref)
+
+    dlatent_ref[:] += dl
+
+
+def _bwd_pallas(latent, maskf, query, w_key, b_key, w_val, b_val, dctx,
+                interpret):
+    n, h = latent.shape
+    k = query.shape[0]
+    grids = pl.pallas_call(
+        _bwd_kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), jnp.float32),      # dlatent
+            jax.ShapeDtypeStruct((k, h), jnp.float32),      # dquery
+            jax.ShapeDtypeStruct((k, h, h), jnp.float32),   # dWk
+            jax.ShapeDtypeStruct((k, h), jnp.float32),      # dbk
+            jax.ShapeDtypeStruct((k, h, h), jnp.float32),   # dWv
+            jax.ShapeDtypeStruct((k, h), jnp.float32),      # dbv
+        ],
+        interpret=interpret,
+    )(
+        latent.astype(jnp.float32),
+        maskf.reshape(1, -1).astype(jnp.float32),
+        query.astype(jnp.float32),
+        w_key.astype(jnp.float32),
+        b_key.astype(jnp.float32),
+        w_val.astype(jnp.float32),
+        b_val.astype(jnp.float32),
+        dctx.astype(jnp.float32),
+    )
+    return grids
+
+
+@jax.custom_vjp
+def fused_attention(latent, maskf, query, w_key, b_key, w_val, b_val):
+    """Differentiable fused K-head attention. maskf: (N,) float {0,1}."""
+    return multihead_cross_section_attention(
+        latent, maskf > 0, query, w_key, b_key, w_val, b_val
+    )
+
+
+def _fwd(latent, maskf, query, w_key, b_key, w_val, b_val):
+    out = fused_attention(latent, maskf, query, w_key, b_key, w_val, b_val)
+    return out, (latent, maskf, query, w_key, b_key, w_val, b_val)
+
+
+def _bwd(res, dctx):
+    latent, maskf, query, w_key, b_key, w_val, b_val = res
+    interpret = jax.default_backend() != "tpu"
+    dlatent, dq, dwk, dbk, dwv, dbv = _bwd_pallas(
+        latent, maskf, query, w_key, b_key, w_val, b_val, dctx, interpret
+    )
+    return dlatent, jnp.zeros_like(maskf), dq, dwk, dbk, dwv, dbv
+
+
+fused_attention.defvjp(_fwd, _bwd)
