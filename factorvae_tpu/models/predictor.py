@@ -19,6 +19,7 @@ Faithfully preserved quirks:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -43,18 +44,25 @@ class FactorPredictor(nn.Module):
         w_val = self.param("value_kernel", init, (k, h, h))
         b_val = self.param("value_bias", init, (k, h))
 
-        if cfg.use_pallas_attention and (not train or cfg.dropout_rate == 0.0):
+        if cfg.use_pallas_attention:
             # Fused Pallas kernel: never materializes the (K, N, H)
             # key/value stacks in HBM, and is differentiable (custom VJP
-            # with flash-style recompute backward), so it serves both the
-            # inference path and dropout-free training. Train-time dropout
-            # (the reference's score dropout, module.py:144) stays on the
-            # XLA path below.
+            # with flash-style recompute backward), so it serves inference
+            # AND training. The reference's score dropout (module.py:144,
+            # applied before the ReLU) is a tiny (K, N) keep-mask drawn
+            # outside the kernel from the flax 'dropout' rng.
             from factorvae_tpu.ops.pallas.attention_grad import fused_attention
 
+            dropout_mask = None
+            if train and cfg.dropout_rate > 0.0:
+                keep_p = 1.0 - cfg.dropout_rate
+                keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), keep_p, (k, latent.shape[0])
+                )
+                dropout_mask = keep.astype(jnp.float32) / keep_p
             context = fused_attention(
                 latent, mask.astype(jnp.float32), query, w_key, b_key,
-                w_val, b_val,
+                w_val, b_val, dropout_mask,
             )
         else:
             # All K per-head Linears at once: (N,H) x (K,H,H) -> (K,N,H).

@@ -33,10 +33,11 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _head_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
-                 out_ref):
+def _head_kernel(latent_ref, maskf_ref, dmask_ref, q_ref, wk_ref, bk_ref,
+                 wv_ref, bv_ref, out_ref):
     """One head per grid step. latent: (N, H), maskf: (1, N) float {0,1},
-    q/bk/bv: (1, H), wk/wv: (H, H), out: (1, H)."""
+    dmask: (1, N) dropout keep-mask (pre-scaled by 1/(1-p); all-ones at
+    inference), q/bk/bv: (1, H), wk/wv: (H, H), out: (1, H)."""
     latent = latent_ref[:]                                   # (N, H)
     maskf = maskf_ref[0, :]                                  # (N,)
     key = jnp.dot(latent, wk_ref[0], preferred_element_type=jnp.float32)
@@ -45,7 +46,8 @@ def _head_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
     scores = jnp.dot(key, q_ref[0, :][:, None],
                      preferred_element_type=jnp.float32)[:, 0]  # (N,)
     scores = scores / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
-    scores = jnp.maximum(scores, 0.0)                        # ReLU (module.py:145)
+    scores = scores * dmask_ref[0, :]           # dropout (module.py:144) ...
+    scores = jnp.maximum(scores, 0.0)           # ... BEFORE ReLU (module.py:145)
     # reference NaN guard (module.py:149-150): any non-finite valid score
     # zeroes this head's context entirely
     bad = jnp.any(~jnp.isfinite(jnp.where(maskf > 0, scores, 0.0)))
@@ -70,17 +72,24 @@ def multihead_cross_section_attention(
     w_val: jnp.ndarray,    # (K, H, H)
     b_val: jnp.ndarray,    # (K, H)
     interpret: bool = None,
+    dropout_mask: jnp.ndarray = None,   # (K, N) keep-mask / (1-p); None = off
 ) -> jnp.ndarray:
     """Returns the (K, H) context stack (reference h_multi, module.py:178).
 
     interpret=None auto-selects the Pallas interpreter off-TPU (the CPU
-    test rig), the compiled kernel on TPU.
+    test rig), the compiled kernel on TPU. `dropout_mask`, when given,
+    reproduces the reference's score dropout (module.py:144, applied
+    before the ReLU): a per-head (K, N) keep-mask pre-scaled by 1/(1-p),
+    generated OUTSIDE the kernel with jax.random (tiny array; the big
+    (K, N, H) intermediates stay fused in VMEM).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n, h = latent.shape
     k = query.shape[0]
     maskf = mask.astype(jnp.float32)[None, :]                # (1, N)
+    if dropout_mask is None:
+        dropout_mask = jnp.ones((k, n), jnp.float32)
     grid = (k,)
     return pl.pallas_call(
         _head_kernel,
@@ -88,6 +97,7 @@ def multihead_cross_section_attention(
         in_specs=[
             pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -100,6 +110,7 @@ def multihead_cross_section_attention(
     )(
         latent.astype(jnp.float32),
         maskf,
+        dropout_mask.astype(jnp.float32),
         query.astype(jnp.float32),
         w_key.astype(jnp.float32),
         b_key.astype(jnp.float32),

@@ -40,11 +40,12 @@ from factorvae_tpu.ops.pallas.attention import (
 )
 
 
-def _bwd_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
-                dctx_ref, dlatent_ref, dq_ref, dwk_ref, dbk_ref, dwv_ref,
-                dbv_ref):
+def _bwd_kernel(latent_ref, maskf_ref, dmask_ref, q_ref, wk_ref, bk_ref,
+                wv_ref, bv_ref, dctx_ref, dlatent_ref, dq_ref, dwk_ref,
+                dbk_ref, dwv_ref, dbv_ref):
     latent = latent_ref[:]                                   # (N, H)
     maskf = maskf_ref[0, :]                                  # (N,)
+    dmask = dmask_ref[0, :]                                  # (N,) keep/(1-p)
     q = q_ref[0, :]                                          # (H,)
     dctx = dctx_ref[0, :]                                    # (H,)
 
@@ -53,7 +54,7 @@ def _bwd_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
     h_dim = key.shape[1]
     sc = 1.0 / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
     z = jnp.dot(key, q[:, None], preferred_element_type=jnp.float32)[:, 0]
-    s = z * sc
+    s = z * sc * dmask
     r = jnp.maximum(s, 0.0)
     bad = jnp.any(~jnp.isfinite(jnp.where(maskf > 0, r, 0.0)))
     rm = jnp.where(maskf > 0, r, _NEG_INF)
@@ -72,7 +73,7 @@ def _bwd_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
                  preferred_element_type=jnp.float32)[:, 0] * zero_head
     t = a * da
     dr = t - a * jnp.sum(t)
-    dz = jnp.where(s > 0, dr, 0.0) * sc                      # (N,)
+    dz = jnp.where(s > 0, dr, 0.0) * sc * dmask              # (N,)
     dkey = dz[:, None] * q[None, :]                          # (N, H)
 
     dq_ref[0, :] = jnp.dot(key.T, dz[:, None],
@@ -94,7 +95,7 @@ def _bwd_kernel(latent_ref, maskf_ref, q_ref, wk_ref, bk_ref, wv_ref, bv_ref,
     dlatent_ref[:] += dl
 
 
-def _bwd_pallas(latent, maskf, query, w_key, b_key, w_val, b_val, dctx,
+def _bwd_pallas(latent, maskf, dmask, query, w_key, b_key, w_val, b_val, dctx,
                 interpret):
     n, h = latent.shape
     k = query.shape[0]
@@ -104,6 +105,7 @@ def _bwd_pallas(latent, maskf, query, w_key, b_key, w_val, b_val, dctx,
         in_specs=[
             pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -131,6 +133,7 @@ def _bwd_pallas(latent, maskf, query, w_key, b_key, w_val, b_val, dctx,
     )(
         latent.astype(jnp.float32),
         maskf.reshape(1, -1).astype(jnp.float32),
+        dmask.astype(jnp.float32),
         query.astype(jnp.float32),
         w_key.astype(jnp.float32),
         b_key.astype(jnp.float32),
@@ -142,25 +145,35 @@ def _bwd_pallas(latent, maskf, query, w_key, b_key, w_val, b_val, dctx,
 
 
 @jax.custom_vjp
-def fused_attention(latent, maskf, query, w_key, b_key, w_val, b_val):
-    """Differentiable fused K-head attention. maskf: (N,) float {0,1}."""
+def fused_attention(latent, maskf, query, w_key, b_key, w_val, b_val,
+                    dropout_mask=None):
+    """Differentiable fused K-head attention. maskf: (N,) float {0,1};
+    dropout_mask: optional (K, N) keep-mask / (1-p) (see attention.py)."""
     return multihead_cross_section_attention(
-        latent, maskf > 0, query, w_key, b_key, w_val, b_val
+        latent, maskf > 0, query, w_key, b_key, w_val, b_val,
+        dropout_mask=dropout_mask,
     )
 
 
-def _fwd(latent, maskf, query, w_key, b_key, w_val, b_val):
-    out = fused_attention(latent, maskf, query, w_key, b_key, w_val, b_val)
-    return out, (latent, maskf, query, w_key, b_key, w_val, b_val)
+def _fwd(latent, maskf, query, w_key, b_key, w_val, b_val, dropout_mask=None):
+    out = fused_attention(latent, maskf, query, w_key, b_key, w_val, b_val,
+                          dropout_mask)
+    return out, (latent, maskf, query, w_key, b_key, w_val, b_val, dropout_mask)
 
 
 def _bwd(res, dctx):
-    latent, maskf, query, w_key, b_key, w_val, b_val = res
+    latent, maskf, query, w_key, b_key, w_val, b_val, dropout_mask = res
+    if dropout_mask is None:
+        dropout_mask = jnp.ones((query.shape[0], latent.shape[0]), jnp.float32)
+        dmask_grad = None
+    else:
+        dmask_grad = jnp.zeros_like(dropout_mask)
     interpret = jax.default_backend() != "tpu"
     dlatent, dq, dwk, dbk, dwv, dbv = _bwd_pallas(
-        latent, maskf, query, w_key, b_key, w_val, b_val, dctx, interpret
+        latent, maskf, dropout_mask, query, w_key, b_key, w_val, b_val, dctx,
+        interpret,
     )
-    return dlatent, jnp.zeros_like(maskf), dq, dwk, dbk, dwv, dbv
+    return (dlatent, jnp.zeros_like(maskf), dq, dwk, dbk, dwv, dbv, dmask_grad)
 
 
 fused_attention.defvjp(_fwd, _bwd)

@@ -262,3 +262,68 @@ class TestPallasAttentionGrad:
                         jax.tree_util.tree_leaves(gp_p)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=1e-5)
+
+    def test_fused_dropout_matches_xla_dropout_statistics(self, rng):
+        """Fused in-kernel dropout path: same keep-mask applied to the
+        einsum reference must produce identical context; gradients flow."""
+        from factorvae_tpu.ops.pallas.attention_grad import fused_attention
+
+        latent, maskf, q, wk, bk, wv, bv = self._setup(rng)
+        k_, n_ = 4, 16
+        keep = (jnp.asarray(rng.random((k_, n_))) > 0.1).astype(jnp.float32) / 0.9
+
+        got = fused_attention(latent, maskf, q, wk, bk, wv, bv, keep)
+
+        h = latent.shape[1]
+        m = maskf > 0
+        keys = jnp.einsum("nh,khj->knj", latent, wk) + bk[:, None, :]
+        vals = jnp.einsum("nh,khj->knj", latent, wv) + bv[:, None, :]
+        s = jnp.einsum("kh,knh->kn", q, keys) / jnp.sqrt(jnp.float32(h) + 1e-6)
+        s = s * keep
+        a = masked_softmax(jax.nn.relu(s), m[None, :], axis=-1)
+        want = jnp.einsum("kn,knh->kh", a, vals)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+        # gradient parity through the dropout path
+        dctx = jnp.asarray(rng.normal(size=(k_, 8)), jnp.float32)
+        gf = jax.grad(lambda lt: jnp.sum(
+            fused_attention(lt, maskf, q, wk, bk, wv, bv, keep) * dctx))(latent)
+
+        def ref_loss(lt):
+            keys = jnp.einsum("nh,khj->knj", lt, wk) + bk[:, None, :]
+            vals = jnp.einsum("nh,khj->knj", lt, wv) + bv[:, None, :]
+            s = jnp.einsum("kh,knh->kn", q, keys) / jnp.sqrt(jnp.float32(h) + 1e-6)
+            s = s * keep
+            a = masked_softmax(jax.nn.relu(s), m[None, :], axis=-1)
+            return jnp.sum(jnp.einsum("kn,knh->kh", a, vals) * dctx)
+
+        gr = jax.grad(ref_loss)(latent)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_predictor_pallas_dropout_training(self, rng):
+        """use_pallas_attention with dropout_rate>0 in train mode: runs,
+        finite grads, and dropout actually perturbs the prior."""
+        from factorvae_tpu.config import ModelConfig
+        from factorvae_tpu.models.predictor import FactorPredictor
+
+        cfg = ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5, dropout_rate=0.3,
+                          use_pallas_attention=True)
+        latent = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+        mask = jnp.ones(16, bool)
+        params = FactorPredictor(cfg).init(jax.random.PRNGKey(0), latent, mask)
+        mu1, _ = FactorPredictor(cfg).apply(
+            params, latent, mask, train=True,
+            rngs={"dropout": jax.random.PRNGKey(1)})
+        mu2, _ = FactorPredictor(cfg).apply(
+            params, latent, mask, train=True,
+            rngs={"dropout": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(mu1), np.asarray(mu2))
+
+        g = jax.grad(lambda p: float(0) + jnp.sum(FactorPredictor(cfg).apply(
+            p, latent, mask, train=True,
+            rngs={"dropout": jax.random.PRNGKey(3)})[0]))(params)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert np.isfinite(np.asarray(leaf)).all()
