@@ -39,6 +39,8 @@ N_STOCKS = int(os.environ.get("BENCH_STOCKS", 356))  # reference score CSVs
 NUM_DAYS = int(os.environ.get("BENCH_DAYS", 256))
 DAYS_PER_STEP = int(os.environ.get("BENCH_DAYS_PER_STEP", 8))
 EPOCHS_TIMED = int(os.environ.get("BENCH_EPOCHS", 3))
+USE_BF16 = os.environ.get("BENCH_BF16", "0") == "1"
+USE_PALLAS = os.environ.get("BENCH_PALLAS", "0") == "1"
 
 
 def main() -> None:
@@ -58,6 +60,8 @@ def main() -> None:
         model=ModelConfig(
             num_features=NUM_FEATURES, hidden_size=HIDDEN, num_factors=FACTORS,
             num_portfolios=PORTFOLIOS, seq_len=SEQ_LEN,
+            compute_dtype="bfloat16" if USE_BF16 else "float32",
+            use_pallas_attention=USE_PALLAS,
         ),
         data=DataConfig(seq_len=SEQ_LEN, start_time=None, fit_end_time=None,
                         val_start_time=None, val_end_time=None),
@@ -89,8 +93,8 @@ def main() -> None:
     value = EPOCHS_TIMED * windows_per_epoch / dt
     # mark non-flagship runs so the dashboard's flagship series stays clean
     flagship = (NUM_FEATURES, SEQ_LEN, HIDDEN, FACTORS, PORTFOLIOS, N_STOCKS,
-                NUM_DAYS, DAYS_PER_STEP, EPOCHS_TIMED) == (
-                158, 20, 64, 96, 128, 356, 256, 8, 3)
+                NUM_DAYS, DAYS_PER_STEP, EPOCHS_TIMED, USE_BF16, USE_PALLAS
+                ) == (158, 20, 64, 96, 128, 356, 256, 8, 3, False, False)
     print(json.dumps({
         "metric": "train_throughput_flagship_K96_H64_Alpha158"
                   + ("" if flagship else "_smoke"),

@@ -34,6 +34,33 @@ from factorvae_tpu.models import (
     FeatureExtractor,
 )
 
+
+def __getattr__(name):
+    """Lazy top-level conveniences (avoid importing heavy deps eagerly):
+    Trainer, PanelDataset, build_panel, load_frame, load_model,
+    generate_prediction_scores, RankIC, topk_dropout_backtest, get_preset.
+    """
+    lazy = {
+        "Trainer": ("factorvae_tpu.train.trainer", "Trainer"),
+        "PanelDataset": ("factorvae_tpu.data.loader", "PanelDataset"),
+        "build_panel": ("factorvae_tpu.data.panel", "build_panel"),
+        "load_frame": ("factorvae_tpu.data.panel", "load_frame"),
+        "load_model": ("factorvae_tpu.models.factorvae", "load_model"),
+        "generate_prediction_scores": (
+            "factorvae_tpu.eval.predict", "generate_prediction_scores"),
+        "RankIC": ("factorvae_tpu.eval.metrics", "RankIC"),
+        "topk_dropout_backtest": (
+            "factorvae_tpu.eval.backtest", "topk_dropout_backtest"),
+        "get_preset": ("factorvae_tpu.presets", "get_preset"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'factorvae_tpu' has no attribute {name!r}")
+
+
 __version__ = "0.1.0"
 
 __all__ = [

@@ -239,3 +239,30 @@ class Trainer:
             ckpt.close()
         self.logger.log("best", best_val=best_val)
         return state, {"history": history, "best_val": best_val}
+
+    # ------------------------------------------------------------------
+
+    def evaluate(self, params, start=None, end=None, seed: int = 0) -> dict:
+        """Validation-style metrics over an arbitrary date range (the
+        standalone `validate` the reference exposes, train_model.py:40)."""
+        days = self.ds.split_days(
+            start if start is not None else self.cfg.data.val_start_time,
+            end if end is not None else self.cfg.data.val_end_time,
+        )
+        if len(days) == 0:
+            raise ValueError("no trading days in the requested range")
+        order = jnp.asarray(
+            self.ds.epoch_order(
+                days, shuffle=False, seed=0, epoch=0, pad_to=self.batch_days
+            ).reshape(-1, self.batch_days)
+        )
+        m = self._eval_epoch(params, order, jax.random.PRNGKey(seed))
+        return {k: float(v) for k, v in m.items()}
+
+    def score(self, params, start=None, end=None, **kw):
+        """Prediction scores DataFrame (see eval.generate_prediction_scores)."""
+        from factorvae_tpu.eval.predict import generate_prediction_scores
+
+        return generate_prediction_scores(
+            params, self.cfg, self.ds, start=start, end=end, **kw
+        )

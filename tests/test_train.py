@@ -148,3 +148,26 @@ class TestMetricsLogger:
         events = [l["event"] for l in lines]
         assert events == ["epoch", "custom", "final"]
         assert lines[0]["train_loss"] == 1.0
+
+
+class TestTrainerConvenienceAPI:
+    def test_evaluate_and_score(self, tiny_dataset, tmp_path):
+        _, ds = tiny_dataset
+        cfg = small_config(tmp_path, checkpoint_every=0)
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state, _ = tr.fit(num_epochs=1)
+        m = tr.evaluate(state.params)
+        assert np.isfinite(m["loss"]) and m["days"] > 0
+        df = tr.score(state.params, stochastic=False)
+        assert len(df) == ds.valid.sum()
+        with pytest.raises(ValueError):
+            tr.evaluate(state.params, start="2050-01-01", end="2050-02-01")
+
+    def test_top_level_lazy_exports(self):
+        import factorvae_tpu as fv
+
+        assert fv.Trainer is Trainer
+        assert callable(fv.RankIC)
+        assert callable(fv.get_preset)
+        with pytest.raises(AttributeError):
+            fv.not_a_thing
