@@ -79,6 +79,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug_nans", action="store_true",
                    help="raise on any NaN inside jitted code (replaces the "
                         "reference's silent runtime NaN guards while debugging)")
+    p.add_argument("--backtest", action="store_true",
+                   help="run the built-in TopkDropout backtest on the "
+                        "generated scores (reference backtest.ipynb cell 6 "
+                        "parameters: topk 50, n_drop 10, costs 5bp/15bp)")
+    p.add_argument("--backtest_topk", type=int, default=50)
+    p.add_argument("--backtest_n_drop", type=int, default=10)
     return p
 
 
@@ -269,6 +275,16 @@ def main(argv=None) -> int:
         rank_ic=float(ic["RankIC"].iloc[0]),
         rank_ic_ir=float(ic["RankIC_IR"].iloc[0]),
     )
+    if args.backtest:
+        from factorvae_tpu.eval.backtest import topk_dropout_backtest
+
+        bt = topk_dropout_backtest(
+            scores.dropna(), topk=args.backtest_topk,
+            n_drop=args.backtest_n_drop,
+        )
+        logger.log("backtest", **{
+            k: v for k, v in bt.summary().items() if v is not None
+        })
     logger.finish()
     return 0
 
