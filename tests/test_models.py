@@ -468,3 +468,52 @@ class TestEncoderGolden:
         yp1 = (1 - a) * 0.1 + a * (-0.2)
         np.testing.assert_allclose(float(mu[0]), yp0 + yp1, rtol=1e-6)
         np.testing.assert_allclose(float(sigma[0]), math.log(2.0), rtol=1e-6)
+
+
+class TestDecoderMath:
+    def test_distribution_formula_numpy_oracle(self, rng):
+        """mu = alpha_mu + beta @ f_mu ; sigma = sqrt(alpha_sigma^2 +
+        beta^2 @ f_sigma^2 + 1e-6) recomputed in numpy from planted
+        sub-layer outputs (reference module.py:120-121)."""
+        from factorvae_tpu.models.decoder import FactorDecoder
+
+        cfg = CFG
+        dec = FactorDecoder(cfg)
+        latent = jnp.asarray(rng.normal(size=(9, cfg.hidden_size)), jnp.float32)
+        fmu = jnp.asarray(rng.normal(size=(cfg.num_factors,)), jnp.float32)
+        fsig = jnp.asarray(rng.random(cfg.num_factors) + 0.1, jnp.float32)
+        params = dec.init(jax.random.PRNGKey(0), latent, fmu, fsig,
+                          sample=False)
+        mu, (mu2, sigma) = dec.apply(params, latent, fmu, fsig, sample=False)
+
+        # recompute alpha/beta through the same params, then the formula
+        from factorvae_tpu.models.decoder import AlphaLayer, BetaLayer
+
+        alpha = AlphaLayer(cfg)
+        a_params = {"params": params["params"]["alpha_layer"]}
+        amu, asig = alpha.apply(a_params, latent)
+        beta = BetaLayer(cfg)
+        b_params = {"params": params["params"]["beta_layer"]}
+        b = beta.apply(b_params, latent)
+
+        want_mu = np.asarray(amu) + np.asarray(b) @ np.asarray(fmu)
+        want_sig = np.sqrt(
+            np.asarray(asig) ** 2
+            + (np.asarray(b) ** 2) @ (np.asarray(fsig) ** 2) + 1e-6
+        )
+        np.testing.assert_allclose(np.asarray(mu2), want_mu, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(sigma), want_sig, rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(mu), np.asarray(mu2))
+
+    def test_sigma_zero_guard(self, rng):
+        """factor_sigma == 0 entries are replaced by 1e-6 (module.py:117)."""
+        from factorvae_tpu.models.decoder import FactorDecoder
+
+        cfg = CFG
+        dec = FactorDecoder(cfg)
+        latent = jnp.asarray(rng.normal(size=(4, cfg.hidden_size)), jnp.float32)
+        fmu = jnp.zeros(cfg.num_factors)
+        fsig = jnp.zeros(cfg.num_factors)  # all-zero sigma
+        params = dec.init(jax.random.PRNGKey(0), latent, fmu, fsig, sample=False)
+        _, (_, sigma) = dec.apply(params, latent, fmu, fsig, sample=False)
+        assert np.isfinite(np.asarray(sigma)).all()

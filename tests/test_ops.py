@@ -135,3 +135,18 @@ def test_masked_softmax_axis_variants(rng, axis):
     np.testing.assert_allclose(
         masked_softmax(x, mask, axis=axis), jax.nn.softmax(x, axis=axis), rtol=1e-6
     )
+
+
+class TestGaussianNLL:
+    def test_matches_scipy_logpdf(self, rng):
+        from scipy.stats import norm
+
+        from factorvae_tpu.ops.masked import masked_gaussian_nll
+
+        mu = rng.normal(size=(12,)).astype(np.float32)
+        sigma = (rng.random(12) + 0.2).astype(np.float32)
+        y = rng.normal(size=(12,)).astype(np.float32)
+        m = rng.random(12) > 0.3
+        got = float(masked_gaussian_nll(*map(jnp.asarray, (mu, sigma, y, m))))
+        want = float(np.mean(-norm.logpdf(y[m], mu[m], sigma[m])))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
