@@ -51,6 +51,7 @@ def main() -> None:
         frame = synthetic_frame(
             num_days=60, num_instruments=20, num_features=16,
             missing_prob=0.05, signal=0.7, seed=0,
+            label_scale=0.02,  # daily-return-like magnitudes for the demo
         )
         cfg = Config(
             model=ModelConfig(num_features=16, hidden_size=16, num_factors=8,

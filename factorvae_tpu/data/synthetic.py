@@ -21,8 +21,13 @@ def synthetic_frame(
     missing_prob: float = 0.1,
     signal: float = 0.5,
     seed: int = 0,
+    label_scale: float = 1.0,
 ) -> pd.DataFrame:
-    """Reference-schema frame with random (day, instrument) dropout."""
+    """Reference-schema frame with random (day, instrument) dropout.
+
+    `label_scale` scales LABEL0 (e.g. 0.02 for daily-return-like
+    magnitudes in demos; tests keep the default unit scale).
+    """
     rng = np.random.default_rng(seed)
     dates = pd.bdate_range("2020-01-01", periods=num_days)
     instruments = np.array([f"SH{600000 + k}" for k in range(num_instruments)])
@@ -34,7 +39,9 @@ def synthetic_frame(
             if rng.random() < missing_prob:
                 continue
             f = rng.normal(size=(num_features,)).astype(np.float32)
-            y = signal * float(f @ w) + (1 - signal) * float(rng.normal())
+            y = label_scale * (
+                signal * float(f @ w) + (1 - signal) * float(rng.normal())
+            )
             rows.append((d, inst))
             feats.append(f)
             labels.append(y)
