@@ -171,3 +171,43 @@ class TestTrainerConvenienceAPI:
         assert callable(fv.get_preset)
         with pytest.raises(AttributeError):
             fv.not_a_thing
+
+
+class TestSampleWeightedMetric:
+    def test_weighting_math(self, tmp_path):
+        """loss_sample_weighted = sum(day_loss * n_valid) / sum(n_valid),
+        recomputed on host from per-day evals (SURVEY §2 row 19)."""
+        import jax
+        import dataclasses
+
+        panel = synthetic_panel(num_days=8, num_instruments=6, num_features=8,
+                                missing_prob=0.35, seed=3)
+        ds = PanelDataset(panel, seq_len=3)
+        cfg = small_config(tmp_path, checkpoint_every=0)
+        cfg = dataclasses.replace(cfg, data=dataclasses.replace(cfg.data, seq_len=3),
+                                  model=dataclasses.replace(cfg.model, seq_len=3))
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = tr.init_state()
+
+        days = ds.split_days(None, None)
+        order = jnp.asarray(days.reshape(-1, 1))
+        key = jax.random.PRNGKey(7)
+        m = tr._eval_epoch(state.params, order, key)
+
+        # recompute per-day: same key splitting as eval_epoch's scan
+        total_w, total_n = 0.0, 0.0
+        k = key
+        for i, d in enumerate(days):
+            k, sub = jax.random.split(k)
+            k_s, k_d = jax.random.split(sub)
+            x, y, mask = ds.day_batch(int(d))
+            out = tr.model_eval.apply(
+                state.params, x[None], y[None], mask[None],
+                rngs={"sample": k_s, "dropout": k_d},
+            )
+            n = float(np.asarray(mask).sum())
+            total_w += float(out.loss[0]) * n
+            total_n += n
+        np.testing.assert_allclose(
+            float(m["loss_sample_weighted"]), total_w / total_n, rtol=1e-4
+        )
