@@ -218,3 +218,31 @@ class TestMultihostHelper:
         info = process_info()
         assert info["process_count"] == 1
         assert info["global_devices"] == 8
+
+
+class TestAOTExport:
+    def test_export_roundtrip_matches_live_model(self, trained, tmp_path):
+        """Serialized artifact reproduces the live model's deterministic
+        scores without touching flax or the params tree."""
+        import jax
+
+        from factorvae_tpu.eval.export_aot import export_prediction, load_exported
+
+        cfg, ds, state = trained
+        blob = export_prediction(state.params, cfg, n_max=ds.n_max)
+        assert isinstance(blob, bytes) and len(blob) > 1000
+        (tmp_path / "model.stablehlo").write_bytes(blob)
+
+        art = load_exported((tmp_path / "model.stablehlo").read_bytes())
+        x, y, mask = ds.day_batch(8)
+        from factorvae_tpu.models.factorvae import day_prediction
+
+        model = day_prediction(cfg.model, stochastic=False)
+        live = model.apply(state.params, x[None], mask[None],
+                           rngs={"sample": jax.random.PRNGKey(0)})
+        got = art.call(np.asarray(x)[None], np.asarray(mask)[None])
+        np.testing.assert_allclose(
+            np.asarray(got)[np.asarray(mask)[None]],
+            np.asarray(live)[np.asarray(mask)[None]],
+            rtol=1e-5, atol=1e-6,
+        )
