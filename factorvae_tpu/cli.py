@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "parameters: topk 50, n_drop 10, costs 5bp/15bp)")
     p.add_argument("--backtest_topk", type=int, default=50)
     p.add_argument("--backtest_n_drop", type=int, default=10)
+    p.add_argument("--export", type=str, default=None, metavar="PATH",
+                   help="write an AOT serving artifact (StableHLO, weights "
+                        "baked in) of the prediction function to PATH")
+    p.add_argument("--export_platform", type=str, default=None,
+                   help="cross-export target platform (e.g. 'tpu' from a "
+                        "CPU host); default: current backend")
     return p
 
 
@@ -285,6 +291,17 @@ def main(argv=None) -> int:
         logger.log("backtest", **{
             k: v for k, v in bt.summary().items() if v is not None
         })
+    if args.export:
+        from factorvae_tpu.eval.export_aot import export_prediction
+
+        platforms = (args.export_platform,) if args.export_platform else None
+        blob = export_prediction(
+            params, cfg, n_max=dataset.n_max,
+            stochastic=cfg.model.stochastic_inference, platforms=platforms,
+        )
+        with open(args.export, "wb") as fh:
+            fh.write(blob)
+        logger.log("export", path=args.export, bytes=len(blob))
     logger.finish()
     return 0
 

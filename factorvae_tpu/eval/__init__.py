@@ -1,4 +1,5 @@
 from factorvae_tpu.eval.backtest import BacktestResult, topk_dropout_backtest
+from factorvae_tpu.eval.export_aot import export_prediction, load_exported
 from factorvae_tpu.eval.metrics import RankIC, daily_rank_ic, rank_ic_frame
 from factorvae_tpu.eval.predict import (
     export_scores,
@@ -11,7 +12,9 @@ __all__ = [
     "BacktestResult",
     "RankIC",
     "daily_rank_ic",
+    "export_prediction",
     "export_scores",
+    "load_exported",
     "generate_prediction_scores",
     "predict_panel",
     "rank_ic_frame",
