@@ -246,3 +246,13 @@ class TestAOTExport:
             np.asarray(live)[np.asarray(mask)[None]],
             rtol=1e-5, atol=1e-6,
         )
+
+    def test_cross_export_to_tpu_platform(self, trained):
+        """A TPU-servable artifact can be produced on the CPU host (CI /
+        build machines without a chip)."""
+        from factorvae_tpu.eval.export_aot import export_prediction
+
+        cfg, ds, state = trained
+        blob = export_prediction(state.params, cfg, n_max=ds.n_max,
+                                 platforms=("tpu",))
+        assert isinstance(blob, bytes) and len(blob) > 1000
