@@ -99,7 +99,8 @@ def bench_ours(args, x, y):
                           num_portfolios=args.portfolios, seq_len=args.seq_len),
         data=DataConfig(seq_len=args.seq_len, start_time=None, fit_end_time=None,
                         val_start_time=None, val_end_time=None),
-        train=TrainConfig(num_epochs=1 + args.reps, days_per_step=1, seed=0,
+        train=TrainConfig(num_epochs=1 + args.reps,
+                          days_per_step=args.ours_days_per_step, seed=0,
                           checkpoint_every=0, save_dir="/tmp/factorvae_cmp"),
     )
     trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
@@ -127,6 +128,8 @@ def main():
     p.add_argument("--factors", type=int, default=96)
     p.add_argument("--portfolios", type=int, default=128)
     p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--ours_days_per_step", type=int, default=1,
+                   help="batched-update mode for the jax side (1 = faithful)")
     p.add_argument("--skip", choices=["none", "reference", "ours"], default="none")
     args = p.parse_args()
 
