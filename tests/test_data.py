@@ -214,3 +214,28 @@ class TestLoadFrame:
         out = load_frame(str(pkl))
         assert out.shape[1] == 159
         assert out.columns[-1] == "LABEL0"
+
+
+class TestETLGating:
+    def test_build_dataset_without_qlib_raises_recipe(self):
+        """qlib absent -> ImportError carrying the full setup recipe."""
+        import importlib.util
+
+        from factorvae_tpu.data import etl
+
+        if importlib.util.find_spec("qlib") is not None:
+            pytest.skip("qlib installed in this environment")
+        with pytest.raises(ImportError) as ei:
+            etl.build_dataset("/tmp/nope.pkl")
+        assert "qlib" in str(ei.value)
+        assert "factorvae_tpu.data.etl" in str(ei.value)
+
+    def test_etl_cli_returns_2_without_qlib(self, capsys):
+        import importlib.util
+
+        from factorvae_tpu.data import etl
+
+        if importlib.util.find_spec("qlib") is not None:
+            pytest.skip("qlib installed in this environment")
+        rc = etl.main(["--out", "/tmp/nope.pkl"])
+        assert rc == 2

@@ -211,3 +211,24 @@ class TestSampleWeightedMetric:
         np.testing.assert_allclose(
             float(m["loss_sample_weighted"]), total_w / total_n, rtol=1e-4
         )
+
+
+class TestProfilingUtils:
+    def test_trace_capture_writes_profile(self, tmp_path):
+        import os
+
+        import jax.numpy as jnp
+
+        from factorvae_tpu.utils.profiling import step_annotation, trace
+
+        with trace(str(tmp_path / "tr")):
+            with step_annotation("unit"):
+                jnp.ones(8).sum().block_until_ready()
+        prof = tmp_path / "tr" / "plugins" / "profile"
+        assert prof.is_dir() and any(prof.iterdir())
+
+    def test_trace_noop_without_dir(self):
+        from factorvae_tpu.utils.profiling import trace
+
+        with trace(None):
+            pass
