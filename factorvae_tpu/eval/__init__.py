@@ -1,5 +1,6 @@
 from factorvae_tpu.eval.backtest import BacktestResult, topk_dropout_backtest
 from factorvae_tpu.eval.export_aot import export_prediction, load_exported
+from factorvae_tpu.eval.factors import decompose
 from factorvae_tpu.eval.metrics import RankIC, daily_rank_ic, rank_ic_frame
 from factorvae_tpu.eval.predict import (
     export_scores,
@@ -12,6 +13,7 @@ __all__ = [
     "BacktestResult",
     "RankIC",
     "daily_rank_ic",
+    "decompose",
     "export_prediction",
     "export_scores",
     "load_exported",

@@ -1,0 +1,127 @@
+"""Factor decomposition over a date range.
+
+Everything the reference's forward returns per day (vae_loss,
+reconstruction, factor_mu/sigma, pred_mu/sigma — module.py:270) plus the
+decoder's internals (alpha, beta exposures), extracted as aligned pandas
+artifacts for factor analysis: which latent factors the posterior loads
+on, how the prior tracks it, and each stock's exposures — the
+interpretability surface of a dynamic factor model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from factorvae_tpu.config import Config
+from factorvae_tpu.data.loader import PanelDataset
+from factorvae_tpu.data.windows import gather_day
+from factorvae_tpu.models.factorvae import day_forward
+
+
+def decompose(
+    params,
+    config: Config,
+    dataset: PanelDataset,
+    start: Optional[str] = None,
+    end: Optional[str] = None,
+    seed: int = 0,
+    chunk: int = 32,
+) -> dict:
+    """Returns a dict of frames over [start, end]:
+
+    - 'factors': per-day K-factor stats, MultiIndex (datetime, factor),
+      columns [post_mu, post_sigma, prior_mu, prior_sigma] — posterior
+      vs prior trajectories (the KL's two sides).
+    - 'exposures': per (datetime, instrument) factor exposures beta (K
+      columns) plus the idiosyncratic alpha_mu/alpha_sigma.
+    - 'loss': per-day [loss, recon, kl].
+    """
+    cfg = config.model
+    seq_len = config.data.seq_len
+    model = day_forward(cfg, train=False)
+
+    from factorvae_tpu.models.decoder import AlphaLayer, BetaLayer
+    from factorvae_tpu.models.extractor import FeatureExtractor
+
+    inner = params["params"]["model"]
+
+    @jax.jit
+    def run_chunk(day_idx, key):
+        def one(d):
+            return gather_day(
+                dataset.values, dataset.last_valid, dataset.next_valid, d, seq_len
+            )
+
+        x, y, mask = jax.vmap(one)(jnp.maximum(day_idx, 0))
+        mask = mask & (day_idx >= 0)[:, None]
+        k1, k2 = jax.random.split(key)
+        out = model.apply(
+            params, x, jnp.nan_to_num(y), mask,
+            rngs={"sample": k1, "dropout": k2},
+        )
+        # decoder internals per stock (vmapped over days)
+        def internals(xd):
+            latent = FeatureExtractor(cfg).apply(
+                {"params": inner["feature_extractor"]}, xd
+            )
+            amu, asig = AlphaLayer(cfg).apply(
+                {"params": inner["factor_decoder"]["alpha_layer"]}, latent
+            )
+            beta = BetaLayer(cfg).apply(
+                {"params": inner["factor_decoder"]["beta_layer"]}, latent
+            )
+            return amu, asig, beta
+
+        amu, asig, beta = jax.vmap(internals)(x)
+        return out, amu, asig, beta
+
+    days = dataset.split_days(start, end)
+    k_factors = cfg.num_factors
+    rows_f, rows_l, exp_frames = [], [], []
+    base = jax.random.PRNGKey(seed)
+    for c0 in range(0, len(days), chunk):
+        sel = days[c0 : c0 + chunk]
+        padded = np.full(chunk, -1, np.int32)
+        padded[: len(sel)] = sel
+        out, amu, asig, beta = run_chunk(
+            jnp.asarray(padded), jax.random.fold_in(base, c0)
+        )
+        for j, d in enumerate(sel):
+            date = dataset.dates[int(d)]
+            for kf in range(k_factors):
+                rows_f.append((
+                    date, kf,
+                    float(out.factor_mu[j, kf]), float(out.factor_sigma[j, kf]),
+                    float(out.pred_mu[j, kf]), float(out.pred_sigma[j, kf]),
+                ))
+            rows_l.append((date, float(out.loss[j]), float(out.recon_loss[j]),
+                           float(out.kl[j])))
+            valid = dataset.valid[int(d)]
+            idx = pd.MultiIndex.from_product(
+                [[date], dataset.instruments[valid[: len(dataset.instruments)]]],
+                names=["datetime", "instrument"],
+            )
+            ef = pd.DataFrame(
+                np.asarray(beta[j])[valid],
+                index=idx,
+                columns=[f"beta_{kf}" for kf in range(k_factors)],
+            )
+            ef["alpha_mu"] = np.asarray(amu[j])[valid]
+            ef["alpha_sigma"] = np.asarray(asig[j])[valid]
+            exp_frames.append(ef)
+
+    factors = pd.DataFrame(
+        rows_f,
+        columns=["datetime", "factor", "post_mu", "post_sigma", "prior_mu",
+                 "prior_sigma"],
+    ).set_index(["datetime", "factor"])
+    loss = pd.DataFrame(
+        rows_l, columns=["datetime", "loss", "recon", "kl"]
+    ).set_index("datetime")
+    exposures = pd.concat(exp_frames) if exp_frames else pd.DataFrame()
+    return {"factors": factors, "exposures": exposures, "loss": loss}

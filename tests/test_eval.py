@@ -256,3 +256,24 @@ class TestAOTExport:
         blob = export_prediction(state.params, cfg, n_max=ds.n_max,
                                  platforms=("tpu",))
         assert isinstance(blob, bytes) and len(blob) > 1000
+
+
+class TestFactorDecomposition:
+    def test_decompose_frames(self, trained):
+        from factorvae_tpu.eval.factors import decompose
+
+        cfg, ds, state = trained
+        out = decompose(state.params, cfg, ds)
+        k = cfg.model.num_factors
+        d = len(ds.split_days(None, None))
+        assert len(out["factors"]) == d * k
+        assert list(out["factors"].columns) == [
+            "post_mu", "post_sigma", "prior_mu", "prior_sigma"]
+        assert (out["factors"]["post_sigma"] > 0).all()
+        assert (out["factors"]["prior_sigma"] > 0).all()
+        assert len(out["loss"]) == d
+        assert np.isfinite(out["loss"]).all().all()
+        # exposures: one row per valid (day, stock), K beta cols + alpha
+        assert len(out["exposures"]) == ds.valid.sum()
+        assert f"beta_{k-1}" in out["exposures"].columns
+        assert (out["exposures"]["alpha_sigma"] > 0).all()
