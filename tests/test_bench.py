@@ -1,0 +1,32 @@
+"""bench.py contract test: one JSON line with the required keys (the
+driver records this verbatim into BENCH_r{N}.json)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_single_json_line():
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO,            # drop the sandbox sitecustomize
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_DAYS": "8", "BENCH_STOCKS": "16", "BENCH_FEATURES": "8",
+        "BENCH_HIDDEN": "8", "BENCH_FACTORS": "4", "BENCH_PORTFOLIOS": "4",
+        "BENCH_SEQ_LEN": "4", "BENCH_DAYS_PER_STEP": "4", "BENCH_EPOCHS": "1",
+    }
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "windows/sec/chip"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    assert rec["metric"].endswith("_smoke")  # shapes differ from flagship
