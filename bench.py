@@ -62,6 +62,7 @@ def main() -> None:
             num_portfolios=PORTFOLIOS, seq_len=SEQ_LEN,
             compute_dtype="bfloat16" if USE_BF16 else "float32",
             use_pallas_attention=USE_PALLAS,
+            use_pallas_gru=USE_PALLAS,
         ),
         data=DataConfig(seq_len=SEQ_LEN, start_time=None, fit_end_time=None,
                         val_start_time=None, val_end_time=None),

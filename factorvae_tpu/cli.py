@@ -56,8 +56,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mse = reference-faithful single-sample MSE; nll = Gaussian NLL")
     p.add_argument("--bf16", action="store_true", help="bfloat16 compute dtype")
     p.add_argument("--pallas", action="store_true",
-                   help="use the fused Pallas attention kernel on the "
-                        "inference path (ops/pallas/attention.py)")
+                   help="use the fused Pallas kernels (attention + GRU "
+                        "recurrence, ops/pallas/) for compute")
     p.add_argument("--max_stocks", type=int, default=None,
                    help="cross-section padding N_max (default: inferred)")
     p.add_argument("--score_only", action="store_true",
@@ -156,6 +156,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             compute_dtype="bfloat16" if args.bf16 else "float32",
             stochastic_inference=bool(args.stochastic_scores),
             use_pallas_attention=bool(args.pallas),
+            use_pallas_gru=bool(args.pallas),
         ),
         data=DataConfig(
             dataset_path=resolve("dataset"),

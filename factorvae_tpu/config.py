@@ -48,10 +48,14 @@ class ModelConfig:
     # Use torch-style U(+-1/sqrt(fan_in)) initializers so training dynamics
     # match the reference's scale. False -> flax defaults (lecun_normal).
     torch_init: bool = True
-    # Fused Pallas kernel for the K-head cross-section attention on the
-    # inference path (ops/pallas/attention.py). Off by default; the XLA
-    # einsum path is the reference implementation.
+    # Fused Pallas kernel for the K-head cross-section attention
+    # (ops/pallas/attention.py + attention_grad.py; differentiable, fused
+    # dropout). Off by default; the XLA einsum path is the reference
+    # implementation.
     use_pallas_attention: bool = False
+    # Fused Pallas GRU recurrence (ops/pallas/gru.py; custom-VJP BPTT,
+    # single-layer path). Off by default; lax.scan is the reference path.
+    use_pallas_gru: bool = False
 
     @property
     def dtype(self):

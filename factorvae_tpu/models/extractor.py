@@ -38,7 +38,8 @@ class FeatureExtractor(nn.Module):
         # existing checkpoints restore unchanged; L>1 nests per-layer.
         if cfg.gru_layers == 1:
             gru = GRU(
-                cfg.hidden_size, torch_init=cfg.torch_init, dtype=dtype, name="gru"
+                cfg.hidden_size, torch_init=cfg.torch_init, dtype=dtype,
+                use_pallas=cfg.use_pallas_gru, name="gru",
             )
         else:
             gru = StackedGRU(

@@ -111,6 +111,9 @@ class GRU(nn.Module):
     torch_init: bool = True
     dtype: Optional[jnp.dtype] = None
     return_sequence: bool = False
+    # Fused Pallas recurrence kernel (ops/pallas/gru.py): whole-sequence
+    # VMEM-resident scan with custom-VJP BPTT. Last-hidden output only.
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -132,6 +135,12 @@ class GRU(nn.Module):
             (3 * h_dim,),
         )
         dtype = self.dtype or x.dtype
+
+        if self.use_pallas and not self.return_sequence:
+            from factorvae_tpu.ops.pallas.gru import gru_scan
+
+            return gru_scan(xi.astype(jnp.float32), w_h, b_h).astype(dtype)
+
         w_h = w_h.astype(dtype)
         b_h = b_h.astype(dtype)
 

@@ -1,0 +1,207 @@
+"""Fused GRU recurrence as a Pallas TPU kernel (forward + custom VJP).
+
+The extractor's GRU runs as `lax.scan` over T (models/layers.py) — already
+good under XLA. This kernel fuses the *whole recurrence* into one Pallas
+call: the precomputed input projections `xi` (N, T, 3H), the hidden
+weights and the running hidden state all stay in VMEM for all T steps, so
+nothing round-trips HBM between timesteps. The input-side projection (one
+big matmul) deliberately stays OUTSIDE the kernel where the MXU already
+handles it optimally.
+
+Backward is a second kernel doing recompute-BPTT: re-run the recurrence
+storing the (T+1, Nb, H) hidden sequence in VMEM, then walk t = T-1..0
+accumulating d_xi, d_Wh, d_bh and the carried d_h.
+
+Rows (stocks) are independent in the recurrence, so both kernels tile the
+N axis into blocks of `_N_BLOCK` rows per grid step — bounding VMEM to a
+few MB regardless of N and T (the backward's per-block footprint is
+xi + dxi + h-seq ≈ 2*Nb*T*3H + (T+1)*Nb*H floats; at Nb=64, T=60, H=64
+that is ~7 MB). d_Wh/d_bh accumulate across the sequential TPU grid.
+
+Gate math matches layers.GRU exactly (torch layout [r | z | n]):
+
+    r = sigmoid(xi_r + gh_r)    z = sigmoid(xi_z + gh_z)
+    n = tanh(xi_n + r * gh_n)   h' = (1 - z) * n + z * h
+    with gh = h @ Wh + bh
+
+Selected via ``ModelConfig.use_pallas_gru``; interpret-mode on CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_N_BLOCK = 64  # rows per grid step; bounds VMEM independent of N/T
+
+
+def _gates(xt, gh, h_dim):
+    r = jax.nn.sigmoid(xt[:, :h_dim] + gh[:, :h_dim])
+    z = jax.nn.sigmoid(xt[:, h_dim:2 * h_dim] + gh[:, h_dim:2 * h_dim])
+    n = jnp.tanh(xt[:, 2 * h_dim:] + r * gh[:, 2 * h_dim:])
+    return r, z, n
+
+
+def _fwd_kernel(xi_ref, wh_ref, bh_ref, hlast_ref):
+    xi = xi_ref[:]                                   # (N, T, 3H)
+    wh = wh_ref[:]                                   # (H, 3H)
+    bh = bh_ref[0, :]                                # (3H,)
+    n_rows, t_len, h3 = xi.shape
+    h_dim = h3 // 3
+
+    def step(t, h):
+        xt = jax.lax.dynamic_slice_in_dim(xi, t, 1, axis=1)[:, 0, :]
+        gh = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
+        r, z, n = _gates(xt, gh, h_dim)
+        return (1.0 - z) * n + z * h
+
+    h0 = jnp.zeros((n_rows, h_dim), jnp.float32)
+    hlast_ref[:] = jax.lax.fori_loop(0, t_len, step, h0)
+
+
+def _bwd_kernel(xi_ref, wh_ref, bh_ref, dh_ref, dxi_ref, dwh_ref, dbh_ref):
+    xi = xi_ref[:]
+    wh = wh_ref[:]
+    bh = bh_ref[0, :]
+    n_rows, t_len, h3 = xi.shape
+    h_dim = h3 // 3
+
+    # recompute the hidden sequence: hseq[t] = h before step t
+    def fstep(t, hseq):
+        h = jax.lax.dynamic_slice_in_dim(hseq, t, 1, axis=0)[0]
+        xt = jax.lax.dynamic_slice_in_dim(xi, t, 1, axis=1)[:, 0, :]
+        gh = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
+        r, z, n = _gates(xt, gh, h_dim)
+        h_new = (1.0 - z) * n + z * h
+        return jax.lax.dynamic_update_slice(hseq, h_new[None], (t + 1, 0, 0))
+
+    hseq = jnp.zeros((t_len + 1, n_rows, h_dim), jnp.float32)
+    hseq = jax.lax.fori_loop(0, t_len, fstep, hseq)
+
+    def bstep(i, carry):
+        dh, dxi, dwh, dbh = carry
+        t = t_len - 1 - i
+        h_prev = jax.lax.dynamic_slice_in_dim(hseq, t, 1, axis=0)[0]
+        xt = jax.lax.dynamic_slice_in_dim(xi, t, 1, axis=1)[:, 0, :]
+        gh = jnp.dot(h_prev, wh, preferred_element_type=jnp.float32) + bh
+        r, z, n = _gates(xt, gh, h_dim)
+        # h' = (1-z) n + z h_prev
+        dz = dh * (h_prev - n)
+        dn = dh * (1.0 - z)
+        dh_prev = dh * z
+        dtanh = dn * (1.0 - n * n)               # d(xi_n + r*gh_n)
+        dr = dtanh * gh[:, 2 * h_dim:]
+        dgh_n = dtanh * r
+        dsig_r = dr * r * (1.0 - r)              # d(xi_r + gh_r)
+        dsig_z = dz * z * (1.0 - z)              # d(xi_z + gh_z)
+        dxt = jnp.concatenate([dsig_r, dsig_z, dtanh], axis=-1)   # (Nb, 3H)
+        dgh = jnp.concatenate([dsig_r, dsig_z, dgh_n], axis=-1)   # (Nb, 3H)
+        dh_prev = dh_prev + jnp.dot(
+            dgh, wh.T, preferred_element_type=jnp.float32
+        )
+        dwh = dwh + jnp.dot(h_prev.T, dgh, preferred_element_type=jnp.float32)
+        dbh = dbh + jnp.sum(dgh, axis=0)
+        dxi = jax.lax.dynamic_update_slice(dxi, dxt[:, None, :], (0, t, 0))
+        return dh_prev, dxi, dwh, dbh
+
+    init = (
+        dh_ref[:],
+        jnp.zeros((n_rows, t_len, h3), jnp.float32),
+        jnp.zeros((h_dim, h3), jnp.float32),
+        jnp.zeros((h3,), jnp.float32),
+    )
+    _, dxi, dwh, dbh = jax.lax.fori_loop(0, t_len, bstep, init)
+    dxi_ref[:] = dxi
+
+    # dWh/dbh accumulate across the sequential grid of row blocks
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dwh_ref[:] = jnp.zeros_like(dwh_ref)
+        dbh_ref[:] = jnp.zeros_like(dbh_ref)
+
+    dwh_ref[:] += dwh
+    dbh_ref[0, :] += dbh
+
+
+def _pad_rows(a: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    if n_pad == 0:
+        return a
+    pad = [(0, n_pad)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, pad)
+
+
+@jax.custom_vjp
+def gru_scan(xi: jnp.ndarray, w_h: jnp.ndarray, b_h: jnp.ndarray) -> jnp.ndarray:
+    """Fused recurrence: xi (N, T, 3H), w_h (H, 3H), b_h (3H,) -> last
+    hidden state (N, H)."""
+    interpret = jax.default_backend() != "tpu"
+    n_rows, t_len, h3 = xi.shape
+    h_dim = h3 // 3
+    nb = min(_N_BLOCK, n_rows)
+    n_pad = (-n_rows) % nb
+    grid = ((n_rows + n_pad) // nb,)
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((nb, t_len, h3), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h_dim, h3), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h3), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((nb, h_dim), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_rows + n_pad, h_dim), jnp.float32),
+        interpret=interpret,
+    )(_pad_rows(xi.astype(jnp.float32), n_pad), w_h.astype(jnp.float32),
+      b_h.reshape(1, -1).astype(jnp.float32))
+    return out[:n_rows]
+
+
+def _fwd(xi, w_h, b_h):
+    return gru_scan(xi, w_h, b_h), (xi, w_h, b_h)
+
+
+def _bwd(res, dh):
+    xi, w_h, b_h = res
+    interpret = jax.default_backend() != "tpu"
+    n_rows, t_len, h3 = xi.shape
+    h_dim = h3 // 3
+    nb = min(_N_BLOCK, n_rows)
+    n_pad = (-n_rows) % nb
+    grid = ((n_rows + n_pad) // nb,)
+    dxi, dwh, dbh = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((nb, t_len, h3), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h_dim, h3), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h3), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nb, h_dim), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((nb, t_len, h3), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h_dim, h3), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, h3), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_rows + n_pad, t_len, h3), jnp.float32),
+            jax.ShapeDtypeStruct((h_dim, h3), jnp.float32),
+            jax.ShapeDtypeStruct((1, h3), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_pad_rows(xi.astype(jnp.float32), n_pad), w_h.astype(jnp.float32),
+      b_h.reshape(1, -1).astype(jnp.float32),
+      _pad_rows(dh.astype(jnp.float32), n_pad))
+    return dxi[:n_rows], dwh, dbh[0]
+
+
+gru_scan.defvjp(_fwd, _bwd)
