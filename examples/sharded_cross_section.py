@@ -1,0 +1,86 @@
+"""Example: cross-section ('stock'-axis) sharding with explicit collectives.
+
+Demonstrates the framework's distributed primitives directly — the same
+ops GSPMD inserts automatically in the trainer, written against a named
+mesh axis with `jax.shard_map`:
+
+  1. masked softmax over a sharded stock axis (pmax/psum),
+  2. the distributed portfolio reduction W^T y,
+  3. ring attention over the sharded cross-section (ppermute rotation).
+
+Runs on any device count (virtual CPU mesh here; a TPU slice unchanged).
+
+Run:  python examples/sharded_cross_section.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from factorvae_tpu.utils.testing import force_host_devices
+
+force_host_devices(8)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from factorvae_tpu.ops.masked import masked_softmax
+from factorvae_tpu.parallel.collective_ops import (
+    pmax_masked_softmax,
+    psum_matvec,
+)
+from factorvae_tpu.parallel.ring import ring_cross_section_attention
+
+
+def main() -> None:
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices).reshape(len(devices)), ("stock",))
+    print(f"mesh: {len(devices)} x {devices[0].platform} over axis 'stock'")
+
+    rng = np.random.default_rng(0)
+    n, m, h, k = 64, 6, 8, 4  # stocks, portfolios, hidden, heads
+    weights = jnp.asarray(rng.normal(size=(n, m)), jnp.float32)
+    returns = jnp.asarray(rng.normal(size=(n,)) * 0.02, jnp.float32)
+    mask = jnp.asarray(rng.random(n) > 0.1)
+
+    # 1) distributed masked softmax over stocks (the encoder's dim=0 softmax)
+    dist_softmax = shard_map(
+        lambda w, mk: pmax_masked_softmax(w, mk[:, None], "stock", axis=0),
+        mesh=mesh, in_specs=(P("stock", None), P("stock")),
+        out_specs=P("stock", None),
+    )
+    w_dist = dist_softmax(weights, mask)
+    w_ref = masked_softmax(weights, mask[:, None], axis=0)
+    print("softmax max|delta|:", float(jnp.abs(w_dist - w_ref).max()))
+
+    # 2) distributed portfolio returns y_p = W^T y
+    dist_portfolio = shard_map(
+        lambda w, y: psum_matvec(w, y, "stock"),
+        mesh=mesh, in_specs=(P("stock", None), P("stock")), out_specs=P(),
+    )
+    y_p = dist_portfolio(w_dist, jnp.where(mask, returns, 0.0))
+    print("portfolio returns:", np.round(np.asarray(y_p), 5))
+
+    # 3) ring attention: K queries over the sharded cross-section
+    q = jnp.asarray(rng.normal(size=(k, h)), jnp.float32)
+    keys = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+    vals = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+    ring = shard_map(
+        lambda kl, vl, ml: ring_cross_section_attention(q, kl, vl, ml, "stock"),
+        mesh=mesh,
+        in_specs=(P("stock", None), P("stock", None), P("stock")),
+        out_specs=P(), check_vma=False,
+    )
+    ctx = ring(keys, vals, mask)
+    print("ring attention context:", ctx.shape, "finite:",
+          bool(np.isfinite(np.asarray(ctx)).all()))
+
+
+if __name__ == "__main__":
+    main()
