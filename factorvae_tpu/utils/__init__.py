@@ -1,11 +1,16 @@
 from factorvae_tpu.utils.logging import MetricsLogger
 from factorvae_tpu.utils.profiling import debug_nans, step_annotation, trace
 from factorvae_tpu.utils.rng import set_seed
-from factorvae_tpu.utils.testing import force_host_devices, host_device_count
+from factorvae_tpu.utils.testing import (
+    enable_persistent_compile_cache,
+    force_host_devices,
+    host_device_count,
+)
 
 __all__ = [
     "MetricsLogger",
     "debug_nans",
+    "enable_persistent_compile_cache",
     "force_host_devices",
     "host_device_count",
     "set_seed",
