@@ -12,6 +12,23 @@ from factorvae_tpu.models.layers import GRU
 from factorvae_tpu.ops.pallas.gru import gru_scan
 
 
+def scan_gru_reference(xi, wh, bh):
+    """lax.scan oracle with the kernel's gate math (torch [r|z|n] layout)."""
+    n, _, h3 = xi.shape
+    h = h3 // 3
+
+    def step(hc, xt):
+        gh = hc @ wh + bh
+        r = jax.nn.sigmoid(xt[:, :h] + gh[:, :h])
+        z = jax.nn.sigmoid(xt[:, h:2 * h] + gh[:, h:2 * h])
+        nn_ = jnp.tanh(xt[:, 2 * h:] + r * gh[:, 2 * h:])
+        return (1 - z) * nn_ + z * hc, None
+
+    out, _ = jax.lax.scan(step, jnp.zeros((n, h)), jnp.swapaxes(xi, 0, 1))
+    return out
+
+
+
 class TestGruKernel:
     def test_forward_and_grads_match_scan(self, rng):
         n, t, h = 6, 5, 4
@@ -19,24 +36,14 @@ class TestGruKernel:
         wh = jnp.asarray(rng.normal(size=(h, 3 * h)) * 0.3, jnp.float32)
         bh = jnp.asarray(rng.normal(size=(3 * h,)) * 0.1, jnp.float32)
 
-        def ref(xi, wh, bh):
-            def step(hc, xt):
-                gh = hc @ wh + bh
-                r = jax.nn.sigmoid(xt[:, :h] + gh[:, :h])
-                z = jax.nn.sigmoid(xt[:, h:2 * h] + gh[:, h:2 * h])
-                nn_ = jnp.tanh(xt[:, 2 * h:] + r * gh[:, 2 * h:])
-                return (1 - z) * nn_ + z * hc, None
-            out, _ = jax.lax.scan(step, jnp.zeros((n, h)), jnp.swapaxes(xi, 0, 1))
-            return out
-
         np.testing.assert_allclose(
-            np.asarray(gru_scan(xi, wh, bh)), np.asarray(ref(xi, wh, bh)),
+            np.asarray(gru_scan(xi, wh, bh)), np.asarray(scan_gru_reference(xi, wh, bh)),
             rtol=1e-5, atol=1e-6,
         )
         dh = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
         gf = jax.grad(lambda *a: jnp.sum(gru_scan(*a) * dh), argnums=(0, 1, 2))(
             xi, wh, bh)
-        gr = jax.grad(lambda *a: jnp.sum(ref(*a) * dh), argnums=(0, 1, 2))(
+        gr = jax.grad(lambda *a: jnp.sum(scan_gru_reference(*a) * dh), argnums=(0, 1, 2))(
             xi, wh, bh)
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -99,25 +106,14 @@ class TestGruKernel:
         wh = jnp.asarray(rng.normal(size=(h, 3 * h)) * 0.3, jnp.float32)
         bh = jnp.asarray(rng.normal(size=(3 * h,)) * 0.1, jnp.float32)
 
-        def ref(xi, wh, bh):
-            def step(hc, xt):
-                gh = hc @ wh + bh
-                r = jax.nn.sigmoid(xt[:, :h] + gh[:, :h])
-                z = jax.nn.sigmoid(xt[:, h:2 * h] + gh[:, h:2 * h])
-                nn_ = jnp.tanh(xt[:, 2 * h:] + r * gh[:, 2 * h:])
-                return (1 - z) * nn_ + z * hc, None
-            out, _ = jax.lax.scan(step, jnp.zeros((n, h)),
-                                  jnp.swapaxes(xi, 0, 1))
-            return out
-
         np.testing.assert_allclose(
-            np.asarray(gru_scan(xi, wh, bh)), np.asarray(ref(xi, wh, bh)),
+            np.asarray(gru_scan(xi, wh, bh)), np.asarray(scan_gru_reference(xi, wh, bh)),
             rtol=1e-5, atol=1e-6,
         )
         dh = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
         gf = jax.grad(lambda *a: jnp.sum(gru_scan(*a) * dh),
                       argnums=(0, 1, 2))(xi, wh, bh)
-        gr = jax.grad(lambda *a: jnp.sum(ref(*a) * dh),
+        gr = jax.grad(lambda *a: jnp.sum(scan_gru_reference(*a) * dh),
                       argnums=(0, 1, 2))(xi, wh, bh)
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
