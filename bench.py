@@ -1,8 +1,8 @@
-"""Benchmark: training throughput (windows/sec) on the flagship config.
+"""Benchmark: training throughput (windows/sec) + MFU on the flagship config.
 
 Flagship = the reference CLI's default architecture (main.py:92-113):
 Alpha158 (C=158), T=20, H=64, K=96, M=128, CSI300-scale cross-section
-(N_max=360), training on synthetic data of that exact shape. A "window"
+(N_max=356), training on synthetic data of that exact shape. A "window"
 is one (stock, day) sample — one (T, C) look-back matrix — matching the
 north-star metric "training windows/sec/chip" (BASELINE.json).
 
@@ -14,19 +14,46 @@ kernel launches, per-step host sync at train_model.py:28) x ~300
 stocks/day = 3.0e4 windows/sec. Replace with a measured number if one
 ever lands in BASELINE.md.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness contract (the driver gets ONE shot per round):
+- The accelerator backend is probed in a SUBPROCESS with a timeout, with
+  bounded retry + backoff, so a hung or crashing TPU-plugin init (the
+  round-1 failure mode: `RuntimeError: Unable to initialize backend
+  'axon'`) can neither kill nor wedge the bench. A probe that comes back
+  with only host CPU counts as "no accelerator" (a silent CPU
+  fall-through must not masquerade as a flagship chip number).
+- The accelerator run itself also executes in a TIMED subprocess
+  (BENCH_RUN_TIMEOUT), so a relay dying mid-run cannot wedge the parent.
+- If the accelerator never comes up — or the accelerator-path run itself
+  dies or times out — the bench re-executes itself pinned to host CPU at
+  reduced shapes and reports that number, tagged `_cpu_fallback`, with
+  the accelerator error recorded in the JSON.
+- Every terminal path prints exactly ONE JSON line with at least
+  {"metric", "value", "unit", "vs_baseline"} and exits 0.
+
+MFU: an analytic per-day FLOPs model of the flagship network (see
+`model_flops_per_day`) gives model FLOPs/sec; divided by the chip's peak
+(bf16 headline peak — the standard MFU denominator) it yields `mfu` in
+the JSON line. On CPU, `mfu` is null (no meaningful peak to divide by).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 REF_A100_WINDOWS_PER_SEC = 3.0e4  # documented estimate; see module docstring
 
-import os
+# Headline (bf16) peak FLOPs/sec per chip generation — the standard MFU
+# denominator. Generation read from PALLAS_AXON_TPU_GEN when present.
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
 
 # CSI300-flagship shapes (env-overridable for smoke runs on small hosts:
 # BENCH_DAYS=16 BENCH_STOCKS=16 ... python bench.py)
@@ -42,10 +69,131 @@ EPOCHS_TIMED = int(os.environ.get("BENCH_EPOCHS", 3))
 USE_BF16 = os.environ.get("BENCH_BF16", "0") == "1"
 USE_PALLAS = os.environ.get("BENCH_PALLAS", "0") == "1"
 
+# Backend-acquisition knobs (VERDICT round-1: no retry existed and the one
+# shot crashed at backend init).
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", 75))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", 3))
+PROBE_BACKOFF_S = (5.0, 10.0)
 
-def main() -> None:
+FORCED_CPU = os.environ.get("BENCH_FORCE_CPU", "0") == "1"
+ACCEL_CHILD = os.environ.get("BENCH_ACCEL_CHILD", "0") == "1"
+RUN_TIMEOUT_S = float(os.environ.get("BENCH_RUN_TIMEOUT", 1200))
+
+# Reduced shapes for the CPU-fallback rerun: same architecture family,
+# small enough to finish in ~a minute on a 1-core host.
+CPU_FALLBACK_SHAPES = {
+    "BENCH_STOCKS": "96",
+    "BENCH_DAYS": "32",
+    "BENCH_EPOCHS": "1",
+}
+
+
+def emit(payload: dict) -> None:
+    """The ONE JSON line the driver parses."""
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def probe_backend() -> tuple[bool, str]:
+    """Try to bring up the accelerator backend in a SUBPROCESS.
+
+    Returns (ok, detail). A subprocess bounds both failure modes observed
+    in round 1: fast RuntimeError (BENCH_r01.json) and an indefinite hang
+    when the plugin's relay endpoint is dead. Retries with backoff because
+    the relay failure is transient per PERF.md.
+    """
+    code = (
+        "import jax; d = jax.devices();"
+        "print(d[0].platform, getattr(d[0], 'device_kind', '?'))"
+    )
+    last = ""
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+            if r.returncode == 0:
+                out = r.stdout.strip()
+                # A silent fall-through to host CPU is NOT an accelerator:
+                # running flagship shapes on a 1-core host would take hours
+                # and report an untagged flagship number. Route it to the
+                # tagged reduced-shape CPU fallback instead.
+                if out.split()[:1] == ["cpu"]:
+                    return False, "probe found only host CPU (no accelerator)"
+                return True, out
+            last = (r.stderr.strip().splitlines() or ["rc=%d" % r.returncode])[-1]
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung >{PROBE_TIMEOUT_S:.0f}s (relay dead?)"
+        except Exception as e:  # pragma: no cover - defensive
+            last = f"{type(e).__name__}: {e}"
+        if attempt < PROBE_ATTEMPTS - 1:
+            time.sleep(PROBE_BACKOFF_S[min(attempt, len(PROBE_BACKOFF_S) - 1)])
+    return False, last
+
+
+def model_flops_per_day(
+    n: int,
+    *,
+    c: int = NUM_FEATURES,
+    t: int = SEQ_LEN,
+    h: int = HIDDEN,
+    k: int = FACTORS,
+    m: int = PORTFOLIOS,
+    gru_layers: int = 1,
+) -> float:
+    """Analytic FORWARD FLOPs for one day's cross-section of n stocks.
+
+    Counts multiply-adds as 2 FLOPs; ignores O(N·H) elementwise epsilon
+    terms. Mirrors the flagship graph:
+      extractor  proj Dense C->C over (N,T) + GRU (input C->3H, hidden
+                 H->3H per step)                       [module.py:26-31]
+      encoder    Dense H->M, portfolio matvec, mapping M->K mu/sigma
+                                                       [module.py:52-64]
+      alpha      Dense H->H + two H->1 heads           [module.py:78-84]
+      beta       Dense H->K                            [module.py:92-94]
+      predictor  batched K-head attention: key/value (K,H,H) einsums,
+                 q.K^T scores, context, shared MLP + heads
+                                                       [module.py:169-187]
+    """
+    fl = 0.0
+    fl += 2.0 * n * t * c * c                       # extractor proj
+    cin = c
+    for _ in range(gru_layers):                     # GRU gates
+        fl += 2.0 * n * t * 3 * h * (cin + h)
+        cin = h
+    fl += 2.0 * n * h * m + 2.0 * n * m + 2 * 2.0 * m * k       # encoder
+    fl += 2.0 * n * h * h + 2 * 2.0 * n * h                     # alpha
+    fl += 2.0 * n * h * k                                       # beta
+    fl += 2 * 2.0 * k * n * h * h                   # predictor key/value
+    fl += 2 * 2.0 * k * n * h                       # scores + context
+    fl += 2.0 * k * h * h + 2 * 2.0 * k * h         # predictor MLP+heads
+    fl += 6.0 * n * k                               # decoder combine
+    return fl
+
+
+def detect_platform() -> tuple[str, float | None]:
+    """(platform_label, peak_flops_or_None). Call only after backend is up."""
     import jax
-    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    plat = d.platform
+    if plat == "cpu":
+        return "cpu", None
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    peak = TPU_PEAK_FLOPS.get(gen)
+    if peak is None:
+        kind = str(getattr(d, "device_kind", "")).lower()
+        for g, p in TPU_PEAK_FLOPS.items():
+            if g in kind:
+                peak = p
+                break
+    label = f"tpu-{gen}" if gen else plat
+    return label, peak
+
+
+def run_bench() -> dict:
+    import jax
 
     from factorvae_tpu.utils.testing import enable_persistent_compile_cache
 
@@ -55,6 +203,8 @@ def main() -> None:
     from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
     from factorvae_tpu.train import Trainer
     from factorvae_tpu.utils.logging import MetricsLogger
+
+    platform, peak = detect_platform()
 
     cfg = Config(
         model=ModelConfig(
@@ -84,7 +234,8 @@ def main() -> None:
     state, m = trainer._train_epoch(state, order)
     jax.block_until_ready(m["loss"])
 
-    windows_per_epoch = float(m["days"]) * N_STOCKS
+    days_per_epoch = float(m["days"])
+    windows_per_epoch = days_per_epoch * N_STOCKS
     t0 = time.time()
     for epoch in range(1, EPOCHS_TIMED + 1):
         state, m = trainer._train_epoch(state, trainer._epoch_orders(epoch))
@@ -92,17 +243,128 @@ def main() -> None:
     dt = time.time() - t0
 
     value = EPOCHS_TIMED * windows_per_epoch / dt
+    days_per_sec = EPOCHS_TIMED * days_per_epoch / dt
+
+    # MFU: model FLOPs (fwd+bwd ~= 3x fwd), computed on the PADDED
+    # cross-section actually run on the MXU, over the measured wall time.
+    n_pad = int(ds.n_max)
+    train_flops_per_day = 3.0 * model_flops_per_day(n_pad)
+    flops_per_sec = train_flops_per_day * days_per_sec
+    mfu = (flops_per_sec / peak) if peak else None
+
     # mark non-flagship runs so the dashboard's flagship series stays clean
     flagship = (NUM_FEATURES, SEQ_LEN, HIDDEN, FACTORS, PORTFOLIOS, N_STOCKS,
                 NUM_DAYS, DAYS_PER_STEP, EPOCHS_TIMED, USE_BF16, USE_PALLAS
                 ) == (158, 20, 64, 96, 128, 356, 256, 8, 3, False, False)
-    print(json.dumps({
+    return {
         "metric": "train_throughput_flagship_K96_H64_Alpha158"
-                  + ("" if flagship else "_smoke"),
+                  + ("" if flagship else "_smoke")
+                  + ("_cpu_fallback" if FORCED_CPU else ""),
         "value": round(value, 1),
         "unit": "windows/sec/chip",
         "vs_baseline": round(value / REF_A100_WINDOWS_PER_SEC, 3),
-    }))
+        "platform": platform,
+        "days_per_sec": round(days_per_sec, 2),
+        "model_tflops_per_sec": round(flops_per_sec / 1e12, 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "n_padded": n_pad,
+        "bf16": USE_BF16,
+        "pallas": USE_PALLAS,
+    }
+
+
+def rerun_on_cpu(error: str) -> None:
+    """Re-exec pinned to host CPU at reduced shapes; forward its JSON line."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"  # the driver env pins an accelerator here
+    for k, v in CPU_FALLBACK_SHAPES.items():
+        env.setdefault(k, v)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+        line = next(
+            (ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")), None)
+        if r.returncode == 0 and line:
+            payload = json.loads(line)
+            payload["accelerator_error"] = error
+            emit(payload)
+            return
+        detail = (r.stderr.strip().splitlines() or ["no output"])[-1]
+    except Exception as e:  # pragma: no cover - defensive
+        detail = f"{type(e).__name__}: {e}"
+    emit({
+        "metric": "train_throughput_flagship_K96_H64_Alpha158_failed",
+        "value": 0.0,
+        "unit": "windows/sec/chip",
+        "vs_baseline": 0.0,
+        "accelerator_error": error,
+        "cpu_fallback_error": detail,
+    })
+
+
+def run_accel_child() -> tuple[bool, str]:
+    """Run the accelerator bench in a TIMED subprocess and forward its JSON
+    line. A post-probe hang (relay dying mid-run — the other round-1
+    failure mode) is bounded by BENCH_RUN_TIMEOUT instead of wedging the
+    driver's one shot. Returns (ok, error_detail)."""
+    env = dict(os.environ)
+    env["BENCH_ACCEL_CHILD"] = "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=RUN_TIMEOUT_S, env=env,
+        )
+        line = next(
+            (ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")), None)
+        if r.returncode == 0 and line:
+            emit(json.loads(line))
+            return True, ""
+        detail = (r.stderr.strip().splitlines() or ["no output"])[-1]
+    except subprocess.TimeoutExpired:
+        detail = f"accelerator run exceeded {RUN_TIMEOUT_S:.0f}s"
+    except Exception as e:  # pragma: no cover - defensive
+        detail = f"{type(e).__name__}: {e}"
+    return False, detail
+
+
+def main() -> None:
+    if ACCEL_CHILD:
+        # Child: backend already validated by the parent's probe; any crash
+        # here surfaces as rc!=0 and the parent falls back to CPU.
+        emit(run_bench())
+        return
+
+    if FORCED_CPU:
+        # Pin host CPU BEFORE any jax import: the sandbox TPU plugin pins
+        # jax_platforms at the config level, so the env var alone is not
+        # enough (utils/testing.force_host_devices handles both).
+        from factorvae_tpu.utils.testing import force_host_devices
+
+        force_host_devices(1)
+        try:
+            emit(run_bench())
+        except Exception as e:
+            emit({
+                "metric": "train_throughput_flagship_K96_H64_Alpha158_failed",
+                "value": 0.0,
+                "unit": "windows/sec/chip",
+                "vs_baseline": 0.0,
+                "cpu_fallback_error": f"{type(e).__name__}: {e}",
+            })
+        return
+
+    ok, detail = probe_backend()
+    if not ok:
+        rerun_on_cpu(f"backend probe failed after {PROBE_ATTEMPTS} attempts: {detail}")
+        return
+    ok, detail = run_accel_child()
+    if not ok:
+        rerun_on_cpu(f"accelerator run failed: {detail}")
 
 
 if __name__ == "__main__":

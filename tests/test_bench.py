@@ -1,5 +1,8 @@
-"""bench.py contract test: one JSON line with the required keys (the
-driver records this verbatim into BENCH_r{N}.json)."""
+"""bench.py contract tests: one JSON line with the required keys (the
+driver records this verbatim into BENCH_r{N}.json) — on BOTH the happy
+path and the accelerator-failure path (round-1 lesson: the bench crashed
+at backend init and the round produced zero measurements; VERDICT.md
+weak-1 requires retry + a parseable diagnostic instead)."""
 
 import json
 import os
@@ -8,16 +11,17 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
 
-def test_bench_emits_single_json_line():
-    env = {
-        **os.environ,
-        "PYTHONPATH": REPO,            # drop the sandbox sitecustomize
-        "JAX_PLATFORMS": "cpu",
-        "BENCH_DAYS": "8", "BENCH_STOCKS": "16", "BENCH_FEATURES": "8",
-        "BENCH_HIDDEN": "8", "BENCH_FACTORS": "4", "BENCH_PORTFOLIOS": "4",
-        "BENCH_SEQ_LEN": "4", "BENCH_DAYS_PER_STEP": "4", "BENCH_EPOCHS": "1",
-    }
+SMOKE_SHAPES = {
+    "BENCH_DAYS": "8", "BENCH_STOCKS": "16", "BENCH_FEATURES": "8",
+    "BENCH_HIDDEN": "8", "BENCH_FACTORS": "4", "BENCH_PORTFOLIOS": "4",
+    "BENCH_SEQ_LEN": "4", "BENCH_DAYS_PER_STEP": "4", "BENCH_EPOCHS": "1",
+}
+
+
+def _run(extra_env):
+    env = {**os.environ, "PYTHONPATH": REPO, **SMOKE_SHAPES, **extra_env}
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=540, env=env,
@@ -25,8 +29,41 @@ def test_bench_emits_single_json_line():
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
     assert len(lines) == 1, out.stdout
-    rec = json.loads(lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    return json.loads(lines[0])
+
+
+def test_bench_emits_single_json_line():
+    # Direct pinned-CPU run (the documented quick smoke).
+    rec = _run({"BENCH_FORCE_CPU": "1"})
+    assert REQUIRED_KEYS <= set(rec)
     assert rec["unit"] == "windows/sec/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
-    assert rec["metric"].endswith("_smoke")  # shapes differ from flagship
+    assert "_smoke" in rec["metric"]  # shapes differ from flagship
+    assert rec["platform"] == "cpu"
+    assert rec["mfu"] is None  # no meaningful peak on CPU
+    assert rec["model_tflops_per_sec"] > 0
+
+
+def test_bench_survives_backend_init_failure():
+    # A bogus platform makes every probe attempt fail fast (the round-1
+    # failure mode); the bench must fall back to pinned host CPU and emit
+    # one JSON line with the accelerator error recorded — NOT a traceback.
+    rec = _run({
+        "JAX_PLATFORMS": "bogus_axon",
+        "BENCH_INIT_ATTEMPTS": "1",
+        "BENCH_PROBE_TIMEOUT": "30",
+    })
+    assert REQUIRED_KEYS <= set(rec)
+    assert rec["value"] > 0  # the CPU fallback still measured something
+    assert rec["metric"].endswith("_cpu_fallback")
+    assert "accelerator_error" in rec and rec["accelerator_error"]
+    assert rec["platform"] == "cpu"
+
+
+def test_bench_rejects_silent_cpu_fallthrough():
+    # If the probe finds ONLY host CPU (e.g. the accelerator plugin failed
+    # to register), bench must NOT run flagship shapes untagged — it routes
+    # to the reduced-shape fallback and says why.
+    rec = _run({"JAX_PLATFORMS": "cpu"})
+    assert rec["metric"].endswith("_cpu_fallback")
+    assert "only host CPU" in rec.get("accelerator_error", "")
