@@ -60,6 +60,39 @@ def test_bench_survives_backend_init_failure():
     assert rec["platform"] == "cpu"
 
 
+def test_flops_model_matches_xla_cost_analysis():
+    # The MFU denominator data: bench.model_flops_per_day must track what
+    # XLA actually schedules. At flagship shapes the measured ratio is
+    # 1.09 (fwd) / 1.10 (3x-fwd vs fwd+bwd); assert loosely here at small
+    # shapes where the ignored elementwise terms weigh more.
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, REPO)
+    import bench
+    from factorvae_tpu.config import ModelConfig
+    from factorvae_tpu.models.factorvae import FactorVAE
+
+    n, c, t, h, k, m = 64, 32, 8, 16, 8, 16
+    cfg = ModelConfig(num_features=c, hidden_size=h, num_factors=k,
+                      num_portfolios=m, seq_len=t)
+    model = FactorVAE(cfg)
+    key = jax.random.PRNGKey(0)
+    x = jnp.ones((n, t, c))
+    y = jnp.ones((n,))
+    mask = jnp.ones((n,), bool)
+    params = model.init({"params": key, "sample": key, "dropout": key}, x, y, mask)
+
+    def fwd(p, x, y, msk):
+        return model.apply(p, x, y, msk, rngs={"sample": key, "dropout": key}).loss
+
+    ca = jax.jit(fwd).lower(params, x, y, mask).compile().cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    xla = float(ca["flops"])
+    analytic = bench.model_flops_per_day(n, c=c, t=t, h=h, k=k, m=m)
+    assert 0.5 < analytic / xla < 2.0, (analytic, xla)
+
+
 def test_bench_rejects_silent_cpu_fallthrough():
     # If the probe finds ONLY host CPU (e.g. the accelerator plugin failed
     # to register), bench must NOT run flagship shapes untagged — it routes
