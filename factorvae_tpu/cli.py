@@ -65,8 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--score_start", type=str, default="2019-01-01")
     p.add_argument("--score_end", type=str, default="2020-12-31")
     p.add_argument("--score_dir", type=str, default="./scores")
-    p.add_argument("--stochastic_scores", action="store_true",
-                   help="sample at inference like the reference (module.py:123)")
+    p.add_argument("--stochastic_scores", dest="stochastic_scores",
+                   action="store_true", default=True,
+                   help="sample at inference like the reference "
+                        "(module.py:123). This is the DEFAULT, matching "
+                        "both the reference and ModelConfig")
+    p.add_argument("--deterministic_scores", dest="stochastic_scores",
+                   action="store_false",
+                   help="score with the prior mean instead of sampling "
+                        "(reproducible scores; diverges from the "
+                        "reference's stochastic inference)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--preset", type=str, default=None,
                    help="named config preset (see factorvae_tpu.presets). The "
@@ -125,6 +133,17 @@ def config_from_args(args: argparse.Namespace) -> Config:
             raise SystemExit(f"error: {e.args[0]}")
         return dataclasses.replace(
             cfg,
+            # The preset fixes the *architecture* (sizes/param layout); the
+            # behavior knobs below are runtime choices and must still
+            # follow the flags (e.g. --deterministic_scores with --preset).
+            model=dataclasses.replace(
+                cfg.model,
+                stochastic_inference=bool(args.stochastic_scores),
+                recon_loss=args.recon_loss,
+                compute_dtype="bfloat16" if args.bf16 else cfg.model.compute_dtype,
+                use_pallas_attention=bool(args.pallas) or cfg.model.use_pallas_attention,
+                use_pallas_gru=bool(args.pallas) or cfg.model.use_pallas_gru,
+            ),
             data=dataclasses.replace(
                 cfg.data,
                 dataset_path=resolve("dataset", cfg.data.dataset_path),

@@ -16,7 +16,10 @@ shared (N, H) latent (resident across steps) plus one head's (H, H)
 weights, computes everything in VMEM, and writes just the (1, H) context
 — the intermediate stacks never touch HBM.
 
-Inference-path only for now (no dropout, no custom VJP); selected via
+This module is the raw forward kernel; training goes through the
+`jax.custom_vjp` wrapper in attention_grad.py. Score dropout
+(module.py:144) is supported via an external (K, N) keep-mask applied
+between the scaled scores and the ReLU. Selected via
 ``ModelConfig.use_pallas_attention``. The softmax here is the masked
 variant with the reference's NaN guard semantics folded in (a fully
 masked or non-finite row yields a zero context).

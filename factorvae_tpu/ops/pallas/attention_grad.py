@@ -23,8 +23,11 @@ scores -> ReLU -> masked softmax -> context):
 
 The reference's NaN guard (module.py:149-150) zeroes a poisoned head's
 context in the forward; the backward mirrors it by zeroing that head's
-gradients. Dropout is NOT fused (the XLA path handles train-time
-dropout); the predictor uses this op when dropout is inactive.
+gradients. Train-time score dropout (module.py:144) IS supported: the
+predictor draws a tiny (K, N) keep-mask from the flax 'dropout' rng
+outside the kernel and passes it as `dropout_mask`
+(models/predictor.py:55-66); the kernel applies it between the scaled
+scores and the ReLU, so this op serves both inference and training.
 """
 
 from __future__ import annotations

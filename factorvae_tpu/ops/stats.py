@@ -44,7 +44,12 @@ def masked_pearson(
     cov = jnp.sum(dx * dy, axis=-1)
     vx = jnp.sum(dx * dx, axis=-1)
     vy = jnp.sum(dy * dy, axis=-1)
-    return cov / jnp.sqrt(vx * vy + eps)
+    # A zero-variance side (constant scores or labels, or <2 valid
+    # entries) has no defined correlation: return NaN exactly as
+    # scipy.stats.spearmanr does (reference utils.py:120-126), rather
+    # than counting the day as IC=0. rank_ic_summary drops NaN days.
+    defined = (vx > 0) & (vy > 0)
+    return jnp.where(defined, cov / jnp.sqrt(vx * vy + eps), jnp.nan)
 
 
 def masked_spearman(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
@@ -69,9 +74,16 @@ def rank_ic_summary(ic: jnp.ndarray, day_mask: jnp.ndarray):
     """Mean Rank-IC and information ratio over valid days.
 
     Matches reference utils.py:126-129: IR = mean/std with the *population*
-    std (numpy default ddof=0).
+    std (numpy default ddof=0). Non-finite ICs (degenerate days — see
+    masked_pearson) are excluded from both moments, mirroring how scipy's
+    NaN would simply be dropped from a well-formed evaluation.
     """
-    mean = masked_mean(ic, day_mask)
+    day_mask = day_mask & jnp.isfinite(ic)
+    ic = jnp.where(day_mask, ic, 0.0)
+    # No defined day at all -> NaN mean (a mean over the empty set), not a
+    # plausible-looking 0.0 that would masquerade as "uncorrelated".
+    any_valid = jnp.any(day_mask)
+    mean = jnp.where(any_valid, masked_mean(ic, day_mask), jnp.nan)
     var = masked_mean((ic - mean) ** 2, day_mask)
     std = jnp.sqrt(var)
     ir = jnp.where(std > 0, mean / jnp.where(std > 0, std, 1.0), jnp.nan)

@@ -82,16 +82,25 @@ class Trainer:
         # model + optimizer
         self.model = day_forward(config.model, train=True)
         self.model_eval = day_forward(config.model, train=False)
-        self.tx = make_optimizer(config.train, self.total_steps)
+        self._shard_batch = shard_batch
+        self._build_step_fns()
+
+    def _build_step_fns(self) -> None:
+        """(Re)build optimizer + jitted epoch fns for the current
+        `self.total_steps`. Called again by `fit(num_epochs=...)` when the
+        override changes the cosine-schedule horizon (ADVICE round 1: the
+        LR horizon must follow the actual run length)."""
+        cfg = self.cfg
+        self.tx = make_optimizer(cfg.train, self.total_steps)
         self.fns = make_step_fns(
             self.model,
             self.model_eval,
             self.tx,
-            dataset.values,
-            dataset.last_valid,
-            dataset.next_valid,
-            config.data.seq_len,
-            shard_batch=shard_batch,
+            self.ds.values,
+            self.ds.last_valid,
+            self.ds.next_valid,
+            cfg.data.seq_len,
+            shard_batch=self._shard_batch,
         )
 
         donate = (0,)
@@ -155,9 +164,32 @@ class Trainer:
         state: Optional[TrainState] = None,
         resume: bool = False,
         num_epochs: Optional[int] = None,
+        rescale_schedule: bool = False,
     ):
+        """Train for `num_epochs` (default: the config value).
+
+        `num_epochs` alone means "run the FIRST N epochs of the configured
+        schedule": the cosine horizon stays at `cfg.train.num_epochs` so a
+        partial run + resume reproduces an unbroken run exactly (see
+        TestCheckpointResume). Pass `rescale_schedule=True` to instead
+        treat N as the whole run length and rebuild the optimizer so the
+        cosine schedule decays to its floor at epoch N (ADVICE round 1:
+        the two meanings must be explicit, not silently conflated).
+        """
         cfg = self.cfg
-        epochs = num_epochs or cfg.train.num_epochs
+        # `is None` (not `or`): num_epochs=0 means "train zero epochs",
+        # not "fall back to the config value" (ADVICE round 1).
+        epochs = cfg.train.num_epochs if num_epochs is None else num_epochs
+        # Without rescale_schedule the horizon is ALWAYS the config's —
+        # including restoring it after an earlier rescale_schedule=True fit
+        # on this Trainer (a stale shrunken horizon would pin the LR at the
+        # cosine floor for the whole run).
+        total = self.steps_per_epoch * (
+            epochs if rescale_schedule else cfg.train.num_epochs
+        )
+        if total != self.total_steps:
+            self.total_steps = total
+            self._build_step_fns()
         ckpt = None
         start_epoch = 0
         best_val = float("inf")

@@ -135,6 +135,31 @@ class TestCLI:
         assert "epoch" in events and "scores" in events
 
 
+class TestCLIDefaults:
+    def test_stochastic_scores_is_the_default(self):
+        """The reference always samples at inference (module.py:123);
+        the CLI default must agree with ModelConfig's (ADVICE round 1)."""
+        from factorvae_tpu.cli import build_parser
+        from factorvae_tpu.config import ModelConfig
+
+        p = build_parser()
+        assert p.parse_args([]).stochastic_scores is True
+        assert p.parse_args(["--deterministic_scores"]).stochastic_scores is False
+        assert ModelConfig().stochastic_inference is True
+
+    def test_behavior_flags_survive_presets(self):
+        """--deterministic_scores / --recon_loss are runtime behavior, not
+        architecture: a preset must not silently discard them."""
+        from factorvae_tpu.cli import build_parser, config_from_args
+
+        p = build_parser()
+        cfg = config_from_args(
+            p.parse_args(["--preset", "csi300-k20", "--deterministic_scores"]))
+        assert cfg.model.stochastic_inference is False
+        cfg = config_from_args(p.parse_args(["--preset", "csi300-k20"]))
+        assert cfg.model.stochastic_inference is True
+
+
 class TestSeedSweep:
     def test_two_seed_sweep(self, tmp_path):
         from factorvae_tpu.data import PanelDataset, synthetic_panel

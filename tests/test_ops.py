@@ -115,6 +115,35 @@ class TestRanking:
         want, _ = spearmanr(x[m], y[m])
         np.testing.assert_allclose(got, want, rtol=1e-5)
 
+    def test_degenerate_day_is_nan_and_dropped(self, rng):
+        """A zero-variance day (constant scores or labels) has no defined
+        Spearman: scipy returns NaN (reference utils.py:120-126), so must
+        we — and rank_ic_summary must exclude it from the moments instead
+        of counting it as IC=0 (ADVICE round 1)."""
+        d, n = 4, 20
+        scores = rng.normal(size=(d, n)).astype(np.float32)
+        labels = (0.5 * scores + rng.normal(size=(d, n))).astype(np.float32)
+        scores[1] = 3.14  # constant cross-section -> zero variance
+        mask = np.ones((d, n), bool)
+        ic = np.asarray(rank_ic_series(*map(jnp.asarray, (scores, labels, mask))))
+        assert np.isnan(ic[1])
+        assert np.isfinite(ic[[0, 2, 3]]).all()
+        mean, ir = rank_ic_summary(jnp.asarray(ic), jnp.ones(d, bool))
+        good = ic[[0, 2, 3]]
+        np.testing.assert_allclose(float(mean), good.mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(ir), good.mean() / good.std(), rtol=1e-4)
+        # a day with <2 valid entries is degenerate too
+        one = np.zeros((1, n), bool)
+        one[0, 0] = True
+        ic1 = np.asarray(rank_ic_series(
+            jnp.asarray(scores[:1]), jnp.asarray(labels[:1]), jnp.asarray(one)))
+        assert np.isnan(ic1[0])
+        # EVERY day degenerate -> the summary itself is undefined (NaN),
+        # not a plausible-looking 0.0
+        mean_all, ir_all = rank_ic_summary(
+            jnp.asarray(np.full(3, np.nan, np.float32)), jnp.ones(3, bool))
+        assert np.isnan(float(mean_all)) and np.isnan(float(ir_all))
+
     def test_rank_ic_series_and_summary(self, rng):
         d, n = 6, 40
         scores = rng.normal(size=(d, n)).astype(np.float32)

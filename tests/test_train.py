@@ -47,6 +47,32 @@ class TestTrainerMechanics:
         assert np.isfinite(out["history"][0]["train_loss"])
         assert np.isfinite(out["best_val"])
 
+    def test_fit_num_epochs_override_rebuilds_schedule(self, tiny_dataset, tmp_path):
+        """fit(num_epochs=N, rescale_schedule=True) must retune the cosine
+        horizon to the actual run length; without the flag the horizon
+        stays at the config value (partial-run semantics, which resume
+        depends on); and num_epochs=0 must mean zero epochs, not the
+        config default (ADVICE round 1)."""
+        _, ds = tiny_dataset
+        cfg = small_config(tmp_path, checkpoint_every=0)  # cfg says 2 epochs
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        assert tr.total_steps == tr.steps_per_epoch * 2
+        state, out = tr.fit(num_epochs=1, rescale_schedule=True)
+        assert tr.total_steps == tr.steps_per_epoch * 1
+        assert len(out["history"]) == 1
+        # the cosine schedule reaches its floor at the end of the actual run
+        assert out["history"][-1]["lr"] < cfg.train.lr * 1e-6
+        # a later fit WITHOUT the flag restores the config horizon (a stale
+        # shrunken horizon would pin the LR at the cosine floor)
+        state, out = tr.fit()
+        assert tr.total_steps == tr.steps_per_epoch * 2
+        assert out["history"][0]["lr"] > 0
+
+        tr2 = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state2, out2 = tr2.fit(num_epochs=0)
+        assert out2["history"] == []
+        assert int(state2.step) == 0
+
     def test_loss_decreases_on_learnable_signal(self, tmp_path):
         """Overfit test: strong planted linear signal, loss must drop."""
         panel = synthetic_panel(
