@@ -52,12 +52,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size of the 'stock' (cross-section) mesh axis")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest full-state checkpoint")
-    p.add_argument("--recon_loss", choices=["mse", "nll"], default="mse",
-                   help="mse = reference-faithful single-sample MSE; nll = Gaussian NLL")
-    p.add_argument("--bf16", action="store_true", help="bfloat16 compute dtype")
-    p.add_argument("--pallas", action="store_true",
+    p.add_argument("--recon_loss", choices=["mse", "nll"], default=None,
+                   help="mse = reference-faithful single-sample MSE; nll = "
+                        "Gaussian NLL (default: mse, or the preset's choice)")
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="bfloat16 compute dtype (--no-bf16 forces float32 "
+                        "even when a preset enables bf16)")
+    p.add_argument("--pallas", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="use the fused Pallas kernels (attention + GRU "
-                        "recurrence, ops/pallas/) for compute")
+                        "recurrence, ops/pallas/) for compute; --no-pallas "
+                        "overrides a preset that enables them")
     p.add_argument("--max_stocks", type=int, default=None,
                    help="cross-section padding N_max (default: inferred)")
     p.add_argument("--score_only", action="store_true",
@@ -66,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--score_end", type=str, default="2020-12-31")
     p.add_argument("--score_dir", type=str, default="./scores")
     p.add_argument("--stochastic_scores", dest="stochastic_scores",
-                   action="store_true", default=True,
+                   action="store_true", default=None,
                    help="sample at inference like the reference "
                         "(module.py:123). This is the DEFAULT, matching "
                         "both the reference and ModelConfig")
@@ -138,11 +144,24 @@ def config_from_args(args: argparse.Namespace) -> Config:
             # follow the flags (e.g. --deterministic_scores with --preset).
             model=dataclasses.replace(
                 cfg.model,
-                stochastic_inference=bool(args.stochastic_scores),
-                recon_loss=args.recon_loss,
-                compute_dtype="bfloat16" if args.bf16 else cfg.model.compute_dtype,
-                use_pallas_attention=bool(args.pallas) or cfg.model.use_pallas_attention,
-                use_pallas_gru=bool(args.pallas) or cfg.model.use_pallas_gru,
+                stochastic_inference=(
+                    cfg.model.stochastic_inference
+                    if args.stochastic_scores is None
+                    else args.stochastic_scores
+                ),
+                recon_loss=args.recon_loss or cfg.model.recon_loss,
+                compute_dtype=(
+                    cfg.model.compute_dtype if args.bf16 is None
+                    else ("bfloat16" if args.bf16 else "float32")
+                ),
+                use_pallas_attention=(
+                    cfg.model.use_pallas_attention if args.pallas is None
+                    else args.pallas
+                ),
+                use_pallas_gru=(
+                    cfg.model.use_pallas_gru if args.pallas is None
+                    else args.pallas
+                ),
             ),
             data=dataclasses.replace(
                 cfg.data,
@@ -171,9 +190,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
             num_factors=args.num_factor,
             num_portfolios=args.num_portfolio,
             seq_len=args.seq_len,
-            recon_loss=args.recon_loss,
+            recon_loss=args.recon_loss or "mse",
             compute_dtype="bfloat16" if args.bf16 else "float32",
-            stochastic_inference=bool(args.stochastic_scores),
+            stochastic_inference=(True if args.stochastic_scores is None
+                                  else args.stochastic_scores),
             use_pallas_attention=bool(args.pallas),
             use_pallas_gru=bool(args.pallas),
         ),

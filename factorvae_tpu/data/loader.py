@@ -135,11 +135,12 @@ class PanelDataset:
         """(datetime, instrument) MultiIndex of valid samples in day order —
         the analogue of TSDataSampler.get_index() (dataset.py:124-125),
         used to align exported scores."""
-        tuples = []
-        for d in days:
-            for i in np.nonzero(self.valid[d])[0]:
-                tuples.append((self.dates[d], self.instruments[i]))
-        return pd.MultiIndex.from_tuples(tuples, names=["datetime", "instrument"])
+        days = np.asarray(days, dtype=np.intp)
+        day_pos, inst_pos = np.nonzero(self.valid[days])
+        return pd.MultiIndex.from_arrays(
+            [self.dates[days[day_pos]], self.instruments[inst_pos]],
+            names=["datetime", "instrument"],
+        )
 
 
 if __name__ == "__main__":

@@ -38,15 +38,17 @@ def daily_rank_ic(
     """Per-day Rank-IC series (index: datetime)."""
     dates = df.index.get_level_values(0)
     unique_dates = dates.unique()
-    n_max = int(df.groupby(level=0).size().max()) if len(df) else 0
     d = len(unique_dates)
+    # Vectorized (D, N_max) scatter: factorize rows into (day, slot) pairs —
+    # no per-day pandas loop on the scoring path (the round-1 loop was
+    # O(days * stocks) host work).
+    day_codes = unique_dates.get_indexer(dates)
+    slots = df.groupby(level=0).cumcount().to_numpy()
+    n_max = int(slots.max()) + 1 if len(df) else 0
     a = np.full((d, n_max), np.nan, np.float32)
     b = np.full((d, n_max), np.nan, np.float32)
-    for i, date in enumerate(unique_dates):
-        day = df.loc[date]
-        k = len(day)
-        a[i, :k] = day[column1].to_numpy()
-        b[i, :k] = day[column2].to_numpy()
+    a[day_codes, slots] = df[column1].to_numpy()
+    b[day_codes, slots] = df[column2].to_numpy()
     mask = np.isfinite(a) & np.isfinite(b)
     ic = masked_spearman(
         jnp.nan_to_num(jnp.asarray(a)), jnp.nan_to_num(jnp.asarray(b)),
