@@ -322,7 +322,10 @@ def main(argv=None) -> int:
         rank_ic_ir=float(ic["RankIC_IR"].iloc[0]),
     )
     if args.backtest:
-        from factorvae_tpu.eval.backtest import topk_dropout_backtest
+        from factorvae_tpu.eval.backtest import (
+            simulate_topk_account,
+            topk_dropout_backtest,
+        )
 
         bt = topk_dropout_backtest(
             scores.dropna(), topk=args.backtest_topk,
@@ -330,6 +333,16 @@ def main(argv=None) -> int:
         )
         logger.log("backtest", **{
             k: v for k, v in bt.summary().items() if v is not None
+        })
+        # Full-fidelity account simulation (cell 6 exchange config) and
+        # the cell-8 annualized excess-return risk table.
+        acct = simulate_topk_account(
+            scores.dropna(), topk=args.backtest_topk,
+            n_drop=args.backtest_n_drop,
+        )
+        logger.log("backtest_account", **{
+            k: (v if v is None or isinstance(v, (int, float)) else float(v))
+            for k, v in acct.summary().items()
         })
     if args.export:
         from factorvae_tpu.eval.export_aot import export_prediction

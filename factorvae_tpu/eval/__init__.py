@@ -1,4 +1,10 @@
-from factorvae_tpu.eval.backtest import BacktestResult, topk_dropout_backtest
+from factorvae_tpu.eval.backtest import (
+    AccountBacktestResult,
+    BacktestResult,
+    risk_analysis,
+    simulate_topk_account,
+    topk_dropout_backtest,
+)
 from factorvae_tpu.eval.export_aot import export_prediction, load_exported
 from factorvae_tpu.eval.factors import decompose
 from factorvae_tpu.eval.metrics import RankIC, daily_rank_ic, rank_ic_frame
@@ -10,7 +16,10 @@ from factorvae_tpu.eval.predict import (
 from factorvae_tpu.eval.sweep import seed_sweep
 
 __all__ = [
+    "AccountBacktestResult",
     "BacktestResult",
+    "risk_analysis",
+    "simulate_topk_account",
     "RankIC",
     "daily_rank_ic",
     "decompose",

@@ -21,9 +21,16 @@ directly from a scores DataFrame. Semantics:
 - Outputs both with-cost and without-cost curves, excess vs a benchmark
   series when given, max drawdown, and mean daily turnover.
 
-The reference's full-fidelity path (limit thresholds, cash accounting,
-exchange calendars) remains qlib's job, exactly as in the reference; use
-qlib on the exported score CSVs for that.
+Two simulators are provided:
+
+- `topk_dropout_backtest` — the fast equal-weight screener (above).
+- `simulate_topk_account` — full-fidelity account simulation of the
+  reference's exchange config (backtest.ipynb cell 6): cash/position
+  accounting from `account=1e8`, per-order `min_cost`, `limit_threshold`
+  trade rejection, and qlib's 0.95 risk-degree cash buffer; its report
+  frame mirrors `report_normal_df` (return gross-of-cost + a separate
+  cost-rate column) so `risk_analysis` reproduces the cell-8 annualized
+  excess-return table (w/ and w/o cost).
 """
 
 from __future__ import annotations
@@ -136,6 +143,249 @@ def topk_dropout_backtest(
         excess_return_wo_cost=excess_wo,
         max_drawdown=_max_drawdown(curve.to_numpy()),
         mean_turnover=float(turn.iloc[1:].mean()) if len(turn) > 1 else 0.0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Full-fidelity account simulation (backtest.ipynb cells 6 & 8 semantics)
+# ---------------------------------------------------------------------------
+
+# qlib annualization scaler for daily CN-market frequency (238 trading
+# days/year — qlib.contrib.evaluate.risk_analysis's day default).
+TRADING_DAYS_PER_YEAR = 238
+
+
+def risk_analysis(r: pd.Series, N: int = TRADING_DAYS_PER_YEAR) -> dict:
+    """qlib `risk_analysis` parity (contrib.evaluate, mode='sum'): mean,
+    std (ddof=1), annualized return = mean*N, IR = mean/std*sqrt(N), and
+    max drawdown of the CUMSUM curve (qlib's default 'sum' mode — not the
+    compounded curve used by `_max_drawdown` above)."""
+    r = r.dropna()
+    if len(r) == 0:
+        return {k: float("nan") for k in (
+            "mean", "std", "annualized_return", "information_ratio",
+            "max_drawdown")}
+    mean = float(r.mean())
+    std = float(r.std(ddof=1))
+    cum = r.cumsum()
+    mdd = float((cum - cum.cummax()).min())
+    return {
+        "mean": mean,
+        "std": std,
+        "annualized_return": mean * N,
+        "information_ratio": (mean / std * float(np.sqrt(N))) if std > 0
+                             else float("nan"),
+        "max_drawdown": mdd,
+    }
+
+
+@dataclasses.dataclass
+class AccountBacktestResult:
+    """Account-level simulation output mirroring qlib's portfolio metrics.
+
+    `report` mirrors `report_normal_df` (backtest.ipynb cell 6): columns
+    account / return / turnover / cost / bench / cash / value, where
+    `return` is GROSS of cost and `cost` is the day's cost as a fraction
+    of start-of-day account value — so cell 8's
+    `risk_analysis(return - bench - cost)` applies verbatim.
+    """
+
+    report: pd.DataFrame
+    risk_excess_without_cost: dict
+    risk_excess_with_cost: dict
+    final_positions: dict = dataclasses.field(default_factory=dict)
+
+    def analysis_frame(self) -> pd.DataFrame:
+        """The cell-8 table: a (analysis, risk) x metric frame."""
+        return pd.concat({
+            "excess_return_without_cost": pd.DataFrame(
+                {"risk": self.risk_excess_without_cost}),
+            "excess_return_with_cost": pd.DataFrame(
+                {"risk": self.risk_excess_with_cost}),
+        })
+
+    def summary(self) -> dict:
+        end = self.report["account"].iloc[-1] if len(self.report) else np.nan
+        start = self.report["account"].iloc[0] if len(self.report) else np.nan
+        return {
+            "final_account": float(end),
+            "annualized_excess_return_with_cost":
+                self.risk_excess_with_cost["annualized_return"],
+            "annualized_excess_return_without_cost":
+                self.risk_excess_without_cost["annualized_return"],
+            "information_ratio_with_cost":
+                self.risk_excess_with_cost["information_ratio"],
+            "max_drawdown_with_cost":
+                self.risk_excess_with_cost["max_drawdown"],
+            "mean_turnover": float(self.report["turnover"].mean())
+                             if len(self.report) else np.nan,
+        }
+
+
+def simulate_topk_account(
+    scores: pd.DataFrame,
+    score_col: str = "score",
+    label_col: str = "LABEL0",
+    topk: int = 50,
+    n_drop: int = 10,
+    account: float = 1e8,
+    open_cost: float = 0.0005,
+    close_cost: float = 0.0015,
+    min_cost: float = 5.0,
+    limit_threshold: Optional[float] = 0.095,
+    risk_degree: float = 0.95,
+    benchmark: Optional[pd.Series] = None,
+) -> AccountBacktestResult:
+    """TopkDropoutStrategy + SimulatorExecutor analogue with real cash and
+    position accounting (backtest.ipynb cell 6 exchange_kwargs).
+
+    Semantics per trading day t (scores dated t; the reference label is
+    `Ref($close,-2)/Ref($close,-1)-1`, i.e. the close(t+1)->close(t+2)
+    return earned by a position entered at close(t+1)):
+
+    - Strategy (qlib TopkDropoutStrategy, method_buy='top'/
+      method_sell='bottom'): rank held names and the top
+      `n_drop + topk - held` candidates together; sell the held names
+      that fall below rank `topk` in that combined ranking (at most
+      `n_drop` by construction), buy the best-ranked candidates to
+      refill freed + empty slots. A held name that still outranks every
+      candidate is NOT dropped.
+    - Exchange: an order is REJECTED when the name moves through
+      `limit_threshold` on the execution day — buys at limit-up
+      (change >= +thr), sells at limit-down (change <= -thr). The
+      execution-day (close(t)->close(t+1)) change of a day-t decision is
+      exactly the name's label at t-1, so the limit check uses the label
+      shifted one day; names missing from today's frame are suspended
+      (unsellable, value carried at 0 return). First-day names with no
+      prior label are assumed tradable.
+    - Costs: per executed order, `max(traded_value * rate, min_cost)`
+      with the open/close rates of cell 6; deducted from cash.
+    - Cash: sells credit proceeds minus cost; buys split
+      `cash * risk_degree` equally (qlib BaseSignalStrategy.get_risk_degree
+      = 0.95) across accepted buy orders.
+    - Mark to market: every held position earns its day-t label; account
+      value = cash + sum(position values). Positions drift from equal
+      weight exactly as in qlib (no daily rebalance of held names).
+    """
+    df = scores.dropna(subset=[score_col])
+    dates = df.index.get_level_values(0).unique().sort_values()
+    if len(dates) == 0:
+        empty = pd.DataFrame(
+            columns=["account", "return", "turnover", "cost", "cash",
+                     "value", "bench"],
+            index=pd.DatetimeIndex([], name="datetime"))
+        nan_risk = risk_analysis(pd.Series([], dtype=float))
+        return AccountBacktestResult(
+            report=empty, risk_excess_without_cost=nan_risk,
+            risk_excess_with_cost=dict(nan_risk))
+
+    # (day, name) -> label / prior-day label (execution-day change proxy).
+    labels = scores[label_col]
+    by_name = labels.sort_index().reset_index()
+    by_name.columns = ["datetime", "instrument", "label"]
+    by_name["prev"] = by_name.groupby("instrument")["label"].shift(1)
+    by_name["prev_date"] = by_name.groupby("instrument")["datetime"].shift(1)
+    # Only a CONSECUTIVE prior trading day is a valid execution-day change:
+    # a name returning from a suspension gap must not be limit-checked
+    # against a stale, weeks-old move.
+    cal = {d: i for i, d in enumerate(
+        labels.index.get_level_values(0).unique().sort_values())}
+    prev_label = {
+        (d, i): v
+        for d, i, v, pd_ in zip(by_name["datetime"], by_name["instrument"],
+                                by_name["prev"], by_name["prev_date"])
+        if np.isfinite(v)
+        and pd_ in cal and cal[d] - cal[pd_] == 1
+    }
+
+    cash = float(account)
+    pos: dict = {}                  # name -> market value
+    rows = []
+    for date in dates:
+        day = df.loc[date]
+        ranked = day[score_col].sort_values(ascending=False)
+        universe = list(ranked.index)
+        start_value = cash + sum(pos.values())
+
+        def tradable(name, side):
+            if limit_threshold is None:
+                return True
+            chg = prev_label.get((date, name))
+            if chg is None:
+                return True
+            return chg < limit_threshold if side == "buy" \
+                else chg > -limit_threshold
+
+        # --- strategy: target holdings (qlib comb ranking) --------------
+        held_ranked = [s for s in universe if s in pos]     # today's order
+        candidates = [s for s in universe if s not in pos]
+        # suspended names (held but absent today) occupy slots but can't
+        # be ranked or sold
+        n_held = len(pos)
+        today_cand = candidates[: n_drop + max(0, topk - n_held)]
+        cand_set = set(today_cand)
+        comb = [s for s in universe if s in pos or s in cand_set]
+        below_topk = set(comb[topk:])
+        want_sell = [s for s in held_ranked if s in below_topk]
+        # Unclamped qlib sizing (len(sell) + topk - held): a portfolio
+        # drifted above topk (blocked sell + executed buy) buys fewer
+        # than it sells and self-corrects back to topk.
+        want_buy = today_cand[: max(0, len(want_sell) + topk - n_held)]
+
+        # --- exchange: sells first (frees cash), limit/suspension aware -
+        cost_today = 0.0
+        traded = 0.0
+        for name in want_sell:
+            if not tradable(name, "sell"):
+                continue
+            v = pos.pop(name)
+            fee = max(v * close_cost, min_cost) if v > 0 else 0.0
+            cash += v - fee
+            cost_today += fee
+            traded += v
+        buys = [n for n in want_buy if tradable(n, "buy")]
+        if buys:
+            per = cash * risk_degree / len(buys)
+            for name in buys:
+                fee = max(per * open_cost, min_cost)
+                if per <= 0 or cash < per + fee:
+                    continue
+                cash -= per + fee
+                cost_today += fee
+                pos[name] = per
+                traded += per
+
+        # --- mark to market against today's labels ----------------------
+        for name in list(pos):
+            lab = labels.get((date, name))
+            if lab is not None and np.isfinite(lab):
+                pos[name] *= 1.0 + float(lab)
+        end_value = cash + sum(pos.values())
+
+        gross_ret = (end_value - start_value + cost_today) / start_value
+        rows.append({
+            "datetime": date,
+            "account": end_value,
+            "return": gross_ret,
+            "turnover": traded / start_value,
+            "cost": cost_today / start_value,
+            "cash": cash,
+            "value": sum(pos.values()),
+        })
+
+    report = pd.DataFrame(rows).set_index("datetime")
+    if benchmark is not None:
+        report["bench"] = benchmark.reindex(report.index).fillna(0.0)
+    else:
+        report["bench"] = 0.0
+
+    excess_wo = report["return"] - report["bench"]
+    excess_w = excess_wo - report["cost"]
+    return AccountBacktestResult(
+        report=report,
+        risk_excess_without_cost=risk_analysis(excess_wo),
+        risk_excess_with_cost=risk_analysis(excess_w),
+        final_positions=dict(pos),
     )
 
 

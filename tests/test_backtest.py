@@ -4,7 +4,12 @@ import numpy as np
 import pandas as pd
 import pytest
 
-from factorvae_tpu.eval.backtest import topk_dropout_backtest
+from factorvae_tpu.eval.backtest import (
+    TRADING_DAYS_PER_YEAR,
+    risk_analysis,
+    simulate_topk_account,
+    topk_dropout_backtest,
+)
 
 
 def make_scores(num_days=6, num_inst=8, seed=0, perfect=False):
@@ -120,3 +125,208 @@ class TestTopkDropout:
         )
         r = topk_dropout_backtest(df, topk=2, n_drop=0, open_cost=0, close_cost=0)
         np.testing.assert_allclose(r.max_drawdown, -0.5, rtol=1e-9)
+
+
+def frame(rows):
+    """rows: list of (date_str, instrument, score, label)."""
+    idx = pd.MultiIndex.from_tuples(
+        [(pd.Timestamp(d), i) for d, i, _, _ in rows],
+        names=["datetime", "instrument"],
+    )
+    return pd.DataFrame(
+        {"score": [r[2] for r in rows], "LABEL0": [r[3] for r in rows]},
+        index=idx,
+    )
+
+
+class TestRiskAnalysis:
+    def test_qlib_formula_parity(self):
+        r = pd.Series([0.01, -0.02, 0.03])
+        out = risk_analysis(r)
+        mean, std = r.mean(), r.std(ddof=1)
+        np.testing.assert_allclose(out["mean"], mean)
+        np.testing.assert_allclose(out["std"], std)
+        np.testing.assert_allclose(out["annualized_return"],
+                                   mean * TRADING_DAYS_PER_YEAR)
+        np.testing.assert_allclose(
+            out["information_ratio"],
+            mean / std * np.sqrt(TRADING_DAYS_PER_YEAR))
+        # cumsum-mode drawdown: cum=[.01,-.01,.02] vs cummax -> -0.02
+        np.testing.assert_allclose(out["max_drawdown"], -0.02)
+
+    def test_empty_and_nan(self):
+        out = risk_analysis(pd.Series([], dtype=float))
+        assert all(np.isnan(v) for v in out.values())
+        out = risk_analysis(pd.Series([0.01, np.nan, 0.02]))
+        np.testing.assert_allclose(out["mean"], 0.015)
+
+
+class TestAccountSimulator:
+    def test_hand_computed_two_days(self):
+        """Full cash-accounting hand calc: 2 days, 3 names, costs + the
+        0.95 risk-degree buffer, no limits/min_cost."""
+        df = frame([
+            ("2020-01-01", "A", 3, 0.1), ("2020-01-01", "B", 2, 0.2),
+            ("2020-01-01", "C", 1, 0.3),
+            ("2020-01-02", "A", 1, 0.3), ("2020-01-02", "B", 2, 0.2),
+            ("2020-01-02", "C", 3, 0.1),
+        ])
+        r = simulate_topk_account(
+            df, topk=2, n_drop=1, account=1000.0, open_cost=0.01,
+            close_cost=0.02, min_cost=0.0, limit_threshold=None,
+            risk_degree=0.95)
+        # Day 1: buy A,B at 475 each, fee 4.75 each
+        cash1 = 1000 - 2 * (475 + 4.75)            # 40.5
+        a1, b1 = 475 * 1.1, 475 * 1.2              # mark to market
+        acct1 = cash1 + a1 + b1
+        rep = r.report
+        np.testing.assert_allclose(rep["cash"].iloc[0], cash1)
+        np.testing.assert_allclose(rep["account"].iloc[0], acct1)
+        np.testing.assert_allclose(rep["cost"].iloc[0], 9.5 / 1000)
+        np.testing.assert_allclose(rep["return"].iloc[0],
+                                   (acct1 - 1000 + 9.5) / 1000)
+        np.testing.assert_allclose(rep["turnover"].iloc[0], 950 / 1000)
+        # Day 2: ranked C>B>A; drop A (worst held), buy C
+        sell_fee = a1 * 0.02
+        cash2 = cash1 + a1 - sell_fee
+        per = cash2 * 0.95
+        buy_fee = per * 0.01
+        cash_end = cash2 - per - buy_fee
+        b2, c2 = b1 * 1.2, per * 1.1
+        acct2 = cash_end + b2 + c2
+        cost2 = sell_fee + buy_fee
+        np.testing.assert_allclose(rep["account"].iloc[1], acct2)
+        np.testing.assert_allclose(rep["cost"].iloc[1], cost2 / acct1)
+        np.testing.assert_allclose(rep["return"].iloc[1],
+                                   (acct2 - acct1 + cost2) / acct1)
+        np.testing.assert_allclose(rep["turnover"].iloc[1],
+                                   (a1 + per) / acct1)
+        assert set(r.final_positions) == {"B", "C"}
+
+    def test_account_identity(self):
+        """account == cash + value; net growth == return - cost."""
+        df = make_scores(num_days=30, num_inst=20, seed=7)
+        r = simulate_topk_account(df, topk=5, n_drop=2, account=1e8)
+        rep = r.report
+        np.testing.assert_allclose(rep["account"],
+                                   rep["cash"] + rep["value"], rtol=1e-12)
+        prev = np.concatenate([[1e8], rep["account"].to_numpy()[:-1]])
+        np.testing.assert_allclose(rep["account"].to_numpy() / prev - 1.0,
+                                   (rep["return"] - rep["cost"]).to_numpy(),
+                                   atol=1e-12)
+
+    def test_min_cost_binds(self):
+        """Small trades pay min_cost, not value*rate."""
+        df = frame([
+            ("2020-01-01", "A", 2, 0.0), ("2020-01-01", "B", 1, 0.0),
+        ])
+        r = simulate_topk_account(
+            df, topk=2, n_drop=0, account=1000.0, open_cost=0.0005,
+            close_cost=0.0015, min_cost=5.0, limit_threshold=None)
+        # 2 buys of 475: rate cost would be 0.2375 each; min_cost 5 binds
+        np.testing.assert_allclose(r.report["cost"].iloc[0], 10.0 / 1000)
+
+    def test_limit_up_blocks_buy(self):
+        """A name at limit-up on the execution day can't be bought: its
+        day-(t-1) label (= execution-day change) >= +0.095."""
+        rows = [
+            ("2020-01-01", "X", 1, 0.10),   # X limit-up into day 2
+            ("2020-01-01", "Y", 2, 0.00),
+            ("2020-01-02", "X", 9, 0.50),
+            ("2020-01-02", "Y", 1, 0.00),
+        ]
+        blocked = simulate_topk_account(
+            frame(rows), topk=1, n_drop=1, account=1000.0,
+            min_cost=0.0, limit_threshold=0.095)
+        free = simulate_topk_account(
+            frame(rows), topk=1, n_drop=1, account=1000.0,
+            min_cost=0.0, limit_threshold=None)
+        assert "X" not in blocked.final_positions
+        assert "X" in free.final_positions
+        # the blocked account missed X's +50% day
+        assert blocked.report["account"].iloc[-1] < \
+            free.report["account"].iloc[-1]
+
+    def test_limit_down_blocks_sell(self):
+        """A held name at limit-down can't be sold and stays held."""
+        rows = [
+            ("2020-01-01", "Y", 2, -0.10),  # Y limit-down into day 2
+            ("2020-01-01", "X", 1, 0.00),
+            ("2020-01-02", "Y", 1, 0.00),
+            ("2020-01-02", "X", 9, 0.00),
+        ]
+        blocked = simulate_topk_account(
+            frame(rows), topk=1, n_drop=1, account=1000.0,
+            min_cost=0.0, limit_threshold=0.095)
+        assert "Y" in blocked.final_positions
+        free = simulate_topk_account(
+            frame(rows), topk=1, n_drop=1, account=1000.0,
+            min_cost=0.0, limit_threshold=None)
+        assert "Y" not in free.final_positions
+
+    def test_suspended_name_carried(self):
+        """A held name missing from the frame is unsellable and carried
+        at zero return; no crash, slot not refilled away."""
+        rows = [
+            ("2020-01-01", "A", 2, 0.1), ("2020-01-01", "B", 1, 0.0),
+            ("2020-01-02", "B", 1, 0.0),     # A suspended
+            ("2020-01-03", "A", 2, 0.0), ("2020-01-03", "B", 1, 0.0),
+        ]
+        r = simulate_topk_account(
+            frame(rows), topk=1, n_drop=1, account=1000.0,
+            min_cost=0.0, limit_threshold=None)
+        assert "A" in r.final_positions
+        assert len(r.report) == 3
+
+    def test_analysis_frame_shape(self):
+        """Cell-8 table shape: two analyses x five risk metrics."""
+        df = make_scores(num_days=20, num_inst=15, seed=9)
+        bench = pd.Series(
+            0.0005,
+            index=df.index.get_level_values(0).unique().sort_values())
+        r = simulate_topk_account(df, topk=4, n_drop=2, benchmark=bench)
+        af = r.analysis_frame()
+        assert set(af.index.get_level_values(0)) == {
+            "excess_return_without_cost", "excess_return_with_cost"}
+        assert set(af.index.get_level_values(1)) == {
+            "mean", "std", "annualized_return", "information_ratio",
+            "max_drawdown"}
+        s = r.summary()
+        assert np.isfinite(s["final_account"])
+
+    def test_empty_frame_graceful(self):
+        df = frame([("2020-01-01", "A", np.nan, 0.1)])
+        r = simulate_topk_account(df)
+        assert len(r.report) == 0
+        assert np.isnan(r.risk_excess_with_cost["mean"])
+
+    def test_relisting_after_gap_is_tradable(self):
+        """A limit move weeks before a suspension gap must not block the
+        relisting-day trade (only a consecutive prior day counts)."""
+        rows = [
+            ("2020-01-01", "X", 1, 0.10),   # limit-up, then suspended
+            ("2020-01-01", "Y", 2, 0.00),
+            ("2020-01-02", "Y", 2, 0.00),   # X absent (gap)
+            ("2020-01-03", "X", 9, 0.50),   # relists; stale +0.10 is NOT
+            ("2020-01-03", "Y", 1, 0.00),   # an execution-day change
+        ]
+        r = simulate_topk_account(
+            frame(rows), topk=1, n_drop=1, account=1000.0,
+            min_cost=0.0, limit_threshold=0.095)
+        assert "X" in r.final_positions
+
+    def test_drifted_portfolio_self_corrects(self):
+        """Blocked sell + executed buy -> topk+1 holdings; the unclamped
+        buy sizing shrinks back to topk the next day (qlib invariant)."""
+        rows = [
+            ("2020-01-01", "Y", 2, -0.10),  # Y limit-down into day 2
+            ("2020-01-01", "X", 1, 0.00),
+            ("2020-01-02", "Y", 1, 0.00),   # sell blocked; X bought
+            ("2020-01-02", "X", 9, 0.00),
+            ("2020-01-03", "Y", 1, 0.00),   # Y sellable again
+            ("2020-01-03", "X", 9, 0.00),
+        ]
+        r = simulate_topk_account(
+            frame(rows), topk=1, n_drop=1, account=1000.0,
+            min_cost=0.0, limit_threshold=0.095)
+        assert set(r.final_positions) == {"X"}
