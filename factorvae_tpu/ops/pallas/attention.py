@@ -39,17 +39,20 @@ _NEG_INF = -1e30
 def _head_kernel(latent_ref, maskf_ref, dmask_ref, q_ref, wk_ref, bk_ref,
                  wv_ref, bv_ref, out_ref):
     """One head per grid step. latent: (N, H), maskf: (1, N) float {0,1},
-    dmask: (1, N) dropout keep-mask (pre-scaled by 1/(1-p); all-ones at
-    inference), q/bk/bv: (1, H), wk/wv: (H, H), out: (1, H)."""
+    dmask: (1, 1, N) dropout keep-mask (pre-scaled by 1/(1-p); all-ones
+    at inference), q/bk/bv: (1, 1, H), wk/wv: (1, H, H), out: (1, 1, H).
+    Per-head vectors carry a singleton middle axis so their (1, X) blocks
+    satisfy Mosaic's block-shape tiling rule (second-to-last block dim
+    must divide 8 or equal the array dim)."""
     latent = latent_ref[:]                                   # (N, H)
     maskf = maskf_ref[0, :]                                  # (N,)
     key = jnp.dot(latent, wk_ref[0], preferred_element_type=jnp.float32)
-    key = key + bk_ref[0, :][None, :]
+    key = key + bk_ref[0, 0, :][None, :]
     h_dim = key.shape[1]
-    scores = jnp.dot(key, q_ref[0, :][:, None],
+    scores = jnp.dot(key, q_ref[0, 0, :][:, None],
                      preferred_element_type=jnp.float32)[:, 0]  # (N,)
     scores = scores / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
-    scores = scores * dmask_ref[0, :]           # dropout (module.py:144) ...
+    scores = scores * dmask_ref[0, 0, :]        # dropout (module.py:144) ...
     scores = jnp.maximum(scores, 0.0)           # ... BEFORE ReLU (module.py:145)
     # reference NaN guard (module.py:149-150): any non-finite valid score
     # zeroes this head's context entirely
@@ -60,10 +63,10 @@ def _head_kernel(latent_ref, maskf_ref, dmask_ref, q_ref, wk_ref, bk_ref,
     denom = jnp.sum(ex)
     attn = jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
     value = jnp.dot(latent, wv_ref[0], preferred_element_type=jnp.float32)
-    value = value + bv_ref[0, :][None, :]
+    value = value + bv_ref[0, 0, :][None, :]
     ctx = jnp.dot(attn[None, :], jnp.nan_to_num(value),
                   preferred_element_type=jnp.float32)[0]
-    out_ref[0, :] = jnp.where(bad, 0.0, ctx)
+    out_ref[0, 0, :] = jnp.where(bad, 0.0, ctx)
 
 
 def multihead_cross_section_attention(
@@ -94,29 +97,34 @@ def multihead_cross_section_attention(
     if dropout_mask is None:
         dropout_mask = jnp.ones((k, n), jnp.float32)
     grid = (k,)
-    return pl.pallas_call(
+    vec = pl.BlockSpec((1, 1, h), lambda i: (i, 0, 0),
+                       memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
         _head_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            vec,
             pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            vec,
             pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            vec,
         ],
-        out_specs=pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((k, h), jnp.float32),
+        out_specs=pl.BlockSpec((1, 1, h), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, 1, h), jnp.float32),
         interpret=interpret,
     )(
         latent.astype(jnp.float32),
         maskf,
-        dropout_mask.astype(jnp.float32),
-        query.astype(jnp.float32),
+        dropout_mask.astype(jnp.float32).reshape(k, 1, n),
+        query.astype(jnp.float32).reshape(k, 1, h),
         w_key.astype(jnp.float32),
-        b_key.astype(jnp.float32),
+        b_key.astype(jnp.float32).reshape(k, 1, h),
         w_val.astype(jnp.float32),
-        b_val.astype(jnp.float32),
+        b_val.astype(jnp.float32).reshape(k, 1, h),
     )
+    return out.reshape(k, h)

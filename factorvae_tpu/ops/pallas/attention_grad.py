@@ -48,12 +48,12 @@ def _bwd_kernel(latent_ref, maskf_ref, dmask_ref, q_ref, wk_ref, bk_ref,
                 dbk_ref, dwv_ref, dbv_ref):
     latent = latent_ref[:]                                   # (N, H)
     maskf = maskf_ref[0, :]                                  # (N,)
-    dmask = dmask_ref[0, :]                                  # (N,) keep/(1-p)
-    q = q_ref[0, :]                                          # (H,)
-    dctx = dctx_ref[0, :]                                    # (H,)
+    dmask = dmask_ref[0, 0, :]                               # (N,) keep/(1-p)
+    q = q_ref[0, 0, :]                                       # (H,)
+    dctx = dctx_ref[0, 0, :]                                 # (H,)
 
     key = jnp.dot(latent, wk_ref[0], preferred_element_type=jnp.float32)
-    key = key + bk_ref[0, :][None, :]
+    key = key + bk_ref[0, 0, :][None, :]
     h_dim = key.shape[1]
     sc = 1.0 / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
     z = jnp.dot(key, q[:, None], preferred_element_type=jnp.float32)[:, 0]
@@ -67,7 +67,7 @@ def _bwd_kernel(latent_ref, maskf_ref, dmask_ref, q_ref, wk_ref, bk_ref,
     a = jnp.where(denom > 0, ex / jnp.where(denom > 0, denom, 1.0), 0.0)
 
     value = jnp.dot(latent, wv_ref[0], preferred_element_type=jnp.float32)
-    value = value + bv_ref[0, :][None, :]
+    value = value + bv_ref[0, 0, :][None, :]
     value = jnp.nan_to_num(value)
 
     zero_head = jnp.where(bad, 0.0, 1.0)
@@ -79,13 +79,14 @@ def _bwd_kernel(latent_ref, maskf_ref, dmask_ref, q_ref, wk_ref, bk_ref,
     dz = jnp.where(s > 0, dr, 0.0) * sc * dmask              # (N,)
     dkey = dz[:, None] * q[None, :]                          # (N, H)
 
-    dq_ref[0, :] = jnp.dot(key.T, dz[:, None],
-                           preferred_element_type=jnp.float32)[:, 0] * zero_head
+    dq_ref[0, 0, :] = jnp.dot(
+        key.T, dz[:, None], preferred_element_type=jnp.float32
+    )[:, 0] * zero_head
     dkey = dkey * zero_head
     dwk_ref[0] = jnp.dot(latent.T, dkey, preferred_element_type=jnp.float32)
-    dbk_ref[0, :] = jnp.sum(dkey, axis=0)
+    dbk_ref[0, 0, :] = jnp.sum(dkey, axis=0)
     dwv_ref[0] = jnp.dot(latent.T, dv, preferred_element_type=jnp.float32)
-    dbv_ref[0, :] = jnp.sum(dv, axis=0)
+    dbv_ref[0, 0, :] = jnp.sum(dv, axis=0)
 
     dl = jnp.dot(dkey, wk_ref[0].T, preferred_element_type=jnp.float32)
     dl = dl + jnp.dot(dv, wv_ref[0].T, preferred_element_type=jnp.float32)
@@ -102,49 +103,46 @@ def _bwd_pallas(latent, maskf, dmask, query, w_key, b_key, w_val, b_val, dctx,
                 interpret):
     n, h = latent.shape
     k = query.shape[0]
-    grids = pl.pallas_call(
+    vec = pl.BlockSpec((1, 1, h), lambda i: (i, 0, 0),
+                       memory_space=pltpu.VMEM)
+    mat = pl.BlockSpec((1, h, h), lambda i: (i, 0, 0),
+                       memory_space=pltpu.VMEM)
+    dlatent, dq, dwk, dbk, dwv, dbv = pl.pallas_call(
         _bwd_kernel,
         grid=(k,),
         in_specs=[
             pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            vec, mat, vec, mat, vec, vec,
         ],
         out_specs=[
             pl.BlockSpec((n, h), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h, h), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            vec, mat, vec, mat, vec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, h), jnp.float32),      # dlatent
-            jax.ShapeDtypeStruct((k, h), jnp.float32),      # dquery
+            jax.ShapeDtypeStruct((k, 1, h), jnp.float32),   # dquery
             jax.ShapeDtypeStruct((k, h, h), jnp.float32),   # dWk
-            jax.ShapeDtypeStruct((k, h), jnp.float32),      # dbk
+            jax.ShapeDtypeStruct((k, 1, h), jnp.float32),   # dbk
             jax.ShapeDtypeStruct((k, h, h), jnp.float32),   # dWv
-            jax.ShapeDtypeStruct((k, h), jnp.float32),      # dbv
+            jax.ShapeDtypeStruct((k, 1, h), jnp.float32),   # dbv
         ],
         interpret=interpret,
     )(
         latent.astype(jnp.float32),
         maskf.reshape(1, -1).astype(jnp.float32),
-        dmask.astype(jnp.float32),
-        query.astype(jnp.float32),
+        dmask.astype(jnp.float32).reshape(k, 1, n),
+        query.astype(jnp.float32).reshape(k, 1, h),
         w_key.astype(jnp.float32),
-        b_key.astype(jnp.float32),
+        b_key.astype(jnp.float32).reshape(k, 1, h),
         w_val.astype(jnp.float32),
-        b_val.astype(jnp.float32),
-        dctx.astype(jnp.float32),
+        b_val.astype(jnp.float32).reshape(k, 1, h),
+        dctx.astype(jnp.float32).reshape(k, 1, h),
     )
-    return grids
+    return (dlatent, dq.reshape(k, h), dwk, dbk.reshape(k, h), dwv,
+            dbv.reshape(k, h))
 
 
 @jax.custom_vjp

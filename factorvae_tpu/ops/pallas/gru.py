@@ -2,29 +2,41 @@
 
 The extractor's GRU runs as `lax.scan` over T (models/layers.py) — already
 good under XLA. This kernel fuses the *whole recurrence* into one Pallas
-call: the precomputed input projections `xi` (N, T, 3H), the hidden
-weights and the running hidden state all stay in VMEM for all T steps, so
-nothing round-trips HBM between timesteps. The input-side projection (one
-big matmul) deliberately stays OUTSIDE the kernel where the MXU already
+call: the precomputed input projections, the hidden weights and the
+running hidden state all stay in VMEM for all T steps, so nothing
+round-trips HBM between timesteps. The input-side projection (one big
+matmul) deliberately stays OUTSIDE the kernel where the MXU already
 handles it optimally.
 
-Backward is a second kernel doing recompute-BPTT: re-run the recurrence
-storing the (T+1, Nb, H) hidden sequence in VMEM, then walk t = T-1..0
-accumulating d_xi, d_Wh, d_bh and the carried d_h.
+Mosaic-compatibility notes (the round-1 kernel compiled only in
+interpret mode; VERDICT r1 item 3):
+- The Pallas TPU lowering has no `dynamic_slice` on *values*, so the
+  per-timestep read is a dynamic **ref** load (`ref[pl.ds(t, 1)]`) on a
+  time-LEADING layout — dynamic indexing is only cheap/legal on leading
+  dims.
+- Gate projections arrive pre-split per gate (r/z/n) instead of one
+  (N, T, 3H) block, so the kernel never slices the minor (lane) axis at
+  non-128-aligned offsets.
+- The backward's recomputed hidden sequence lives in a VMEM scratch ref
+  (dynamic stores on values are likewise unsupported).
 
-Rows (stocks) are independent in the recurrence, so both kernels tile the
-N axis into blocks of `_N_BLOCK` rows per grid step — bounding VMEM to a
-few MB regardless of N and T (the backward's per-block footprint is
-xi + dxi + h-seq ≈ 2*Nb*T*3H + (T+1)*Nb*H floats; at Nb=64, T=60, H=64
-that is ~7 MB). d_Wh/d_bh accumulate across the sequential TPU grid.
+Backward is recompute-BPTT: re-run the recurrence storing the
+(T+1, Nb, H) hidden sequence in scratch, then walk t = T-1..0
+accumulating d_x*, d_Wh*, d_b* and the carried d_h.
+
+Rows (stocks) are independent in the recurrence, so both kernels tile
+the N axis into row blocks per grid step, sized by `_block_setup` from
+the backward's MEASURED VMEM footprint (see its docstring) — 64 rows at
+T=20, 24 rows at T=60/H=64. d_Wh/d_b accumulate across the sequential
+grid.
 
 Gate math matches layers.GRU exactly (torch layout [r | z | n]):
 
-    r = sigmoid(xi_r + gh_r)    z = sigmoid(xi_z + gh_z)
-    n = tanh(xi_n + r * gh_n)   h' = (1 - z) * n + z * h
-    with gh = h @ Wh + bh
+    r = sigmoid(x_r + h Wh_r + b_r)    z = sigmoid(x_z + h Wh_z + b_z)
+    n = tanh(x_n + r * (h Wh_n + b_n))
+    h' = (1 - z) * n + z * h
 
-Selected via ``ModelConfig.use_pallas_gru``; interpret-mode on CPU.
+Selected via ``ModelConfig.use_pallas_gru``; interpret-mode off-TPU.
 """
 
 from __future__ import annotations
@@ -34,174 +46,243 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_N_BLOCK = 64  # rows per grid step; bounds VMEM independent of N/T
+_N_BLOCK = 64        # max rows per grid step
+_VMEM_BUDGET = 12 * 2 ** 20   # target bytes for the backward's refs
+# (the v5e scoped-vmem limit is 16 MB; leave headroom for the compiler)
 
 
-def _gates(xt, gh, h_dim):
-    r = jax.nn.sigmoid(xt[:, :h_dim] + gh[:, :h_dim])
-    z = jax.nn.sigmoid(xt[:, h_dim:2 * h_dim] + gh[:, h_dim:2 * h_dim])
-    n = jnp.tanh(xt[:, 2 * h_dim:] + r * gh[:, 2 * h_dim:])
-    return r, z, n
+def _load_t(ref, t):
+    """(T, Nb, H) ref -> (Nb, H) timestep t (dynamic leading-dim load)."""
+    return ref[pl.ds(t, 1), :, :][0]
 
 
-def _fwd_kernel(xi_ref, wh_ref, bh_ref, hlast_ref):
-    xi = xi_ref[:]                                   # (N, T, 3H)
-    wh = wh_ref[:]                                   # (H, 3H)
-    bh = bh_ref[0, :]                                # (3H,)
-    n_rows, t_len, h3 = xi.shape
-    h_dim = h3 // 3
+def _fwd_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref, whn_ref,
+                br_ref, bz_ref, bn_ref, hlast_ref):
+    t_len, nb, h_dim = xr_ref.shape
+    whr, whz, whn = whr_ref[:], whz_ref[:], whn_ref[:]
+    br, bz, bn = br_ref[0, :], bz_ref[0, :], bn_ref[0, :]
 
     def step(t, h):
-        xt = jax.lax.dynamic_slice_in_dim(xi, t, 1, axis=1)[:, 0, :]
-        gh = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
-        r, z, n = _gates(xt, gh, h_dim)
+        ghr = jnp.dot(h, whr, preferred_element_type=jnp.float32) + br
+        ghz = jnp.dot(h, whz, preferred_element_type=jnp.float32) + bz
+        ghn = jnp.dot(h, whn, preferred_element_type=jnp.float32) + bn
+        r = jax.nn.sigmoid(_load_t(xr_ref, t) + ghr)
+        z = jax.nn.sigmoid(_load_t(xz_ref, t) + ghz)
+        n = jnp.tanh(_load_t(xn_ref, t) + r * ghn)
         return (1.0 - z) * n + z * h
 
-    h0 = jnp.zeros((n_rows, h_dim), jnp.float32)
+    h0 = jnp.zeros((nb, h_dim), jnp.float32)
     hlast_ref[:] = jax.lax.fori_loop(0, t_len, step, h0)
 
 
-def _bwd_kernel(xi_ref, wh_ref, bh_ref, dh_ref, dxi_ref, dwh_ref, dbh_ref):
-    xi = xi_ref[:]
-    wh = wh_ref[:]
-    bh = bh_ref[0, :]
-    n_rows, t_len, h3 = xi.shape
-    h_dim = h3 // 3
+def _bwd_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref, whn_ref,
+                br_ref, bz_ref, bn_ref, dh_ref,
+                dxr_ref, dxz_ref, dxn_ref,
+                dwhr_ref, dwhz_ref, dwhn_ref,
+                dbr_ref, dbz_ref, dbn_ref,
+                hseq_ref):
+    t_len, nb, h_dim = xr_ref.shape
+    whr, whz, whn = whr_ref[:], whz_ref[:], whn_ref[:]
+    br, bz, bn = br_ref[0, :], bz_ref[0, :], bn_ref[0, :]
 
-    # recompute the hidden sequence: hseq[t] = h before step t
-    def fstep(t, hseq):
-        h = jax.lax.dynamic_slice_in_dim(hseq, t, 1, axis=0)[0]
-        xt = jax.lax.dynamic_slice_in_dim(xi, t, 1, axis=1)[:, 0, :]
-        gh = jnp.dot(h, wh, preferred_element_type=jnp.float32) + bh
-        r, z, n = _gates(xt, gh, h_dim)
+    # recompute the hidden sequence into scratch: hseq[t] = h BEFORE step t
+    hseq_ref[0] = jnp.zeros((nb, h_dim), jnp.float32)
+
+    def fstep(t, _):
+        h = _load_t(hseq_ref, t)
+        ghr = jnp.dot(h, whr, preferred_element_type=jnp.float32) + br
+        ghz = jnp.dot(h, whz, preferred_element_type=jnp.float32) + bz
+        ghn = jnp.dot(h, whn, preferred_element_type=jnp.float32) + bn
+        r = jax.nn.sigmoid(_load_t(xr_ref, t) + ghr)
+        z = jax.nn.sigmoid(_load_t(xz_ref, t) + ghz)
+        n = jnp.tanh(_load_t(xn_ref, t) + r * ghn)
         h_new = (1.0 - z) * n + z * h
-        return jax.lax.dynamic_update_slice(hseq, h_new[None], (t + 1, 0, 0))
+        hseq_ref[pl.ds(t + 1, 1), :, :] = h_new[None]
+        return 0
 
-    hseq = jnp.zeros((t_len + 1, n_rows, h_dim), jnp.float32)
-    hseq = jax.lax.fori_loop(0, t_len, fstep, hseq)
+    jax.lax.fori_loop(0, t_len, fstep, 0)
 
     def bstep(i, carry):
-        dh, dxi, dwh, dbh = carry
+        dh, dwhr, dwhz, dwhn, dbr, dbz, dbn = carry
         t = t_len - 1 - i
-        h_prev = jax.lax.dynamic_slice_in_dim(hseq, t, 1, axis=0)[0]
-        xt = jax.lax.dynamic_slice_in_dim(xi, t, 1, axis=1)[:, 0, :]
-        gh = jnp.dot(h_prev, wh, preferred_element_type=jnp.float32) + bh
-        r, z, n = _gates(xt, gh, h_dim)
+        h_prev = _load_t(hseq_ref, t)
+        ghr = jnp.dot(h_prev, whr, preferred_element_type=jnp.float32) + br
+        ghz = jnp.dot(h_prev, whz, preferred_element_type=jnp.float32) + bz
+        ghn = jnp.dot(h_prev, whn, preferred_element_type=jnp.float32) + bn
+        r = jax.nn.sigmoid(_load_t(xr_ref, t) + ghr)
+        z = jax.nn.sigmoid(_load_t(xz_ref, t) + ghz)
+        n = jnp.tanh(_load_t(xn_ref, t) + r * ghn)
         # h' = (1-z) n + z h_prev
         dz = dh * (h_prev - n)
         dn = dh * (1.0 - z)
         dh_prev = dh * z
-        dtanh = dn * (1.0 - n * n)               # d(xi_n + r*gh_n)
-        dr = dtanh * gh[:, 2 * h_dim:]
-        dgh_n = dtanh * r
-        dsig_r = dr * r * (1.0 - r)              # d(xi_r + gh_r)
-        dsig_z = dz * z * (1.0 - z)              # d(xi_z + gh_z)
-        dxt = jnp.concatenate([dsig_r, dsig_z, dtanh], axis=-1)   # (Nb, 3H)
-        dgh = jnp.concatenate([dsig_r, dsig_z, dgh_n], axis=-1)   # (Nb, 3H)
-        dh_prev = dh_prev + jnp.dot(
-            dgh, wh.T, preferred_element_type=jnp.float32
+        dtanh = dn * (1.0 - n * n)               # d(x_n + r*ghn)
+        dr = dtanh * ghn
+        dghn = dtanh * r
+        dghr = dr * r * (1.0 - r)                # d(x_r + ghr)
+        dghz = dz * z * (1.0 - z)                # d(x_z + ghz)
+        dxr_ref[pl.ds(t, 1), :, :] = dghr[None]
+        dxz_ref[pl.ds(t, 1), :, :] = dghz[None]
+        dxn_ref[pl.ds(t, 1), :, :] = dtanh[None]
+        dh_prev = dh_prev + (
+            jnp.dot(dghr, whr.T, preferred_element_type=jnp.float32)
+            + jnp.dot(dghz, whz.T, preferred_element_type=jnp.float32)
+            + jnp.dot(dghn, whn.T, preferred_element_type=jnp.float32)
         )
-        dwh = dwh + jnp.dot(h_prev.T, dgh, preferred_element_type=jnp.float32)
-        dbh = dbh + jnp.sum(dgh, axis=0)
-        dxi = jax.lax.dynamic_update_slice(dxi, dxt[:, None, :], (0, t, 0))
-        return dh_prev, dxi, dwh, dbh
+        dwhr = dwhr + jnp.dot(h_prev.T, dghr,
+                              preferred_element_type=jnp.float32)
+        dwhz = dwhz + jnp.dot(h_prev.T, dghz,
+                              preferred_element_type=jnp.float32)
+        dwhn = dwhn + jnp.dot(h_prev.T, dghn,
+                              preferred_element_type=jnp.float32)
+        dbr = dbr + jnp.sum(dghr, axis=0, keepdims=True)
+        dbz = dbz + jnp.sum(dghz, axis=0, keepdims=True)
+        dbn = dbn + jnp.sum(dghn, axis=0, keepdims=True)
+        return dh_prev, dwhr, dwhz, dwhn, dbr, dbz, dbn
 
-    init = (
-        dh_ref[:],
-        jnp.zeros((n_rows, t_len, h3), jnp.float32),
-        jnp.zeros((h_dim, h3), jnp.float32),
-        jnp.zeros((h3,), jnp.float32),
-    )
-    _, dxi, dwh, dbh = jax.lax.fori_loop(0, t_len, bstep, init)
-    dxi_ref[:] = dxi
+    zero_w = jnp.zeros((h_dim, h_dim), jnp.float32)
+    zero_b = jnp.zeros((1, h_dim), jnp.float32)
+    init = (dh_ref[:], zero_w, zero_w, zero_w, zero_b, zero_b, zero_b)
+    _, dwhr, dwhz, dwhn, dbr, dbz, dbn = jax.lax.fori_loop(
+        0, t_len, bstep, init)
 
-    # dWh/dbh accumulate across the sequential grid of row blocks
+    # dWh/db accumulate across the sequential grid of row blocks
     @pl.when(pl.program_id(0) == 0)
     def _init():
-        dwh_ref[:] = jnp.zeros_like(dwh_ref)
-        dbh_ref[:] = jnp.zeros_like(dbh_ref)
+        dwhr_ref[:] = jnp.zeros_like(dwhr_ref)
+        dwhz_ref[:] = jnp.zeros_like(dwhz_ref)
+        dwhn_ref[:] = jnp.zeros_like(dwhn_ref)
+        dbr_ref[:] = jnp.zeros_like(dbr_ref)
+        dbz_ref[:] = jnp.zeros_like(dbz_ref)
+        dbn_ref[:] = jnp.zeros_like(dbn_ref)
 
-    dwh_ref[:] += dwh
-    dbh_ref[0, :] += dbh
+    dwhr_ref[:] += dwhr
+    dwhz_ref[:] += dwhz
+    dwhn_ref[:] += dwhn
+    dbr_ref[:] += dbr
+    dbz_ref[:] += dbz
+    dbn_ref[:] += dbn
 
 
-def _pad_rows(a: jnp.ndarray, n_pad: int) -> jnp.ndarray:
-    if n_pad == 0:
-        return a
-    pad = [(0, n_pad)] + [(0, 0)] * (a.ndim - 1)
-    return jnp.pad(a, pad)
+def _split_gates(xi: jnp.ndarray, w_h: jnp.ndarray, b_h: jnp.ndarray,
+                 n_pad: int):
+    """(N, T, 3H) -> three time-leading (T, N+pad, H) gate streams plus
+    per-gate weights/biases (torch layout [r | z | n])."""
+    h_dim = w_h.shape[0]
+    xs, ws, bs = [], [], []
+    for g in range(3):
+        x = xi[:, :, g * h_dim:(g + 1) * h_dim].astype(jnp.float32)
+        x = jnp.transpose(x, (1, 0, 2))              # (T, N, H)
+        if n_pad:
+            x = jnp.pad(x, ((0, 0), (0, n_pad), (0, 0)))
+        xs.append(x)
+        ws.append(w_h[:, g * h_dim:(g + 1) * h_dim].astype(jnp.float32))
+        bs.append(b_h[g * h_dim:(g + 1) * h_dim].reshape(1, -1)
+                  .astype(jnp.float32))
+    return xs, ws, bs
+
+
+def _block_setup(n_rows: int, t_len: int, h_dim: int):
+    """Row-block size bounded by the BACKWARD's measured VMEM footprint.
+
+    The analytic model — six (T, Nb, H) refs double-buffered plus the
+    (T+1, Nb, H) scratch, (13*T + 1) * H * 4 bytes/row — under-counts
+    Mosaic's actual scoped allocation by ~2x (measured r2 on v5e at
+    T=60/H=64: nb=64 allocated 24.41 MB and nb=48 18.30 MB against a
+    16 MB limit, i.e. ~0.38 MB/row vs the model's 0.20 MB/row), so the
+    sizing applies that empirical factor. Yields nb=64 at T=20/H<=64
+    and nb=24 at T=60/H=64 (~9.2 MB measured-scale)."""
+    per_row = 2 * (13 * t_len + 1) * h_dim * 4
+    nb = max(8, min(_N_BLOCK, (_VMEM_BUDGET // per_row) // 8 * 8))
+    nb = min(nb, n_rows) if n_rows >= 8 else n_rows
+    n_pad = (-n_rows) % nb
+    grid = ((n_rows + n_pad) // nb,)
+    return nb, n_pad, grid
+
+
+def _specs(t_len: int, nb: int, h_dim: int):
+    x_spec = pl.BlockSpec((t_len, nb, h_dim), lambda i: (0, i, 0),
+                          memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((h_dim, h_dim), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
+    b_spec = pl.BlockSpec((1, h_dim), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
+    return x_spec, w_spec, b_spec
+
+
+def _forward_impl(xs, ws, bs, n_rows, t_len, h_dim, nb, n_pad, grid):
+    interpret = jax.default_backend() != "tpu"
+    x_spec, w_spec, b_spec = _specs(t_len, nb, h_dim)
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[x_spec] * 3 + [w_spec] * 3 + [b_spec] * 3,
+        out_specs=pl.BlockSpec((nb, h_dim), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_rows + n_pad, h_dim), jnp.float32),
+        interpret=interpret,
+    )(*xs, *ws, *bs)
+    return out[:n_rows]
 
 
 @jax.custom_vjp
 def gru_scan(xi: jnp.ndarray, w_h: jnp.ndarray, b_h: jnp.ndarray) -> jnp.ndarray:
     """Fused recurrence: xi (N, T, 3H), w_h (H, 3H), b_h (3H,) -> last
     hidden state (N, H)."""
-    interpret = jax.default_backend() != "tpu"
     n_rows, t_len, h3 = xi.shape
     h_dim = h3 // 3
-    nb = min(_N_BLOCK, n_rows)
-    n_pad = (-n_rows) % nb
-    grid = ((n_rows + n_pad) // nb,)
-    out = pl.pallas_call(
-        _fwd_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((nb, t_len, h3), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((h_dim, h3), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h3), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((nb, h_dim), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_rows + n_pad, h_dim), jnp.float32),
-        interpret=interpret,
-    )(_pad_rows(xi.astype(jnp.float32), n_pad), w_h.astype(jnp.float32),
-      b_h.reshape(1, -1).astype(jnp.float32))
-    return out[:n_rows]
+    nb, n_pad, grid = _block_setup(n_rows, t_len, h_dim)
+    xs, ws, bs = _split_gates(xi, w_h, b_h, n_pad)
+    return _forward_impl(xs, ws, bs, n_rows, t_len, h_dim, nb, n_pad, grid)
 
 
 def _fwd(xi, w_h, b_h):
-    return gru_scan(xi, w_h, b_h), (xi, w_h, b_h)
+    # Residuals carry the already-split time-leading gate streams so the
+    # backward never re-does the (N, T, 3H) -> 3x(T, N+pad, H) relayout.
+    n_rows, t_len, h3 = xi.shape
+    h_dim = h3 // 3
+    nb, n_pad, grid = _block_setup(n_rows, t_len, h_dim)
+    xs, ws, bs = _split_gates(xi, w_h, b_h, n_pad)
+    out = _forward_impl(xs, ws, bs, n_rows, t_len, h_dim, nb, n_pad, grid)
+    return out, (xs, ws, bs, n_rows)
 
 
 def _bwd(res, dh):
-    xi, w_h, b_h = res
+    xs, ws, bs, n_rows = res
     interpret = jax.default_backend() != "tpu"
-    n_rows, t_len, h3 = xi.shape
-    h_dim = h3 // 3
-    nb = min(_N_BLOCK, n_rows)
-    n_pad = (-n_rows) % nb
-    grid = ((n_rows + n_pad) // nb,)
-    dxi, dwh, dbh = pl.pallas_call(
+    t_len, n_padded, h_dim = xs[0].shape
+    nb, n_pad, grid = _block_setup(n_rows, t_len, h_dim)
+    dh_in = dh.astype(jnp.float32)
+    if n_pad:
+        dh_in = jnp.pad(dh_in, ((0, n_pad), (0, 0)))
+
+    x_spec, w_spec, b_spec = _specs(t_len, nb, h_dim)
+    outs = pl.pallas_call(
         _bwd_kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((nb, t_len, h3), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((h_dim, h3), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h3), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        in_specs=[x_spec] * 3 + [w_spec] * 3 + [b_spec] * 3 + [
             pl.BlockSpec((nb, h_dim), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((nb, t_len, h3), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((h_dim, h3), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, h3), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_rows + n_pad, t_len, h3), jnp.float32),
-            jax.ShapeDtypeStruct((h_dim, h3), jnp.float32),
-            jax.ShapeDtypeStruct((1, h3), jnp.float32),
+        out_specs=[x_spec] * 3 + [w_spec] * 3 + [b_spec] * 3,
+        out_shape=(
+            [jax.ShapeDtypeStruct((t_len, n_rows + n_pad, h_dim),
+                                  jnp.float32)] * 3
+            + [jax.ShapeDtypeStruct((h_dim, h_dim), jnp.float32)] * 3
+            + [jax.ShapeDtypeStruct((1, h_dim), jnp.float32)] * 3
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((t_len + 1, nb, h_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(_pad_rows(xi.astype(jnp.float32), n_pad), w_h.astype(jnp.float32),
-      b_h.reshape(1, -1).astype(jnp.float32),
-      _pad_rows(dh.astype(jnp.float32), n_pad))
-    return dxi[:n_rows], dwh, dbh[0]
+    )(*xs, *ws, *bs, dh_in)
+    dxr, dxz, dxn, dwhr, dwhz, dwhn, dbr, dbz, dbn = outs
+    # reassemble the packed [r | z | n] layouts
+    dxi = jnp.concatenate([dxr, dxz, dxn], axis=-1)       # (T, N+pad, 3H)
+    dxi = jnp.transpose(dxi, (1, 0, 2))[:n_rows]
+    dwh = jnp.concatenate([dwhr, dwhz, dwhn], axis=1)
+    dbh = jnp.concatenate([dbr[0], dbz[0], dbn[0]])
+    return dxi, dwh, dbh
 
 
 gru_scan.defvjp(_fwd, _bwd)

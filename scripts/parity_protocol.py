@@ -1,0 +1,224 @@
+"""Rank-IC parity protocol runner (BASELINE.md protocol; VERDICT r1 item 4).
+
+Real CSI300 market data is unavailable in this sandbox (zero egress, no
+qlib bundle), so the REAL-data parity number remains blocked-by-data —
+documented in PARITY.md. This driver still executes the complete
+protocol mechanically against the reference's shipped ground-truth
+artifacts (`/root/reference/scores/free{20,48,60}_*.csv`, 125,539 rows
+each; naming per scores/readme.md):
+
+1. Load the three reference score CSVs (the real 2018-12-28→2020-09-23
+   window, 356 instruments, ~299/day validity pattern).
+2. Build a proxy CSI300-shaped panel whose latent per-(day, stock) alpha
+   IN THE SCORE WINDOW is the cross-sectionally z-scored reference K=60
+   score itself, embedded linearly in the 158 features; labels are
+   `s * alpha + sqrt(1-s^2) * noise` at daily-return scale. So the real
+   reference scores genuinely predict the proxy labels (Rank-IC ~= s by
+   construction), the real cross-config correlation structure between
+   K=20/48/60 is preserved, and a model that recovers alpha from the
+   features can match the reference's Rank-IC.
+3. Train the csi300-k{20,48,60} presets on the proxy panel, score the
+   reference window deterministically, export reference-named CSVs.
+4. Run eval/compare.py's join+Rank-IC on (reference CSV, our CSV,
+   shared labels) and report the measured delta vs the ±0.002 target,
+   plus the mean per-day Spearman between our scores and the
+   reference's (score-alignment diagnostic).
+
+Usage:
+    python scripts/parity_protocol.py [--epochs 15] [--out PARITY_RUN.json]
+        [--scores_dir /root/reference/scores] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REF_CSVS = {
+    "csi300-k20": "free20_20_True_False_158_20.csv",
+    "csi300-k48": "free48_48_True_False_158_48.csv",
+    "csi300-k60": "free60_60_True_False_158_60.csv",
+}
+ALPHA_SOURCE = "csi300-k60"     # whose scores seed the latent alpha
+SIGNAL = 0.08                   # Rank-IC plateau planted in the labels
+FEATURE_STRENGTH = 2.0          # alpha amplitude inside the features
+LABEL_SCALE = 0.02              # daily-return-like magnitude
+PREFIX_DAYS = 500               # training history before the score window
+
+
+def load_ref_scores(scores_dir: str) -> dict:
+    out = {}
+    for preset, fname in REF_CSVS.items():
+        df = pd.read_csv(os.path.join(scores_dir, fname),
+                         parse_dates=["datetime"])
+        out[preset] = df.set_index(["datetime", "instrument"]).sort_index()
+    return out
+
+
+def zscore_by_day(s: pd.Series) -> pd.Series:
+    g = s.groupby(level=0)
+    return (s - g.transform("mean")) / g.transform("std").replace(0, np.nan)
+
+
+def build_proxy_panel(ref: dict, seed: int = 0):
+    """Panel whose window alpha = z-scored reference K=60 scores."""
+    from factorvae_tpu.data.panel import Panel
+
+    src = ref[ALPHA_SOURCE]["score"]
+    window_dates = src.index.get_level_values(0).unique().sort_values()
+    instruments = np.sort(src.index.get_level_values(1).unique().to_numpy())
+    prefix_dates = pd.bdate_range(
+        end=window_dates[0] - pd.Timedelta(days=1), periods=PREFIX_DAYS)
+    dates = prefix_dates.append(pd.DatetimeIndex(window_dates))
+    d, n, c = len(dates), len(instruments), 158
+    p = len(prefix_dates)
+
+    rng = np.random.default_rng(seed)
+    # latent alpha: iid in the prefix, z-scored real reference scores in
+    # the window (missing (day, stock) pairs stay invalid)
+    alpha = rng.normal(size=(n, d)).astype(np.float32)
+    valid = np.ones((d, n), bool)
+
+    z = zscore_by_day(src)
+    date_pos = pd.Series(np.arange(d), index=dates)
+    inst_pos = pd.Series(np.arange(n), index=instruments)
+    di = date_pos[z.index.get_level_values(0)].to_numpy()
+    ii = inst_pos[z.index.get_level_values(1)].to_numpy()
+    window_valid = np.zeros((d, n), bool)
+    window_valid[di, ii] = np.isfinite(z.to_numpy())
+    valid[p:] = window_valid[p:]
+    a = np.zeros((d, n), np.float32)
+    a[di, ii] = np.nan_to_num(z.to_numpy()).astype(np.float32)
+    alpha[:, p:] = a[p:].T
+
+    w = (rng.normal(size=(c,)) / np.sqrt(c)).astype(np.float32)
+    feats = (FEATURE_STRENGTH * alpha[:, :, None] * w[None, None, :]
+             + rng.normal(size=(n, d, c)).astype(np.float32))
+    noise = rng.normal(size=(n, d)).astype(np.float32)
+    label = LABEL_SCALE * (SIGNAL * alpha
+                           + np.sqrt(1.0 - SIGNAL**2) * noise)
+    values = np.concatenate([feats, label[..., None]], axis=-1)
+    values[~valid.T[..., None].repeat(c + 1, -1)] = np.nan
+
+    panel = Panel(values=values, valid=valid, dates=dates,
+                  instruments=instruments)
+    return panel, prefix_dates, window_dates
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scores_dir", default="/root/reference/scores")
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--out", default="PARITY_RUN.json")
+    ap.add_argument("--score_dir", default="/tmp/parity_scores")
+    ap.add_argument("--quick", action="store_true",
+                    help="2 epochs, k20 only (smoke)")
+    ap.add_argument("--tolerance", type=float, default=0.002)
+    args = ap.parse_args(argv)
+
+    from factorvae_tpu.config import Config, DataConfig, TrainConfig
+    from factorvae_tpu.data.loader import PanelDataset
+    from factorvae_tpu.eval.compare import compare_scores
+    from factorvae_tpu.eval.metrics import daily_rank_ic
+    from factorvae_tpu.eval.predict import (
+        export_scores,
+        generate_prediction_scores,
+    )
+    from factorvae_tpu.presets import get_preset
+    from factorvae_tpu.train.trainer import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    ref = load_ref_scores(args.scores_dir)
+    panel, prefix_dates, window_dates = build_proxy_panel(ref)
+    labels = pd.Series(
+        panel.values[..., -1].T[panel.valid],
+        index=pd.MultiIndex.from_arrays(
+            [np.repeat(panel.dates, panel.valid.sum(axis=1)),
+             np.concatenate([panel.instruments[panel.valid[i]]
+                             for i in range(len(panel.dates))])],
+            names=["datetime", "instrument"]),
+        name="LABEL0")
+
+    # split: train on the prefix minus a 60-day validation tail
+    fit_end = prefix_dates[-61]
+    val_start, val_end = prefix_dates[-60], prefix_dates[-1]
+    score_start, score_end = window_dates[0], window_dates[-1]
+
+    presets = ["csi300-k20"] if args.quick else list(REF_CSVS)
+    epochs = 2 if args.quick else args.epochs
+    results = {
+        "protocol": "BASELINE.md Rank-IC parity (proxy labels)",
+        "real_data": False,
+        "blocked_by": "no qlib CSI300 bundle in sandbox (zero egress); "
+                      "proxy panel seeds the window alpha with the real "
+                      "reference K=60 scores",
+        "planted_signal": SIGNAL,
+        "tolerance": args.tolerance,
+        "configs": {},
+    }
+    for preset_name in presets:
+        cfg0 = get_preset(preset_name)
+        cfg = Config(
+            model=cfg0.model,
+            data=dataclasses.replace(
+                cfg0.data,
+                dataset_path=None,
+                start_time=str(prefix_dates[0].date()),
+                fit_end_time=str(fit_end.date()),
+                val_start_time=str(val_start.date()),
+                val_end_time=str(val_end.date()),
+                end_time=str(score_end.date()),
+            ),
+            train=dataclasses.replace(
+                cfg0.train, num_epochs=epochs, checkpoint_every=0,
+                save_dir=os.path.join("/tmp/parity_models", preset_name)),
+            mesh=cfg0.mesh,
+        )
+        ds = PanelDataset(panel, seq_len=cfg.model.seq_len, pad_multiple=8)
+        t0 = time.time()
+        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state, out = trainer.fit()
+        train_s = time.time() - t0
+        scores = generate_prediction_scores(
+            state.params, cfg, ds,
+            start=str(score_start.date()), end=str(score_end.date()),
+            stochastic=False, with_labels=True)
+        path = export_scores(scores, cfg, args.score_dir)
+
+        cmp = compare_scores(ref[preset_name], scores[["score"]], labels,
+                             tolerance=args.tolerance)
+        # score-alignment diagnostic: mean per-day Spearman(ours, ref)
+        joined = scores[["score"]].rename(columns={"score": "ours"}).join(
+            ref[preset_name]["score"].rename("ref"), how="inner").dropna()
+        align = daily_rank_ic(joined, "ref", "ours")
+        cmp["score_spearman_to_ref"] = float(align.mean())
+        cmp["train_seconds"] = round(train_s, 2)
+        cmp["best_val"] = float(out["best_val"])
+        cmp["epochs"] = epochs
+        cmp["export"] = path
+        results["configs"][preset_name] = cmp
+        print(f"[parity] {preset_name}: ref_ic={cmp['reference_rank_ic']:.4f} "
+              f"ours_ic={cmp['ours_rank_ic']:.4f} "
+              f"delta={cmp['delta_rank_ic']:+.4f} "
+              f"align={cmp['score_spearman_to_ref']:.3f} "
+              f"({train_s:.0f}s train)")
+
+    with open(args.out, "w") as fh:
+        json.dump(results, fh, indent=2)
+    print(f"[parity] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

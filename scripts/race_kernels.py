@@ -1,0 +1,155 @@
+"""Race the Pallas kernels against the XLA paths on real TPU hardware.
+
+VERDICT r1 item 3: both kernels must go through Mosaic (not interpret)
+at N_max in {360, 1024}, H in {20..64}, K in {20..96}, and be timed
+against the XLA einsum/scan paths so the winner per shape is measured,
+not assumed. Emits a JSON list (one record per shape) and a markdown
+table for PERF.md.
+
+The XLA oracles here are the exact computations models/{layers,
+predictor}.py run when use_pallas_* is off: a `lax.scan` GRU recurrence
+and the batched K-head einsum attention (both operating on the same
+pre-computed inputs the kernels take, so the race isolates the fused
+part).
+
+Usage: python scripts/race_kernels.py [--out RACE.json] [--reps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, *args, reps: int = 20) -> float:
+    """Median wall seconds of jitted fn over reps (after warmup)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def gru_xla(xi, wh, bh):
+    """The models/layers.py scan recurrence on precomputed projections."""
+    h = wh.shape[0]
+
+    def step(hc, xt):
+        gh = hc @ wh + bh
+        r = jax.nn.sigmoid(xt[:, :h] + gh[:, :h])
+        z = jax.nn.sigmoid(xt[:, h:2 * h] + gh[:, h:2 * h])
+        n = jnp.tanh(xt[:, 2 * h:] + r * gh[:, 2 * h:])
+        return (1 - z) * n + z * hc, None
+
+    h0 = jnp.zeros((xi.shape[0], h))
+    out, _ = jax.lax.scan(step, h0, jnp.transpose(xi, (1, 0, 2)))
+    return out
+
+
+def attn_xla(latent, maskf, q, wk, bk, wv, bv):
+    """The models/predictor.py batched K-head einsum path."""
+    h = latent.shape[1]
+    key = jnp.einsum("nh,khj->knj", latent, wk) + bk[:, None, :]
+    val = jnp.einsum("nh,khj->knj", latent, wv) + bv[:, None, :]
+    scores = jnp.einsum("knh,kh->kn", key, q) / jnp.sqrt(
+        jnp.float32(h) + 1e-6)
+    scores = jnp.maximum(scores, 0.0)
+    neg = jnp.where(maskf[None, :] > 0, scores, -1e30)
+    m = jnp.max(neg, axis=1, keepdims=True)
+    ex = jnp.where(maskf[None, :] > 0, jnp.exp(neg - m), 0.0)
+    attn = ex / jnp.maximum(jnp.sum(ex, axis=1, keepdims=True), 1e-30)
+    return jnp.einsum("kn,knh->kh", attn, jnp.nan_to_num(val))
+
+
+def race_gru(n, t, h, reps):
+    from factorvae_tpu.ops.pallas.gru import gru_scan
+
+    rng = np.random.default_rng(0)
+    xi = jnp.asarray(rng.normal(size=(n, t, 3 * h)), jnp.float32) * 0.5
+    wh = jnp.asarray(rng.normal(size=(h, 3 * h)), jnp.float32) * 0.2
+    bh = jnp.asarray(rng.normal(size=(3 * h,)), jnp.float32) * 0.1
+
+    rec = {"op": "gru", "n": n, "t": t, "h": h}
+    for name, f in (("pallas", gru_scan), ("xla", gru_xla)):
+        fwd = jax.jit(lambda a, b, c, f=f: f(a, b, c))
+        bwd = jax.jit(jax.grad(
+            lambda a, b, c, f=f: jnp.sum(f(a, b, c) ** 2), argnums=(0, 1, 2)))
+        rec[f"{name}_fwd_us"] = round(timed(fwd, xi, wh, bh, reps=reps) * 1e6, 1)
+        rec[f"{name}_fwdbwd_us"] = round(
+            timed(bwd, xi, wh, bh, reps=reps) * 1e6, 1)
+    rec["fwd_speedup"] = round(rec["xla_fwd_us"] / rec["pallas_fwd_us"], 2)
+    rec["fwdbwd_speedup"] = round(
+        rec["xla_fwdbwd_us"] / rec["pallas_fwdbwd_us"], 2)
+    return rec
+
+
+def race_attention(n, h, k, reps):
+    from factorvae_tpu.ops.pallas.attention_grad import fused_attention
+
+    rng = np.random.default_rng(0)
+    latent = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+    maskf = jnp.asarray(rng.random(n) < 0.9, jnp.float32)
+    q = jnp.asarray(rng.normal(size=(k, h)), jnp.float32)
+    wk = jnp.asarray(rng.normal(size=(k, h, h)), jnp.float32) * 0.1
+    bk = jnp.zeros((k, h))
+    wv = wk * 0.5
+    bv = jnp.zeros((k, h))
+    args = (latent, maskf, q, wk, bk, wv, bv)
+
+    rec = {"op": "attention", "n": n, "h": h, "k": k}
+    for name, f in (("pallas", fused_attention), ("xla", attn_xla)):
+        fwd = jax.jit(lambda *a, f=f: f(*a))
+        bwd = jax.jit(jax.grad(
+            lambda *a, f=f: jnp.sum(f(*a) ** 2), argnums=(0, 2, 3)))
+        rec[f"{name}_fwd_us"] = round(timed(fwd, *args, reps=reps) * 1e6, 1)
+        rec[f"{name}_fwdbwd_us"] = round(
+            timed(bwd, *args, reps=reps) * 1e6, 1)
+    rec["fwd_speedup"] = round(rec["xla_fwd_us"] / rec["pallas_fwd_us"], 2)
+    rec["fwdbwd_speedup"] = round(
+        rec["xla_fwdbwd_us"] / rec["pallas_fwdbwd_us"], 2)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="RACE_KERNELS.json")
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    backend = jax.default_backend()
+    records = []
+    for n in (360, 1024):
+        for t, h in ((20, 20), (20, 64), (60, 64)):
+            rec = race_gru(n, t, h, args.reps)
+            records.append(rec)
+            print(json.dumps(rec))
+    for n in (360, 1024):
+        for h, k in ((20, 20), (48, 48), (64, 96)):
+            rec = race_attention(n, h, k, args.reps)
+            records.append(rec)
+            print(json.dumps(rec))
+    with open(args.out, "w") as fh:
+        json.dump({"backend": backend, "records": records}, fh, indent=2)
+    print(f"wrote {args.out} (backend={backend})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,71 @@ class TestMesh:
             np.testing.assert_array_equal(shards[0], s)
 
 
+def _collective_groups(hlo: str):
+    """Extract every replica_groups= annotation from compiled HLO text as
+    a set of frozen group-sets, handling both the explicit
+    `{{0,2},{1,3}}` format and the iota V2 format
+    `[nGroups,size]<=[dims]T(perm)` / `[nGroups,size]<=[n]`."""
+    import re
+
+    out = []
+    for m in re.finditer(r"replica_groups=\{\{([0-9,{} ]*)\}\}", hlo):
+        groups = [
+            frozenset(int(x) for x in g.split(",") if x.strip() != "")
+            for g in m.group(1).split("},{")
+        ]
+        out.append(frozenset(groups))
+    for m in re.finditer(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?",
+        hlo,
+    ):
+        n_groups, size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(x) for x in m.group(4).split(",")])
+        ids = ids.reshape(n_groups, size)
+        out.append(frozenset(frozenset(int(i) for i in row) for row in ids))
+    return out
+
+
+class TestCollectivePlacement:
+    """Assert the compiler actually inserted the collectives the sharding
+    design promises (VERDICT r1 item 7) — not just that losses match."""
+
+    def test_hlo_has_data_allreduce_and_stock_collective(
+        self, dense_ds, tmp_path
+    ):
+        mesh = make_mesh(MeshConfig(stock_axis=2))  # dp=4 x sp=2
+        cfg = cfg_for(tmp_path, days_per_step=4)
+        tr = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
+        state = tr.init_state()
+        order = jnp.asarray(tr.train_days[:4].reshape(1, 4))
+        hlo = tr._train_epoch.lower(state, order).compile().as_text()
+
+        groups = _collective_groups(hlo)
+        # expected groups come from the mesh's OWN device array ('data'
+        # groups are the columns, 'stock' groups the rows) so a reordered
+        # device mesh doesn't produce spurious failures
+        ids = np.vectorize(lambda d: d.id)(mesh.devices)
+        data_groups = frozenset(
+            frozenset(int(i) for i in ids[:, j]) for j in range(2)
+        )
+        stock_groups = frozenset(
+            frozenset(int(i) for i in ids[j, :]) for j in range(4)
+        )
+        assert data_groups in groups, (
+            f"no collective over the 'data' axis (gradient all-reduce "
+            f"missing); saw groups: {groups}"
+        )
+        assert stock_groups in groups, (
+            f"no collective over the 'stock' axis (cross-section "
+            f"softmax/portfolio reductions missing); saw groups: {groups}"
+        )
+        # and the gradient sync is an all-reduce op specifically
+        assert "all-reduce" in hlo
+
+
 class TestGraftEntry:
     def test_dryrun_multichip(self):
         import sys, os
@@ -80,7 +145,9 @@ class TestGraftEntry:
         sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         import __graft_entry__ as ge
 
-        ge.dryrun_multichip(8)
+        # reduced shapes keep the suite fast; the driver's own invocation
+        # (python __graft_entry__.py 8) runs the flagship default
+        ge.dryrun_multichip(8, flagship=False)
 
     def test_entry_compiles_small(self):
         """entry() targets the flagship shape; here we only check the
