@@ -259,7 +259,11 @@ def run_bench() -> dict:
                 NUM_DAYS, DAYS_PER_STEP, EPOCHS_TIMED, USE_BF16, USE_PALLAS
                 ) == (158, 20, 64, 96, 128, 356, 256, 8, 3, True, False)
     return {
+        # the dtype is part of the metric NAME so the longitudinal series
+        # can't silently splice a dtype change in as a code speedup
+        # (round 1-2 fp32 runs reported without the suffix)
         "metric": "train_throughput_flagship_K96_H64_Alpha158"
+                  + ("_bf16" if USE_BF16 else "")
                   + ("" if flagship else "_smoke")
                   + ("_cpu_fallback" if FORCED_CPU else ""),
         "value": round(value, 1),

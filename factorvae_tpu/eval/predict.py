@@ -43,12 +43,12 @@ def predict_panel(
 
     from factorvae_tpu.data.windows import gather_day
 
+    # The panel arrays are explicit jit arguments (not closed over) so
+    # they never enter the compile payload — see train/loop.py.
     @jax.jit
-    def score_chunk(day_idx, key):
+    def score_chunk(values, last_valid, next_valid, day_idx, key):
         def one(d):
-            return gather_day(
-                dataset.values, dataset.last_valid, dataset.next_valid, d, seq_len
-            )
+            return gather_day(values, last_valid, next_valid, d, seq_len)
 
         x, _, mask = jax.vmap(one)(jnp.maximum(day_idx, 0))
         mask = mask & (day_idx >= 0)[:, None]
@@ -60,7 +60,9 @@ def predict_panel(
         sel = days[c0 : c0 + chunk]
         padded = np.full(chunk, -1, np.int32)
         padded[: len(sel)] = sel
-        scores = score_chunk(jnp.asarray(padded), jax.random.fold_in(base, c0))
+        scores = score_chunk(
+            dataset.values, dataset.last_valid, dataset.next_valid,
+            jnp.asarray(padded), jax.random.fold_in(base, c0))
         out[c0 : c0 + len(sel)] = np.asarray(scores)[: len(sel)]
     return out
 

@@ -31,30 +31,36 @@ from factorvae_tpu.train.state import TrainState
 
 
 class StepFns(NamedTuple):
-    train_step: Callable        # (state, days) -> (state, (loss_sum, day_count))
-    train_epoch: Callable       # (state, order (S,B)) -> (state, metrics dict)
-    eval_epoch: Callable        # (params, order (S,B), key) -> metrics dict
-    batch_for: Callable         # (days (B,)) -> (x, y, mask)
+    train_step: Callable        # (state, days, panel) -> (state, aux)
+    train_epoch: Callable       # (state, order (S,B), panel) -> (state, metrics)
+    eval_epoch: Callable        # (params, order (S,B), key, panel) -> metrics
+    batch_for: Callable         # (days (B,), panel) -> (x, y, mask)
 
 
 def make_step_fns(
     model_train: Any,
     model_eval: Any,
     tx: optax.GradientTransformation,
-    values: jnp.ndarray,
-    last_valid: jnp.ndarray,
-    next_valid: jnp.ndarray,
     seq_len: int,
     shard_batch: Any = None,
 ) -> StepFns:
     """`model_train` / `model_eval` are the day-batched forward variants
     (models.day_forward with train=True/False; they share one param tree).
 
+    Every entry point takes `panel = (values, last_valid, next_valid)` as
+    an EXPLICIT runtime argument. Closing over the HBM panel instead
+    (the round-1 design) made JAX embed it as a compile-time constant —
+    at real CSI300 history length (~1,200 days, ~280 MB) that blew the
+    axon relay's compile-payload limit (HTTP 413) and would bloat any
+    serialized executable; as arguments the arrays stay where they live
+    and the compiled program is shape-only.
+
     `shard_batch`, when given (parallel.make_batch_constraint), pins the
     gathered (B, N, ...) batch to the ('data', 'stock') mesh layout inside
     the jitted step."""
 
-    def batch_for(days: jnp.ndarray):
+    def batch_for(days: jnp.ndarray, panel):
+        values, last_valid, next_valid = panel
         safe = jnp.maximum(days, 0)
         x, y, mask = jax.vmap(
             lambda d: gather_day(values, last_valid, next_valid, d, seq_len)
@@ -64,8 +70,8 @@ def make_step_fns(
             x, y, mask = shard_batch(x, y, mask)
         return x, y, mask
 
-    def weighted_day_loss(params, days, key, train: bool):
-        x, y, mask = batch_for(days)
+    def weighted_day_loss(params, days, key, panel, train: bool):
+        x, y, mask = batch_for(days, panel)
         day_w = (days >= 0).astype(jnp.float32)
         k_sample, k_drop = jax.random.split(key)
         model = model_train if train else model_eval
@@ -91,10 +97,10 @@ def make_step_fns(
         }
         return loss, aux
 
-    def train_step(state: TrainState, days: jnp.ndarray):
+    def train_step(state: TrainState, days: jnp.ndarray, panel):
         state, key = state.advance_rng()
         (_, aux), grads = jax.value_and_grad(weighted_day_loss, has_aux=True)(
-            state.params, days, key, True
+            state.params, days, key, panel, True
         )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -103,10 +109,10 @@ def make_step_fns(
         )
         return state, aux
 
-    def train_epoch(state: TrainState, order: jnp.ndarray):
+    def train_epoch(state: TrainState, order: jnp.ndarray, panel):
         """order: (S, B) int32 day indices (-1 = pad)."""
         def body(st, days):
-            st, aux = train_step(st, days)
+            st, aux = train_step(st, days, panel)
             return st, aux
 
         state, auxes = jax.lax.scan(body, state, order)
@@ -119,12 +125,12 @@ def make_step_fns(
         }
         return state, metrics
 
-    def eval_epoch(params, order: jnp.ndarray, key: jax.Array):
+    def eval_epoch(params, order: jnp.ndarray, key: jax.Array, panel):
         """Validation mean loss (reference validate(), train_model.py:40-60:
         dropout off, reconstruction still sampled)."""
         def body(k, days):
             k, sub = jax.random.split(k)
-            _, aux = weighted_day_loss(params, days, sub, False)
+            _, aux = weighted_day_loss(params, days, sub, panel, False)
             return k, aux
 
         _, auxes = jax.lax.scan(body, key, order)

@@ -24,6 +24,7 @@ from factorvae_tpu.parallel.mesh import make_mesh
 from factorvae_tpu.parallel.sharding import (
     make_batch_constraint,
     order_sharding,
+    panel_shardings,
     replicated,
     shard_dataset,
 )
@@ -96,9 +97,6 @@ class Trainer:
             self.model,
             self.model_eval,
             self.tx,
-            self.ds.values,
-            self.ds.last_valid,
-            self.ds.next_valid,
             cfg.data.seq_len,
             shard_batch=self._shard_batch,
         )
@@ -107,20 +105,34 @@ class Trainer:
         if self.mesh is not None:
             rep = replicated(self.mesh)
             ord_s = order_sharding(self.mesh)
+            pan_s = panel_shardings(self.mesh)
             # `rep` as a prefix pytree replicates the whole state/metrics
-            self._train_epoch = jax.jit(
+            self._train_epoch_jit = jax.jit(
                 self.fns.train_epoch,
                 donate_argnums=donate,
-                in_shardings=(rep, ord_s),
+                in_shardings=(rep, ord_s, pan_s),
                 out_shardings=(rep, rep),
             )
-            self._eval_epoch = jax.jit(
-                self.fns.eval_epoch, in_shardings=(rep, ord_s, rep),
+            self._eval_epoch_jit = jax.jit(
+                self.fns.eval_epoch, in_shardings=(rep, ord_s, rep, pan_s),
                 out_shardings=rep,
             )
         else:
-            self._train_epoch = jax.jit(self.fns.train_epoch, donate_argnums=donate)
-            self._eval_epoch = jax.jit(self.fns.eval_epoch)
+            self._train_epoch_jit = jax.jit(
+                self.fns.train_epoch, donate_argnums=donate)
+            self._eval_epoch_jit = jax.jit(self.fns.eval_epoch)
+
+    def panel_args(self):
+        """The HBM panel as explicit jit arguments (loop.py: passing these
+        instead of closing over them keeps the ~O(100 MB) panel out of the
+        compile payload)."""
+        return (self.ds.values, self.ds.last_valid, self.ds.next_valid)
+
+    def _train_epoch(self, state, order):
+        return self._train_epoch_jit(state, order, self.panel_args())
+
+    def _eval_epoch(self, params, order, key):
+        return self._eval_epoch_jit(params, order, key, self.panel_args())
 
     # ------------------------------------------------------------------
 

@@ -52,7 +52,11 @@ ALPHA_SOURCE = "csi300-k60"     # whose scores seed the latent alpha
 SIGNAL = 0.08                   # Rank-IC plateau planted in the labels
 FEATURE_STRENGTH = 2.0          # alpha amplitude inside the features
 LABEL_SCALE = 0.02              # daily-return-like magnitude
-PREFIX_DAYS = 500               # training history before the score window
+PREFIX_DAYS = 800               # training history before the score window
+# (the reference protocol is ~2190 train days x 30 epochs = 65k steps at
+# lr 1e-4; at this SNR the VAE needs a comparable step count to surface
+# the signal — a linear probe on the same panel reaches IC 0.065, the
+# r2 first attempt with 440 days x 15 epochs = 6.6k steps reached ~0)
 
 
 def load_ref_scores(scores_dir: str) -> dict:
@@ -117,11 +121,14 @@ def build_proxy_panel(ref: dict, seed: int = 0):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scores_dir", default="/root/reference/scores")
-    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--epochs", type=int, default=50)
     ap.add_argument("--out", default="PARITY_RUN.json")
     ap.add_argument("--score_dir", default="/tmp/parity_scores")
     ap.add_argument("--quick", action="store_true",
                     help="2 epochs, k20 only (smoke)")
+    ap.add_argument("--presets", default=None,
+                    help="comma-separated subset (e.g. csi300-k48); "
+                         "merges into --out if it already exists")
     ap.add_argument("--tolerance", type=float, default=0.002)
     args = ap.parse_args(argv)
 
@@ -155,7 +162,12 @@ def main(argv=None) -> int:
     val_start, val_end = prefix_dates[-60], prefix_dates[-1]
     score_start, score_end = window_dates[0], window_dates[-1]
 
-    presets = ["csi300-k20"] if args.quick else list(REF_CSVS)
+    if args.quick:
+        presets = ["csi300-k20"]
+    elif args.presets:
+        presets = [p.strip() for p in args.presets.split(",")]
+    else:
+        presets = list(REF_CSVS)
     epochs = 2 if args.quick else args.epochs
     results = {
         "protocol": "BASELINE.md Rank-IC parity (proxy labels)",
@@ -214,6 +226,23 @@ def main(argv=None) -> int:
               f"align={cmp['score_spearman_to_ref']:.3f} "
               f"({train_s:.0f}s train)")
 
+    # Merge ONLY for explicit --presets subset runs (per --presets help);
+    # full and --quick runs overwrite so a smoke run can never silently
+    # splice 2-epoch results into the authoritative artifact.
+    if args.presets and os.path.exists(args.out):
+        try:
+            with open(args.out) as fh:
+                prior = json.load(fh)
+            merged_configs = dict(prior.get("configs", {}))
+            merged_configs.update(results["configs"])
+            prior.update({k: v for k, v in results.items()
+                          if k != "configs"})
+            prior["configs"] = merged_configs
+            results = prior
+        except Exception as e:
+            print(f"[parity] WARNING: could not merge into existing "
+                  f"{args.out} ({type(e).__name__}: {e}); prior configs "
+                  f"will be OVERWRITTEN by this subset run")
     with open(args.out, "w") as fh:
         json.dump(results, fh, indent=2)
     print(f"[parity] wrote {args.out}")

@@ -113,8 +113,11 @@ def race_attention(n, h, k, reps):
     rec = {"op": "attention", "n": n, "h": h, "k": k}
     for name, f in (("pallas", fused_attention), ("xla", attn_xla)):
         fwd = jax.jit(lambda *a, f=f: f(*a))
+        # grads w.r.t. ALL trainable inputs (latent, q, Wk, bk, Wv, bv) so
+        # both paths time the full training-relevant backward
         bwd = jax.jit(jax.grad(
-            lambda *a, f=f: jnp.sum(f(*a) ** 2), argnums=(0, 2, 3)))
+            lambda *a, f=f: jnp.sum(f(*a) ** 2),
+            argnums=(0, 2, 3, 4, 5, 6)))
         rec[f"{name}_fwd_us"] = round(timed(fwd, *args, reps=reps) * 1e6, 1)
         rec[f"{name}_fwdbwd_us"] = round(
             timed(bwd, *args, reps=reps) * 1e6, 1)

@@ -1,0 +1,157 @@
+"""Scale demos: CSI800 (N=1024) and Alpha360 (C=360, T=60) end-to-end.
+
+VERDICT r1 item 6: run the two big BASELINE.json configs (4: CSI800
+K=60/H=60 with the cross-section padded to 1024; 5: Alpha360 features
+C=360 at seq_len=60) end-to-end and measure throughput + device memory,
+single-chip and under a stock-sharded mesh.
+
+Notes on the mesh variant: the sandbox exposes ONE real TPU chip, so
+`--mesh_stock 2` can only execute on the virtual CPU mesh — launch with
+`JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8`
+(or via tests' force_host_devices), where wall-clock on the 1-core host
+is meaningless: the mesh run is a correctness/compile demonstration;
+the sharding-payoff question needs real multi-chip wall-clock.
+Single-chip numbers are real v5e measurements.
+
+This intentionally repeats bench.py's warmup+timed-epochs methodology
+(different metrics: HBM peak + compile time here, MFU/vs_baseline
+there); if the timing protocol changes, change both.
+
+Usage:
+    python scripts/scale_demo.py [--config csi800|alpha360|both]
+        [--days 64] [--epochs 2] [--mesh_stock N] [--out SCALE_DEMO.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SHAPES = {
+    # BASELINE.json config 4: CSI800 universe, K=60/H=60, Alpha158
+    "csi800": dict(num_features=158, seq_len=20, hidden=60, factors=60,
+                   portfolios=128, stocks=800, max_stocks=1024),
+    # BASELINE.json config 5: Alpha360 features, seq_len=60
+    "alpha360": dict(num_features=360, seq_len=60, hidden=60, factors=60,
+                     portfolios=128, stocks=300, max_stocks=None),
+}
+
+
+def run_config(name: str, days: int, epochs: int, days_per_step: int,
+               bf16: bool, mesh_stock: int = 1) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from factorvae_tpu.config import (
+        Config, DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+    from factorvae_tpu.parallel import make_mesh
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    s = SHAPES[name]
+    cfg = Config(
+        model=ModelConfig(
+            num_features=s["num_features"], hidden_size=s["hidden"],
+            num_factors=s["factors"], num_portfolios=s["portfolios"],
+            seq_len=s["seq_len"],
+            compute_dtype="bfloat16" if bf16 else "float32",
+        ),
+        data=DataConfig(seq_len=s["seq_len"], start_time=None,
+                        fit_end_time=None, val_start_time=None,
+                        val_end_time=None),
+        # +1: the warmup (compile) epoch consumes schedule steps too, so
+        # the cosine horizon must cover warmup + timed epochs or the last
+        # timed epoch trains at lr ~= 0
+        train=TrainConfig(num_epochs=epochs + 1,
+                          days_per_step=days_per_step,
+                          seed=0, checkpoint_every=0,
+                          save_dir=f"/tmp/scale_{name}"),
+    )
+    panel = synthetic_panel_dense(
+        num_days=days, num_instruments=s["stocks"],
+        num_features=s["num_features"])
+    ds = PanelDataset(panel, seq_len=s["seq_len"],
+                      max_stocks=s["max_stocks"],
+                      pad_multiple=8 * max(1, mesh_stock))
+    mesh = make_mesh(MeshConfig(stock_axis=mesh_stock)) \
+        if mesh_stock > 1 else None
+    trainer = Trainer(cfg, ds, mesh=mesh, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+
+    # warmup epoch = compile
+    t0 = time.time()
+    state, m = trainer._train_epoch(state, trainer._epoch_orders(0))
+    jax.block_until_ready(m["loss"])
+    compile_s = time.time() - t0
+
+    days_per_epoch = float(m["days"])
+    t0 = time.time()
+    for e in range(1, epochs + 1):
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(e))
+    jax.block_until_ready(m["loss"])
+    dt = time.time() - t0
+
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)() or {}
+    days_per_sec = epochs * days_per_epoch / dt
+    return {
+        "config": name,
+        "platform": dev.platform,
+        "mesh_stock": mesh_stock,
+        "n_padded": int(ds.n_max),
+        "num_features": s["num_features"],
+        "seq_len": s["seq_len"],
+        "bf16": bf16,
+        "days_per_step": days_per_step,
+        "compile_seconds": round(compile_s, 1),
+        "days_per_sec": round(days_per_sec, 2),
+        "windows_per_sec": round(days_per_sec * s["stocks"], 1),
+        "loss": float(m["loss"]),
+        "hbm_peak_bytes": stats.get("peak_bytes_in_use"),
+        "hbm_peak_gb": round(stats.get("peak_bytes_in_use", 0) / 2**30, 3)
+                       if stats.get("peak_bytes_in_use") else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", default="both",
+                    choices=["csi800", "alpha360", "both"])
+    ap.add_argument("--days", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--days_per_step", type=int, default=8)
+    ap.add_argument("--mesh_stock", type=int, default=1,
+                    help="size of the 'stock' mesh axis (>1 needs >=2 "
+                         "devices; on this sandbox use the virtual CPU "
+                         "mesh env — see module docstring)")
+    ap.add_argument("--fp32", action="store_true")
+    ap.add_argument("--out", default="SCALE_DEMO.json")
+    args = ap.parse_args(argv)
+
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    names = ["csi800", "alpha360"] if args.config == "both" else [args.config]
+    results = []
+    for name in names:
+        rec = run_config(name, args.days, args.epochs, args.days_per_step,
+                         bf16=not args.fp32, mesh_stock=args.mesh_stock)
+        results.append(rec)
+        print(json.dumps(rec))
+    with open(args.out, "w") as fh:
+        json.dump(results, fh, indent=2)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,7 +113,8 @@ class TestCollectivePlacement:
         tr = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
         state = tr.init_state()
         order = jnp.asarray(tr.train_days[:4].reshape(1, 4))
-        hlo = tr._train_epoch.lower(state, order).compile().as_text()
+        hlo = tr._train_epoch_jit.lower(
+            state, order, tr.panel_args()).compile().as_text()
 
         groups = _collective_groups(hlo)
         # expected groups come from the mesh's OWN device array ('data'
