@@ -10,6 +10,7 @@ the mean ± std the parity comparison needs.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Sequence
 
 import pandas as pd
@@ -18,6 +19,7 @@ from factorvae_tpu.config import Config
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.eval.metrics import rank_ic_frame
 from factorvae_tpu.eval.predict import generate_prediction_scores
+from factorvae_tpu.train.checkpoint import load_params
 from factorvae_tpu.train.trainer import Trainer
 from factorvae_tpu.utils.logging import MetricsLogger
 
@@ -40,8 +42,19 @@ def seed_sweep(
         )
         trainer = Trainer(cfg, dataset, logger=logger)
         state, out = trainer.fit()
+        # Score with the per-seed BEST-VALIDATION weights (the reference
+        # backtest's selection rule, backtest.ipynb cell 2; the
+        # checkpoint name encodes the seed so sweeps don't collide).
+        best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
+        if os.path.isdir(best):
+            params = load_params(best, state.params)
+        else:
+            logger.log("sweep_warning", seed=int(seed),
+                       note=f"best-val checkpoint missing at {best}; "
+                            "scoring FINAL-epoch params")
+            params = state.params
         scores = generate_prediction_scores(
-            state.params, cfg, dataset, start=score_start, end=score_end,
+            params, cfg, dataset, start=score_start, end=score_end,
             stochastic=False, with_labels=True,
         )
         ic = rank_ic_frame(scores.dropna(), "LABEL0", "score")

@@ -35,6 +35,7 @@ import argparse
 import dataclasses
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -129,6 +130,10 @@ def main(argv=None) -> int:
     ap.add_argument("--presets", default=None,
                     help="comma-separated subset (e.g. csi300-k48); "
                          "merges into --out if it already exists")
+    ap.add_argument("--sweep_seeds", type=int, default=0,
+                    help="additionally run eval.sweep.seed_sweep with "
+                         "this many seeds per preset (statistical parity "
+                         "per SURVEY §7 hard-part 3)")
     ap.add_argument("--tolerance", type=float, default=0.002)
     args = ap.parse_args(argv)
 
@@ -141,6 +146,7 @@ def main(argv=None) -> int:
         generate_prediction_scores,
     )
     from factorvae_tpu.presets import get_preset
+    from factorvae_tpu.train.checkpoint import load_params
     from factorvae_tpu.train.trainer import Trainer
     from factorvae_tpu.utils.logging import MetricsLogger
     from factorvae_tpu.utils.testing import enable_persistent_compile_cache
@@ -197,13 +203,27 @@ def main(argv=None) -> int:
                 save_dir=os.path.join("/tmp/parity_models", preset_name)),
             mesh=cfg0.mesh,
         )
+        # fresh best-val dir: never load a stale checkpoint from an
+        # earlier protocol run
+        shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
         ds = PanelDataset(panel, seq_len=cfg.model.seq_len, pad_multiple=8)
         t0 = time.time()
         trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
         state, out = trainer.fit()
         train_s = time.time() - t0
+        # score with the BEST-VALIDATION weights, as the reference's
+        # backtest does (backtest.ipynb cell 2) — at K=60 the final-epoch
+        # params overfit the proxy panel hard (r2: IC 0.010 final vs
+        # best-val selection)
+        best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
+        if os.path.isdir(best):
+            params = load_params(best, state.params)
+        else:
+            print(f"[parity] WARNING: best-val checkpoint missing at "
+                  f"{best}; scoring FINAL-epoch params")
+            params = state.params
         scores = generate_prediction_scores(
-            state.params, cfg, ds,
+            params, cfg, ds,
             start=str(score_start.date()), end=str(score_end.date()),
             stochastic=False, with_labels=True)
         path = export_scores(scores, cfg, args.score_dir)
@@ -219,12 +239,26 @@ def main(argv=None) -> int:
         cmp["best_val"] = float(out["best_val"])
         cmp["epochs"] = epochs
         cmp["export"] = path
+        if args.sweep_seeds:
+            from factorvae_tpu.eval.sweep import seed_sweep
+
+            sw = seed_sweep(
+                cfg, ds, seeds=list(range(args.sweep_seeds)),
+                score_start=str(score_start.date()),
+                score_end=str(score_end.date()))
+            cmp["seed_sweep"] = {
+                "per_seed_rank_ic": sw["rank_ic"].to_dict(),
+                **sw.attrs["summary"],
+            }
         results["configs"][preset_name] = cmp
         print(f"[parity] {preset_name}: ref_ic={cmp['reference_rank_ic']:.4f} "
               f"ours_ic={cmp['ours_rank_ic']:.4f} "
               f"delta={cmp['delta_rank_ic']:+.4f} "
               f"align={cmp['score_spearman_to_ref']:.3f} "
-              f"({train_s:.0f}s train)")
+              f"({train_s:.0f}s train)"
+              + (f" sweep_mean={cmp['seed_sweep']['rank_ic_mean']:.4f}"
+                 f"±{cmp['seed_sweep']['rank_ic_std']:.4f}"
+                 if args.sweep_seeds else ""))
 
     # Merge ONLY for explicit --presets subset runs (per --presets help);
     # full and --quick runs overwrite so a smoke run can never silently

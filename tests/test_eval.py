@@ -138,12 +138,16 @@ class TestCLI:
 class TestCLIDefaults:
     def test_stochastic_scores_is_the_default(self):
         """The reference always samples at inference (module.py:123);
-        the CLI default must agree with ModelConfig's (ADVICE round 1)."""
-        from factorvae_tpu.cli import build_parser
+        the resolved CLI config must agree with ModelConfig's default
+        (ADVICE round 1). The parser itself holds a None sentinel so
+        presets are only overridden by explicitly passed flags."""
+        from factorvae_tpu.cli import build_parser, config_from_args
         from factorvae_tpu.config import ModelConfig
 
         p = build_parser()
-        assert p.parse_args([]).stochastic_scores is True
+        assert p.parse_args([]).stochastic_scores is None  # sentinel
+        assert config_from_args(
+            p.parse_args([])).model.stochastic_inference is True
         assert p.parse_args(["--deterministic_scores"]).stochastic_scores is False
         assert ModelConfig().stochastic_inference is True
 
