@@ -99,6 +99,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "parameters: topk 50, n_drop 10, costs 5bp/15bp)")
     p.add_argument("--backtest_topk", type=int, default=50)
     p.add_argument("--backtest_n_drop", type=int, default=10)
+    p.add_argument("--backtest_plot", type=str, default=None, metavar="PNG",
+                   help="write the report_graph-style 4-panel figure "
+                        "(backtest.ipynb cell 7 artifact) to this path")
     p.add_argument("--export", type=str, default=None, metavar="PATH",
                    help="write an AOT serving artifact (StableHLO, weights "
                         "baked in) of the prediction function to PATH")
@@ -344,6 +347,13 @@ def main(argv=None) -> int:
             k: (v if v is None or isinstance(v, (int, float)) else float(v))
             for k, v in acct.summary().items()
         })
+        if args.backtest_plot:
+            from factorvae_tpu.eval.plots import report_graph
+
+            out_png = report_graph(
+                acct.report, args.backtest_plot,
+                title=cfg.train.run_name)
+            logger.log("backtest_plot", path=out_png)
     if args.export:
         from factorvae_tpu.eval.export_aot import export_prediction
 

@@ -8,6 +8,7 @@ from factorvae_tpu.eval.backtest import (
 from factorvae_tpu.eval.export_aot import export_prediction, load_exported
 from factorvae_tpu.eval.factors import decompose
 from factorvae_tpu.eval.metrics import RankIC, daily_rank_ic, rank_ic_frame
+from factorvae_tpu.eval.plots import report_graph
 from factorvae_tpu.eval.predict import (
     export_scores,
     generate_prediction_scores,
@@ -29,6 +30,7 @@ __all__ = [
     "generate_prediction_scores",
     "predict_panel",
     "rank_ic_frame",
+    "report_graph",
     "seed_sweep",
     "topk_dropout_backtest",
 ]

@@ -330,3 +330,17 @@ class TestAccountSimulator:
             frame(rows), topk=1, n_drop=1, account=1000.0,
             min_cost=0.0, limit_threshold=0.095)
         assert set(r.final_positions) == {"X"}
+
+
+class TestReportGraph:
+    def test_four_panel_png(self, tmp_path):
+        pytest.importorskip("matplotlib")
+        from factorvae_tpu.eval.plots import report_graph
+
+        df = make_scores(num_days=30, num_inst=20, seed=11)
+        r = simulate_topk_account(df, topk=5, n_drop=2)
+        out = report_graph(r.report, str(tmp_path / "bt.png"), title="t")
+        import os
+
+        assert os.path.exists(out)
+        assert os.path.getsize(out) > 20_000  # a real 4-panel figure
