@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use the fused Pallas kernels (attention + GRU "
                         "recurrence, ops/pallas/) for compute; --no-pallas "
                         "overrides a preset that enables them")
+    p.add_argument("--pallas_auto", action="store_true",
+                   help="per-shape kernel choice from the measured v5e "
+                        "race (ops/pallas/select.py); overrides --pallas")
     p.add_argument("--max_stocks", type=int, default=None,
                    help="cross-section padding N_max (default: inferred)")
     p.add_argument("--score_only", action="store_true",
@@ -158,11 +161,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
                     else ("bfloat16" if args.bf16 else "float32")
                 ),
                 use_pallas_attention=(
-                    cfg.model.use_pallas_attention if args.pallas is None
+                    "auto" if args.pallas_auto
+                    else cfg.model.use_pallas_attention if args.pallas is None
                     else args.pallas
                 ),
                 use_pallas_gru=(
-                    cfg.model.use_pallas_gru if args.pallas is None
+                    "auto" if args.pallas_auto
+                    else cfg.model.use_pallas_gru if args.pallas is None
                     else args.pallas
                 ),
             ),
@@ -197,8 +202,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             compute_dtype="bfloat16" if args.bf16 else "float32",
             stochastic_inference=(True if args.stochastic_scores is None
                                   else args.stochastic_scores),
-            use_pallas_attention=bool(args.pallas),
-            use_pallas_gru=bool(args.pallas),
+            use_pallas_attention="auto" if args.pallas_auto else bool(args.pallas),
+            use_pallas_gru="auto" if args.pallas_auto else bool(args.pallas),
         ),
         data=DataConfig(
             dataset_path=resolve("dataset"),

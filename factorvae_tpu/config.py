@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import json
 from dataclasses import dataclass, field
-from typing import Any, Optional, Sequence
+from typing import Any, Optional, Sequence, Union
 
 
 @dataclass(frozen=True)
@@ -50,12 +50,14 @@ class ModelConfig:
     torch_init: bool = True
     # Fused Pallas kernel for the K-head cross-section attention
     # (ops/pallas/attention.py + attention_grad.py; differentiable, fused
-    # dropout). Off by default; the XLA einsum path is the reference
-    # implementation.
-    use_pallas_attention: bool = False
+    # dropout). False (default) = XLA einsum path; True = force the
+    # kernel; "auto" = per-shape choice from the measured round-2 race
+    # (ops/pallas/select.py).
+    use_pallas_attention: Union[bool, str] = False
     # Fused Pallas GRU recurrence (ops/pallas/gru.py; custom-VJP BPTT,
-    # single-layer path). Off by default; lax.scan is the reference path.
-    use_pallas_gru: bool = False
+    # single-layer path). False | True | "auto" as above; lax.scan is
+    # the reference path.
+    use_pallas_gru: Union[bool, str] = False
 
     @property
     def dtype(self):

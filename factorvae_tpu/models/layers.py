@@ -10,7 +10,7 @@ unrolls/fuses it well.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -113,7 +113,8 @@ class GRU(nn.Module):
     return_sequence: bool = False
     # Fused Pallas recurrence kernel (ops/pallas/gru.py): whole-sequence
     # VMEM-resident scan with custom-VJP BPTT. Last-hidden output only.
-    use_pallas: bool = False
+    # False | True | "auto" (per-shape measured choice, ops/pallas/select).
+    use_pallas: Any = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -136,7 +137,11 @@ class GRU(nn.Module):
         )
         dtype = self.dtype or x.dtype
 
-        if self.use_pallas and not self.return_sequence:
+        from factorvae_tpu.ops.pallas.select import pallas_gru_wins, resolve
+
+        use_pallas = resolve(
+            self.use_pallas, pallas_gru_wins(n, t, h_dim))
+        if use_pallas and not self.return_sequence:
             from factorvae_tpu.ops.pallas.gru import gru_scan
 
             return gru_scan(xi.astype(jnp.float32), w_h, b_h).astype(dtype)

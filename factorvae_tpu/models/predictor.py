@@ -44,7 +44,16 @@ class FactorPredictor(nn.Module):
         w_val = self.param("value_kernel", init, (k, h, h))
         b_val = self.param("value_bias", init, (k, h))
 
-        if cfg.use_pallas_attention:
+        from factorvae_tpu.ops.pallas.select import (
+            pallas_attention_wins,
+            resolve,
+        )
+
+        use_pallas = resolve(
+            cfg.use_pallas_attention,
+            pallas_attention_wins(latent.shape[0], h, k),
+        )
+        if use_pallas:
             # Fused Pallas kernel: never materializes the (K, N, H)
             # key/value stacks in HBM, and is differentiable (custom VJP
             # with flash-style recompute backward), so it serves inference

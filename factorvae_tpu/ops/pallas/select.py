@@ -1,0 +1,47 @@
+"""Measured per-shape kernel selection (ModelConfig.use_pallas_* = "auto").
+
+The round-2 race on a real v5e (scripts/race_kernels.py →
+RACE_KERNELS.json; PERF.md "Pallas kernels vs XLA on the chip") showed
+both paths are launch-bound at FactorVAE's op sizes, with reproducible
+per-shape winners on the full fwd+bwd:
+
+- attention: the fused kernel wins at small H (H=20: 1.38×/1.14×),
+  ties at H>=48, and loses slightly at flagship K=96/H=64 backward.
+- GRU: the fused recurrence wins at wide-N small-H short-T
+  (N=1024/T=20/H=20: 1.38×), ties at H=64, and clearly loses at T=60
+  (the VMEM-bounded 24-row backward blocking costs 1.6×).
+
+"auto" applies those measurements. Shapes are static under jit, so the
+choice is made at trace time with zero runtime cost. Off-TPU backends
+resolve to the XLA path (the kernels would only run interpreted).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def pallas_attention_wins(n: int, h: int, k: int) -> bool:
+    """True where the fused attention beat XLA in the round-2 race."""
+    return _on_tpu() and h <= 24
+
+
+def pallas_gru_wins(n: int, t: int, h: int) -> bool:
+    """True where the fused GRU recurrence beat XLA in the race."""
+    return _on_tpu() and n >= 512 and h <= 24 and t <= 20
+
+
+def resolve(flag, measured: bool) -> bool:
+    """Resolve a config tri-state (False | True | 'auto'). Any other
+    string is an error — a truthy fallback would force the kernels on
+    for a typo like "off" or "Auto"."""
+    if isinstance(flag, str):
+        if flag == "auto":
+            return measured
+        raise ValueError(
+            f"use_pallas_* must be False, True or 'auto'; got {flag!r}")
+    return bool(flag)

@@ -179,3 +179,62 @@ class TestGaussianNLL:
         got = float(masked_gaussian_nll(*map(jnp.asarray, (mu, sigma, y, m))))
         want = float(np.mean(-norm.logpdf(y[m], mu[m], sigma[m])))
         np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+class TestKernelAutoSelect:
+    """ModelConfig.use_pallas_* = 'auto': per-shape measured choice."""
+
+    def test_resolve_tristate(self):
+        from factorvae_tpu.ops.pallas.select import resolve
+
+        assert resolve(True, False) is True
+        assert resolve(False, True) is False
+        assert resolve("auto", True) is True
+        assert resolve("auto", False) is False
+
+    def test_auto_is_xla_off_tpu(self):
+        """On the CPU test rig 'auto' must resolve to the XLA path (the
+        kernels would only run interpreted)."""
+        from factorvae_tpu.ops.pallas.select import (
+            pallas_attention_wins,
+            pallas_gru_wins,
+        )
+
+        assert pallas_attention_wins(360, 20, 20) is False
+        assert pallas_gru_wins(1024, 20, 20) is False
+
+    def test_auto_model_runs_and_matches_xla(self):
+        """'auto' config trains/scores identically to the XLA path on the
+        CPU rig (where auto == XLA)."""
+        import dataclasses
+
+        import jax
+        import numpy as np
+
+        from factorvae_tpu.config import ModelConfig
+        from factorvae_tpu.models.factorvae import day_forward
+
+        base = ModelConfig(num_features=6, hidden_size=8, num_factors=4,
+                           num_portfolios=5, seq_len=3)
+        auto = dataclasses.replace(base, use_pallas_attention="auto",
+                                   use_pallas_gru="auto")
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (2, 10, 3, 6))
+        y = jax.random.normal(key, (2, 10))
+        mask = jax.numpy.ones((2, 10), bool)
+        rngs = {"params": key, "sample": key, "dropout": key}
+        m1, m2 = day_forward(base, train=False), day_forward(auto, train=False)
+        p1 = m1.init(rngs, x, y, mask)
+        out1 = m1.apply(p1, x, y, mask, rngs={"sample": key, "dropout": key})
+        out2 = m2.apply(p1, x, y, mask, rngs={"sample": key, "dropout": key})
+        np.testing.assert_allclose(np.asarray(out1.loss),
+                                   np.asarray(out2.loss), rtol=1e-6)
+
+    def test_invalid_string_rejected(self):
+        import pytest as _pytest
+
+        from factorvae_tpu.ops.pallas.select import resolve
+
+        for bad in ("Auto", "off", "xla"):
+            with _pytest.raises(ValueError):
+                resolve(bad, True)
