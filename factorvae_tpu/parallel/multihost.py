@@ -65,6 +65,40 @@ def maybe_initialize(
     return True
 
 
+def global_put(x, sharding):
+    """Place identical-on-every-process host data onto a (possibly
+    multi-process) sharding.
+
+    `jax.device_put` onto a multi-process sharding runs an
+    equality-across-processes assertion that is both slow (it ships the
+    whole array over the coordinator) and wrong for NaN padding
+    (NaN != NaN — the panel's padded rows trip it). The standard pod
+    pattern is used instead: every process materializes just its
+    addressable shards from its local copy via
+    `jax.make_array_from_callback`. Single-process falls back to plain
+    device_put.
+    """
+    import jax
+    import numpy as np
+
+    if is_global(x):
+        # already spans processes (e.g. a dataset shared by a second
+        # Trainer) — re-placing would require a cross-process gather
+        return x
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    host = np.asarray(x)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
+def is_global(x) -> bool:
+    """True for an array already spanning processes (not fully
+    addressable locally) — i.e. one that must NOT be re-placed."""
+    return not getattr(x, "is_fully_addressable", True)
+
+
 def process_info() -> dict:
     """Host/process layout for logging."""
     import jax

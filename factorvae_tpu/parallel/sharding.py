@@ -64,8 +64,14 @@ def make_batch_constraint(mesh: Mesh) -> Callable:
 
 
 def shard_dataset(mesh: Mesh, dataset) -> None:
-    """Re-place a PanelDataset's device arrays onto the mesh in-place."""
+    """Re-place a PanelDataset's device arrays onto the mesh in-place.
+
+    Goes through multihost.global_put so a mesh spanning several
+    processes (a pod slice) works identically: every process holds the
+    same host panel and materializes its addressable shards."""
+    from factorvae_tpu.parallel.multihost import global_put
+
     v_s, lv_s, nv_s = panel_shardings(mesh)
-    dataset.values = jax.device_put(dataset.values, v_s)
-    dataset.last_valid = jax.device_put(dataset.last_valid, lv_s)
-    dataset.next_valid = jax.device_put(dataset.next_valid, nv_s)
+    dataset.values = global_put(dataset.values, v_s)
+    dataset.last_valid = global_put(dataset.last_valid, lv_s)
+    dataset.next_valid = global_put(dataset.next_valid, nv_s)

@@ -128,10 +128,32 @@ class Trainer:
         compile payload)."""
         return (self.ds.values, self.ds.last_valid, self.ds.next_valid)
 
+    def _globalize(self, tree, sharding):
+        """Multi-process meshes need explicitly global inputs: every
+        process holds identical host values (same seeds), so each leaf
+        not already spanning processes is re-placed via
+        multihost.global_put. Single-process is a no-op."""
+        if jax.process_count() == 1:
+            return tree
+        from factorvae_tpu.parallel.multihost import global_put, is_global
+
+        return jax.tree_util.tree_map(
+            lambda x: x if is_global(x) else global_put(x, sharding), tree
+        )
+
     def _train_epoch(self, state, order):
+        if self.mesh is not None:
+            state = self._globalize(state, replicated(self.mesh))
+            order = self._globalize(
+                jnp.asarray(order), order_sharding(self.mesh))
         return self._train_epoch_jit(state, order, self.panel_args())
 
     def _eval_epoch(self, params, order, key):
+        if self.mesh is not None:
+            params = self._globalize(params, replicated(self.mesh))
+            key = self._globalize(key, replicated(self.mesh))
+            order = self._globalize(
+                jnp.asarray(order), order_sharding(self.mesh))
         return self._eval_epoch_jit(params, order, key, self.panel_args())
 
     # ------------------------------------------------------------------

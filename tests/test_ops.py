@@ -192,6 +192,10 @@ class TestKernelAutoSelect:
         assert resolve("auto", True) is True
         assert resolve("auto", False) is False
 
+    @pytest.mark.skipif(
+        jax.default_backend() == "tpu",
+        reason="on TPU these shapes legitimately select the kernels",
+    )
     def test_auto_is_xla_off_tpu(self):
         """On the CPU test rig 'auto' must resolve to the XLA path (the
         kernels would only run interpreted)."""
