@@ -21,6 +21,12 @@ directly from a scores DataFrame. Semantics:
 - Outputs both with-cost and without-cost curves, excess vs a benchmark
   series when given, max drawdown, and mean daily turnover.
 
+Runnable directly on an exported score CSV (the reference's
+score→notebook handoff, without the notebook):
+
+    python -m factorvae_tpu.eval.backtest SCORES.csv \\
+        [--labels panel.pkl] [--topk 50 --n_drop 10] [--plot out.png]
+
 Two simulators are provided:
 
 - `topk_dropout_backtest` — the fast equal-weight screener (above).
@@ -389,3 +395,69 @@ def simulate_topk_account(
     )
 
 
+
+
+def main(argv=None) -> int:
+    """CLI: full backtest suite over an exported score CSV.
+
+    Reproduces the reference's backtest notebook outputs (cells 6-8)
+    from a `scores/...csv` artifact: TopkDropout screener headline
+    metrics, the account-simulation summary, the annualized
+    excess-return risk table, and optionally the report_graph figure.
+    """
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("scores_csv", help="CSV with datetime,instrument,score"
+                                      "[,LABEL0] (eval.export_scores output)")
+    p.add_argument("--labels", default=None,
+                   help="reference-schema panel pickle supplying LABEL0 "
+                        "when the CSV has none")
+    p.add_argument("--topk", type=int, default=50)
+    p.add_argument("--n_drop", type=int, default=10)
+    p.add_argument("--account", type=float, default=1e8)
+    p.add_argument("--open_cost", type=float, default=0.0005)
+    p.add_argument("--close_cost", type=float, default=0.0015)
+    p.add_argument("--min_cost", type=float, default=5.0)
+    p.add_argument("--limit_threshold", type=float, default=0.095)
+    p.add_argument("--plot", default=None, metavar="PNG",
+                   help="write the report_graph 4-panel figure here")
+    args = p.parse_args(argv)
+
+    df = pd.read_csv(args.scores_csv, parse_dates=["datetime"])
+    df = df.set_index(["datetime", "instrument"]).sort_index()
+    if "LABEL0" not in df.columns:
+        if not args.labels:
+            p.error("scores CSV has no LABEL0 column; pass --labels")
+        from factorvae_tpu.data.panel import load_frame
+
+        df = df.join(load_frame(args.labels)["LABEL0"], how="inner")
+    df = df.dropna(subset=["score", "LABEL0"])
+
+    screener = topk_dropout_backtest(df, topk=args.topk, n_drop=args.n_drop,
+                                     open_cost=args.open_cost,
+                                     close_cost=args.close_cost)
+    acct = simulate_topk_account(
+        df, topk=args.topk, n_drop=args.n_drop, account=args.account,
+        open_cost=args.open_cost, close_cost=args.close_cost,
+        min_cost=args.min_cost, limit_threshold=args.limit_threshold)
+    out = {
+        "screener": {k: v for k, v in screener.summary().items()
+                     if v is not None},
+        "account": acct.summary(),
+        "excess_return_without_cost": acct.risk_excess_without_cost,
+        "excess_return_with_cost": acct.risk_excess_with_cost,
+    }
+    if args.plot:
+        from factorvae_tpu.eval.plots import report_graph
+
+        out["plot"] = report_graph(acct.report, args.plot)
+    print(json.dumps(out, indent=2, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -344,3 +344,21 @@ class TestReportGraph:
 
         assert os.path.exists(out)
         assert os.path.getsize(out) > 20_000  # a real 4-panel figure
+
+
+class TestBacktestCLI:
+    def test_csv_roundtrip(self, tmp_path, capsys):
+        from factorvae_tpu.eval.backtest import main as bt_main
+
+        df = make_scores(num_days=15, num_inst=12, seed=3)
+        csv = tmp_path / "scores.csv"
+        df.reset_index().to_csv(csv, index=False)
+        rc = bt_main([str(csv), "--topk", "4", "--n_drop", "2",
+                      "--plot", str(tmp_path / "bt.png")])
+        assert rc == 0
+        import json as _json
+
+        out = _json.loads(capsys.readouterr().out)
+        assert "screener" in out and "account" in out
+        assert np.isfinite(out["account"]["final_account"])
+        assert (tmp_path / "bt.png").exists()
