@@ -421,6 +421,12 @@ def main(argv=None) -> int:
     p.add_argument("--close_cost", type=float, default=0.0015)
     p.add_argument("--min_cost", type=float, default=5.0)
     p.add_argument("--limit_threshold", type=float, default=0.095)
+    p.add_argument("--benchmark", default=None, metavar="CSV",
+                   help="per-day benchmark returns (columns: datetime, "
+                        "return) — the CSI300 series of notebook cell 6. "
+                        "Without it the excess tables are vs zero (i.e. "
+                        "absolute returns), NOT comparable to the "
+                        "reference's cell-8 numbers")
     p.add_argument("--plot", default=None, metavar="PNG",
                    help="write the report_graph 4-panel figure here")
     args = p.parse_args(argv)
@@ -433,27 +439,56 @@ def main(argv=None) -> int:
         from factorvae_tpu.data.panel import load_frame
 
         df = df.join(load_frame(args.labels)["LABEL0"], how="inner")
-    df = df.dropna(subset=["score", "LABEL0"])
+        if len(df) == 0:
+            p.error("joining --labels matched ZERO rows — do the "
+                    "instrument/date conventions of the CSV and the "
+                    "panel agree?")
+    df = df.dropna(subset=["score"])
+    if len(df) == 0 or df["LABEL0"].notna().sum() == 0:
+        p.error("no scored rows with labels to backtest")
 
-    screener = topk_dropout_backtest(df, topk=args.topk, n_drop=args.n_drop,
-                                     open_cost=args.open_cost,
-                                     close_cost=args.close_cost)
+    benchmark = None
+    if args.benchmark:
+        b = pd.read_csv(args.benchmark, parse_dates=["datetime"])
+        benchmark = b.set_index("datetime")["return"].sort_index()
+
+    # the screener needs labeled rows; the account simulator keeps
+    # NaN-label rows (rankable/sellable, mark-to-market skipped)
+    screener = topk_dropout_backtest(
+        df.dropna(subset=["LABEL0"]), topk=args.topk, n_drop=args.n_drop,
+        open_cost=args.open_cost, close_cost=args.close_cost,
+        benchmark=benchmark)
     acct = simulate_topk_account(
         df, topk=args.topk, n_drop=args.n_drop, account=args.account,
         open_cost=args.open_cost, close_cost=args.close_cost,
-        min_cost=args.min_cost, limit_threshold=args.limit_threshold)
+        min_cost=args.min_cost, limit_threshold=args.limit_threshold,
+        benchmark=benchmark)
     out = {
         "screener": {k: v for k, v in screener.summary().items()
                      if v is not None},
         "account": acct.summary(),
         "excess_return_without_cost": acct.risk_excess_without_cost,
         "excess_return_with_cost": acct.risk_excess_with_cost,
+        "benchmark": args.benchmark or "none (excess == absolute return)",
     }
     if args.plot:
         from factorvae_tpu.eval.plots import report_graph
 
         out["plot"] = report_graph(acct.report, args.plot)
-    print(json.dumps(out, indent=2, default=float))
+
+    def _clean(o):
+        """Strict JSON: numpy scalars -> python, NaN/inf -> null."""
+        if isinstance(o, dict):
+            return {k: _clean(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return [_clean(v) for v in o]
+        if isinstance(o, (np.floating, np.integer)):
+            o = float(o)
+        if isinstance(o, float) and not np.isfinite(o):
+            return None
+        return o
+
+    print(json.dumps(_clean(out), indent=2))
     return 0
 
 
