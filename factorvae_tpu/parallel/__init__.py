@@ -1,6 +1,10 @@
 from factorvae_tpu.parallel.mesh import (
     DATA_AXIS,
+    HOST_AXIS,
     STOCK_AXIS,
+    batch_axes,
+    data_parallel_size,
+    make_hierarchical_mesh,
     make_mesh,
     single_device_mesh,
 )
@@ -21,8 +25,12 @@ from factorvae_tpu.parallel.sharding import (
 
 __all__ = [
     "DATA_AXIS",
+    "HOST_AXIS",
     "STOCK_AXIS",
+    "batch_axes",
     "batch_sharding",
+    "data_parallel_size",
+    "make_hierarchical_mesh",
     "in_multihost_env",
     "make_batch_constraint",
     "make_mesh",

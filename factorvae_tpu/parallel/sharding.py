@@ -22,7 +22,7 @@ from typing import Callable, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from factorvae_tpu.parallel.mesh import DATA_AXIS, STOCK_AXIS
+from factorvae_tpu.parallel.mesh import DATA_AXIS, STOCK_AXIS, batch_axes
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -39,19 +39,22 @@ def panel_shardings(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding, NamedShar
 
 
 def order_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(None, DATA_AXIS))
+    return NamedSharding(mesh, P(None, batch_axes(mesh)))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(DATA_AXIS, STOCK_AXIS))
+    return NamedSharding(mesh, P(batch_axes(mesh), STOCK_AXIS))
 
 
 def make_batch_constraint(mesh: Mesh) -> Callable:
     """Constraint applied inside the jitted step right after the day-batch
     gather, pinning the (B, N, ...) layout so GSPMD doesn't re-replicate
-    the batch."""
-    x_s = NamedSharding(mesh, P(DATA_AXIS, STOCK_AXIS, None, None))
-    v_s = NamedSharding(mesh, P(DATA_AXIS, STOCK_AXIS))
+    the batch. On a hierarchical ('host','data','stock') mesh the B axis
+    shards over BOTH batch axes, so the gradient all-reduce groups span
+    hosts (DCN) while the 'stock' groups stay within one host (ICI)."""
+    b = batch_axes(mesh)
+    x_s = NamedSharding(mesh, P(b, STOCK_AXIS, None, None))
+    v_s = NamedSharding(mesh, P(b, STOCK_AXIS))
 
     def constrain(x, y, mask):
         return (

@@ -20,7 +20,7 @@ import numpy as np
 from factorvae_tpu.config import Config
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.models.factorvae import day_forward
-from factorvae_tpu.parallel.mesh import make_mesh
+from factorvae_tpu.parallel.mesh import data_parallel_size, make_mesh
 from factorvae_tpu.parallel.sharding import (
     make_batch_constraint,
     order_sharding,
@@ -71,11 +71,11 @@ class Trainer:
         )
         shard_batch = None
         if self.mesh is not None:
-            dp = self.mesh.shape["data"]
+            dp = data_parallel_size(self.mesh)
             if self.batch_days % dp != 0:
                 raise ValueError(
                     f"days_per_step={self.batch_days} not divisible by "
-                    f"data axis {dp}"
+                    f"data-parallel size {dp}"
                 )
             shard_dataset(self.mesh, dataset)
             shard_batch = make_batch_constraint(self.mesh)

@@ -139,6 +139,82 @@ class TestCollectivePlacement:
         assert "all-reduce" in hlo
 
 
+class TestHierarchicalMesh:
+    """('host','data','stock') pod-slice topology, simulated with
+    num_hosts on the 8-device CPU rig. The DCN/ICI contract: gradient
+    all-reduce groups SPAN host blocks (they may ride DCN — once per
+    step, small payload), stock-axis collective groups stay WITHIN one
+    host block (ICI-only — latency-sensitive, every softmax)."""
+
+    def test_shape_and_dp_size(self, devices):
+        from factorvae_tpu.parallel import data_parallel_size, make_hierarchical_mesh
+
+        mesh = make_hierarchical_mesh(MeshConfig(stock_axis=2), num_hosts=2)
+        assert dict(mesh.shape) == {"host": 2, "data": 2, "stock": 2}
+        assert data_parallel_size(mesh) == 4
+        # per-host device blocks are contiguous rows of the device array
+        for h in range(2):
+            assert mesh.devices[h].size == 4
+
+    def test_training_matches_single_device(self, dense_ds, tmp_path):
+        from factorvae_tpu.parallel import make_hierarchical_mesh
+
+        losses = {}
+        for name, mesh in [
+            ("single", None),
+            ("hier", make_hierarchical_mesh(MeshConfig(stock_axis=2),
+                                            num_hosts=2)),
+        ]:
+            cfg = cfg_for(tmp_path / name)
+            tr = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
+            _, out = tr.fit()
+            losses[name] = [h["train_loss"] for h in out["history"]]
+        np.testing.assert_allclose(losses["single"], losses["hier"], rtol=2e-3)
+
+    def test_hlo_dcn_ici_collective_placement(self, dense_ds, tmp_path):
+        """Extends the round-2 HLO assertion to the hierarchical mesh:
+        the gradient all-reduce must cross host blocks, and every
+        stock-axis group must be a subset of a single host block."""
+        from factorvae_tpu.parallel import make_hierarchical_mesh
+
+        mesh = make_hierarchical_mesh(MeshConfig(stock_axis=2), num_hosts=2)
+        cfg = cfg_for(tmp_path, days_per_step=4)
+        tr = Trainer(cfg, dense_ds, mesh=mesh, logger=MetricsLogger(echo=False))
+        state = tr.init_state()
+        order = jnp.asarray(tr.train_days[:4].reshape(1, 4))
+        hlo = tr._train_epoch_jit.lower(
+            state, order, tr.panel_args()).compile().as_text()
+
+        groups = _collective_groups(hlo)
+        ids = np.vectorize(lambda d: d.id)(mesh.devices)  # (host, data, stock)
+        host_blocks = [frozenset(int(i) for i in ids[h].ravel()) for h in range(2)]
+        # gradient all-reduce: one group per stock shard, spanning hosts
+        grad_groups = frozenset(
+            frozenset(int(i) for i in ids[:, :, j].ravel()) for j in range(2)
+        )
+        # stock collectives: one group per (host, data) coordinate
+        stock_groups = frozenset(
+            frozenset(int(i) for i in ids[h, d, :])
+            for h in range(2) for d in range(2)
+        )
+        assert grad_groups in groups, (
+            f"no collective over the joint ('host','data') batch axes; "
+            f"saw: {groups}"
+        )
+        assert stock_groups in groups, (
+            f"no collective over the 'stock' axis; saw: {groups}"
+        )
+        for g in grad_groups:
+            assert any(g & b for b in host_blocks) and not any(
+                g <= b for b in host_blocks
+            ), "gradient all-reduce group does not span host blocks"
+        for g in stock_groups:
+            assert any(
+                g <= b for b in host_blocks
+            ), f"stock group {g} crosses a host block (would ride DCN)"
+        assert "all-reduce" in hlo
+
+
 class TestGraftEntry:
     def test_dryrun_multichip(self):
         import sys, os
