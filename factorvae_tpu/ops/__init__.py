@@ -5,9 +5,21 @@ from factorvae_tpu.ops.masked import (
     masked_softmax,
     masked_gaussian_nll,
 )
+from factorvae_tpu.ops.quant import (
+    QTensor,
+    dequantize_params,
+    quantize_params,
+    quantize_tensor,
+    tree_nbytes,
+)
 from factorvae_tpu.ops.stats import masked_rank, masked_spearman, rank_ic_series
 
 __all__ = [
+    "QTensor",
+    "dequantize_params",
+    "quantize_params",
+    "quantize_tensor",
+    "tree_nbytes",
     "gaussian_kl",
     "gaussian_kl_sum",
     "masked_mean",

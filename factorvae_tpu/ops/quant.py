@@ -1,0 +1,129 @@
+"""Weight-only int8 quantization for the scoring path.
+
+The scoring workload (eval/predict.py; reference utils.py:70-93) is
+read-only over the parameter tree, so the weights can live in HBM as
+int8 with per-output-channel float scales — 4x smaller than f32, 2x
+smaller than bf16 — and be dequantized on the fly in VMEM right before
+each matmul. At FactorVAE sizes the matmuls are launch/bandwidth-bound,
+not FLOP-bound (PERF.md roofline), so shrinking the bytes the MXU must
+pull is the lever this path targets; numerics stay in the model's
+compute dtype after dequantization, and the quantization error on
+symmetric per-channel int8 is ~0.4% of each channel's max weight.
+
+Symmetric scheme: q = round(clip(w / s, ±127)), s = max|w| per output
+channel (the LAST axis — Dense kernels are (in, out), GRU hidden kernels
+(H, 3H), the predictor's batched key/value stacks (K, H, H)). Biases,
+LayerNorm parameters, the attention query and every other small/1-D leaf
+stay in float — they are bytes-irrelevant and precision-critical. The
+exclusion is by ROLE, not just size: any leaf whose tree path contains
+"bias" or "query" is kept float even when it is 2-D and large (at K=96,
+H=64 the predictor's query and key/value biases are (96, 64)).
+
+`QTensor` is a registered pytree node, so a quantized parameter tree
+passes through `jax.jit` boundaries as (int8, f32) array pairs and the
+dequantize happens *inside* the compiled program (XLA fuses it into the
+consumer matmul's operand read).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """A per-output-channel symmetric int8 tensor: values `q` (int8) and
+    scales `s` broadcastable against `q` (f32, 1 along all axes but the
+    last)."""
+
+    def __init__(self, q: jnp.ndarray, s: jnp.ndarray):
+        self.q = q
+        self.s = s
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self, dtype=jnp.float32) -> jnp.ndarray:
+        return self.q.astype(dtype) * self.s.astype(dtype)
+
+    def __repr__(self):
+        return f"QTensor(shape={tuple(self.q.shape)}, int8+scales)"
+
+
+def quantize_tensor(w: jnp.ndarray) -> QTensor:
+    """Symmetric per-last-axis-channel int8 quantization."""
+    reduce_axes = tuple(range(w.ndim - 1))
+    s = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True) / 127.0
+    s = jnp.where(s == 0.0, 1.0, s).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return QTensor(q, s)
+
+
+def _is_quantizable(leaf: Any, min_size: int) -> bool:
+    return (
+        hasattr(leaf, "ndim")
+        and leaf.ndim >= 2
+        and leaf.size >= min_size
+        and jnp.issubdtype(leaf.dtype, jnp.floating)
+    )
+
+
+# Precision-critical roles kept in float regardless of shape: biases add
+# directly into activations/attention logits, and the learned query
+# (predictor.py, module.py:129 semantics) sets every head's logit scale.
+EXCLUDED_PATH_KEYS = ("bias", "query")
+
+
+def _path_excluded(path) -> bool:
+    for entry in path:
+        key = str(getattr(entry, "key", getattr(entry, "idx", "")))
+        if any(x in key.lower() for x in EXCLUDED_PATH_KEYS):
+            return True
+    return False
+
+
+def quantize_params(params, min_size: int = 256):
+    """Quantize every >=2-D float leaf with at least `min_size` elements
+    to a QTensor — except leaves named as biases/queries (see
+    EXCLUDED_PATH_KEYS); leave everything else untouched. Returns a tree
+    with the same structure (QTensor nodes expand into (q, s) leaf
+    pairs)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, w: (
+            quantize_tensor(w)
+            if _is_quantizable(w, min_size) and not _path_excluded(path)
+            else w
+        ),
+        params,
+    )
+
+
+def dequantize_params(qparams, dtype=jnp.float32):
+    """Rebuild a dense float tree from a quantize_params output. Safe to
+    call inside jit (and that is the intended use: weights cross into
+    the compiled program as int8 and inflate in VMEM)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.dequantize(dtype) if isinstance(x, QTensor) else x,
+        qparams,
+        is_leaf=lambda x: isinstance(x, QTensor),
+    )
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of every array leaf (QTensor counts q + s)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "dtype")
+    )

@@ -1,0 +1,96 @@
+"""Race f32 vs int8-weight scoring at flagship shapes on the current
+backend. Prints one JSON line per variant plus a summary.
+
+Usage: python scripts/bench_int8_scoring.py [--days 256] [--reps 5]
+
+The scoring path (eval/predict.predict_panel) is chunked jitted
+day-batched inference; the int8 variant stores weights in HBM as
+per-channel int8 and dequantizes in the compiled program (ops/quant.py).
+At FactorVAE sizes the win to measure is parameter-byte residency and
+any bandwidth-bound speedup; fidelity is tested in tests/test_quant.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--days", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--chunk", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+    from factorvae_tpu.eval.predict import predict_panel
+    from factorvae_tpu.ops.quant import quantize_params, tree_nbytes
+
+    platform = jax.devices()[0].platform
+    cfg = Config(
+        model=ModelConfig(num_features=158, hidden_size=64, num_factors=96,
+                          num_portfolios=128, seq_len=20),
+        data=DataConfig(seq_len=20, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(seed=0),
+    )
+    ds = PanelDataset(
+        synthetic_panel_dense(num_days=args.days, num_instruments=356,
+                              num_features=158),
+        seq_len=20, pad_multiple=8,
+    )
+    import jax.numpy as jnp
+
+    from factorvae_tpu.models.factorvae import day_prediction
+
+    model = day_prediction(cfg.model, stochastic=False)
+    x0 = jnp.zeros((1, ds.n_max, 20, 158), jnp.float32)
+    m0 = jnp.ones((1, ds.n_max), bool)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "sample": jax.random.PRNGKey(1)},
+        x0, m0)
+    days = ds.split_days(None, None)
+
+    f32_bytes = tree_nbytes(params)
+    i8_bytes = tree_nbytes(quantize_params(params))
+
+    results = {}
+    for name, kw in [("f32", {}), ("int8", {"int8": True})]:
+        # compile + warm
+        predict_panel(params, cfg, ds, days[: args.chunk], stochastic=False,
+                      chunk=args.chunk, **kw)
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = predict_panel(params, cfg, ds, days, stochastic=False,
+                                chunk=args.chunk, **kw)
+            times.append(time.perf_counter() - t0)
+        med = float(np.median(times))
+        dps = len(days) / med
+        results[name] = dps
+        print(json.dumps({
+            "variant": name, "platform": platform, "days": len(days),
+            "seconds": round(med, 4), "days_per_sec": round(dps, 1),
+            "windows_per_sec": round(dps * ds.n_max, 1),
+            "param_bytes": i8_bytes if name == "int8" else f32_bytes,
+            "finite": bool(np.isfinite(out).any()),
+        }))
+    print(json.dumps({
+        "summary": "int8_vs_f32_scoring",
+        "speedup": round(results["int8"] / results["f32"], 3),
+        "bytes_ratio": round(f32_bytes / i8_bytes, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,156 @@
+"""int8 weight-only quantization (ops/quant.py) and the quantized
+scoring path (eval/predict int8=True)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import spearmanr
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.eval import generate_prediction_scores
+from factorvae_tpu.ops.quant import (
+    QTensor,
+    dequantize_params,
+    quantize_params,
+    quantize_tensor,
+    tree_nbytes,
+)
+from factorvae_tpu.train import Trainer
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+class TestQTensor:
+    def test_roundtrip_error_bound(self, rng):
+        w = jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32))
+        qt = quantize_tensor(w)
+        assert qt.q.dtype == jnp.int8
+        assert qt.s.shape == (1, 16)
+        back = qt.dequantize()
+        # symmetric int8: error <= s/2 per element, s = channel max / 127
+        bound = np.asarray(qt.s)[0] / 2 + 1e-8
+        assert np.all(np.abs(np.asarray(back - w)) <= bound[None, :])
+
+    def test_zero_channel_safe(self):
+        w = jnp.zeros((8, 4), jnp.float32)
+        back = quantize_tensor(w).dequantize()
+        np.testing.assert_array_equal(np.asarray(back), 0.0)
+
+    def test_3d_stack_per_channel(self, rng):
+        w = jnp.asarray(rng.normal(size=(4, 8, 8)).astype(np.float32))
+        qt = quantize_tensor(w)
+        assert qt.s.shape == (1, 1, 8)
+
+    def test_tree_selectivity_and_size(self, rng):
+        params = {
+            "kernel": jnp.asarray(rng.normal(size=(64, 64)).astype(np.float32)),
+            "bias": jnp.asarray(rng.normal(size=(64,)).astype(np.float32)),
+            "tiny": jnp.asarray(rng.normal(size=(2, 2)).astype(np.float32)),
+            "step": jnp.asarray(3, jnp.int32),
+        }
+        q = quantize_params(params, min_size=256)
+        assert isinstance(q["kernel"], QTensor)
+        assert not isinstance(q["bias"], QTensor)   # 1-D stays float
+        assert not isinstance(q["tiny"], QTensor)   # below min_size
+        assert q["step"].dtype == jnp.int32
+        # the big kernel dominates: quantized tree must be ~4x smaller
+        assert tree_nbytes(q) < tree_nbytes(params) / 3
+        back = dequantize_params(q)
+        assert back["kernel"].dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(back["bias"]),
+                                      np.asarray(params["bias"]))
+
+    def test_role_exclusion_over_size(self, rng):
+        """2-D leaves named bias/query stay float even when large — at
+        flagship shapes the predictor's query and key/value biases are
+        (96, 64) and must not be quantized (precision-critical roles)."""
+        params = {
+            "query": jnp.asarray(rng.normal(size=(96, 64)).astype(np.float32)),
+            "key_bias": jnp.asarray(rng.normal(size=(96, 64)).astype(np.float32)),
+            "key_kernel": jnp.asarray(
+                rng.normal(size=(96, 64, 64)).astype(np.float32)),
+        }
+        q = quantize_params(params, min_size=256)
+        assert not isinstance(q["query"], QTensor)
+        assert not isinstance(q["key_bias"], QTensor)
+        assert isinstance(q["key_kernel"], QTensor)
+
+    def test_qtensor_tree_crosses_jit(self, rng):
+        w = jnp.asarray(rng.normal(size=(16, 16)).astype(np.float32))
+        q = quantize_params({"w": w}, min_size=1)
+
+        @jax.jit
+        def apply(qp, x):
+            p = dequantize_params(qp)
+            return x @ p["w"]
+
+        x = jnp.ones((2, 16), jnp.float32)
+        out = apply(q, x)
+        ref = x @ quantize_tensor(w).dequantize()
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+class TestInt8Scoring:
+    @pytest.fixture
+    def trained(self, tmp_path):
+        # H=16/C=16 keeps the suite fast while ensuring the GRU kernels
+        # (16x48=768) and extractor Dense (16x16=256) clear the
+        # min_size=256 default — the fidelity test must exercise the
+        # leaves that actually quantize at flagship shapes, not only the
+        # (K,H,H) stacks
+        panel = synthetic_panel(num_days=20, num_instruments=8, num_features=16,
+                                missing_prob=0.1, seed=3)
+        ds = PanelDataset(panel, seq_len=5)
+        cfg = Config(
+            model=ModelConfig(num_features=16, hidden_size=16, num_factors=4,
+                              num_portfolios=6, seq_len=5),
+            data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                            val_start_time=None, val_end_time=None),
+            train=TrainConfig(num_epochs=2, seed=0, save_dir=str(tmp_path),
+                              checkpoint_every=0),
+        )
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state, _ = tr.fit()
+        return cfg, ds, state
+
+    def test_score_fidelity_vs_float(self, trained):
+        """Deterministic scores from the int8 path must rank-correlate
+        ~1 with the float path day by day."""
+        cfg, ds, state = trained
+        # the quantized tree must cover the dominant kernels, not only
+        # the (K,H,H) stacks
+        q = quantize_params(state.params)
+        qpaths = [
+            jax.tree_util.keystr(p)
+            for p, leaf in jax.tree_util.tree_flatten_with_path(
+                q, is_leaf=lambda x: isinstance(x, QTensor))[0]
+            if isinstance(leaf, QTensor)
+        ]
+        assert any("input_proj" in p for p in qpaths), qpaths
+        assert any("key_kernel" in p for p in qpaths), qpaths
+        f32 = generate_prediction_scores(state.params, cfg, ds,
+                                         stochastic=False)
+        i8 = generate_prediction_scores(state.params, cfg, ds,
+                                        stochastic=False, int8=True)
+        assert len(f32) == len(i8)
+        joined = f32.rename(columns={"score": "f32"}).join(
+            i8.rename(columns={"score": "i8"}))
+        rhos = [
+            spearmanr(g["f32"], g["i8"]).correlation
+            for _, g in joined.groupby(level="datetime")
+            if len(g) >= 3
+        ]
+        assert np.mean(rhos) > 0.97, f"rank fidelity degraded: {rhos}"
+
+    def test_stochastic_int8_same_rng_stream(self, trained):
+        """The int8 path must consume the identical RNG stream: sampled
+        scores at the same seed differ only by quantization error."""
+        cfg, ds, state = trained
+        a = generate_prediction_scores(state.params, cfg, ds,
+                                       stochastic=True, seed=5)
+        b = generate_prediction_scores(state.params, cfg, ds,
+                                       stochastic=True, seed=5, int8=True)
+        diff = np.abs(a["score"].values - b["score"].values)
+        spread = np.std(a["score"].values)
+        assert np.median(diff) < 0.2 * spread
