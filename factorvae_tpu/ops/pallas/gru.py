@@ -20,15 +20,24 @@ interpret mode; VERDICT r1 item 3):
 - The backward's recomputed hidden sequence lives in a VMEM scratch ref
   (dynamic stores on values are likewise unsupported).
 
-Backward is recompute-BPTT: re-run the recurrence storing the
-(T+1, Nb, H) hidden sequence in scratch, then walk t = T-1..0
-accumulating d_x*, d_Wh*, d_b* and the carried d_h.
+Backward is recompute-BPTT: re-run the recurrence storing the hidden
+sequence in scratch, then walk t backwards accumulating d_x*, d_Wh*,
+d_b* and the carried d_h.
 
 Rows (stocks) are independent in the recurrence, so both kernels tile
 the N axis into row blocks per grid step, sized by `_block_setup` from
-the backward's MEASURED VMEM footprint (see its docstring) — 64 rows at
-T=20, 24 rows at T=60/H=64. d_Wh/d_b accumulate across the sequential
-grid.
+the backward's MEASURED VMEM footprint (see its docstring). At long T
+the full-sequence backward is VMEM-bound (T=60/H=64 forced 24-row
+blocks, costing 1.6x vs XLA in the round-2 race), so for T > _SEG_MAX
+the backward switches to SEGMENT-CHECKPOINTED BPTT: a cheap XLA scan
+precomputes the hidden state at segment boundaries, then a 2-D-grid
+kernel (row blocks x time segments in REVERSE order) recomputes and
+differentiates one (S, Nb, H) segment at a time, carrying d_h across
+segment iterations in persistent VMEM scratch. VMEM then scales with S
+instead of T, restoring wide row blocks at any sequence length — the
+long-context move (gradient checkpointing inside the kernel) applied to
+the stock-panel GRU. d_Wh/d_b accumulate across the whole sequential
+grid either way.
 
 Gate math matches layers.GRU exactly (torch layout [r | z | n]):
 
@@ -49,6 +58,9 @@ from jax.experimental.pallas import tpu as pltpu
 _N_BLOCK = 64        # max rows per grid step
 _VMEM_BUDGET = 12 * 2 ** 20   # target bytes for the backward's refs
 # (the v5e scoped-vmem limit is 16 MB; leave headroom for the compiler)
+_SEG_MAX = 24        # longest sequence the backward holds whole in VMEM;
+# beyond it, time is chunked into segments of at most this length
+_SEG_MIN = 8         # shortest segment worth the per-segment overhead
 
 
 def _load_t(ref, t):
@@ -75,18 +87,14 @@ def _fwd_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref, whn_ref,
     hlast_ref[:] = jax.lax.fori_loop(0, t_len, step, h0)
 
 
-def _bwd_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref, whn_ref,
-                br_ref, bz_ref, bn_ref, dh_ref,
-                dxr_ref, dxz_ref, dxn_ref,
-                dwhr_ref, dwhz_ref, dwhn_ref,
-                dbr_ref, dbz_ref, dbn_ref,
-                hseq_ref):
-    t_len, nb, h_dim = xr_ref.shape
-    whr, whz, whn = whr_ref[:], whz_ref[:], whn_ref[:]
-    br, bz, bn = br_ref[0, :], bz_ref[0, :], bn_ref[0, :]
-
-    # recompute the hidden sequence into scratch: hseq[t] = h BEFORE step t
-    hseq_ref[0] = jnp.zeros((nb, h_dim), jnp.float32)
+def _recompute_segment(hseq_ref, h0, xr_ref, xz_ref, xn_ref, ws, bs,
+                       s_len):
+    """Refill `hseq_ref` with the hidden states of one segment:
+    hseq[t] = h BEFORE step t, starting from h0 (zeros for the full
+    sequence, the boundary checkpoint for a segment)."""
+    whr, whz, whn = ws
+    br, bz, bn = bs
+    hseq_ref[0] = h0
 
     def fstep(t, _):
         h = _load_t(hseq_ref, t)
@@ -96,15 +104,25 @@ def _bwd_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref, whn_ref,
         r = jax.nn.sigmoid(_load_t(xr_ref, t) + ghr)
         z = jax.nn.sigmoid(_load_t(xz_ref, t) + ghz)
         n = jnp.tanh(_load_t(xn_ref, t) + r * ghn)
-        h_new = (1.0 - z) * n + z * h
-        hseq_ref[pl.ds(t + 1, 1), :, :] = h_new[None]
+        hseq_ref[pl.ds(t + 1, 1), :, :] = ((1.0 - z) * n + z * h)[None]
         return 0
 
-    jax.lax.fori_loop(0, t_len, fstep, 0)
+    jax.lax.fori_loop(0, s_len, fstep, 0)
+
+
+def _backward_walk(dh0, hseq_ref, xr_ref, xz_ref, xn_ref,
+                   dxr_ref, dxz_ref, dxn_ref, ws, bs, s_len):
+    """Walk t = s_len-1..0 writing d_x* blocks and returning
+    (d_h_before_segment, dWh_r, dWh_z, dWh_n, db_r, db_z, db_n) local
+    accumulations. The single home of the hand-derived gate VJP — both
+    backward kernels call this."""
+    whr, whz, whn = ws
+    br, bz, bn = bs
+    h_dim = whr.shape[0]
 
     def bstep(i, carry):
         dh, dwhr, dwhz, dwhn, dbr, dbz, dbn = carry
-        t = t_len - 1 - i
+        t = s_len - 1 - i
         h_prev = _load_t(hseq_ref, t)
         ghr = jnp.dot(h_prev, whr, preferred_element_type=jnp.float32) + br
         ghz = jnp.dot(h_prev, whz, preferred_element_type=jnp.float32) + bz
@@ -142,26 +160,78 @@ def _bwd_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref, whn_ref,
 
     zero_w = jnp.zeros((h_dim, h_dim), jnp.float32)
     zero_b = jnp.zeros((1, h_dim), jnp.float32)
-    init = (dh_ref[:], zero_w, zero_w, zero_w, zero_b, zero_b, zero_b)
-    _, dwhr, dwhz, dwhn, dbr, dbz, dbn = jax.lax.fori_loop(
-        0, t_len, bstep, init)
+    init = (dh0, zero_w, zero_w, zero_w, zero_b, zero_b, zero_b)
+    return jax.lax.fori_loop(0, s_len, bstep, init)
 
-    # dWh/db accumulate across the sequential grid of row blocks
-    @pl.when(pl.program_id(0) == 0)
+
+def _accumulate_weight_grads(first, refs, vals):
+    """dWh/db accumulate across the whole sequential grid; `first` marks
+    the very first grid iteration (zero-init)."""
+
+    @pl.when(first)
     def _init():
-        dwhr_ref[:] = jnp.zeros_like(dwhr_ref)
-        dwhz_ref[:] = jnp.zeros_like(dwhz_ref)
-        dwhn_ref[:] = jnp.zeros_like(dwhn_ref)
-        dbr_ref[:] = jnp.zeros_like(dbr_ref)
-        dbz_ref[:] = jnp.zeros_like(dbz_ref)
-        dbn_ref[:] = jnp.zeros_like(dbn_ref)
+        for ref in refs:
+            ref[:] = jnp.zeros_like(ref)
 
-    dwhr_ref[:] += dwhr
-    dwhz_ref[:] += dwhz
-    dwhn_ref[:] += dwhn
-    dbr_ref[:] += dbr
-    dbz_ref[:] += dbz
-    dbn_ref[:] += dbn
+    for ref, val in zip(refs, vals):
+        ref[:] += val
+
+
+def _bwd_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref, whn_ref,
+                br_ref, bz_ref, bn_ref, dh_ref,
+                dxr_ref, dxz_ref, dxn_ref,
+                dwhr_ref, dwhz_ref, dwhn_ref,
+                dbr_ref, dbz_ref, dbn_ref,
+                hseq_ref):
+    """Full-sequence backward: recompute all T hidden states into
+    scratch, then one backward walk. Grid = (row blocks,)."""
+    t_len, nb, h_dim = xr_ref.shape
+    ws = (whr_ref[:], whz_ref[:], whn_ref[:])
+    bs = (br_ref[0, :], bz_ref[0, :], bn_ref[0, :])
+
+    _recompute_segment(hseq_ref, jnp.zeros((nb, h_dim), jnp.float32),
+                       xr_ref, xz_ref, xn_ref, ws, bs, t_len)
+    _, dwhr, dwhz, dwhn, dbr, dbz, dbn = _backward_walk(
+        dh_ref[:], hseq_ref, xr_ref, xz_ref, xn_ref,
+        dxr_ref, dxz_ref, dxn_ref, ws, bs, t_len)
+    _accumulate_weight_grads(
+        pl.program_id(0) == 0,
+        (dwhr_ref, dwhz_ref, dwhn_ref, dbr_ref, dbz_ref, dbn_ref),
+        (dwhr, dwhz, dwhn, dbr, dbz, dbn))
+
+
+def _bwd_seg_kernel(xr_ref, xz_ref, xn_ref, whr_ref, whz_ref,
+                    whn_ref, br_ref, bz_ref, bn_ref, dh_ref, hck_ref,
+                    dxr_ref, dxz_ref, dxn_ref,
+                    dwhr_ref, dwhz_ref, dwhn_ref,
+                    dbr_ref, dbz_ref, dbn_ref,
+                    hseq_ref, carry_ref):
+    """One (row block, time segment) backward step. Grid is
+    (n_blocks, n_segs) with segments visited in REVERSE time order (the
+    index maps flip s); `carry_ref` holds d_h flowing from segment
+    seg+1 down to seg across grid iterations (TPU grids run
+    sequentially, which the accumulators already rely on)."""
+    s_len, nb, h_dim = xr_ref.shape
+    ws = (whr_ref[:], whz_ref[:], whn_ref[:])
+    bs = (br_ref[0, :], bz_ref[0, :], bn_ref[0, :])
+
+    # the first segment iteration of each row block is the LAST time
+    # segment: seed the carry with the incoming d_h for these rows
+    @pl.when(pl.program_id(1) == 0)
+    def _seed():
+        carry_ref[:] = dh_ref[:]
+
+    # recompute this segment's hidden sequence from its checkpoint
+    _recompute_segment(hseq_ref, hck_ref[0], xr_ref, xz_ref, xn_ref,
+                       ws, bs, s_len)
+    dh_out, dwhr, dwhz, dwhn, dbr, dbz, dbn = _backward_walk(
+        carry_ref[:], hseq_ref, xr_ref, xz_ref, xn_ref,
+        dxr_ref, dxz_ref, dxn_ref, ws, bs, s_len)
+    carry_ref[:] = dh_out
+    _accumulate_weight_grads(
+        jnp.logical_and(pl.program_id(0) == 0, pl.program_id(1) == 0),
+        (dwhr_ref, dwhz_ref, dwhn_ref, dbr_ref, dbz_ref, dbn_ref),
+        (dwhr, dwhz, dwhn, dbr, dbz, dbn))
 
 
 def _split_gates(xi: jnp.ndarray, w_h: jnp.ndarray, b_h: jnp.ndarray,
@@ -190,14 +260,115 @@ def _block_setup(n_rows: int, t_len: int, h_dim: int):
     Mosaic's actual scoped allocation by ~2x (measured r2 on v5e at
     T=60/H=64: nb=64 allocated 24.41 MB and nb=48 18.30 MB against a
     16 MB limit, i.e. ~0.38 MB/row vs the model's 0.20 MB/row), so the
-    sizing applies that empirical factor. Yields nb=64 at T=20/H<=64
-    and nb=24 at T=60/H=64 (~9.2 MB measured-scale)."""
+    sizing applies that empirical factor. Yields nb=64 at T=20/H<=64.
+    (The T=60 full-sequence case that forced nb=24 now takes the
+    segmented path instead — see _segment_setup.)"""
     per_row = 2 * (13 * t_len + 1) * h_dim * 4
     nb = max(8, min(_N_BLOCK, (_VMEM_BUDGET // per_row) // 8 * 8))
     nb = min(nb, n_rows) if n_rows >= 8 else n_rows
     n_pad = (-n_rows) % nb
     grid = ((n_rows + n_pad) // nb,)
     return nb, n_pad, grid
+
+
+def _segment_len(t_len: int) -> int:
+    """Largest divisor of T in [_SEG_MIN, _SEG_MAX] (so segments tile T
+    exactly and each carries enough work to amortize the per-segment
+    hseq refill / carry round-trip); falls back to T itself when none
+    exists (then the full-sequence path is used — e.g. prime T, or
+    T = 2 * prime whose only small divisor is a degenerate 2)."""
+    if t_len <= _SEG_MAX:
+        return t_len
+    for s in range(_SEG_MAX, _SEG_MIN - 1, -1):
+        if t_len % s == 0:
+            return s
+    return t_len
+
+
+def _segment_setup(n_rows: int, t_len: int, h_dim: int):
+    """(s_len, n_segs, nb, n_pad, grid) for the segmented backward: the
+    VMEM footprint is the _block_setup model with T replaced by the
+    segment length (plus the tiny (1, Nb, H) checkpoint block and
+    (Nb, H) carry), so row blocks stay wide at any T."""
+    s_len = _segment_len(t_len)
+    n_segs = t_len // s_len
+    per_row = 2 * (13 * s_len + 3) * h_dim * 4
+    nb = max(8, min(_N_BLOCK, (_VMEM_BUDGET // per_row) // 8 * 8))
+    nb = min(nb, n_rows) if n_rows >= 8 else n_rows
+    n_pad = (-n_rows) % nb
+    grid = ((n_rows + n_pad) // nb, n_segs)
+    return s_len, n_segs, nb, n_pad, grid
+
+
+def _segment_checkpoints(xs, ws, bs, s_len: int, n_segs: int):
+    """Hidden state at each segment START, (n_segs, N_padded, H), via a
+    plain XLA scan over segments (fori over steps inside). One extra
+    forward recurrence — the standard cost of gradient checkpointing —
+    on the already-relayouted time-leading gate streams."""
+    xr, xz, xn = xs
+    whr, whz, whn = ws
+    br, bz, bn = (b[0] for b in bs)
+    n_padded, h_dim = xr.shape[1], xr.shape[2]
+
+    def seg(h, chunk):
+        cr, cz, cn = chunk
+
+        hi = jax.lax.Precision.HIGHEST
+
+        def step(t, hh):
+            # HIGHEST matches the kernel's f32 in-VMEM recompute — the
+            # default TPU precision (bf16-class MXU passes) would drift
+            # the boundary states every segment's gradients start from
+            ghr = jnp.dot(hh, whr, precision=hi) + br
+            ghz = jnp.dot(hh, whz, precision=hi) + bz
+            ghn = jnp.dot(hh, whn, precision=hi) + bn
+            r = jax.nn.sigmoid(cr[t] + ghr)
+            z = jax.nn.sigmoid(cz[t] + ghz)
+            n = jnp.tanh(cn[t] + r * ghn)
+            return (1.0 - z) * n + z * hh
+
+        h_end = jax.lax.fori_loop(0, s_len, step, h)
+        return h_end, h          # emit the state at segment START
+
+    chunks = tuple(
+        x.reshape(n_segs, s_len, n_padded, h_dim) for x in (xr, xz, xn)
+    )
+    h0 = jnp.zeros((n_padded, h_dim), jnp.float32)
+    _, h_starts = jax.lax.scan(seg, h0, chunks)
+    return h_starts              # (n_segs, N_padded, H)
+
+
+def _fwd_block_setup(n_rows: int, t_len: int, h_dim: int):
+    """Forward-only row blocks: just the three gate streams
+    (double-buffered) plus the output live in VMEM — (6*T + 2)*H*4
+    bytes/row with the same 2x empirical Mosaic factor — so the forward
+    keeps wide blocks even at T=60 where the full-sequence backward
+    could not."""
+    per_row = 2 * (6 * t_len + 2) * h_dim * 4
+    nb = max(8, min(_N_BLOCK, (_VMEM_BUDGET // per_row) // 8 * 8))
+    nb = min(nb, n_rows) if n_rows >= 8 else n_rows
+    n_pad = (-n_rows) % nb
+    grid = ((n_rows + n_pad) // nb,)
+    return nb, n_pad, grid
+
+
+def _repad_rows(arrs, target: int):
+    """Re-pad/slice time-leading (T, N_padded, H) arrays (and the
+    (N_padded, H) d_h) on the row axis to `target` rows. The forward and
+    the two backward paths size their row blocks independently, so their
+    paddings can differ; padding rows are zeros and produce zero grads."""
+    out = []
+    for a in arrs:
+        axis = a.ndim - 2
+        cur = a.shape[axis]
+        if cur < target:
+            pad = [(0, 0)] * a.ndim
+            pad[axis] = (0, target - cur)
+            a = jnp.pad(a, pad)
+        elif cur > target:
+            a = jax.lax.slice_in_dim(a, 0, target, axis=axis)
+        out.append(a)
+    return out
 
 
 def _specs(t_len: int, nb: int, h_dim: int):
@@ -231,7 +402,7 @@ def gru_scan(xi: jnp.ndarray, w_h: jnp.ndarray, b_h: jnp.ndarray) -> jnp.ndarray
     hidden state (N, H)."""
     n_rows, t_len, h3 = xi.shape
     h_dim = h3 // 3
-    nb, n_pad, grid = _block_setup(n_rows, t_len, h_dim)
+    nb, n_pad, grid = _fwd_block_setup(n_rows, t_len, h_dim)
     xs, ws, bs = _split_gates(xi, w_h, b_h, n_pad)
     return _forward_impl(xs, ws, bs, n_rows, t_len, h_dim, nb, n_pad, grid)
 
@@ -241,7 +412,7 @@ def _fwd(xi, w_h, b_h):
     # backward never re-does the (N, T, 3H) -> 3x(T, N+pad, H) relayout.
     n_rows, t_len, h3 = xi.shape
     h_dim = h3 // 3
-    nb, n_pad, grid = _block_setup(n_rows, t_len, h_dim)
+    nb, n_pad, grid = _fwd_block_setup(n_rows, t_len, h_dim)
     xs, ws, bs = _split_gates(xi, w_h, b_h, n_pad)
     out = _forward_impl(xs, ws, bs, n_rows, t_len, h_dim, nb, n_pad, grid)
     return out, (xs, ws, bs, n_rows)
@@ -249,12 +420,32 @@ def _fwd(xi, w_h, b_h):
 
 def _bwd(res, dh):
     xs, ws, bs, n_rows = res
+    t_len = xs[0].shape[0]
+    if _segment_len(t_len) < t_len:
+        return _bwd_segmented(xs, ws, bs, n_rows, dh)
+    return _bwd_full(xs, ws, bs, n_rows, dh)
+
+
+def _finish_bwd(outs, n_rows: int):
+    """Reassemble the per-gate kernel outputs into the packed
+    [r | z | n] gradients (shared by both backward paths)."""
+    dxr, dxz, dxn, dwhr, dwhz, dwhn, dbr, dbz, dbn = outs
+    dxi = jnp.concatenate([dxr, dxz, dxn], axis=-1)       # (T, N+pad, 3H)
+    dxi = jnp.transpose(dxi, (1, 0, 2))[:n_rows]
+    dwh = jnp.concatenate([dwhr, dwhz, dwhn], axis=1)
+    dbh = jnp.concatenate([dbr[0], dbz[0], dbn[0]])
+    return dxi, dwh, dbh
+
+
+def _bwd_full(xs, ws, bs, n_rows, dh):
     interpret = jax.default_backend() != "tpu"
     t_len, n_padded, h_dim = xs[0].shape
     nb, n_pad, grid = _block_setup(n_rows, t_len, h_dim)
     dh_in = dh.astype(jnp.float32)
-    if n_pad:
-        dh_in = jnp.pad(dh_in, ((0, n_pad), (0, 0)))
+    target = n_rows + n_pad
+    if target != n_padded or target != dh_in.shape[0]:
+        xs = _repad_rows(xs, target)
+        (dh_in,) = _repad_rows([dh_in], target)
 
     x_spec, w_spec, b_spec = _specs(t_len, nb, h_dim)
     outs = pl.pallas_call(
@@ -276,13 +467,58 @@ def _bwd(res, dh):
         ],
         interpret=interpret,
     )(*xs, *ws, *bs, dh_in)
-    dxr, dxz, dxn, dwhr, dwhz, dwhn, dbr, dbz, dbn = outs
-    # reassemble the packed [r | z | n] layouts
-    dxi = jnp.concatenate([dxr, dxz, dxn], axis=-1)       # (T, N+pad, 3H)
-    dxi = jnp.transpose(dxi, (1, 0, 2))[:n_rows]
-    dwh = jnp.concatenate([dwhr, dwhz, dwhn], axis=1)
-    dbh = jnp.concatenate([dbr[0], dbz[0], dbn[0]])
-    return dxi, dwh, dbh
+    return _finish_bwd(outs, n_rows)
+
+
+def _bwd_segmented(xs, ws, bs, n_rows, dh):
+    """Segment-checkpointed BPTT (see module docstring): XLA scan
+    precomputes per-segment boundary states, then a (row blocks x
+    reversed time segments) grid differentiates one (S, Nb, H) chunk at
+    a time with d_h carried in persistent scratch."""
+    interpret = jax.default_backend() != "tpu"
+    t_len, n_padded, h_dim = xs[0].shape
+    s_len, n_segs, nb, n_pad, grid = _segment_setup(n_rows, t_len, h_dim)
+    target = n_rows + n_pad
+    dh_in = dh.astype(jnp.float32)
+    if target != n_padded or target != dh_in.shape[0]:
+        xs = _repad_rows(xs, target)
+        (dh_in,) = _repad_rows([dh_in], target)
+
+    hck = _segment_checkpoints(xs, ws, bs, s_len, n_segs)
+
+    # time segments are visited in reverse: grid step s works on
+    # time-block (n_segs - 1 - s)
+    seg_x = pl.BlockSpec((s_len, nb, h_dim),
+                         lambda i, s: (n_segs - 1 - s, i, 0),
+                         memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((h_dim, h_dim), lambda i, s: (0, 0),
+                          memory_space=pltpu.VMEM)
+    b_spec = pl.BlockSpec((1, h_dim), lambda i, s: (0, 0),
+                          memory_space=pltpu.VMEM)
+    dh_spec = pl.BlockSpec((nb, h_dim), lambda i, s: (i, 0),
+                           memory_space=pltpu.VMEM)
+    ck_spec = pl.BlockSpec((1, nb, h_dim),
+                           lambda i, s: (n_segs - 1 - s, i, 0),
+                           memory_space=pltpu.VMEM)
+
+    outs = pl.pallas_call(
+        _bwd_seg_kernel,
+        grid=grid,
+        in_specs=[seg_x] * 3 + [w_spec] * 3 + [b_spec] * 3
+        + [dh_spec, ck_spec],
+        out_specs=[seg_x] * 3 + [w_spec] * 3 + [b_spec] * 3,
+        out_shape=(
+            [jax.ShapeDtypeStruct((t_len, target, h_dim), jnp.float32)] * 3
+            + [jax.ShapeDtypeStruct((h_dim, h_dim), jnp.float32)] * 3
+            + [jax.ShapeDtypeStruct((1, h_dim), jnp.float32)] * 3
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((s_len + 1, nb, h_dim), jnp.float32),
+            pltpu.VMEM((nb, h_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*xs, *ws, *bs, dh_in, hck)
+    return _finish_bwd(outs, n_rows)
 
 
 gru_scan.defvjp(_fwd, _bwd)

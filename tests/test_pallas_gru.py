@@ -49,6 +49,42 @@ class TestGruKernel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=1e-5)
 
+    @pytest.mark.parametrize(
+        "n,t,h",
+        [
+            (72, 60, 8),   # multi row-block x 3 segments (S=20)
+            (10, 50, 4),   # S=10, 5 segments
+            (6, 29, 4),    # prime T > _SEG_MAX: full-sequence fallback
+        ],
+    )
+    def test_long_sequence_backward_matches_scan(self, rng, n, t, h):
+        """The segment-checkpointed BPTT path (T > _SEG_MAX) must produce
+        the same gradients as the scan oracle — including the reverse
+        d_h carry across segment boundaries and the dWh/db accumulation
+        over the 2-D grid."""
+        from factorvae_tpu.ops.pallas.gru import _segment_len
+
+        if t == 29:
+            assert _segment_len(t) == t          # fallback engaged
+        else:
+            assert _segment_len(t) < t           # segmentation engaged
+        xi = jnp.asarray(rng.normal(size=(n, t, 3 * h)) * 0.5, jnp.float32)
+        wh = jnp.asarray(rng.normal(size=(h, 3 * h)) * 0.3, jnp.float32)
+        bh = jnp.asarray(rng.normal(size=(3 * h,)) * 0.1, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(gru_scan(xi, wh, bh)),
+            np.asarray(scan_gru_reference(xi, wh, bh)),
+            rtol=1e-5, atol=1e-6,
+        )
+        dh = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+        gf = jax.grad(lambda *a: jnp.sum(gru_scan(*a) * dh), argnums=(0, 1, 2))(
+            xi, wh, bh)
+        gr = jax.grad(lambda *a: jnp.sum(scan_gru_reference(*a) * dh),
+                      argnums=(0, 1, 2))(xi, wh, bh)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=2e-5)
+
     def test_gru_module_flag_parity(self, rng):
         """GRU(use_pallas=True) == GRU(use_pallas=False) with shared params."""
         n, t, c, h = 5, 6, 4, 4
