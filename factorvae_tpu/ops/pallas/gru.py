@@ -252,23 +252,28 @@ def _split_gates(xi: jnp.ndarray, w_h: jnp.ndarray, b_h: jnp.ndarray,
     return xs, ws, bs
 
 
-def _block_setup(n_rows: int, t_len: int, h_dim: int):
-    """Row-block size bounded by the BACKWARD's measured VMEM footprint.
-
-    The analytic model — six (T, Nb, H) refs double-buffered plus the
-    (T+1, Nb, H) scratch, (13*T + 1) * H * 4 bytes/row — under-counts
-    Mosaic's actual scoped allocation by ~2x (measured r2 on v5e at
-    T=60/H=64: nb=64 allocated 24.41 MB and nb=48 18.30 MB against a
-    16 MB limit, i.e. ~0.38 MB/row vs the model's 0.20 MB/row), so the
-    sizing applies that empirical factor. Yields nb=64 at T=20/H<=64.
-    (The T=60 full-sequence case that forced nb=24 now takes the
-    segmented path instead — see _segment_setup.)"""
-    per_row = 2 * (13 * t_len + 1) * h_dim * 4
+def _rows_blocking(n_rows: int, per_row: int):
+    """Shared row-block derivation: clamp to the VMEM budget (8-row
+    aligned, capped at _N_BLOCK), pad the row count to a multiple.
+    `per_row` is each path's measured VMEM bytes per row (the analytic
+    ref count times the ~2x empirical Mosaic scoped-allocation factor —
+    measured r2 on v5e at T=60/H=64: nb=64 allocated 24.41 MB and nb=48
+    18.30 MB against a 16 MB limit, i.e. ~0.38 MB/row vs the analytic
+    0.20 MB/row)."""
     nb = max(8, min(_N_BLOCK, (_VMEM_BUDGET // per_row) // 8 * 8))
     nb = min(nb, n_rows) if n_rows >= 8 else n_rows
     n_pad = (-n_rows) % nb
-    grid = ((n_rows + n_pad) // nb,)
-    return nb, n_pad, grid
+    return nb, n_pad, (n_rows + n_pad) // nb
+
+
+def _block_setup(n_rows: int, t_len: int, h_dim: int):
+    """Full-sequence backward blocks: six (T, Nb, H) refs
+    double-buffered plus the (T+1, Nb, H) scratch. Yields nb=64 at
+    T=20/H<=64. (The T=60 full-sequence case that forced nb=24 now
+    takes the segmented path instead — see _segment_setup.)"""
+    nb, n_pad, n_blocks = _rows_blocking(
+        n_rows, 2 * (13 * t_len + 1) * h_dim * 4)
+    return nb, n_pad, (n_blocks,)
 
 
 def _segment_len(t_len: int) -> int:
@@ -287,17 +292,14 @@ def _segment_len(t_len: int) -> int:
 
 def _segment_setup(n_rows: int, t_len: int, h_dim: int):
     """(s_len, n_segs, nb, n_pad, grid) for the segmented backward: the
-    VMEM footprint is the _block_setup model with T replaced by the
-    segment length (plus the tiny (1, Nb, H) checkpoint block and
-    (Nb, H) carry), so row blocks stay wide at any T."""
+    _block_setup VMEM model with T replaced by the segment length (plus
+    the tiny (1, Nb, H) checkpoint block and (Nb, H) carry), so row
+    blocks stay wide at any T."""
     s_len = _segment_len(t_len)
     n_segs = t_len // s_len
-    per_row = 2 * (13 * s_len + 3) * h_dim * 4
-    nb = max(8, min(_N_BLOCK, (_VMEM_BUDGET // per_row) // 8 * 8))
-    nb = min(nb, n_rows) if n_rows >= 8 else n_rows
-    n_pad = (-n_rows) % nb
-    grid = ((n_rows + n_pad) // nb, n_segs)
-    return s_len, n_segs, nb, n_pad, grid
+    nb, n_pad, n_blocks = _rows_blocking(
+        n_rows, 2 * (13 * s_len + 3) * h_dim * 4)
+    return s_len, n_segs, nb, n_pad, (n_blocks, n_segs)
 
 
 def _segment_checkpoints(xs, ws, bs, s_len: int, n_segs: int):
@@ -340,16 +342,12 @@ def _segment_checkpoints(xs, ws, bs, s_len: int, n_segs: int):
 
 def _fwd_block_setup(n_rows: int, t_len: int, h_dim: int):
     """Forward-only row blocks: just the three gate streams
-    (double-buffered) plus the output live in VMEM — (6*T + 2)*H*4
-    bytes/row with the same 2x empirical Mosaic factor — so the forward
+    (double-buffered) plus the output live in VMEM, so the forward
     keeps wide blocks even at T=60 where the full-sequence backward
     could not."""
-    per_row = 2 * (6 * t_len + 2) * h_dim * 4
-    nb = max(8, min(_N_BLOCK, (_VMEM_BUDGET // per_row) // 8 * 8))
-    nb = min(nb, n_rows) if n_rows >= 8 else n_rows
-    n_pad = (-n_rows) % nb
-    grid = ((n_rows + n_pad) // nb,)
-    return nb, n_pad, grid
+    nb, n_pad, n_blocks = _rows_blocking(
+        n_rows, 2 * (6 * t_len + 2) * h_dim * 4)
+    return nb, n_pad, (n_blocks,)
 
 
 def _repad_rows(arrs, target: int):
@@ -369,6 +367,17 @@ def _repad_rows(arrs, target: int):
             a = jax.lax.slice_in_dim(a, 0, target, axis=axis)
         out.append(a)
     return out
+
+
+def _prep_bwd_inputs(xs, dh, n_rows: int, n_pad: int):
+    """Shared backward preamble: f32 cotangent + reconcile the forward's
+    row padding with this backward path's own blocking."""
+    dh_in = dh.astype(jnp.float32)
+    target = n_rows + n_pad
+    if target != xs[0].shape[1] or target != dh_in.shape[0]:
+        xs = _repad_rows(xs, target)
+        (dh_in,) = _repad_rows([dh_in], target)
+    return xs, dh_in
 
 
 def _specs(t_len: int, nb: int, h_dim: int):
@@ -439,13 +448,9 @@ def _finish_bwd(outs, n_rows: int):
 
 def _bwd_full(xs, ws, bs, n_rows, dh):
     interpret = jax.default_backend() != "tpu"
-    t_len, n_padded, h_dim = xs[0].shape
+    t_len, _, h_dim = xs[0].shape
     nb, n_pad, grid = _block_setup(n_rows, t_len, h_dim)
-    dh_in = dh.astype(jnp.float32)
-    target = n_rows + n_pad
-    if target != n_padded or target != dh_in.shape[0]:
-        xs = _repad_rows(xs, target)
-        (dh_in,) = _repad_rows([dh_in], target)
+    xs, dh_in = _prep_bwd_inputs(xs, dh, n_rows, n_pad)
 
     x_spec, w_spec, b_spec = _specs(t_len, nb, h_dim)
     outs = pl.pallas_call(
@@ -476,13 +481,9 @@ def _bwd_segmented(xs, ws, bs, n_rows, dh):
     reversed time segments) grid differentiates one (S, Nb, H) chunk at
     a time with d_h carried in persistent scratch."""
     interpret = jax.default_backend() != "tpu"
-    t_len, n_padded, h_dim = xs[0].shape
+    t_len, _, h_dim = xs[0].shape
     s_len, n_segs, nb, n_pad, grid = _segment_setup(n_rows, t_len, h_dim)
-    target = n_rows + n_pad
-    dh_in = dh.astype(jnp.float32)
-    if target != n_padded or target != dh_in.shape[0]:
-        xs = _repad_rows(xs, target)
-        (dh_in,) = _repad_rows([dh_in], target)
+    xs, dh_in = _prep_bwd_inputs(xs, dh, n_rows, n_pad)
 
     hck = _segment_checkpoints(xs, ws, bs, s_len, n_segs)
 
@@ -508,7 +509,8 @@ def _bwd_segmented(xs, ws, bs, n_rows, dh):
         + [dh_spec, ck_spec],
         out_specs=[seg_x] * 3 + [w_spec] * 3 + [b_spec] * 3,
         out_shape=(
-            [jax.ShapeDtypeStruct((t_len, target, h_dim), jnp.float32)] * 3
+            [jax.ShapeDtypeStruct((t_len, n_rows + n_pad, h_dim),
+                                  jnp.float32)] * 3
             + [jax.ShapeDtypeStruct((h_dim, h_dim), jnp.float32)] * 3
             + [jax.ShapeDtypeStruct((1, h_dim), jnp.float32)] * 3
         ),
