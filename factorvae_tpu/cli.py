@@ -88,7 +88,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="quantize weights to per-channel int8 for the "
                         "scoring pass (ops/quant.py): 4x smaller HBM "
                         "parameter residency, rank-correlation ~1 vs "
-                        "the float path")
+                        "the float path. Also applies to --export "
+                        "(int8-baked serving artifact)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--preset", type=str, default=None,
                    help="named config preset (see factorvae_tpu.presets). The "
@@ -372,6 +373,7 @@ def main(argv=None) -> int:
         blob = export_prediction(
             params, cfg, n_max=dataset.n_max,
             stochastic=cfg.model.stochastic_inference, platforms=platforms,
+            int8=args.int8_scores,
         )
         with open(args.export, "wb") as fh:
             fh.write(blob)

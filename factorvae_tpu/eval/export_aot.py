@@ -29,18 +29,30 @@ def export_prediction(
     n_max: int,
     stochastic: bool = False,
     platforms: Optional[Sequence[str]] = None,
+    int8: bool = False,
 ) -> bytes:
     """Serialized prediction function: call(x (D,N,T,C), mask (D,N)) ->
     (D,N) scores. D is a fixed batch dim of 1 per call (vmap the artifact
-    or loop days at serving time)."""
+    or loop days at serving time).
+
+    `int8=True` bakes the weight matrices as per-channel int8 constants
+    (ops/quant.py) with the dequantize folded into the program — a ~4x
+    smaller artifact with the tested rank-fidelity of the int8 scoring
+    path."""
     from jax import export as jexport
 
     cfg = config.model
     model = day_prediction(cfg, stochastic=stochastic)
     key = jax.random.PRNGKey(0)  # used only when stochastic
 
+    if int8:
+        from factorvae_tpu.ops.quant import dequantize_params, quantize_params
+
+        qparams = quantize_params(params)
+
     def predict(x, mask):
-        return model.apply(params, x, mask, rngs={"sample": key})
+        p = dequantize_params(qparams, cfg.dtype) if int8 else params
+        return model.apply(p, x, mask, rngs={"sample": key})
 
     fn = jax.jit(predict)
     args = (
