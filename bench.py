@@ -279,6 +279,20 @@ def run_bench() -> dict:
     }
 
 
+# The most recent REAL-TPU measurement, carried as clearly-labeled
+# context in the CPU-fallback payload (the fresh `value` stays the
+# honest CPU number): if the axon relay is dead at bench time — it died
+# mid-round-2 and is unrecoverable from inside the sandbox — the reader
+# still sees what the chip measured and where it is recorded.
+LAST_TPU_MEASUREMENT = {
+    "windows_per_sec": 1057841.0,
+    "vs_baseline": 35.3,
+    "mfu": 0.071,
+    "config": "bf16 days_per_step=8 flagship",
+    "source": "PERF.md 'Measured (round 2)' on a live v5e",
+}
+
+
 def rerun_on_cpu(error: str) -> None:
     """Re-exec pinned to host CPU at reduced shapes; forward its JSON line."""
     env = dict(os.environ)
@@ -297,6 +311,7 @@ def rerun_on_cpu(error: str) -> None:
         if r.returncode == 0 and line:
             payload = json.loads(line)
             payload["accelerator_error"] = error
+            payload["last_tpu_measurement"] = LAST_TPU_MEASUREMENT
             emit(payload)
             return
         detail = (r.stderr.strip().splitlines() or ["no output"])[-1]
