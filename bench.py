@@ -324,6 +324,7 @@ def rerun_on_cpu(error: str) -> None:
         "vs_baseline": 0.0,
         "accelerator_error": error,
         "cpu_fallback_error": detail,
+        "last_tpu_measurement": LAST_TPU_MEASUREMENT,
     })
 
 

@@ -77,6 +77,14 @@ def main() -> None:
           f"(IR {float(ic['RankIC_IR'].iloc[0]):+.3f})")
     print(f"backtest   : {bt.summary()}")
 
+    # int8 weight-only scoring (ops/quant.py): 4x smaller parameter
+    # residency, rank-faithful scores — the serving-oriented path.
+    i8 = generate_prediction_scores(
+        state.params, cfg, dataset, stochastic=False, int8=True
+    )
+    rho = scores["score"].corr(i8["score"], method="spearman")
+    print(f"int8 path  : rank corr vs f32 = {rho:+.4f}")
+
 
 if __name__ == "__main__":
     main()
