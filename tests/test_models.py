@@ -153,6 +153,138 @@ class TestGRUSemantics:
         )
 
 
+class TestModuleOraclesVsTorch:
+    """Per-module golden tests against independent torch implementations
+    of the documented reference math (SURVEY.md §3.2) — deterministic
+    paths only (dropout off, no sampling). The encoder and predictor
+    oracles consume the UNPADDED valid subset with plain dense ops,
+    pinning the central masking equivalence: our masked ops over a
+    padded cross-section must equal the reference's dense ops over the
+    real one. (The decoder takes no mask — it is per-stock elementwise,
+    masking handled upstream — so its oracle runs the full latent.) The
+    predictor oracle additionally iterates heads in a Python loop,
+    pinning the K-batched-einsum == K-loop rewrite (SURVEY.md §3.5)."""
+
+    N_PAD, N_VALID = 9, 7
+
+    @staticmethod
+    def _dense_t(params, name, x_t):
+        import torch
+
+        k = torch.from_numpy(np.asarray(params[name]["Dense_0"]["kernel"]))
+        b = torch.from_numpy(np.asarray(params[name]["Dense_0"]["bias"]))
+        return x_t @ k + b
+
+    @pytest.fixture
+    def latents(self, rng):
+        lat = rng.normal(size=(self.N_PAD, CFG.hidden_size)).astype(np.float32)
+        mask = np.zeros(self.N_PAD, bool)
+        mask[: self.N_VALID] = True
+        return lat, mask
+
+    def test_encoder_matches_torch_oracle(self, latents, rng):
+        torch = pytest.importorskip("torch")
+        from factorvae_tpu.models import FactorEncoder
+
+        lat, mask = latents
+        y = rng.normal(size=(self.N_PAD,)).astype(np.float32)
+        enc = FactorEncoder(CFG)
+        params = enc.init(jax.random.PRNGKey(0), jnp.asarray(lat),
+                          jnp.asarray(y), jnp.asarray(mask))
+        got_mu, got_sigma = enc.apply(params, jnp.asarray(lat),
+                                      jnp.asarray(y), jnp.asarray(mask))
+
+        p = params["params"]
+        lat_t = torch.from_numpy(lat[: self.N_VALID])
+        y_t = torch.from_numpy(y[: self.N_VALID])
+        # module.py:56-57,64,44-50: Linear -> softmax over STOCKS (dim=0)
+        # -> portfolio returns -> mu / softplus-sigma heads
+        w = torch.softmax(self._dense_t(p, "portfolio", lat_t), dim=0)
+        y_p = w.T @ y_t
+        want_mu = self._dense_t(p, "mu", y_p)
+        want_sigma = torch.nn.functional.softplus(self._dense_t(p, "sigma", y_p))
+        np.testing.assert_allclose(np.asarray(got_mu), want_mu.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_sigma), want_sigma.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_decoder_distribution_matches_torch_oracle(self, latents, rng):
+        torch = pytest.importorskip("torch")
+        from factorvae_tpu.models import FactorDecoder
+
+        lat, _ = latents
+        k_dim = CFG.num_factors
+        fmu = rng.normal(size=(k_dim,)).astype(np.float32)
+        fsig = np.abs(rng.normal(size=(k_dim,))).astype(np.float32)
+        fsig[0] = 0.0                       # exercises the sigma=0 guard
+        dec = FactorDecoder(CFG)
+        params = dec.init(
+            {"params": jax.random.PRNGKey(0), "sample": jax.random.PRNGKey(1)},
+            jnp.asarray(lat), jnp.asarray(fmu), jnp.asarray(fsig))
+        got_mu, got_sigma = dec.apply(
+            params, jnp.asarray(lat), jnp.asarray(fmu), jnp.asarray(fsig),
+            method=FactorDecoder.distribution)
+
+        p = params["params"]
+        lat_t = torch.from_numpy(lat)
+        # module.py:78-84 alpha head; :92-94 beta; :117 guard; :120-121
+        h = torch.nn.functional.leaky_relu(
+            self._dense_t(p["alpha_layer"], "proj", lat_t),
+            negative_slope=CFG.leaky_relu_slope)
+        a_mu = self._dense_t(p["alpha_layer"], "mu", h)[:, 0]
+        a_sig = torch.nn.functional.softplus(
+            self._dense_t(p["alpha_layer"], "sigma", h))[:, 0]
+        beta = self._dense_t(p["beta_layer"], "beta", lat_t)
+        fsig_t = torch.from_numpy(np.where(fsig == 0.0, 1e-6, fsig))
+        fmu_t = torch.from_numpy(fmu)
+        want_mu = a_mu + beta @ fmu_t
+        want_sigma = torch.sqrt(a_sig**2 + (beta**2) @ (fsig_t**2) + 1e-6)
+        np.testing.assert_allclose(np.asarray(got_mu), want_mu.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_sigma), want_sigma.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_predictor_matches_torch_head_loop(self, latents):
+        torch = pytest.importorskip("torch")
+        from factorvae_tpu.models import FactorPredictor
+
+        lat, mask = latents
+        pred = FactorPredictor(CFG)
+        params = pred.init(jax.random.PRNGKey(0), jnp.asarray(lat),
+                           jnp.asarray(mask))
+        got_mu, got_sigma = pred.apply(params, jnp.asarray(lat),
+                                       jnp.asarray(mask), train=False)
+
+        p = params["params"]
+        lat_t = torch.from_numpy(lat[: self.N_VALID])
+        h_dim = CFG.hidden_size
+        contexts = []
+        # the reference's per-head Python loop (module.py:172-178);
+        # dropout inactive at eval, so the order quirk reduces to
+        # ReLU -> softmax (module.py:144-146)
+        for k in range(CFG.num_factors):
+            wk = torch.from_numpy(np.asarray(p["key_kernel"][k]))
+            bk = torch.from_numpy(np.asarray(p["key_bias"][k]))
+            wv = torch.from_numpy(np.asarray(p["value_kernel"][k]))
+            bv = torch.from_numpy(np.asarray(p["value_bias"][k]))
+            q = torch.from_numpy(np.asarray(p["query"][k]))
+            keys = lat_t @ wk + bk
+            vals = lat_t @ wv + bv
+            scores = (keys @ q) / np.sqrt(h_dim + 1e-6)   # module.py:140-142
+            attn = torch.softmax(torch.relu(scores), dim=0)
+            contexts.append(attn @ vals)
+        ctx = torch.stack(contexts)                        # (K, H)
+        h = torch.nn.functional.leaky_relu(
+            self._dense_t(p, "proj", ctx), negative_slope=CFG.leaky_relu_slope)
+        want_mu = self._dense_t(p, "mu", h)[:, 0]
+        want_sigma = torch.nn.functional.softplus(
+            self._dense_t(p, "sigma", h))[:, 0]
+        np.testing.assert_allclose(np.asarray(got_mu), want_mu.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_sigma), want_sigma.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
 class TestExtractor:
     def test_output_shape_and_dtype(self, rng):
         fe = FeatureExtractor(CFG)
