@@ -1,0 +1,156 @@
+"""Summarize a captured `jax.profiler` trace from the command line.
+
+The reference has no profiling story at all (SURVEY.md §5); this closes
+the loop on ours: `--profile DIR` captures a trace
+(utils/profiling.trace), and
+
+    python -m factorvae_tpu.utils.trace_summary DIR [--top 15]
+
+prints the device-time breakdown — total on-device time and the top
+kernels/fusions by accumulated duration — without needing TensorBoard
+(the round-2 PERF.md trace analysis was done by hand; this is that
+analysis as a tool).
+
+Format notes: jax.profiler writes TensorBoard plugin layout
+`DIR/plugins/profile/<run>/<host>.trace.json.gz` in Chrome trace-event
+format. Device lanes are identified by their process_name metadata
+events (e.g. "/device:TPU:0 ..."); complete events ("ph" == "X") carry
+microsecond durations.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+from typing import Optional
+
+
+def find_trace_files(log_dir: str) -> list:
+    """All .trace.json(.gz) files under a profiler log dir."""
+    pats = [
+        os.path.join(log_dir, "**", "*.trace.json.gz"),
+        os.path.join(log_dir, "**", "*.trace.json"),
+    ]
+    out: list = []
+    for p in pats:
+        out.extend(glob.glob(p, recursive=True))
+    return sorted(out)
+
+
+def _load_events(path: str) -> list:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as fh:
+        data = json.load(fh)
+    if isinstance(data, list):      # bare-array chrome trace format
+        return data
+    return data.get("traceEvents", [])
+
+
+def summarize_trace(
+    log_dir: str, device_only: bool = True, top: int = 15
+) -> dict:
+    """{'files', 'device_pids', 'total_us', 'by_name': [(name, us, count)]}
+
+    Aggregates complete ("X") event durations by event name across every
+    trace file, restricted (by default) to processes whose metadata
+    process_name mentions a device lane ("/device:" — TPU/GPU streams;
+    host python/runtime lanes are excluded so the total is device time,
+    not wall time)."""
+    files = find_trace_files(log_dir)
+    device_pids: dict = {}
+    durations: dict = defaultdict(float)
+    counts: dict = defaultdict(int)
+    total = 0.0
+    # first pass: lane metadata for every file, and the GLOBAL decision
+    # of whether any device lane exists — the fallback must not be
+    # per-file, or a host-only trace file alongside a device-lane file
+    # (multi-host captures) would pour host wall time into the total
+    loaded = []
+    any_device = False
+    for f in files:
+        events = _load_events(f)
+        lanes = {}
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                lanes[ev.get("pid")] = (ev.get("args") or {}).get("name", "")
+        any_device = any_device or any("/device:" in n for n in lanes.values())
+        loaded.append((events, lanes))
+    restrict = device_only and any_device
+    for events, lanes in loaded:
+        if restrict:
+            pids = {p for p, n in lanes.items() if "/device:" in n}
+            device_pids.update({p: lanes[p] for p in pids})
+        else:
+            # CPU-only captures have no "/device:" lane (everything runs
+            # under "/host:CPU"): take every lane rather than reporting
+            # an empty trace. `pids = None` means "admit any pid" so
+            # files without process_name metadata still count.
+            pids = None
+            device_pids.update(lanes)
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            if pids is not None and ev.get("pid") not in pids:
+                continue
+            name = ev.get("name", "?")
+            if name.startswith("$"):
+                # python source-frame events ($file.py:line fn) are a
+                # nested call stack — summing them double-counts; the
+                # kernel/op events carry the real time
+                continue
+            dur = float(ev.get("dur", 0.0))
+            durations[name] += dur
+            counts[name] += 1
+            total += dur
+    by_name = sorted(
+        ((n, d, counts[n]) for n, d in durations.items()),
+        key=lambda t: -t[1],
+    )[: max(top, 0)]
+    return {
+        "files": files,
+        "device_pids": device_pids,
+        "total_us": total,
+        "by_name": by_name,
+    }
+
+
+def format_summary(s: dict) -> str:
+    lines = []
+    if not s["files"]:
+        return "no .trace.json(.gz) files found (did the trace capture run?)"
+    lines.append(f"trace files : {len(s['files'])}")
+    lanes = ", ".join(str(v) for v in s["device_pids"].values()) or "(none)"
+    lines.append(f"device lanes: {lanes}")
+    lines.append(f"device time : {s['total_us'] / 1e3:.3f} ms")
+    if s["by_name"]:
+        width = max(len(n) for n, _, _ in s["by_name"])
+        lines.append(f"{'kernel/fusion':<{width}}  {'total':>10}  {'count':>6}  share")
+        for name, us, cnt in s["by_name"]:
+            share = us / s["total_us"] if s["total_us"] else 0.0
+            lines.append(
+                f"{name:<{width}}  {us / 1e3:>8.3f}ms  {cnt:>6}  {share:>5.1%}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Device-time breakdown of a jax.profiler trace dir")
+    ap.add_argument("log_dir")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--all_lanes", action="store_true",
+                    help="include host lanes (default: device lanes only)")
+    args = ap.parse_args(argv)
+    s = summarize_trace(args.log_dir, device_only=not args.all_lanes,
+                        top=args.top)
+    print(format_summary(s))
+    return 0 if s["files"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

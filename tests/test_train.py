@@ -258,3 +258,92 @@ class TestProfilingUtils:
 
         with trace(None):
             pass
+
+    def test_trace_summary_on_real_capture(self, tmp_path):
+        """The summary tool reads an actual jax.profiler capture end to
+        end (CPU lanes fall back when no /device: lane exists)."""
+        import jax.numpy as jnp
+
+        from factorvae_tpu.utils.profiling import trace
+        from factorvae_tpu.utils.trace_summary import (
+            format_summary,
+            summarize_trace,
+        )
+
+        with trace(str(tmp_path / "tr")):
+            x = jnp.ones((64, 64))
+            (x @ x).block_until_ready()
+        s = summarize_trace(str(tmp_path / "tr"))
+        assert s["files"] and s["total_us"] > 0
+        assert s["by_name"]
+        # python stack-frame events must be excluded from the breakdown
+        assert not any(n.startswith("$") for n, _, _ in s["by_name"])
+        text = format_summary(s)
+        assert "device time" in text
+
+    def test_trace_summary_device_lane_filter(self, tmp_path):
+        """Synthetic chrome trace: with a /device: lane present, host
+        lanes and python frames are excluded from the totals."""
+        import gzip
+        import json
+
+        from factorvae_tpu.utils.trace_summary import summarize_trace
+
+        events = [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0 (fake)"}},
+            {"ph": "M", "name": "process_name", "pid": 2,
+             "args": {"name": "/host:CPU"}},
+            {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 0,
+             "ts": 0, "dur": 100.0},
+            {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 0,
+             "ts": 200, "dur": 50.0},
+            {"ph": "X", "name": "copy.2", "pid": 1, "tid": 0,
+             "ts": 300, "dur": 25.0},
+            {"ph": "X", "name": "host_thing", "pid": 2, "tid": 0,
+             "ts": 0, "dur": 999.0},
+            {"ph": "X", "name": "$file.py:1 fn", "pid": 1, "tid": 0,
+             "ts": 0, "dur": 999.0},
+        ]
+        d = tmp_path / "plugins" / "profile" / "run"
+        d.mkdir(parents=True)
+        with gzip.open(d / "host.trace.json.gz", "wt") as fh:
+            json.dump({"traceEvents": events}, fh)
+        s = summarize_trace(str(tmp_path))
+        assert s["total_us"] == 175.0
+        assert s["by_name"][0] == ("fusion.1", 150.0, 2)
+        assert all(n != "host_thing" for n, _, _ in s["by_name"])
+
+        # a host-only trace file alongside the device-lane one must NOT
+        # pour host time into the device total (global lane decision)
+        host_events = [
+            {"ph": "M", "name": "process_name", "pid": 9,
+             "args": {"name": "/host:CPU"}},
+            {"ph": "X", "name": "host_only", "pid": 9, "tid": 0,
+             "ts": 0, "dur": 5000.0},
+        ]
+        with gzip.open(d / "host2.trace.json.gz", "wt") as fh:
+            json.dump({"traceEvents": host_events}, fh)
+        s2 = summarize_trace(str(tmp_path))
+        assert s2["total_us"] == 175.0
+
+    def test_trace_summary_bare_array_and_no_metadata(self, tmp_path):
+        """Bare-array chrome format parses, and a file without
+        process_name metadata still counts in fallback mode."""
+        import gzip
+        import json
+
+        from factorvae_tpu.utils.trace_summary import summarize_trace
+
+        d = tmp_path / "plugins" / "profile" / "run"
+        d.mkdir(parents=True)
+        # top-level ARRAY, no metadata events at all
+        events = [
+            {"ph": "X", "name": "op.a", "pid": 3, "tid": 0,
+             "ts": 0, "dur": 40.0},
+        ]
+        with gzip.open(d / "bare.trace.json.gz", "wt") as fh:
+            json.dump(events, fh)
+        s = summarize_trace(str(tmp_path))
+        assert s["total_us"] == 40.0
+        assert s["by_name"] == [("op.a", 40.0, 1)]
