@@ -116,6 +116,9 @@ def multihead_cross_section_attention(
         out_specs=pl.BlockSpec((1, 1, h), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((k, 1, h), jnp.float32),
+        # heads are independent: a megacore TPU may split them
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(
         latent.astype(jnp.float32),

@@ -129,6 +129,10 @@ def _bwd_pallas(latent, maskf, dmask, query, w_key, b_key, w_val, b_val, dctx,
             jax.ShapeDtypeStruct((k, h, h), jnp.float32),   # dWv
             jax.ShapeDtypeStruct((k, 1, h), jnp.float32),   # dbv
         ],
+        # dlatent accumulates across the head grid (program_id(0)==0
+        # init + += revisits): must stay sequential (no megacore split)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(
         latent.astype(jnp.float32),

@@ -400,6 +400,9 @@ def _forward_impl(xs, ws, bs, n_rows, t_len, h_dim, nb, n_pad, grid):
         out_specs=pl.BlockSpec((nb, h_dim), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_rows + n_pad, h_dim), jnp.float32),
+        # row blocks are independent: a megacore TPU may split them
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*xs, *ws, *bs)
     return out[:n_rows]
@@ -470,6 +473,10 @@ def _bwd_full(xs, ws, bs, n_rows, dh):
         scratch_shapes=[
             pltpu.VMEM((t_len + 1, nb, h_dim), jnp.float32),
         ],
+        # dWh/db accumulate across row blocks: the grid must stay
+        # sequential (no megacore split)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(*xs, *ws, *bs, dh_in)
     return _finish_bwd(outs, n_rows)
@@ -518,6 +525,11 @@ def _bwd_segmented(xs, ws, bs, n_rows, dh):
             pltpu.VMEM((s_len + 1, nb, h_dim), jnp.float32),
             pltpu.VMEM((nb, h_dim), jnp.float32),
         ],
+        # the d_h carry flows across segment iterations and dWh/db
+        # accumulate across the whole grid: both axes must stay
+        # sequential (no megacore split)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(*xs, *ws, *bs, dh_in, hck)
     return _finish_bwd(outs, n_rows)
