@@ -34,8 +34,8 @@ _NEG_INF = -1e30
 
 def ring_cross_section_attention(
     query: jnp.ndarray,       # (K, H) replicated
-    key_local: jnp.ndarray,   # (n_local, H) this shard's keys
-    value_local: jnp.ndarray, # (n_local, H)
+    key_local: jnp.ndarray,   # (n_local, H) shared or (K, n_local, H) per-head
+    value_local: jnp.ndarray, # same leading shape as key_local
     mask_local: jnp.ndarray,  # (n_local,) bool
     axis_name: str,
     relu_scores: bool = True,
@@ -45,15 +45,24 @@ def ring_cross_section_attention(
 
     relu_scores=True keeps the reference's quirky ReLU-before-softmax
     (module.py:145); scale defaults to 1/sqrt(H + 1e-6) (module.py:142).
+
+    2-D key/value chunks are one set shared by every query head; 3-D
+    (K, n_local, H) chunks are per-head keys/values — the real
+    FactorPredictor's layout (each reference AttentionLayer has its own
+    key/value Linears, module.py:131-137).
     """
     k_heads, h_dim = query.shape
     if scale is None:
         scale = 1.0 / jnp.sqrt(jnp.float32(h_dim) + 1e-6)
+    per_head = key_local.ndim == 3
     ring_size = lax.psum(1, axis_name)
     right = [(i, (i + 1) % ring_size) for i in range(ring_size)]
 
     def scores_for(chunk_k, chunk_mask):
-        s = (query @ chunk_k.T) * scale                      # (K, n_local)
+        if per_head:
+            s = jnp.einsum("kh,knh->kn", query, chunk_k) * scale
+        else:
+            s = (query @ chunk_k.T) * scale                  # (K, n_local)
         if relu_scores:
             s = jnp.maximum(s, 0.0)
         return jnp.where(chunk_mask[None, :], s, _NEG_INF)
@@ -67,7 +76,10 @@ def ring_cross_section_attention(
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(cm[None, :], p, 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + p @ cv               # (K, H)
+        if per_head:
+            acc_new = acc * corr[:, None] + jnp.einsum("kn,knh->kh", p, cv)
+        else:
+            acc_new = acc * corr[:, None] + p @ cv           # (K, H)
         return (m_new, l_new, acc_new)
 
     def body(carry, _):
@@ -89,3 +101,47 @@ def ring_cross_section_attention(
     # semantics, module.py:149-150)
     safe = l > 0
     return jnp.where(safe[:, None], acc / jnp.where(safe, l, 1.0)[:, None], 0.0)
+
+
+def predictor_prior_ring(params, latent, mask, mesh, axis_name: str = "stock"):
+    """The REAL FactorPredictor prior (mu_prior, sigma_prior) computed
+    context-parallel: the cross-section is sharded over `axis_name`,
+    each device builds only its LOCAL (K, n_local, H) key/value chunks
+    from its latent shard, and ring attention assembles the exact (K, H)
+    contexts without ever gathering the full cross-section — the
+    explicit-collectives counterpart of models/predictor.py's dense
+    einsum path (dropout-off semantics; tested equal). The shared head
+    MLP (module.py:181-187) then runs replicated.
+
+    `params` is a FactorPredictor variable tree (or its 'params' leaf).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    p = params.get("params", params)
+    query = p["query"].astype(jnp.float32)
+    w_key, b_key = p["key_kernel"], p["key_bias"]
+    w_val, b_val = p["value_kernel"], p["value_bias"]
+
+    def local(lat_l, mask_l):
+        keys = jnp.einsum("nh,khj->knj", lat_l, w_key) + b_key[:, None, :]
+        vals = jnp.einsum("nh,khj->knj", lat_l, w_val) + b_val[:, None, :]
+        ctx = ring_cross_section_attention(
+            query, keys, vals, mask_l, axis_name)
+        return ctx
+
+    ctx = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name)),
+        out_specs=P(),                      # replicated (K, H) context
+        check_rep=False,
+    )(latent.astype(jnp.float32), mask)
+
+    def dense(name, x):
+        d = p[name]["Dense_0"]
+        return x @ d["kernel"] + d["bias"]
+
+    h = jax.nn.leaky_relu(dense("proj", ctx), negative_slope=0.01)
+    mu = dense("mu", h)[:, 0]
+    sigma = jax.nn.softplus(dense("sigma", h))[:, 0]
+    return mu, sigma
