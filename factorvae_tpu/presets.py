@@ -17,6 +17,7 @@ def _csi300(num_factors: int, hidden: int, run: str) -> Config:
         model=ModelConfig(
             num_features=158, hidden_size=hidden, num_factors=num_factors,
             num_portfolios=128, seq_len=20,
+            compute_dtype="bfloat16",
         ),
         data=DataConfig(dataset_path="./data/csi_data.pkl", seq_len=20),
         train=TrainConfig(run_name=run),
@@ -33,7 +34,8 @@ PRESETS = {
     # BASELINE.json config 4: CSI800 full cross-section (N ~= 800)
     "csi800-k60": Config(
         model=ModelConfig(num_features=158, hidden_size=60, num_factors=60,
-                          num_portfolios=128, seq_len=20),
+                          num_portfolios=128, seq_len=20,
+                          compute_dtype="bfloat16"),
         data=DataConfig(dataset_path="./data/csi800_data.pkl", seq_len=20,
                         max_stocks=1024),
         train=TrainConfig(run_name="csi800_k60"),
@@ -41,7 +43,8 @@ PRESETS = {
     # BASELINE.json config 5: Alpha360 features, seq_len=60
     "alpha360-k60": Config(
         model=ModelConfig(num_features=360, hidden_size=60, num_factors=60,
-                          num_portfolios=128, seq_len=60),
+                          num_portfolios=128, seq_len=60,
+                          compute_dtype="bfloat16"),
         data=DataConfig(dataset_path="./data/csi_alpha360.pkl", seq_len=60),
         train=TrainConfig(run_name="alpha360_k60"),
     ),

@@ -151,6 +151,26 @@ class TestCLIDefaults:
         assert p.parse_args(["--deterministic_scores"]).stochastic_scores is False
         assert ModelConfig().stochastic_inference is True
 
+    def test_bf16_is_the_cli_and_preset_default(self):
+        """VERDICT r2 #8: the documented CLI line must get the measured-best
+        dtype (bf16, PERF.md) — default != recommendation was a footgun.
+        --no-bf16 opts back into float32 on both CLI paths."""
+        from factorvae_tpu.cli import build_parser, config_from_args
+        from factorvae_tpu.presets import PRESETS
+
+        p = build_parser()
+        assert config_from_args(p.parse_args([])).model.compute_dtype == "bfloat16"
+        assert config_from_args(
+            p.parse_args(["--no-bf16"])).model.compute_dtype == "float32"
+        assert config_from_args(
+            p.parse_args(["--preset", "csi300-k20"])
+        ).model.compute_dtype == "bfloat16"
+        assert config_from_args(
+            p.parse_args(["--preset", "csi300-k20", "--no-bf16"])
+        ).model.compute_dtype == "float32"
+        for name, cfg in PRESETS.items():
+            assert cfg.model.compute_dtype == "bfloat16", name
+
     def test_behavior_flags_survive_presets(self):
         """--deterministic_scores / --recon_loss are runtime behavior, not
         architecture: a preset must not silently discard them."""
