@@ -142,9 +142,22 @@ class GRU(nn.Module):
         use_pallas = resolve(
             self.use_pallas, pallas_gru_wins(n, t, h_dim))
         if use_pallas and not self.return_sequence:
-            from factorvae_tpu.ops.pallas.gru import gru_scan
+            from factorvae_tpu.ops.pallas.gru import backward_fits, gru_scan
 
-            return gru_scan(xi.astype(jnp.float32), w_h, b_h).astype(dtype)
+            if backward_fits(n, t, h_dim):
+                return gru_scan(xi.astype(jnp.float32), w_h, b_h).astype(dtype)
+            # A divisor-free (prime) T forces the kernel's full-sequence
+            # backward, whose VMEM footprint grows linearly in T and can
+            # exceed the scoped budget on a real chip (ADVICE r2); the
+            # XLA scan below is always safe, so it overrides even an
+            # explicit use_pallas=True.
+            import warnings
+
+            warnings.warn(
+                f"pallas GRU backward does not fit VMEM at T={t}, H={h_dim} "
+                "(divisor-free sequence length); using the XLA scan path",
+                stacklevel=2,
+            )
 
         w_h = w_h.astype(dtype)
         b_h = b_h.astype(dtype)

@@ -277,17 +277,36 @@ def _block_setup(n_rows: int, t_len: int, h_dim: int):
 
 
 def _segment_len(t_len: int) -> int:
-    """Largest divisor of T in [_SEG_MIN, _SEG_MAX] (so segments tile T
-    exactly and each carries enough work to amortize the per-segment
-    hseq refill / carry round-trip); falls back to T itself when none
-    exists (then the full-sequence path is used — e.g. prime T, or
-    T = 2 * prime whose only small divisor is a degenerate 2)."""
+    """Segment length for the checkpointed backward: the largest divisor
+    of T in [_SEG_MIN, _SEG_MAX] (segments tile T exactly and each
+    carries enough work to amortize the per-segment hseq refill / carry
+    round-trip). When none exists (ADVICE r2: e.g. T = 2 * prime), any
+    divisor >= 2 still bounds per-row VMEM by the segment length, so a
+    degenerate-but-safe short segment beats the full-sequence path whose
+    footprint grows linearly in T; only divisor-free (prime) T falls all
+    the way back to T itself (full-sequence path — see `backward_fits`
+    for the guard that keeps that fallback inside the VMEM budget)."""
     if t_len <= _SEG_MAX:
         return t_len
-    for s in range(_SEG_MAX, _SEG_MIN - 1, -1):
+    for s in range(_SEG_MAX, 1, -1):
         if t_len % s == 0:
             return s
     return t_len
+
+
+def backward_fits(n_rows: int, t_len: int, h_dim: int) -> bool:
+    """Whether some backward path fits the scoped-VMEM budget at the
+    minimum 8-row block (ADVICE r2): the segmented path caps per-row
+    bytes by the segment length, but a divisor-free T forces the
+    full-sequence path, whose per-row footprint grows linearly in T and
+    can exceed the 16 MB scoped-VMEM limit on a real chip even at nb=8.
+    Callers (models/layers.py GRU) must fall back to the XLA scan when
+    this is False — including under use_pallas=True."""
+    del n_rows  # blocking already clamps rows; the floor is 8
+    s_len = _segment_len(t_len)
+    extra = 3 if s_len < t_len else 1  # checkpoint + carry blocks
+    per_row = 2 * (13 * s_len + extra) * h_dim * 4
+    return 8 * per_row <= _VMEM_BUDGET
 
 
 def _segment_setup(n_rows: int, t_len: int, h_dim: int):

@@ -40,6 +40,7 @@ def ring_cross_section_attention(
     axis_name: str,
     relu_scores: bool = True,
     scale: float | None = None,
+    guard_nonfinite: bool = False,
 ) -> jnp.ndarray:
     """Exact masked softmax attention over the ring; returns (K, H).
 
@@ -50,6 +51,13 @@ def ring_cross_section_attention(
     (K, n_local, H) chunks are per-head keys/values — the real
     FactorPredictor's layout (each reference AttentionLayer has its own
     key/value Linears, module.py:131-137).
+
+    guard_nonfinite=True reproduces the reference's per-head NaN/Inf
+    guard (module.py:149-150, same keying as models/predictor.py): a head
+    with any non-finite score over the valid cross-section yields a zero
+    context. The flag is tracked through the online-softmax fold, so the
+    guard is exact even though each device only ever sees one chunk of
+    scores at a time.
     """
     k_heads, h_dim = query.shape
     if scale is None:
@@ -68,8 +76,16 @@ def ring_cross_section_attention(
         return jnp.where(chunk_mask[None, :], s, _NEG_INF)
 
     def fold(stats, ck, cv, cm):
-        m, l, acc = stats
+        m, l, acc, bad = stats
         s = scores_for(ck, cm)                               # (K, n)
+        # masked-off positions hold the finite _NEG_INF sentinel, so any
+        # non-finite entry here came from a *valid* stock's score
+        bad = bad | jnp.any(~jnp.isfinite(s), axis=-1)
+        # masked rows of the value chunk may be NaN (padded stocks); they
+        # get weight 0 below, but 0 * NaN would still poison the
+        # accumulator (same hazard the dense path neutralizes with
+        # nan_to_num, models/predictor.py)
+        cv = jnp.where((cm[None, :, None] if per_head else cm[:, None]), cv, 0.0)
         chunk_max = jnp.max(s, axis=-1)                      # (K,)
         m_new = jnp.maximum(m, chunk_max)
         corr = jnp.exp(m - m_new)                            # rescale old stats
@@ -80,7 +96,7 @@ def ring_cross_section_attention(
             acc_new = acc * corr[:, None] + jnp.einsum("kn,knh->kh", p, cv)
         else:
             acc_new = acc * corr[:, None] + p @ cv           # (K, H)
-        return (m_new, l_new, acc_new)
+        return (m_new, l_new, acc_new, bad)
 
     def body(carry, _):
         (ck, cv, cm), stats = carry
@@ -93,31 +109,42 @@ def ring_cross_section_attention(
     m0 = jnp.full((k_heads,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((k_heads,), jnp.float32)
     acc0 = jnp.zeros((k_heads, h_dim), jnp.float32)
-    init = ((key_local, value_local, mask_local), (m0, l0, acc0))
+    bad0 = jnp.zeros((k_heads,), bool)
+    init = ((key_local, value_local, mask_local), (m0, l0, acc0, bad0))
     # rotate only between folds: R-1 fold+rotate steps, final fold outside
     ((ck, cv, cm), stats), _ = lax.scan(body, init, None, length=ring_size - 1)
-    m, l, acc = fold(stats, ck, cv, cm)
+    m, l, acc, bad = fold(stats, ck, cv, cm)
     # fully-masked cross-section -> zero context (reference NaN-guard
-    # semantics, module.py:149-150)
+    # semantics, module.py:149-150); non-finite scores likewise when the
+    # guard is on
     safe = l > 0
+    if guard_nonfinite:
+        safe = safe & ~bad
     return jnp.where(safe[:, None], acc / jnp.where(safe, l, 1.0)[:, None], 0.0)
 
 
-def predictor_prior_ring(params, latent, mask, mesh, axis_name: str = "stock"):
+def predictor_prior_ring(
+    params, latent, mask, mesh, axis_name: str = "stock", cfg=None
+):
     """The REAL FactorPredictor prior (mu_prior, sigma_prior) computed
     context-parallel: the cross-section is sharded over `axis_name`,
     each device builds only its LOCAL (K, n_local, H) key/value chunks
     from its latent shard, and ring attention assembles the exact (K, H)
     contexts without ever gathering the full cross-section — the
     explicit-collectives counterpart of models/predictor.py's dense
-    einsum path (dropout-off semantics; tested equal). The shared head
-    MLP (module.py:181-187) then runs replicated.
+    einsum path (dropout-off semantics; equality is asserted by
+    tests/test_collectives.py::TestRingAttention). The shared head MLP
+    (module.py:181-187) then runs replicated, including the per-head
+    non-finite-score zero-context guard (module.py:149-150).
 
-    `params` is a FactorPredictor variable tree (or its 'params' leaf).
+    `params` is a FactorPredictor variable tree (or its 'params' leaf);
+    `cfg` an optional ModelConfig supplying `leaky_relu_slope` (defaults
+    to the torch default 0.01 the reference uses).
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    slope = cfg.leaky_relu_slope if cfg is not None else 0.01
     p = params.get("params", params)
     query = p["query"].astype(jnp.float32)
     w_key, b_key = p["key_kernel"], p["key_bias"]
@@ -127,21 +154,21 @@ def predictor_prior_ring(params, latent, mask, mesh, axis_name: str = "stock"):
         keys = jnp.einsum("nh,khj->knj", lat_l, w_key) + b_key[:, None, :]
         vals = jnp.einsum("nh,khj->knj", lat_l, w_val) + b_val[:, None, :]
         ctx = ring_cross_section_attention(
-            query, keys, vals, mask_l, axis_name)
+            query, keys, vals, mask_l, axis_name, guard_nonfinite=True)
         return ctx
 
     ctx = shard_map(
         local, mesh=mesh,
         in_specs=(P(axis_name, None), P(axis_name)),
         out_specs=P(),                      # replicated (K, H) context
-        check_rep=False,
+        check_vma=False,
     )(latent.astype(jnp.float32), mask)
 
     def dense(name, x):
         d = p[name]["Dense_0"]
         return x @ d["kernel"] + d["bias"]
 
-    h = jax.nn.leaky_relu(dense("proj", ctx), negative_slope=0.01)
+    h = jax.nn.leaky_relu(dense("proj", ctx), negative_slope=slope)
     mu = dense("mu", h)[:, 0]
     sigma = jax.nn.softplus(dense("sigma", h))[:, 0]
     return mu, sigma

@@ -112,6 +112,12 @@ def summarize_trace(
     return {
         "files": files,
         "device_pids": device_pids,
+        # NOTE (ADVICE r2): durations are summed across ALL matched lanes
+        # and threads. On a multi-device (or multi-stream) capture,
+        # overlapping execution is counted once per lane, so total_us can
+        # legitimately exceed wall time; num_lanes is surfaced so readers
+        # can tell aggregate device-time from wall time.
+        "num_lanes": len(device_pids),
         "total_us": total,
         "by_name": by_name,
     }
@@ -124,7 +130,13 @@ def format_summary(s: dict) -> str:
     lines.append(f"trace files : {len(s['files'])}")
     lanes = ", ".join(str(v) for v in s["device_pids"].values()) or "(none)"
     lines.append(f"device lanes: {lanes}")
-    lines.append(f"device time : {s['total_us'] / 1e3:.3f} ms")
+    n_lanes = s.get("num_lanes", len(s["device_pids"]))
+    qualifier = (
+        f" (summed across {n_lanes} lanes; overlapping execution counts "
+        "once per lane, so this can exceed wall time)"
+        if n_lanes > 1 else ""
+    )
+    lines.append(f"device time : {s['total_us'] / 1e3:.3f} ms{qualifier}")
     if s["by_name"]:
         width = max(len(n) for n, _, _ in s["by_name"])
         lines.append(f"{'kernel/fusion':<{width}}  {'total':>10}  {'count':>6}  share")

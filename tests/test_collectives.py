@@ -180,6 +180,55 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
                                    atol=1e-6)
 
+    def test_predictor_prior_ring_matches_dense_predictor(self, mesh, rng):
+        """The REAL prior path (ADVICE r2): predictor_prior_ring — 3-D
+        per-head keys/values around the ring + replicated head MLP — must
+        equal FactorPredictor.apply (dropout off), including at a
+        non-default leaky_relu_slope (the slope must come from the config,
+        not a hard-coded torch default)."""
+        from factorvae_tpu.config import ModelConfig
+        from factorvae_tpu.models.predictor import FactorPredictor
+        from factorvae_tpu.parallel.ring import predictor_prior_ring
+
+        for slope in (0.01, 0.2):
+            cfg = ModelConfig(num_features=8, hidden_size=8, num_factors=5,
+                              num_portfolios=6, seq_len=4,
+                              leaky_relu_slope=slope)
+            latent = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+            mask = jnp.asarray(rng.random(64) > 0.25)
+            params = FactorPredictor(cfg).init(
+                jax.random.PRNGKey(0), latent, mask)
+            mu_d, sig_d = FactorPredictor(cfg).apply(params, latent, mask)
+            mu_r, sig_r = predictor_prior_ring(
+                params, latent, mask, mesh, "stock", cfg=cfg)
+            np.testing.assert_allclose(np.asarray(mu_r), np.asarray(mu_d),
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(sig_r), np.asarray(sig_d),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_predictor_prior_ring_nonfinite_guard(self, mesh, rng):
+        """A NaN latent poisons every head's scores; the ring path must
+        reproduce the dense path's zero-context guard (module.py:149-150)
+        instead of returning NaN priors."""
+        from factorvae_tpu.config import ModelConfig
+        from factorvae_tpu.models.predictor import FactorPredictor
+        from factorvae_tpu.parallel.ring import predictor_prior_ring
+
+        cfg = ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=4)
+        latent = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+        latent = latent.at[3, 2].set(jnp.nan)   # a *valid* stock goes NaN
+        mask = jnp.ones(64, bool)
+        params = FactorPredictor(cfg).init(jax.random.PRNGKey(0), latent, mask)
+        mu_d, sig_d = FactorPredictor(cfg).apply(params, latent, mask)
+        mu_r, sig_r = predictor_prior_ring(
+            params, latent, mask, mesh, "stock", cfg=cfg)
+        assert np.isfinite(np.asarray(mu_r)).all()
+        np.testing.assert_allclose(np.asarray(mu_r), np.asarray(mu_d),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sig_r), np.asarray(sig_d),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_fully_masked_gives_zero_context(self, mesh, rng):
         from factorvae_tpu.parallel.ring import ring_cross_section_attention
 

@@ -55,6 +55,7 @@ class TestGruKernel:
             (72, 60, 8),   # multi row-block x 3 segments (S=20)
             (10, 50, 4),   # S=10, 5 segments
             (6, 29, 4),    # prime T > _SEG_MAX: full-sequence fallback
+            (6, 58, 4),    # T = 2*29: short-divisor segments (ADVICE r2)
         ],
     )
     def test_long_sequence_backward_matches_scan(self, rng, n, t, h):
@@ -68,6 +69,8 @@ class TestGruKernel:
             assert _segment_len(t) == t          # fallback engaged
         else:
             assert _segment_len(t) < t           # segmentation engaged
+        if t == 58:
+            assert _segment_len(t) == 2          # only small divisor exists
         xi = jnp.asarray(rng.normal(size=(n, t, 3 * h)) * 0.5, jnp.float32)
         wh = jnp.asarray(rng.normal(size=(h, 3 * h)) * 0.3, jnp.float32)
         bh = jnp.asarray(rng.normal(size=(3 * h,)) * 0.1, jnp.float32)
@@ -84,6 +87,29 @@ class TestGruKernel:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=2e-5)
+
+    def test_unfittable_backward_falls_back_to_xla(self, rng):
+        """ADVICE r2: at a divisor-free T large enough that even the 8-row
+        full-sequence backward exceeds the VMEM budget, the module must
+        warn and take the XLA scan — never launch an OOM-bound kernel,
+        even under use_pallas=True."""
+        import warnings as _w
+
+        from factorvae_tpu.ops.pallas.gru import backward_fits
+
+        n, t, h = 4, 241, 64                    # 241 prime, ~1.6 MB/row
+        assert not backward_fits(n, t, h)
+        assert backward_fits(n, 240, h)         # divisor-rich neighbour
+        x = jnp.asarray(rng.normal(size=(n, t, 8)), jnp.float32)
+        base = GRU(hidden_size=h)
+        params = base.init(jax.random.PRNGKey(0), x)
+        want = base.apply(params, x)
+        with _w.catch_warnings(record=True) as caught:
+            _w.simplefilter("always")
+            got = GRU(hidden_size=h, use_pallas=True).apply(params, x)
+        assert any("does not fit VMEM" in str(c.message) for c in caught)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
 
     def test_gru_module_flag_parity(self, rng):
         """GRU(use_pallas=True) == GRU(use_pallas=False) with shared params."""
