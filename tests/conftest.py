@@ -27,6 +27,46 @@ from factorvae_tpu.utils.testing import enable_persistent_compile_cache  # noqa:
 enable_persistent_compile_cache()
 
 
+# ---- quick/slow tiering (VERDICT r2 #9) ---------------------------------
+# `pytest -m quick` is the <2-min core tier for iteration; `-m slow` holds
+# the parallel/collectives block, Pallas kernel races, backtest scenario
+# sweeps and subprocess harnesses. Files below are slow wholesale;
+# individual tests elsewhere can opt in with @pytest.mark.slow.
+_SLOW_FILES = {
+    "test_collectives.py",       # 8-device shard_map + Pallas interpret
+    "test_parallel.py",          # mesh/sharding/HLO-assertion block
+    "test_pallas_gru.py",        # kernel BPTT oracles
+    "test_multihost.py",         # 2-process jax.distributed subprocesses
+    "test_bench.py",             # bench.py subprocess contract runs
+    "test_train.py",             # whole-epoch jit compiles
+    "test_eval.py",              # trained-model fixtures, CLI end-to-end
+    "test_quant.py",             # trained-model fixture
+    "test_reference_oracle.py",  # flagship-shape torch+jax compiles
+}
+# Heavy classes inside otherwise-quick files (full-model jit compiles).
+_SLOW_CLASSES = {
+    "TestDayBatched", "TestFlattenedDayBatch", "TestBaselineConfigShapes",
+    "TestMaskingInvariance", "TestLoadModelFactory", "TestBf16Training",
+    "TestStackedGRU", "TestNaNGuard", "TestKernelAutoSelect",
+}
+_SLOW_TESTS = {"test_flax_default_init_path"}
+# Fast parser/config tests inside slow files stay quick for iteration.
+_QUICK_CLASSES = {"TestCLIDefaults"}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        cls = item.cls.__name__ if item.cls else ""
+        slow = (
+            fname in _SLOW_FILES
+            or cls in _SLOW_CLASSES
+            or item.originalname in _SLOW_TESTS
+            or item.get_closest_marker("slow") is not None
+        ) and cls not in _QUICK_CLASSES
+        item.add_marker(pytest.mark.slow if slow else pytest.mark.quick)
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
