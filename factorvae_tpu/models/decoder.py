@@ -28,12 +28,17 @@ class AlphaLayer(nn.Module):
 
     @nn.compact
     def __call__(self, latent: jnp.ndarray):
-        """latent: (N, H) -> (alpha_mu, alpha_sigma), each (N,)."""
+        """latent: (..., N, H) -> (alpha_mu, alpha_sigma), each (..., N).
+
+        Shape-generic on purpose: the flattened day-batched decoder feeds
+        the whole (B, N, H) block through in one matmul (VERDICT r2 #2)."""
         cfg = self.cfg
         h = Dense(cfg.hidden_size, torch_init=cfg.torch_init, name="proj")(latent)
         h = nn.leaky_relu(h, negative_slope=cfg.leaky_relu_slope)   # module.py:80-81
-        mu = Dense(1, torch_init=cfg.torch_init, name="mu")(h)[:, 0]
-        sigma = nn.softplus(Dense(1, torch_init=cfg.torch_init, name="sigma")(h))[:, 0]
+        mu = Dense(1, torch_init=cfg.torch_init, name="mu")(h)[..., 0]
+        sigma = nn.softplus(
+            Dense(1, torch_init=cfg.torch_init, name="sigma")(h)
+        )[..., 0]
         return mu, sigma
 
 
@@ -56,12 +61,21 @@ class FactorDecoder(nn.Module):
         self.beta_layer = BetaLayer(self.cfg)
 
     def distribution(self, latent, factor_mu, factor_sigma):
-        """Per-stock return distribution (mu, sigma), each (N,)."""
+        """Per-stock return distribution (mu, sigma), each (..., N).
+
+        Shape-generic: latent (..., N, H) with factors (..., K) — the
+        single-day path passes (N, H)/(K,), the cross-day-flattened path
+        (B, N, H)/(B, K); both share this one copy of the
+        reference-pinned combine math."""
         alpha_mu, alpha_sigma = self.alpha_layer(latent)
         beta = self.beta_layer(latent)
         factor_sigma = jnp.where(factor_sigma == 0.0, 1e-6, factor_sigma)  # :117
-        mu = alpha_mu + beta @ factor_mu                                   # :120
-        sigma = jnp.sqrt(alpha_sigma**2 + (beta**2) @ (factor_sigma**2) + 1e-6)  # :121
+        mu = alpha_mu + jnp.einsum("...nk,...k->...n", beta, factor_mu)    # :120
+        sigma = jnp.sqrt(
+            alpha_sigma**2
+            + jnp.einsum("...nk,...k->...n", beta**2, factor_sigma**2)
+            + 1e-6
+        )                                                                  # :121
         return mu, sigma
 
     def __call__(self, latent, factor_mu, factor_sigma, *, sample: bool = True):
@@ -73,5 +87,21 @@ class FactorDecoder(nn.Module):
         mu, sigma = self.distribution(latent, factor_mu, factor_sigma)
         if sample:
             eps = jax.random.normal(self.make_rng("sample"), sigma.shape)  # :103-105
+            return mu + eps * sigma, (mu, sigma)
+        return mu, (mu, sigma)
+
+    def day_batched(self, latent, factor_mu, factor_sigma, *, sample: bool = True):
+        """Cross-day-flattened decode (VERDICT r2 #2): latent (B, N, H),
+        factor_mu/sigma (B, K) -> sample (B, N) + distribution.
+
+        The alpha/beta heads inside `distribution` are day-independent
+        per-stock Denses, so they see the whole (B, N, H) block as one
+        tall matmul; only the (B, N, K) x (B, K) factor combination is
+        day-local — elementwise-plus-reduction, not a launch-bound
+        matmul. One (B, N) eps draw replaces the per-day split rngs
+        (iid either way)."""
+        mu, sigma = self.distribution(latent, factor_mu, factor_sigma)
+        if sample:
+            eps = jax.random.normal(self.make_rng("sample"), sigma.shape)
             return mu + eps * sigma, (mu, sigma)
         return mu, (mu, sigma)

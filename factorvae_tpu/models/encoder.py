@@ -9,6 +9,10 @@ Softplus -> posterior (mu_post, sigma_post) in (K,).
 The softmax over stocks becomes a masked softmax so padded stocks carry
 exactly zero portfolio weight; the portfolio matmul then needs no separate
 masking.
+
+`day_batched` is the cross-day-flattened variant (VERDICT r2 #2): the
+per-stock portfolio Dense runs on the full (B, N, H) block in one matmul;
+only the stock-axis softmax and the portfolio contraction stay per-day.
 """
 
 from __future__ import annotations
@@ -24,18 +28,34 @@ from factorvae_tpu.ops.masked import masked_softmax
 class FactorEncoder(nn.Module):
     cfg: ModelConfig
 
-    @nn.compact
+    def setup(self):
+        cfg = self.cfg
+        self.portfolio = Dense(cfg.num_portfolios, torch_init=cfg.torch_init)
+        self.mu = Dense(cfg.num_factors, torch_init=cfg.torch_init)
+        self.sigma = Dense(cfg.num_factors, torch_init=cfg.torch_init)
+
     def __call__(self, latent: jnp.ndarray, returns: jnp.ndarray, mask: jnp.ndarray):
         """latent: (N, H), returns: (N,), mask: (N,) -> ((K,), (K,))."""
-        cfg = self.cfg
-        w = Dense(cfg.num_portfolios, torch_init=cfg.torch_init, name="portfolio")(
-            latent
-        )                                                     # module.py:56
+        w = self.portfolio(latent)                            # module.py:56
         w = masked_softmax(w, mask[:, None], axis=0)          # module.py:57 (dim=0)
         returns = jnp.where(mask, returns, 0.0)
         y_p = w.T @ returns                                   # module.py:64, (M,)
-        mu = Dense(cfg.num_factors, torch_init=cfg.torch_init, name="mu")(y_p)
-        sigma = nn.softplus(
-            Dense(cfg.num_factors, torch_init=cfg.torch_init, name="sigma")(y_p)
-        )                                                     # module.py:44-50
+        mu = self.mu(y_p)
+        sigma = nn.softplus(self.sigma(y_p))                  # module.py:44-50
+        return mu, sigma
+
+    def day_batched(
+        self, latent: jnp.ndarray, returns: jnp.ndarray, mask: jnp.ndarray
+    ):
+        """latent: (B, N, H), returns/mask: (B, N) -> ((B, K), (B, K)).
+
+        Same math as `__call__` per day; the Dense layers see the whole
+        (B·N | B) row block so the MXU is fed B-fold-taller matmuls.
+        """
+        w = self.portfolio(latent)                            # (B, N, M)
+        w = masked_softmax(w, mask[..., None], axis=1)        # softmax over stocks
+        returns = jnp.where(mask, returns, 0.0)
+        y_p = jnp.einsum("bnm,bn->bm", w, returns)            # (B, M)
+        mu = self.mu(y_p)
+        sigma = nn.softplus(self.sigma(y_p))
         return mu, sigma

@@ -7,6 +7,9 @@ independent single-head attention modules in a Python loop
 worst accelerator-utilization sin (SURVEY.md §3.5). Here all K heads run
 as three batched einsums over a (K, H, H) weight stack; the math per head
 is identical because the reference heads share nothing but their input.
+`day_batched` additionally carries a leading day axis through every einsum
+(VERDICT r2 #2 cross-day flattening), so B days' heads land on the MXU as
+one contraction instead of B.
 
 Faithfully preserved quirks:
 - scores = q . K^T / sqrt(H + 1e-6)  (module.py:140-142)
@@ -31,56 +34,84 @@ from factorvae_tpu.ops.masked import masked_softmax
 class FactorPredictor(nn.Module):
     cfg: ModelConfig
 
-    @nn.compact
-    def __call__(self, latent: jnp.ndarray, mask: jnp.ndarray, *, train: bool = False):
-        """latent: (N, H), mask: (N,) -> prior (mu_prior, sigma_prior), each (K,)."""
+    def setup(self):
         cfg = self.cfg
         k, h = cfg.num_factors, cfg.hidden_size
+        init = (
+            torch_uniform_init(h) if cfg.torch_init
+            else nn.initializers.lecun_normal()
+        )
+        self.query = self.param("query", nn.initializers.normal(1.0), (k, h))
+        self.key_kernel = self.param("key_kernel", init, (k, h, h))
+        self.key_bias = self.param("key_bias", init, (k, h))
+        self.value_kernel = self.param("value_kernel", init, (k, h, h))
+        self.value_bias = self.param("value_bias", init, (k, h))
+        self.proj = Dense(h, torch_init=cfg.torch_init)
+        self.mu = Dense(1, torch_init=cfg.torch_init)
+        self.sigma = Dense(1, torch_init=cfg.torch_init)
 
-        query = self.param("query", nn.initializers.normal(1.0), (k, h))
-        init = torch_uniform_init(h) if cfg.torch_init else nn.initializers.lecun_normal()
-        w_key = self.param("key_kernel", init, (k, h, h))
-        b_key = self.param("key_bias", init, (k, h))
-        w_val = self.param("value_kernel", init, (k, h, h))
-        b_val = self.param("value_bias", init, (k, h))
+    def _heads(self, context: jnp.ndarray):
+        """Shared head MLP (module.py:181-187); context (..., K, H)."""
+        cfg = self.cfg
+        h_multi = self.proj(context)
+        h_multi = nn.leaky_relu(h_multi, negative_slope=cfg.leaky_relu_slope)
+        mu = self.mu(h_multi)[..., 0]
+        sigma = nn.softplus(self.sigma(h_multi))[..., 0]
+        return mu, sigma
 
+    def _use_pallas(self, n: int) -> bool:
         from factorvae_tpu.ops.pallas.select import (
             pallas_attention_wins,
             resolve,
         )
 
-        use_pallas = resolve(
+        cfg = self.cfg
+        return resolve(
             cfg.use_pallas_attention,
-            pallas_attention_wins(latent.shape[0], h, k),
+            pallas_attention_wins(n, cfg.hidden_size, cfg.num_factors),
         )
-        if use_pallas:
+
+    def _dropout_mask(self, shape):
+        """Reference score dropout (module.py:144, before the ReLU) as an
+        explicit inverted-scale keep-mask from the flax 'dropout' rng —
+        shared by the Pallas path (drawn outside the kernel) and the
+        batched einsum path (one draw covers all days; iid either way)."""
+        cfg = self.cfg
+        keep_p = 1.0 - cfg.dropout_rate
+        keep = jax.random.bernoulli(self.make_rng("dropout"), keep_p, shape)
+        return keep.astype(jnp.float32) / keep_p
+
+    def __call__(self, latent: jnp.ndarray, mask: jnp.ndarray, *, train: bool = False):
+        """latent: (N, H), mask: (N,) -> prior (mu_prior, sigma_prior), each (K,)."""
+        cfg = self.cfg
+        k, h = cfg.num_factors, cfg.hidden_size
+
+        if self._use_pallas(latent.shape[0]):
             # Fused Pallas kernel: never materializes the (K, N, H)
             # key/value stacks in HBM, and is differentiable (custom VJP
             # with flash-style recompute backward), so it serves inference
-            # AND training. The reference's score dropout (module.py:144,
-            # applied before the ReLU) is a tiny (K, N) keep-mask drawn
-            # outside the kernel from the flax 'dropout' rng.
+            # AND training.
             from factorvae_tpu.ops.pallas.attention_grad import fused_attention
 
             dropout_mask = None
             if train and cfg.dropout_rate > 0.0:
-                keep_p = 1.0 - cfg.dropout_rate
-                keep = jax.random.bernoulli(
-                    self.make_rng("dropout"), keep_p, (k, latent.shape[0])
-                )
-                dropout_mask = keep.astype(jnp.float32) / keep_p
+                dropout_mask = self._dropout_mask((k, latent.shape[0]))
             context = fused_attention(
-                latent, mask.astype(jnp.float32), query, w_key, b_key,
-                w_val, b_val, dropout_mask,
+                latent, mask.astype(jnp.float32), self.query,
+                self.key_kernel, self.key_bias,
+                self.value_kernel, self.value_bias, dropout_mask,
             )
         else:
             # All K per-head Linears at once: (N,H) x (K,H,H) -> (K,N,H).
-            keys = jnp.einsum("nh,khj->knj", latent, w_key) + b_key[:, None, :]
-            values = jnp.einsum("nh,khj->knj", latent, w_val) + b_val[:, None, :]
+            keys = (jnp.einsum("nh,khj->knj", latent, self.key_kernel)
+                    + self.key_bias[:, None, :])
+            values = (jnp.einsum("nh,khj->knj", latent, self.value_kernel)
+                      + self.value_bias[:, None, :])
 
-            scores = jnp.einsum("kh,knh->kn", query, keys)
+            scores = jnp.einsum("kh,knh->kn", self.query, keys)
             scores = scores / jnp.sqrt(jnp.float32(h) + 1e-6)   # module.py:142
-            scores = nn.Dropout(cfg.dropout_rate)(scores, deterministic=not train)
+            if train and cfg.dropout_rate > 0.0:                # module.py:144
+                scores = scores * self._dropout_mask(scores.shape)
             scores = nn.relu(scores)                            # module.py:145
             attn = masked_softmax(scores, mask[None, :], axis=-1)  # module.py:146
 
@@ -98,10 +129,64 @@ class FactorPredictor(nn.Module):
                 bad, 0.0, jnp.einsum("kn,knh->kh", attn, jnp.nan_to_num(values))
             )                                                   # (K, H)
 
-        h_multi = Dense(h, torch_init=cfg.torch_init, name="proj")(context)
-        h_multi = nn.leaky_relu(h_multi, negative_slope=cfg.leaky_relu_slope)
-        mu = Dense(1, torch_init=cfg.torch_init, name="mu")(h_multi)[:, 0]
-        sigma = nn.softplus(Dense(1, torch_init=cfg.torch_init, name="sigma")(h_multi))[
-            :, 0
-        ]                                                       # module.py:181-187
-        return mu, sigma
+        return self._heads(context)
+
+    def day_batched(
+        self, latent: jnp.ndarray, mask: jnp.ndarray, *, train: bool = False
+    ):
+        """latent: (B, N, H), mask: (B, N) -> ((B, K), (B, K)).
+
+        Identical per-day math to `__call__`; the key/value/score einsums
+        and the head MLP contract over B days at once. The stock-axis
+        softmax and the per-(day, head) non-finite guard remain day-local
+        reductions, as they must.
+        """
+        cfg = self.cfg
+        k, h = cfg.num_factors, cfg.hidden_size
+        b, n = latent.shape[0], latent.shape[1]
+
+        if self._use_pallas(n):
+            # The kernel is single-day; batch it with a plain vmap (its
+            # custom VJP and pallas_call both carry batching rules) —
+            # exactly what the nn.vmap day lift did before flattening.
+            from factorvae_tpu.ops.pallas.attention_grad import fused_attention
+
+            dropout_mask = None
+            if train and cfg.dropout_rate > 0.0:
+                dropout_mask = self._dropout_mask((b, k, n))
+            query, wk, bk = self.query, self.key_kernel, self.key_bias
+            wv, bv = self.value_kernel, self.value_bias
+            if dropout_mask is None:
+                context = jax.vmap(
+                    lambda lat, m: fused_attention(
+                        lat, m, query, wk, bk, wv, bv, None)
+                )(latent, mask.astype(jnp.float32))
+            else:
+                context = jax.vmap(
+                    lambda lat, m, dm: fused_attention(
+                        lat, m, query, wk, bk, wv, bv, dm)
+                )(latent, mask.astype(jnp.float32), dropout_mask)
+        else:
+            keys = (jnp.einsum("bnh,khj->bknj", latent, self.key_kernel)
+                    + self.key_bias[None, :, None, :])
+            values = (jnp.einsum("bnh,khj->bknj", latent, self.value_kernel)
+                      + self.value_bias[None, :, None, :])
+
+            scores = jnp.einsum("kh,bknh->bkn", self.query, keys)
+            scores = scores / jnp.sqrt(jnp.float32(h) + 1e-6)
+            if train and cfg.dropout_rate > 0.0:
+                scores = scores * self._dropout_mask(scores.shape)
+            scores = nn.relu(scores)
+            attn = masked_softmax(scores, mask[:, None, :], axis=-1)
+
+            bad = jnp.any(
+                ~jnp.isfinite(jnp.where(mask[:, None, :], scores, 0.0)),
+                axis=-1, keepdims=True,
+            )                                                   # (B, K, 1)
+            attn = jnp.where(bad, 0.0, attn)
+            context = jnp.where(
+                bad, 0.0,
+                jnp.einsum("bkn,bknh->bkh", attn, jnp.nan_to_num(values)),
+            )                                                   # (B, K, H)
+
+        return self._heads(context)
