@@ -57,16 +57,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "Gaussian NLL (default: mse, or the preset's choice)")
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
                    default=None,
-                   help="bfloat16 compute dtype (--no-bf16 forces float32 "
-                        "even when a preset enables bf16)")
+                   help="bfloat16 compute dtype — the default on every CLI "
+                        "path and preset (measured-best on TPU, PERF.md); "
+                        "--no-bf16 forces float32")
     p.add_argument("--pallas", action=argparse.BooleanOptionalAction,
                    default=None,
-                   help="use the fused Pallas kernels (attention + GRU "
-                        "recurrence, ops/pallas/) for compute; --no-pallas "
-                        "overrides a preset that enables them")
+                   help="force the fused Pallas kernels (attention + GRU "
+                        "recurrence, ops/pallas/) on (--pallas) or off "
+                        "(--no-pallas). Default: 'auto' — per-shape choice "
+                        "from the measured on-chip race "
+                        "(ops/pallas/select.py)")
     p.add_argument("--pallas_auto", action="store_true",
-                   help="per-shape kernel choice from the measured v5e "
-                        "race (ops/pallas/select.py); overrides --pallas")
+                   help="deprecated alias of the default 'auto' behavior "
+                        "(kept for round-2 command lines)")
     p.add_argument("--max_stocks", type=int, default=None,
                    help="cross-section padding N_max (default: inferred)")
     p.add_argument("--score_only", action="store_true",
@@ -205,11 +208,17 @@ def config_from_args(args: argparse.Namespace) -> Config:
             num_portfolios=args.num_portfolio,
             seq_len=args.seq_len,
             recon_loss=args.recon_loss or "mse",
-            compute_dtype="bfloat16" if args.bf16 else "float32",
+            # bf16 is the measured-best default on TPU (PERF.md); --no-bf16
+            # opts back into float32 compute.
+            compute_dtype="float32" if args.bf16 is False else "bfloat16",
             stochastic_inference=(True if args.stochastic_scores is None
                                   else args.stochastic_scores),
-            use_pallas_attention="auto" if args.pallas_auto else bool(args.pallas),
-            use_pallas_gru="auto" if args.pallas_auto else bool(args.pallas),
+            use_pallas_attention=(
+                "auto" if args.pallas_auto or args.pallas is None
+                else bool(args.pallas)),
+            use_pallas_gru=(
+                "auto" if args.pallas_auto or args.pallas is None
+                else bool(args.pallas)),
         ),
         data=DataConfig(
             dataset_path=resolve("dataset"),

@@ -138,7 +138,10 @@ def main(argv=None) -> int:
     enable_persistent_compile_cache()
     backend = jax.default_backend()
     records = []
-    for n in (360, 1024):
+    # 2880 = the cross-day-flattened flagship GRU row count
+    # (days_per_step=8 x N_pad=360, PERF.md "Round 3"): the kernels' real
+    # r3 operating point for the day-independent segment.
+    for n in (360, 1024, 2880):
         for t, h in ((20, 20), (20, 64), (60, 64)):
             rec = race_gru(n, t, h, args.reps)
             records.append(rec)
