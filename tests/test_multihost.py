@@ -78,14 +78,16 @@ TRAIN_WORKER = textwrap.dedent(
     import sys
     port, pid = sys.argv[1], int(sys.argv[2])
     sys.path.insert(0, %r)
-    from factorvae_tpu.parallel.multihost import maybe_initialize
+    from factorvae_tpu.parallel.multihost import (
+        global_put, is_global, maybe_initialize,
+    )
     assert maybe_initialize(coordinator_address=f"127.0.0.1:{port}",
                             num_processes=2, process_id=pid)
 
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from factorvae_tpu.config import (
         Config, DataConfig, ModelConfig, TrainConfig,
@@ -94,14 +96,26 @@ TRAIN_WORKER = textwrap.dedent(
     from factorvae_tpu.train import Trainer
     from factorvae_tpu.utils.logging import MetricsLogger
 
-    # dp x sp mesh spanning BOTH processes (2 local devices each)
+    assert jax.process_count() == 2
+
+    # global_put/is_global under a REAL 2-process runtime (VERDICT r2
+    # #4): a multi-process placement is not fully addressable locally,
+    # is recognized as global, and is NOT re-placed.
     mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("data", "stock"))
+    probe = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+    gprobe = global_put(probe, NamedSharding(mesh, P("data", None)))
+    assert is_global(gprobe), "2-process placement must be global"
+    assert global_put(gprobe, None) is gprobe, "no re-placement"
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(gprobe)
+    np.testing.assert_allclose(np.asarray(total), probe.sum())
+
+    # dp x sp mesh spanning BOTH processes (2 local devices each)
     cfg = Config(
         model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
                           num_portfolios=6, seq_len=4),
         data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
                         val_start_time=None, val_end_time=None),
-        train=TrainConfig(num_epochs=1, days_per_step=2, seed=0,
+        train=TrainConfig(num_epochs=2, days_per_step=2, seed=0,
                           checkpoint_every=0, save_dir=f"/tmp/mh_{pid}"),
     )
     ds = PanelDataset(
@@ -111,11 +125,13 @@ TRAIN_WORKER = textwrap.dedent(
     tr = Trainer(cfg, ds, mesh=mesh, logger=MetricsLogger(echo=False))
     state = tr.init_state()
     order = jnp.asarray(tr.train_days[:4].reshape(2, 2))
-    state, m = tr._train_epoch(state, order)
-    loss = float(m["loss"])
-    assert np.isfinite(loss), loss
-    assert int(state.step) == 2
-    print(f"MULTIHOST_TRAIN_OK p{pid} loss={loss:.6f}")
+    losses = []
+    for _ in range(2):                       # 2 epochs (VERDICT r2 #4)
+        state, m = tr._train_epoch(state, order)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert int(state.step) == 4
+    print(f"MULTIHOST_TRAIN_OK p{pid} losses={losses[0]:.8f},{losses[1]:.8f}")
     """
     % REPO
 )
@@ -158,12 +174,54 @@ def _run_pair(worker_src: str, marker: str):
 def test_two_process_full_train_step():
     """The ENTIRE sharded training path — panel placement
     (multihost.global_put), state/order globalization, epoch scan,
-    gradient all-reduce across the process boundary — executes on a
-    2-process 2x2 dp x sp mesh, and both processes see the same loss."""
+    gradient all-reduce across the process boundary — executes for TWO
+    epochs on a 2-process 2x2 dp x sp mesh; both processes see the same
+    per-epoch losses, and those losses equal a single-process run of the
+    identical configuration (VERDICT r2 #4)."""
     outs = _run_pair(TRAIN_WORKER, "MULTIHOST_TRAIN_OK")
-    losses = {o.split("loss=")[1].split()[0]
-              for _, o, _ in outs for o in [o] if "loss=" in o}
-    assert len(losses) == 1, f"processes disagree on the loss: {losses}"
+    per_proc = []
+    for _, out, _ in outs:
+        token = [t for t in out.split() if t.startswith("losses=")]
+        assert token, out
+        per_proc.append(
+            tuple(float(v) for v in token[0][len("losses="):].split(",")))
+    assert per_proc[0] == per_proc[1], (
+        f"processes disagree on the losses: {per_proc}")
+
+    # single-process oracle: same config, same panel, same day order,
+    # no mesh — the distributed run must be numerically the same model
+    import jax.numpy as jnp
+    import numpy as np
+
+    from factorvae_tpu.config import (
+        Config, DataConfig, ModelConfig, TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg = Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=4),
+        data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(num_epochs=2, days_per_step=2, seed=0,
+                          checkpoint_every=0, save_dir="/tmp/mh_single"),
+    )
+    ds = PanelDataset(
+        synthetic_panel_dense(num_days=8, num_instruments=14,
+                              num_features=8),
+        seq_len=4, pad_multiple=16)
+    tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = tr.init_state()
+    order = jnp.asarray(tr.train_days[:4].reshape(2, 2))
+    single = []
+    for _ in range(2):
+        state, m = tr._train_epoch(state, order)
+        single.append(float(m["loss"]))
+    np.testing.assert_allclose(
+        np.asarray(per_proc[0]), np.asarray(single), rtol=2e-5, atol=1e-7,
+        err_msg="2-process losses diverge from the single-process run")
 
 
 def test_two_process_distributed_init_and_collective(tmp_path):
