@@ -16,12 +16,13 @@ claim in two phases on the same proxy panel as scripts/parity_protocol.py
    normal-approximation CI, plus the reference-faithful (kl_weight=1)
    8-seed row for honest comparison.
 
-Output: PARITY_RUN_r03.json (grid table + both sweeps + the recovery
-fraction vs the reference's 0.0794).
+Output: PARITY_RUN_r04.json (grid table + both sweeps + the recovery
+fraction vs the reference's 0.0794). Runs are float32 regardless of the
+preset's bench dtype.
 
 Usage:
     python scripts/parity_k60_sweep.py [--epochs 50] [--seeds 8]
-        [--out PARITY_RUN_r03.json] [--quick]
+        [--out PARITY_RUN_r04.json] [--quick]
 """
 
 from __future__ import annotations
@@ -44,13 +45,17 @@ from parity_protocol import build_proxy_panel, load_ref_scores  # noqa: E402
 PRESET = "csi300-k60"
 
 
-def _cfg_for(cfg0, panel_dates, prefix_dates, window_dates, epochs,
+def _cfg_for(cfg0, prefix_dates, window_dates, epochs,
              lr, kl_weight, tag):
     from factorvae_tpu.config import Config
 
     fit_end = prefix_dates[-61]
     return Config(
-        model=dataclasses.replace(cfg0.model, kl_weight=float(kl_weight)),
+        # Statistics-sensitive sweep: force float32 regardless of the
+        # preset (presets default to bf16 for bench; parity numbers
+        # should not fold a dtype change in).
+        model=dataclasses.replace(cfg0.model, kl_weight=float(kl_weight),
+                                  compute_dtype="float32"),
         data=dataclasses.replace(
             cfg0.data,
             dataset_path=None,
@@ -101,7 +106,7 @@ def main(argv=None) -> int:
     ap.add_argument("--scores_dir", default="/root/reference/scores")
     ap.add_argument("--epochs", type=int, default=50)
     ap.add_argument("--seeds", type=int, default=8)
-    ap.add_argument("--out", default="PARITY_RUN_r03.json")
+    ap.add_argument("--out", default="PARITY_RUN_r04.json")
     ap.add_argument("--quick", action="store_true",
                     help="2 epochs, 2 seeds, 2 grid points (smoke)")
     args = ap.parse_args(argv)
@@ -125,9 +130,8 @@ def main(argv=None) -> int:
     score_end = str(window_dates[-1].date())
 
     cfg0 = get_preset(PRESET)
-    # The proxy panel is f32-scale synthetic data; keep the library f32
-    # default for the statistics-sensitive sweep (bf16 is benched
-    # separately; parity numbers should not fold a dtype change in).
+    # _cfg_for forces compute_dtype=float32 on every run (presets are
+    # bf16 for bench; parity should not fold a dtype change in).
     ds = PanelDataset(panel, seq_len=cfg0.model.seq_len, pad_multiple=8)
 
     epochs = 2 if args.quick else args.epochs
@@ -152,7 +156,7 @@ def main(argv=None) -> int:
           f"{epochs} epochs each")
     for lr, klw in grid:
         tag = f"lr{lr:g}_kl{klw:g}"
-        cfg = _cfg_for(cfg0, panel.dates, prefix_dates, window_dates,
+        cfg = _cfg_for(cfg0, prefix_dates, window_dates,
                        epochs, lr, klw, tag)
         rec = _run_one(cfg, ds, ref[PRESET], labels,
                        score_start, score_end)
@@ -168,7 +172,7 @@ def main(argv=None) -> int:
     def sweep(lr, klw, label):
         from factorvae_tpu.eval.sweep import seed_sweep
 
-        cfg = _cfg_for(cfg0, panel.dates, prefix_dates, window_dates,
+        cfg = _cfg_for(cfg0, prefix_dates, window_dates,
                        epochs, lr, klw, f"sweep_{label}")
         shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
         df = seed_sweep(cfg, ds, seeds=list(range(n_seeds)),

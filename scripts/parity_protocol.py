@@ -188,7 +188,10 @@ def main(argv=None) -> int:
     for preset_name in presets:
         cfg0 = get_preset(preset_name)
         cfg = Config(
-            model=cfg0.model,
+            # Force float32: presets default to bf16 for bench, but the
+            # statistics-sensitive parity protocol must not fold a dtype
+            # change into its numbers.
+            model=dataclasses.replace(cfg0.model, compute_dtype="float32"),
             data=dataclasses.replace(
                 cfg0.data,
                 dataset_path=None,
