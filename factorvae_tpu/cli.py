@@ -359,9 +359,12 @@ def main(argv=None) -> int:
             k: v for k, v in bt.summary().items() if v is not None
         })
         # Full-fidelity account simulation (cell 6 exchange config) and
-        # the cell-8 annualized excess-return risk table.
+        # the cell-8 annualized excess-return risk table. Pass the
+        # UN-dropped frame: the simulator owns the NaN semantics (all-NaN
+        # day = no-trade day that marks to market; in-frame NaN-label
+        # name = undealable on the execution day).
         acct = simulate_topk_account(
-            scores.dropna(), topk=args.backtest_topk,
+            scores, topk=args.backtest_topk,
             n_drop=args.backtest_n_drop,
         )
         logger.log("backtest_account", **{

@@ -295,10 +295,21 @@ class TestAccountSimulator:
         assert np.isfinite(s["final_account"])
 
     def test_empty_frame_graceful(self):
-        df = frame([("2020-01-01", "A", np.nan, 0.1)])
+        df = frame([("2020-01-01", "A", np.nan, 0.1)])[:0]
         r = simulate_topk_account(df)
         assert len(r.report) == 0
         assert np.isnan(r.risk_excess_with_cost["mean"])
+
+    def test_single_all_nan_day_is_a_no_trade_row(self):
+        """A calendar day whose only score is NaN is still a trading day:
+        the executor steps it (one report row), but with no signal there
+        is nothing to buy — zero turnover, zero return on an empty book."""
+        df = frame([("2020-01-01", "A", np.nan, 0.1)])
+        r = simulate_topk_account(df)
+        assert len(r.report) == 1
+        assert r.report["turnover"].iloc[0] == 0.0
+        assert r.report["return"].iloc[0] == 0.0
+        assert r.final_positions == {}
 
     def test_relisting_after_gap_is_tradable(self):
         """A limit move weeks before a suspension gap must not block the
@@ -383,6 +394,120 @@ class TestQlibSemantics:
         np.testing.assert_allclose(day1["value"],
                                    1000.0 * 0.95 * 1.01, rtol=1e-6)
 
+    def test_all_nan_score_day_marks_to_market(self):
+        """VERDICT r3 #6 adversarial scenario: a mid-series day where
+        EVERY score is NaN (signal outage / market-wide suspension of the
+        score source). qlib's executor still steps that day — held
+        positions must earn the day's label and the report must contain
+        the day; no orders are generated. Before the r4 fix the day
+        vanished from the calendar entirely, silently deleting a full day
+        of portfolio return."""
+        rows = [
+            ("2020-01-01", "A", 2.0, 0.00), ("2020-01-01", "B", 1.0, 0.00),
+            # day 2: scores NaN for everyone, but labels are real moves
+            ("2020-01-02", "A", np.nan, 0.10),
+            ("2020-01-02", "B", np.nan, 0.10),
+            ("2020-01-03", "A", 2.0, 0.00), ("2020-01-03", "B", 1.0, 0.00),
+        ]
+        r = simulate_topk_account(frame(rows), topk=2, n_drop=1,
+                                  account=1000.0, min_cost=0.0,
+                                  open_cost=0.0, close_cost=0.0,
+                                  limit_threshold=None)
+        assert len(r.report) == 3                     # day 2 present
+        day2 = r.report.iloc[1]
+        assert day2["turnover"] == 0.0                # no orders
+        np.testing.assert_allclose(day2["return"], 0.95 * 0.10, rtol=1e-9)
+        assert set(r.final_positions) == {"A", "B"}   # book carried intact
+
+    def test_forced_sell_limit_hit_realizes_the_loss(self):
+        """VERDICT r3 #6 adversarial scenario: a holding that MUST be
+        sold (ranked out of the book) is limit-down on the execution day.
+        The sell is rejected — and critically the blocked position keeps
+        earning its (negative) label while stuck, so the account ends
+        strictly worse than an unconstrained run that exits at once. A
+        simulator that silently fills the blocked order would show the
+        two runs equal."""
+        rows = [
+            ("2020-01-01", "Y", 2.0, -0.10),  # bought; limit-down into d2
+            ("2020-01-01", "X", 1.0, 0.00),
+            ("2020-01-02", "Y", 0.1, -0.10),  # sell forced, blocked; -10%
+            ("2020-01-02", "X", 9.0, 0.00),
+            ("2020-01-03", "Y", 0.1, 0.00),   # still limit-down (d2 label)
+            ("2020-01-03", "X", 9.0, 0.00),
+            ("2020-01-04", "Y", 0.1, 0.00),   # limit cleared -> sold
+            ("2020-01-04", "X", 9.0, 0.00),
+        ]
+        kw = dict(topk=1, n_drop=1, account=1000.0,
+                  min_cost=0.0, open_cost=0.0, close_cost=0.0)
+        blocked = simulate_topk_account(
+            frame(rows), limit_threshold=0.095, **kw)
+        free = simulate_topk_account(
+            frame(rows), limit_threshold=None, **kw)
+        # stuck holding exits only once the limit clears
+        assert "Y" not in blocked.final_positions
+        # the extra limit-down day is a real, realized loss
+        assert blocked.report["account"].iloc[-1] < \
+            free.report["account"].iloc[-1]
+        # day 2 shows the decay with zero sell-side execution of Y:
+        # only X's buy trades that day in the blocked run
+        assert blocked.report["return"].iloc[1] < 0.0
+
+    def test_drifted_book_does_not_trade_on_no_signal_day(self):
+        """A book drifted above topk (blocked sell + executed buy) must
+        NOT shed holdings on an all-NaN-score day: with no signal qlib
+        generates no trade decision, so there is no ranking to pick a
+        victim by — selling the alphabetically-last holding would be an
+        invention."""
+        rows = [
+            ("2020-01-01", "Y", 2.0, -0.10),  # bought; limit-down into d2
+            ("2020-01-01", "X", 1.0, 0.00),
+            ("2020-01-02", "Y", 0.1, 0.00),   # sell blocked; X bought
+            ("2020-01-02", "X", 9.0, 0.00),
+            # day 3: no signal at all — the drifted {X, Y} book holds
+            ("2020-01-03", "Y", np.nan, 0.00),
+            ("2020-01-03", "X", np.nan, 0.00),
+        ]
+        r = simulate_topk_account(frame(rows), topk=1, n_drop=1,
+                                  account=1000.0, min_cost=0.0,
+                                  open_cost=0.0, close_cost=0.0,
+                                  limit_threshold=0.095)
+        assert r.report["turnover"].iloc[2] == 0.0
+        assert set(r.final_positions) == {"X", "Y"}
+
+    def test_nan_score_with_finite_label_is_sellable(self):
+        """An in-frame holding whose SCORE is NaN on a day it actually
+        traded (finite label) is not suspended: qlib ranks it NaN-last,
+        selects it for sale, and the exchange fills the order. Contrast
+        with a name absent from the frame entirely, which stays held."""
+        rows = [
+            ("2020-01-01", "Y", 2.0, 0.00), ("2020-01-01", "X", 1.0, 0.00),
+            # day 2: Y's signal is missing but the market traded it
+            ("2020-01-02", "Y", np.nan, 0.00),
+            ("2020-01-02", "X", 9.0, 0.00),
+            ("2020-01-03", "Y", 0.1, 0.00), ("2020-01-03", "X", 9.0, 0.00),
+        ]
+        r = simulate_topk_account(frame(rows), topk=1, n_drop=1,
+                                  account=1000.0, min_cost=0.0,
+                                  limit_threshold=None)
+        # Y sold on day 2 (NaN-last rank, dealable), X bought in its slot
+        assert set(r.final_positions) == {"X"}
+        assert r.report["turnover"].iloc[1] > 0.0
+
+    def test_day_one_short_book_refills_without_n_drop(self):
+        """Day-1 universe smaller than topk AND n_drop=0: qlib's buy
+        sizing is len(sell) + topk - held, so empty slots must still be
+        refilled on later days even though the drop mechanism is off."""
+        rows = [
+            ("2020-01-01", "A", 3.0, 0.0), ("2020-01-01", "B", 2.0, 0.0),
+            ("2020-01-02", "A", 3.0, 0.0), ("2020-01-02", "B", 2.0, 0.0),
+            ("2020-01-02", "C", 1.0, 0.0), ("2020-01-02", "D", 0.5, 0.0),
+        ]
+        r = simulate_topk_account(frame(rows), topk=3, n_drop=0,
+                                  account=1000.0, min_cost=0.0,
+                                  limit_threshold=None)
+        # day 1 buys the 2 that exist; day 2 fills the third slot with C
+        assert set(r.final_positions) == {"A", "B", "C"}
+
     def test_buy_without_execution_price_rejected(self):
         """A name with no finite label on the decision day has no
         close(t+1)->close(t+2) path — the exchange cannot deal it
@@ -435,3 +560,26 @@ class TestBacktestCLI:
         assert "screener" in out and "account" in out
         assert np.isfinite(out["account"]["final_account"])
         assert (tmp_path / "bt.png").exists()
+
+    def test_cli_keeps_all_nan_day_in_calendar(self, tmp_path, capsys):
+        """The CLI must hand the simulator the UN-dropped frame: a
+        mid-series all-NaN-score day stays in the trading calendar (one
+        no-trade row, positions marked to market) instead of being
+        pre-dropped at the entry point."""
+        from factorvae_tpu.eval.backtest import main as bt_main
+
+        rows = [
+            ("2020-01-01", "A", 2.0, 0.00), ("2020-01-01", "B", 1.0, 0.00),
+            ("2020-01-02", "A", np.nan, 0.10),
+            ("2020-01-02", "B", np.nan, 0.10),
+            ("2020-01-03", "A", 2.0, 0.00), ("2020-01-03", "B", 1.0, 0.00),
+        ]
+        csv = tmp_path / "scores.csv"
+        frame(rows).reset_index().to_csv(csv, index=False)
+        rc = bt_main([str(csv), "--topk", "2", "--n_drop", "1"])
+        assert rc == 0
+        import json as _json
+
+        out = _json.loads(capsys.readouterr().out)
+        # the +10% all-NaN day is in the account curve
+        assert out["account"]["final_account"] > 1e8 * 1.05
