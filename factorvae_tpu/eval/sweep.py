@@ -31,9 +31,15 @@ def seed_sweep(
     score_start: Optional[str] = None,
     score_end: Optional[str] = None,
     logger: Optional[MetricsLogger] = None,
+    on_seed=None,
 ) -> pd.DataFrame:
     """Returns a frame indexed by seed with columns
-    [rank_ic, rank_ic_ir, best_val]; .attrs['summary'] holds mean/std."""
+    [rank_ic, rank_ic_ir, best_val]; .attrs['summary'] holds mean/std.
+
+    ``on_seed(rec)`` (optional) fires after each seed completes so
+    long-running sweeps can persist partial results — a multi-hour CPU
+    sweep killed at round end should leave its finished seeds on disk.
+    """
     logger = logger or MetricsLogger(echo=False)
     records = []
     for seed in seeds:
@@ -66,6 +72,8 @@ def seed_sweep(
         }
         records.append(rec)
         logger.log("sweep_seed", **rec)
+        if on_seed is not None:
+            on_seed(rec)
 
     df = pd.DataFrame(records).set_index("seed")
     df.attrs["summary"] = {

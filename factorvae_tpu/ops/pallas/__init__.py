@@ -1,3 +1,13 @@
+"""Pallas TPU kernels.
+
+`attention.py` is NOT a legacy module: it is the forward kernel of the
+shipped differentiable path — `attention_grad.fused_attention`'s
+custom-vjp primal calls `multihead_cross_section_attention` directly
+(attention_grad.py:157), so every model forward that selects the Pallas
+attention runs it. `attention_grad` adds the flash-style recompute
+backward around it.
+"""
+
 from factorvae_tpu.ops.pallas.attention import multihead_cross_section_attention
 
 __all__ = ["multihead_cross_section_attention"]

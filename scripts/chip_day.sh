@@ -1,0 +1,84 @@
+#!/bin/bash
+# One-command TPU capture day (VERDICT r3 next-steps #1, #3, #7, plus the
+# on-chip k60 parity sweep #2). The relay has been down for most of two
+# rounds; when it returns it may not stay up — so every capture step is
+# bounded, ordered by evidentiary value, persists its artifact
+# immediately, and failures don't stop the sequence.
+#
+# Usage:   bash scripts/chip_day.sh [outdir]   (default: repo root)
+# Outputs: BENCH_r04_tpu.json + BENCH_TPU_CAPTURE.json (bench.py side
+#          effect), BENCH_DPS_SWEEP_r04.jsonl, RACE_KERNELS_TPU_r04.json,
+#          INT8_RACE_r04.json, TRACE_r04/ + TRACE_SUMMARY_r04.md,
+#          PARITY_RUN_r04.json — all under [outdir]; CHIP_DAY.log is the
+#          session transcript.
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-.}"
+mkdir -p "$OUT"
+LOG="$OUT/CHIP_DAY.log"
+# bench.py writes its persisted chip capture to the repo root by default;
+# keep it with the rest of the day's artifacts.
+export BENCH_CAPTURE_PATH="$OUT/BENCH_TPU_CAPTURE.json"
+say() { echo "[chip_day $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+say "probe: bounded jax.devices() check"
+if ! timeout 120 python -u -c "
+import jax
+d = jax.devices()[0]
+assert d.platform != 'cpu', d
+print('platform:', d.platform)
+" >>"$LOG" 2>&1; then
+  say "ABORT: no accelerator (probe hung or cpu-only); nothing captured"
+  exit 1
+fi
+
+say "1/6 flagship bench (flattened default) -> BENCH_r04_tpu.json"
+timeout 1800 python bench.py >"$OUT/BENCH_r04_tpu.json" 2>>"$LOG" \
+  && say "bench ok: $(cat "$OUT/BENCH_r04_tpu.json")" \
+  || say "bench FAILED (rc=$?)"
+
+say "2/6 days_per_step sweep -> BENCH_DPS_SWEEP_r04.jsonl"
+: >"$OUT/BENCH_DPS_SWEEP_r04.jsonl"
+for dps in 4 8 16 32; do
+  BENCH_DAYS_PER_STEP=$dps timeout 1500 python bench.py \
+    >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+    && say "dps=$dps ok" || say "dps=$dps FAILED"
+done
+
+say "3/6 kernel race at flattened shapes -> RACE_KERNELS_TPU_r04.json"
+timeout 3600 python scripts/race_kernels.py \
+  --out "$OUT/RACE_KERNELS_TPU_r04.json" >>"$LOG" 2>&1 \
+  && say "race ok" || say "race FAILED (rc=$?)"
+
+say "4/6 int8 scoring race -> INT8_RACE_r04.json"
+timeout 1200 python scripts/bench_int8_scoring.py \
+  >"$OUT/INT8_RACE_r04.json" 2>>"$LOG" \
+  && say "int8 ok" || say "int8 FAILED (rc=$?)"
+
+say "5/6 profiler trace of flagship training -> TRACE_SUMMARY_r04.md"
+rm -rf "$OUT/TRACE_r04"; mkdir -p /tmp/chipday
+timeout 900 python - >>"$LOG" 2>&1 <<'EOF'
+from factorvae_tpu.data import synthetic_frame
+synthetic_frame(num_days=80, num_instruments=356, num_features=158,
+                missing_prob=0.02, seed=3).to_pickle('/tmp/chipday/panel.pkl')
+EOF
+timeout 1800 python -m factorvae_tpu.cli \
+  --dataset /tmp/chipday/panel.pkl --num_epochs 3 \
+  --start_time 2020-01-01 --fit_end_time 2020-04-10 \
+  --val_start_time 2020-04-13 --val_end_time 2020-04-21 \
+  --days_per_step 8 --save_dir /tmp/chipday/models \
+  --score_start 2020-04-13 --score_end 2020-04-21 \
+  --score_dir /tmp/chipday/scores \
+  --profile "$OUT/TRACE_r04" >>"$LOG" 2>&1 \
+  && say "trace captured" || say "trace FAILED (rc=$?)"
+timeout 600 python -m factorvae_tpu.utils.trace_summary "$OUT/TRACE_r04" \
+  >"$OUT/TRACE_SUMMARY_r04.md" 2>>"$LOG" \
+  && say "trace summarized" || say "trace summary FAILED"
+
+say "6/6 k60 parity sweep ON CHIP (full protocol) -> PARITY_RUN_r04.json"
+timeout 14400 python scripts/parity_k60_sweep.py \
+  --epochs 50 --seeds 8 --out "$OUT/PARITY_RUN_r04.json" >>"$LOG" 2>&1 \
+  && say "parity sweep ok" || say "parity sweep FAILED/partial (rc=$?)"
+
+say "chip day complete; artifacts in $OUT"

@@ -101,11 +101,32 @@ def _run_one(cfg, ds, ref_scores, labels, score_start, score_end):
     }
 
 
+def _parse_points(spec):
+    """'1e-4:1.0,3e-4:0.1' -> [(1e-4, 1.0), (3e-4, 0.1)]."""
+    out = []
+    for tok in spec.split(","):
+        lr, klw = tok.split(":")
+        out.append((float(lr), float(klw)))
+    return out
+
+
+DEFAULT_GRID = "1e-4:1,1e-4:0.1,1e-4:0.02,3e-4:1,3e-4:0.1,3e-4:0.02"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scores_dir", default="/root/reference/scores")
     ap.add_argument("--epochs", type=int, default=50)
     ap.add_argument("--seeds", type=int, default=8)
+    ap.add_argument("--grid", default=DEFAULT_GRID,
+                    help="comma-separated lr:kl_weight grid points; "
+                         "'' skips the grid phase")
+    ap.add_argument("--sweeps", default=None,
+                    help="explicit lr:kl_weight sweep targets, run BEFORE "
+                         "the grid (CPU-fallback mode: headline CIs "
+                         "first, grid points as time allows). Default: "
+                         "grid winner + reference-faithful, after the "
+                         "grid.")
     ap.add_argument("--out", default="PARITY_RUN_r04.json")
     ap.add_argument("--quick", action="store_true",
                     help="2 epochs, 2 seeds, 2 grid points (smoke)")
@@ -136,38 +157,37 @@ def main(argv=None) -> int:
 
     epochs = 2 if args.quick else args.epochs
     n_seeds = 2 if args.quick else args.seeds
-    grid = [
-        # (lr, kl_weight) — row 0 is reference-faithful
-        (1e-4, 1.0),
-        (1e-4, 0.1),
-        (1e-4, 0.02),
-        (3e-4, 1.0),
-        (3e-4, 0.1),
-        (3e-4, 0.02),
-    ]
+    grid = _parse_points(args.grid) if args.grid else []
     if args.quick:
         grid = grid[:2]
 
-    results = {"preset": PRESET, "epochs": epochs,
-               "protocol": "proxy panel (parity_protocol.build_proxy_panel)",
-               "grid": [], "sweeps": {}}
+    import jax
 
-    print(f"[k60] grid search: {len(grid)} points x 1 seed, "
-          f"{epochs} epochs each")
-    for lr, klw in grid:
-        tag = f"lr{lr:g}_kl{klw:g}"
+    from factorvae_tpu.eval.metrics import daily_rank_ic
+
+    ref_joined = ref[PRESET].join(labels.rename("LABEL0"),
+                                  how="inner").dropna()
+    ref_ic0 = float(daily_rank_ic(ref_joined, "LABEL0", "score").mean())
+
+    results = {"preset": PRESET, "epochs": epochs,
+               "platform": jax.devices()[0].platform,
+               "protocol": "proxy panel (parity_protocol.build_proxy_panel)",
+               "reference_rank_ic": ref_ic0,
+               "complete": False, "grid": [], "sweeps": {}}
+
+    def flush():
+        # Incremental persistence: a multi-hour CPU-fallback run killed
+        # at round end must leave every finished record on disk.
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+
+    def run_point(lr, klw, tag):
         cfg = _cfg_for(cfg0, prefix_dates, window_dates,
                        epochs, lr, klw, tag)
         rec = _run_one(cfg, ds, ref[PRESET], labels,
                        score_start, score_end)
         rec.update(lr=lr, kl_weight=klw)
-        results["grid"].append(rec)
-        print(f"[k60] lr={lr:g} kl_weight={klw:g}: "
-              f"ic={rec['rank_ic']:.4f} ({rec['train_seconds']:.0f}s)")
-
-    best = max(results["grid"], key=lambda r: r["rank_ic"])
-    results["grid_winner"] = {"lr": best["lr"],
-                              "kl_weight": best["kl_weight"]}
+        return rec
 
     def sweep(lr, klw, label):
         from factorvae_tpu.eval.sweep import seed_sweep
@@ -175,11 +195,23 @@ def main(argv=None) -> int:
         cfg = _cfg_for(cfg0, prefix_dates, window_dates,
                        epochs, lr, klw, f"sweep_{label}")
         shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
+        if "per_seed_rank_ic" in results["sweeps"].get(label, {}):
+            print(f"[k60] sweep {label} already complete; skipping")
+            return
+        partial = results["sweeps"].setdefault(
+            label, {"lr": lr, "kl_weight": klw})
+        partial.setdefault("partial_seeds", {})
+
+        def on_seed(rec):
+            partial["partial_seeds"][rec["seed"]] = rec["rank_ic"]
+            flush()
+
         df = seed_sweep(cfg, ds, seeds=list(range(n_seeds)),
-                        score_start=score_start, score_end=score_end)
+                        score_start=score_start, score_end=score_end,
+                        on_seed=on_seed)
         s = df.attrs["summary"]
         mean, std, n = s["rank_ic_mean"], s["rank_ic_std"], s["num_seeds"]
-        ref_ic = results["grid"][0]["reference_rank_ic"]
+        ref_ic = results["reference_rank_ic"]
         ci = 1.96 * std / np.sqrt(max(n, 1))
         rec = {
             "lr": lr, "kl_weight": klw,
@@ -188,26 +220,50 @@ def main(argv=None) -> int:
             **s,
             "ci95_half_width": float(ci),
             "reference_rank_ic": ref_ic,
-            "recovery_fraction": float(mean / ref_ic),
-            "recovery_ci": [float((mean - ci) / ref_ic),
-                            float((mean + ci) / ref_ic)],
         }
+        if ref_ic:
+            rec["recovery_fraction"] = float(mean / ref_ic)
+            rec["recovery_ci"] = [float((mean - ci) / ref_ic),
+                                  float((mean + ci) / ref_ic)]
         results["sweeps"][label] = rec
+        flush()
         print(f"[k60] sweep {label}: mean={mean:.4f}±{std:.4f} "
-              f"(n={n}) recovery={rec['recovery_fraction']:.1%} "
-              f"CI=[{rec['recovery_ci'][0]:.1%}, {rec['recovery_ci'][1]:.1%}]")
+              f"(n={n}) recovery="
+              f"{rec.get('recovery_fraction', float('nan')):.1%}")
 
-    print(f"[k60] seed sweep at grid winner "
-          f"(lr={best['lr']:g}, kl={best['kl_weight']:g}), "
-          f"{n_seeds} seeds")
-    sweep(best["lr"], best["kl_weight"], "winner")
-    if (best["lr"], best["kl_weight"]) != (1e-4, 1.0):
-        print(f"[k60] reference-faithful sweep (lr=1e-4, kl=1.0), "
+    explicit_sweeps = _parse_points(args.sweeps) if args.sweeps else None
+    if explicit_sweeps:
+        # CPU-fallback ordering: headline seed-sweep CIs first, grid
+        # afterwards as time allows.
+        for lr, klw in explicit_sweeps:
+            print(f"[k60] explicit sweep lr={lr:g} kl={klw:g}, "
+                  f"{n_seeds} seeds")
+            sweep(lr, klw, f"lr{lr:g}_kl{klw:g}")
+
+    print(f"[k60] grid search: {len(grid)} points x 1 seed, "
+          f"{epochs} epochs each")
+    for lr, klw in grid:
+        rec = run_point(lr, klw, f"lr{lr:g}_kl{klw:g}")
+        results["grid"].append(rec)
+        flush()
+        print(f"[k60] lr={lr:g} kl_weight={klw:g}: "
+              f"ic={rec['rank_ic']:.4f} ({rec['train_seconds']:.0f}s)")
+
+    if not explicit_sweeps and results["grid"]:
+        best = max(results["grid"], key=lambda r: r["rank_ic"])
+        results["grid_winner"] = {"lr": best["lr"],
+                                  "kl_weight": best["kl_weight"]}
+        print(f"[k60] seed sweep at grid winner "
+              f"(lr={best['lr']:g}, kl={best['kl_weight']:g}), "
               f"{n_seeds} seeds")
-        sweep(1e-4, 1.0, "reference_faithful")
+        sweep(best["lr"], best["kl_weight"], "winner")
+        if (best["lr"], best["kl_weight"]) != (1e-4, 1.0):
+            print(f"[k60] reference-faithful sweep (lr=1e-4, kl=1.0), "
+                  f"{n_seeds} seeds")
+            sweep(1e-4, 1.0, "reference_faithful")
 
-    with open(args.out, "w") as f:
-        json.dump(results, f, indent=1)
+    results["complete"] = True
+    flush()
     print(f"[k60] wrote {args.out}")
     return 0
 
