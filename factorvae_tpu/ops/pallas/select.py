@@ -11,14 +11,29 @@ per-shape winners on the full fwd+bwd:
   (N=1024/T=20/H=20: 1.38×), ties at H=64, and clearly loses at T=60
   (the VMEM-bounded 24-row backward blocking costs 1.6×).
 
-"auto" applies those measurements. Shapes are static under jit, so the
-choice is made at trace time with zero runtime cost. Off-TPU backends
-resolve to the XLA path (the kernels would only run interpreted).
+"auto" applies those measurements INSIDE the measured envelope only
+(VERDICT r3 missing-#4: the round-2 grid raced N ∈ {360, 1024}; the r3
+cross-day flattening moved the GRU's production row count to
+N = B·N_pad = 2880 at flagship, a shape with no race row). Outside the
+envelope auto resolves to the XLA path — extrapolating a win boundary
+to 2.8× the largest raced N would turn an unmeasured kernel on in the
+hot loop. When `scripts/race_kernels.py` (whose grid includes N=2880)
+lands chip rows for the flattened shapes, widen `_GRU_RACED_N_MAX` /
+`_ATTN_RACED_N_MAX` to the new measured envelope and encode any new
+winners here.
+
+Shapes are static under jit, so the choice is made at trace time with
+zero runtime cost. Off-TPU backends resolve to the XLA path (the
+kernels would only run interpreted).
 """
 
 from __future__ import annotations
 
 import jax
+
+# Largest N with a measured race row (RACE_KERNELS.json, round-2 v5e).
+_GRU_RACED_N_MAX = 1024
+_ATTN_RACED_N_MAX = 1024
 
 
 def _on_tpu() -> bool:
@@ -26,13 +41,17 @@ def _on_tpu() -> bool:
 
 
 def pallas_attention_wins(n: int, h: int, k: int) -> bool:
-    """True where the fused attention beat XLA in the round-2 race."""
-    return _on_tpu() and h <= 24
+    """True where the fused attention beat XLA in the round-2 race;
+    False outside the raced envelope (no extrapolated wins). The raced
+    N values are {360, 1024} — both bounds are measured points."""
+    return _on_tpu() and 360 <= n <= _ATTN_RACED_N_MAX and h <= 24
 
 
 def pallas_gru_wins(n: int, t: int, h: int) -> bool:
-    """True where the fused GRU recurrence beat XLA in the race."""
-    return _on_tpu() and n >= 512 and h <= 24 and t <= 20
+    """True where the fused GRU recurrence beat XLA in the race;
+    False outside the raced envelope (no extrapolated wins)."""
+    return (_on_tpu() and 512 <= n <= _GRU_RACED_N_MAX
+            and h <= 24 and t <= 20)
 
 
 def resolve(flag, measured: bool) -> bool:

@@ -207,6 +207,27 @@ class TestKernelAutoSelect:
         assert pallas_attention_wins(360, 20, 20) is False
         assert pallas_gru_wins(1024, 20, 20) is False
 
+    def test_auto_never_extrapolates_beyond_raced_envelope(self):
+        """VERDICT r3 missing-#4: the round-2 race covered N<=1024; the
+        flattened flagship runs the GRU at N=2880. 'auto' must not turn
+        an unmeasured kernel on by extrapolation — outside the raced
+        envelope it resolves to XLA on every backend, including TPU."""
+        from unittest import mock
+
+        from factorvae_tpu.ops.pallas import select
+
+        with mock.patch.object(select, "_on_tpu", return_value=True):
+            # inside the envelope: measured winners apply
+            assert select.pallas_gru_wins(1024, 20, 20) is True
+            assert select.pallas_attention_wins(360, 20, 20) is True
+            # flattened flagship shapes (N = 8 x 360): no race row yet
+            assert select.pallas_gru_wins(2880, 20, 20) is False
+            assert select.pallas_attention_wins(2880, 20, 20) is False
+            # below the raced envelope (smallest raced N: 360 attention,
+            # 360->512 win boundary GRU): no extrapolated wins either
+            assert select.pallas_attention_wins(64, 20, 20) is False
+            assert select.pallas_gru_wins(64, 20, 20) is False
+
     def test_auto_model_runs_and_matches_xla(self):
         """'auto' config trains/scores identically to the XLA path on the
         CPU rig (where auto == XLA)."""
