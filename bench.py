@@ -71,6 +71,9 @@ USE_BF16 = os.environ.get("BENCH_BF16", "1") == "1"
 # "1" force kernels | "0" force XLA.
 _PALLAS_ENV = os.environ.get("BENCH_PALLAS", "auto")
 USE_PALLAS = {"0": False, "1": True}.get(_PALLAS_ENV, "auto")
+# BENCH_FLATTEN=0 reverts to the per-day nn.vmap lift so the round-3
+# cross-day-flattening thesis can be A/B-timed on chip in one command.
+USE_FLATTEN = os.environ.get("BENCH_FLATTEN", "1") == "1"
 
 # Backend-acquisition knobs (VERDICT round-1: no retry existed and the one
 # shot crashed at backend init; VERDICT round-2 #7: retry at END of run
@@ -233,6 +236,7 @@ def run_bench() -> dict:
             compute_dtype="bfloat16" if USE_BF16 else "float32",
             use_pallas_attention=USE_PALLAS,
             use_pallas_gru=USE_PALLAS,
+            flatten_days=USE_FLATTEN,
         ),
         data=DataConfig(seq_len=SEQ_LEN, start_time=None, fit_end_time=None,
                         val_start_time=None, val_end_time=None),
@@ -287,6 +291,10 @@ def run_bench() -> dict:
         # (round 1-2 fp32 runs reported without the suffix)
         "metric": "train_throughput_flagship_K96_H64_Alpha158"
                   + ("_bf16" if USE_BF16 else "")
+                  # like the dtype, the day-batch layout is part of the
+                  # metric NAME: a BENCH_FLATTEN=0 A/B run must not share
+                  # a capture key with the flattened flagship series
+                  + ("" if USE_FLATTEN else "_per_day_vmap")
                   + ("" if flagship else "_smoke")
                   + ("_cpu_fallback" if FORCED_CPU else ""),
         "value": round(value, 1),
@@ -299,6 +307,7 @@ def run_bench() -> dict:
         "n_padded": n_pad,
         "bf16": USE_BF16,
         "pallas": USE_PALLAS,
+        "flatten_days": USE_FLATTEN,
     }
 
 

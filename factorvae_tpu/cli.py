@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size of the 'stock' (cross-section) mesh axis")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest full-state checkpoint")
+    p.add_argument("--kl_weight", type=float, default=None,
+                   help="scale on the summed-over-K KL term (default 1.0 "
+                        "= reference-faithful loss; the k60 parity sweep's "
+                        "lever — at large K the unweighted KL sum dominates "
+                        "the mean-over-N MSE gradient)")
     p.add_argument("--recon_loss", choices=["mse", "nll"], default=None,
                    help="mse = reference-faithful single-sample MSE; nll = "
                         "Gaussian NLL (default: mse, or the preset's choice)")
@@ -165,6 +170,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                     else args.stochastic_scores
                 ),
                 recon_loss=args.recon_loss or cfg.model.recon_loss,
+                kl_weight=(cfg.model.kl_weight if args.kl_weight is None
+                           else args.kl_weight),
                 compute_dtype=(
                     cfg.model.compute_dtype if args.bf16 is None
                     else ("bfloat16" if args.bf16 else "float32")
@@ -208,6 +215,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             num_portfolios=args.num_portfolio,
             seq_len=args.seq_len,
             recon_loss=args.recon_loss or "mse",
+            kl_weight=1.0 if args.kl_weight is None else args.kl_weight,
             # bf16 is the measured-best default on TPU (PERF.md); --no-bf16
             # opts back into float32 compute.
             compute_dtype="float32" if args.bf16 is False else "bfloat16",

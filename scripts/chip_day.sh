@@ -46,6 +46,11 @@ for dps in 4 8 16 32; do
     && say "dps=$dps ok" || say "dps=$dps FAILED"
 done
 
+say "2b/6 flatten_days A/B (r3 thesis) -> appended to BENCH_DPS_SWEEP_r04.jsonl"
+BENCH_FLATTEN=0 timeout 1500 python bench.py \
+  >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+  && say "flatten=0 ok" || say "flatten=0 FAILED"
+
 say "3/6 kernel race at flattened shapes -> RACE_KERNELS_TPU_r04.json"
 timeout 3600 python scripts/race_kernels.py \
   --out "$OUT/RACE_KERNELS_TPU_r04.json" >>"$LOG" 2>&1 \
