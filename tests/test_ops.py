@@ -228,6 +228,42 @@ class TestKernelAutoSelect:
             assert select.pallas_attention_wins(64, 20, 20) is False
             assert select.pallas_gru_wins(64, 20, 20) is False
 
+    def test_auto_agrees_with_every_measured_race_row(self):
+        """Pin the select predicates to the committed race table
+        (RACE_KERNELS.json): every measured row with a clear training
+        (fwd+bwd) winner must match the predicate — speedup >= 1.1 must
+        select the kernel, <= 1.0 must select XLA; 1.0-1.1 is the tie
+        zone where either choice is acceptable. When a new chip race
+        merges rows (e.g. N=2880), this test forces the predicates and
+        envelope constants to be recalibrated from the data rather than
+        drifting."""
+        import json
+        import os
+        from unittest import mock
+
+        from factorvae_tpu.ops.pallas import select
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "RACE_KERNELS.json")
+        table = json.load(open(path))
+        assert table["backend"] == "tpu", "race table must be chip-measured"
+        with mock.patch.object(select, "_on_tpu", return_value=True):
+            for r in table["records"]:
+                if r["op"] == "gru":
+                    got = select.pallas_gru_wins(r["n"], r["t"], r["h"])
+                    shape = (r["n"], r["t"], r["h"])
+                else:
+                    got = select.pallas_attention_wins(
+                        r["n"], r["h"], r["k"])
+                    shape = (r["n"], r["h"], r["k"])
+                s = r["fwdbwd_speedup"]
+                if s >= 1.1:
+                    assert got, f"{r['op']}{shape}: measured win {s}x " \
+                                "but auto selects XLA"
+                elif s <= 1.0:
+                    assert not got, f"{r['op']}{shape}: measured loss " \
+                                    f"{s}x but auto selects the kernel"
+
     def test_auto_model_runs_and_matches_xla(self):
         """'auto' config trains/scores identically to the XLA path on the
         CPU rig (where auto == XLA)."""
