@@ -368,9 +368,10 @@ def best_tpu_context() -> dict:
     context — they would understate the chip."""
     captures = load_tpu_capture()
     if captures:
-        headline = {k: v for k, v in captures.items()
-                    if "_per_day_vmap" not in k} or captures
-        best = max(headline.values(),
+        captures = {k: v for k, v in captures.items()
+                    if "_per_day_vmap" not in k}
+    if captures:
+        best = max(captures.values(),
                    key=lambda p: str(p.get("captured_at", "")))
         return {
             "windows_per_sec": best.get("value"),

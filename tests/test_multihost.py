@@ -137,7 +137,8 @@ TRAIN_WORKER = textwrap.dedent(
 )
 
 
-def _run_pair(worker_src: str, marker: str):
+def _run_group(worker_src: str, marker: str, n_procs: int = 2,
+               timeout: int = 220):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -154,12 +155,12 @@ def _run_pair(worker_src: str, marker: str):
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
-        for pid in (0, 1)
+        for pid in range(n_procs)
     ]
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=220)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -169,6 +170,141 @@ def _run_pair(worker_src: str, marker: str):
         assert rc == 0, f"worker {pid} failed:\n{err[-2000:]}"
         assert f"{marker} p{pid}" in out
     return outs
+
+
+def _run_pair(worker_src: str, marker: str):
+    return _run_group(worker_src, marker, 2)
+
+
+def _single_process_losses(days_per_step: int, num_days: int,
+                           save_dir: str):
+    """Single-process oracle shared by the distributed train tests: the
+    same tiny config the workers run (2 steps per epoch, 2 epochs over
+    the same day order), no mesh — distributed losses must equal these
+    exactly (up to float tolerance)."""
+    import jax.numpy as jnp
+
+    from factorvae_tpu.config import (
+        Config, DataConfig, ModelConfig, TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg = Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=4),
+        data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(num_epochs=2, days_per_step=days_per_step,
+                          seed=0, checkpoint_every=0, save_dir=save_dir),
+    )
+    ds = PanelDataset(
+        synthetic_panel_dense(num_days=num_days, num_instruments=14,
+                              num_features=8),
+        seq_len=4, pad_multiple=16)
+    tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = tr.init_state()
+    order = jnp.asarray(
+        tr.train_days[: 2 * days_per_step].reshape(2, days_per_step))
+    losses = []
+    for _ in range(2):
+        state, m = tr._train_epoch(state, order)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+HIER_WORKER = textwrap.dedent(
+    """
+    import sys
+    port, pid = sys.argv[1], int(sys.argv[2])
+    sys.path.insert(0, %r)
+    from factorvae_tpu.parallel.multihost import maybe_initialize
+    assert maybe_initialize(coordinator_address=f"127.0.0.1:{port}",
+                            num_processes=4, process_id=pid)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from factorvae_tpu.config import (
+        Config, DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+    from factorvae_tpu.parallel import (
+        data_parallel_size, make_hierarchical_mesh,
+    )
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    assert jax.process_count() == 4
+    # num_hosts defaults to the REAL process count here — this is the
+    # pod-slice topology with genuine process boundaries, not the
+    # single-process simulation.
+    mesh = make_hierarchical_mesh(MeshConfig(stock_axis=2))
+    assert dict(mesh.shape) == {"host": 4, "data": 1, "stock": 2}, \\
+        dict(mesh.shape)
+    # the 'host' axis must follow process boundaries: every host row of
+    # the device array lives in exactly one process
+    for row in mesh.devices:            # (4, 1, 2) -> rows of 2
+        pis = {d.process_index for d in row.ravel()}
+        assert len(pis) == 1, pis
+    dp = data_parallel_size(mesh)
+    assert dp == 4, dp
+
+    cfg = Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=4),
+        data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(num_epochs=2, days_per_step=dp, seed=0,
+                          checkpoint_every=0, save_dir=f"/tmp/mh4_{pid}"),
+    )
+    ds = PanelDataset(
+        synthetic_panel_dense(num_days=12, num_instruments=14,
+                              num_features=8),
+        seq_len=4, pad_multiple=16)
+    tr = Trainer(cfg, ds, mesh=mesh, logger=MetricsLogger(echo=False))
+    state = tr.init_state()
+    order = jnp.asarray(tr.train_days[: 2 * dp].reshape(2, dp))
+    losses = []
+    for _ in range(2):
+        state, m = tr._train_epoch(state, order)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert int(state.step) == 4
+    print(f"MULTIHOST_HIER_OK p{pid} losses={losses[0]:.8f},{losses[1]:.8f}")
+    """
+    % REPO
+)
+
+
+def test_four_process_hierarchical_mesh_train():
+    """The ('host','data','stock') pod-slice mesh under a REAL 4-process
+    runtime (4 hosts x 2 devices): the host axis is derived from actual
+    process boundaries, day-gradient all-reduce spans all four processes,
+    stock collectives stay inside each process's device pair, and two
+    epochs produce identical losses on every process AND equal to a
+    single-process run of the same configuration."""
+    # generous bound: 4 concurrent jax processes compiling on the 1-core
+    # CI box (with other suite load) have been observed near 500 s
+    outs = _run_group(HIER_WORKER, "MULTIHOST_HIER_OK", 4, timeout=900)
+    per_proc = []
+    for _, out, _ in outs:
+        token = [t for t in out.split() if t.startswith("losses=")]
+        assert token, out
+        per_proc.append(
+            tuple(float(v) for v in token[0][len("losses="):].split(",")))
+    assert len(set(per_proc)) == 1, (
+        f"processes disagree on the losses: {per_proc}")
+
+    import numpy as np
+
+    single = _single_process_losses(days_per_step=4, num_days=12,
+                                    save_dir="/tmp/mh4_single")
+    np.testing.assert_allclose(
+        np.asarray(per_proc[0]), np.asarray(single), rtol=2e-5, atol=1e-7,
+        err_msg="4-process hierarchical losses diverge from single-process")
 
 
 def test_two_process_full_train_step():
@@ -190,35 +326,10 @@ def test_two_process_full_train_step():
 
     # single-process oracle: same config, same panel, same day order,
     # no mesh — the distributed run must be numerically the same model
-    import jax.numpy as jnp
     import numpy as np
 
-    from factorvae_tpu.config import (
-        Config, DataConfig, ModelConfig, TrainConfig,
-    )
-    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
-    from factorvae_tpu.train import Trainer
-    from factorvae_tpu.utils.logging import MetricsLogger
-
-    cfg = Config(
-        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
-                          num_portfolios=6, seq_len=4),
-        data=DataConfig(seq_len=4, start_time=None, fit_end_time=None,
-                        val_start_time=None, val_end_time=None),
-        train=TrainConfig(num_epochs=2, days_per_step=2, seed=0,
-                          checkpoint_every=0, save_dir="/tmp/mh_single"),
-    )
-    ds = PanelDataset(
-        synthetic_panel_dense(num_days=8, num_instruments=14,
-                              num_features=8),
-        seq_len=4, pad_multiple=16)
-    tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
-    state = tr.init_state()
-    order = jnp.asarray(tr.train_days[:4].reshape(2, 2))
-    single = []
-    for _ in range(2):
-        state, m = tr._train_epoch(state, order)
-        single.append(float(m["loss"]))
+    single = _single_process_losses(days_per_step=2, num_days=8,
+                                    save_dir="/tmp/mh_single")
     np.testing.assert_allclose(
         np.asarray(per_proc[0]), np.asarray(single), rtol=2e-5, atol=1e-7,
         err_msg="2-process losses diverge from the single-process run")
