@@ -131,6 +131,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="RACE_KERNELS.json")
     ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--max_n", type=int, default=None,
+                    help="skip grid rows with n above this (CPU smoke of "
+                         "the driver: --max_n 360 --reps 1)")
     args = ap.parse_args(argv)
 
     from factorvae_tpu.utils.testing import enable_persistent_compile_cache
@@ -142,11 +145,15 @@ def main(argv=None) -> int:
     # (days_per_step=8 x N_pad=360, PERF.md "Round 3"): the kernels' real
     # r3 operating point for the day-independent segment.
     for n in (360, 1024, 2880):
+        if args.max_n and n > args.max_n:
+            continue
         for t, h in ((20, 20), (20, 64), (60, 64)):
             rec = race_gru(n, t, h, args.reps)
             records.append(rec)
             print(json.dumps(rec))
     for n in (360, 1024):
+        if args.max_n and n > args.max_n:
+            continue
         for h, k in ((20, 20), (48, 48), (64, 96)):
             rec = race_attention(n, h, k, args.reps)
             records.append(rec)
