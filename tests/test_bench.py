@@ -127,3 +127,71 @@ def test_cpu_fallback_carries_persisted_tpu_capture(tmp_path):
     assert ctx["windows_per_sec"] == 1234567.0
     assert ctx["mfu"] == 0.17
     assert "persisted accelerator capture" in ctx["source"]
+
+
+class TestCaptureMachinery:
+    """In-process unit tests of save_tpu_capture / best_tpu_context —
+    the resilience layer that preserves chip numbers across relay
+    deaths (VERDICT r2 #7) and keeps A/B controls out of the headline."""
+
+    def _bench(self, tmp_path, monkeypatch):
+        os.environ.setdefault("BENCH_FORCE_CPU", "1")
+        sys.path.insert(0, REPO)
+        import bench
+
+        monkeypatch.setattr(bench, "CAPTURE_PATH",
+                            str(tmp_path / "cap.json"))
+        return bench
+
+    def _payload(self, metric, value, at, **kw):
+        return {"metric": metric, "value": value, "captured_at": at,
+                "vs_baseline": value / 30000.0, "mfu": 0.1, **kw}
+
+    def test_best_per_metric_and_smoke_exclusion(self, tmp_path,
+                                                 monkeypatch):
+        bench = self._bench(tmp_path, monkeypatch)
+        m = "train_throughput_flagship_K96_H64_Alpha158_bf16"
+        bench.save_tpu_capture({"metric": m, "value": 100.0})
+        bench.save_tpu_capture({"metric": m, "value": 50.0})   # worse
+        bench.save_tpu_capture({"metric": m + "_smoke", "value": 999.0})
+        caps = bench.load_tpu_capture()
+        assert set(caps) == {m}, "smoke runs must never persist"
+        assert caps[m]["value"] == 100.0, "best-per-metric must be kept"
+
+    def test_headline_skips_per_day_vmap_control(self, tmp_path,
+                                                 monkeypatch):
+        bench = self._bench(tmp_path, monkeypatch)
+        m = "train_throughput_flagship_K96_H64_Alpha158_bf16"
+        caps = {
+            m: self._payload(m, 1_000_000.0, "2026-07-29T01:00:00"),
+            m + "_per_day_vmap": self._payload(
+                m + "_per_day_vmap", 400_000.0, "2026-07-29T02:00:00"),
+        }
+        monkeypatch.setattr(bench, "load_tpu_capture", lambda: caps)
+        ctx = bench.best_tpu_context()
+        # fresher A/B control must NOT become the headline
+        assert ctx["config"] == m
+        assert ctx["windows_per_sec"] == 1_000_000.0
+
+    def test_only_control_captures_fall_back_to_documented(
+            self, tmp_path, monkeypatch):
+        bench = self._bench(tmp_path, monkeypatch)
+        m = "train_throughput_flagship_K96_H64_Alpha158_bf16_per_day_vmap"
+        monkeypatch.setattr(
+            bench, "load_tpu_capture",
+            lambda: {m: self._payload(m, 400_000.0, "2026-07-29T02:00:00")})
+        ctx = bench.best_tpu_context()
+        # nothing headline-worthy persisted -> the documented round-2
+        # measurement, never the deliberately slower control
+        assert ctx == bench.LAST_TPU_MEASUREMENT
+
+    def test_freshest_wins_across_headline_metrics(self, tmp_path,
+                                                   monkeypatch):
+        bench = self._bench(tmp_path, monkeypatch)
+        a = self._payload("metric_a_bf16", 500.0, "2026-07-28T00:00:00")
+        b = self._payload("metric_b_bf16", 300.0, "2026-07-29T00:00:00")
+        monkeypatch.setattr(bench, "load_tpu_capture",
+                            lambda: {"metric_a_bf16": a, "metric_b_bf16": b})
+        ctx = bench.best_tpu_context()
+        assert ctx["config"] == "metric_b_bf16", \
+            "freshest (not max-value) must win across metrics"
