@@ -285,17 +285,24 @@ def run_bench() -> dict:
                 NUM_DAYS, DAYS_PER_STEP, EPOCHS_TIMED, USE_BF16,
                 USE_PALLAS in (False, "auto"),
                 ) == (158, 20, 64, 96, 128, 356, 256, 8, 3, True, True)
+    # Non-flagship runs are their own longitudinal series, keyed by the
+    # full shape (a reduced smoke run, a dps-sweep point, and a
+    # csi800/alpha360 scale-up run must never share a key with each
+    # other or with the flagship).
+    base = (
+        "train_throughput_flagship_K96_H64_Alpha158" if flagship else
+        f"train_throughput_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+        f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}_dps{DAYS_PER_STEP}")
     return {
         # the dtype is part of the metric NAME so the longitudinal series
         # can't silently splice a dtype change in as a code speedup
         # (round 1-2 fp32 runs reported without the suffix)
-        "metric": "train_throughput_flagship_K96_H64_Alpha158"
+        "metric": base
                   + ("_bf16" if USE_BF16 else "")
                   # like the dtype, the day-batch layout is part of the
                   # metric NAME: a BENCH_FLATTEN=0 A/B run must not share
                   # a capture key with the flattened flagship series
                   + ("" if USE_FLATTEN else "_per_day_vmap")
-                  + ("" if flagship else "_smoke")
                   + ("_cpu_fallback" if FORCED_CPU else ""),
         "value": round(value, 1),
         "unit": "windows/sec/chip",
@@ -329,11 +336,11 @@ LAST_TPU_MEASUREMENT = {
 
 def save_tpu_capture(payload: dict) -> None:
     """Persist a successful accelerator measurement (best-per-metric) so a
-    later relay death cannot erase it from the round's artifact. Smoke
-    (reduced-shape) runs are NOT persisted: their windows/sec are not
-    comparable to flagship numbers and must never outrank one."""
+    later relay death cannot erase it from the round's artifact. Every
+    shape is its own metric key, so entries never mix; only the flagship
+    series can become the headline context (best_tpu_context)."""
     metric = payload.get("metric", "?")
-    if "_smoke" in metric:
+    if "_smoke" in metric:  # legacy reduced-shape tag: never persisted
         return
     try:
         existing = load_tpu_capture() or {}
@@ -362,14 +369,15 @@ def load_tpu_capture() -> dict | None:
 def best_tpu_context() -> dict:
     """Freshest persisted chip capture, else the documented round-2 one.
     Freshest — not max-value — because entries span different metrics
-    whose windows/sec are not mutually comparable. A/B control layouts
-    (_per_day_vmap: the deliberately slower pre-r3 day batching) are
-    persisted under their own key but never surfaced as the headline
-    context — they would understate the chip."""
+    whose windows/sec are not mutually comparable. Only the flagship
+    series qualifies as headline: A/B control layouts (_per_day_vmap)
+    and non-flagship shape series (dps sweep points, csi800/alpha360
+    scale-ups, reduced smokes) are persisted under their own keys but
+    would mis-state the chip if surfaced as THE number."""
     captures = load_tpu_capture()
     if captures:
         captures = {k: v for k, v in captures.items()
-                    if "_per_day_vmap" not in k}
+                    if "flagship" in k and "_per_day_vmap" not in k}
     if captures:
         best = max(captures.values(),
                    key=lambda p: str(p.get("captured_at", "")))

@@ -51,6 +51,14 @@ BENCH_FLATTEN=0 timeout 1500 python bench.py \
   >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
   && say "flatten=0 ok" || say "flatten=0 FAILED"
 
+say "2c/6 preset-scale benches (csi800 N=1024, alpha360 C=360/T=60)"
+BENCH_STOCKS=1020 BENCH_HIDDEN=60 BENCH_FACTORS=60 timeout 1500 \
+  python bench.py >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+  && say "csi800-scale ok" || say "csi800-scale FAILED"
+BENCH_FEATURES=360 BENCH_SEQ_LEN=60 BENCH_HIDDEN=60 BENCH_FACTORS=60 \
+  timeout 1500 python bench.py >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+  && say "alpha360-scale ok" || say "alpha360-scale FAILED"
+
 say "3/6 kernel race at flattened shapes -> RACE_KERNELS_TPU_r04.json"
 timeout 3600 python scripts/race_kernels.py \
   --out "$OUT/RACE_KERNELS_TPU_r04.json" >>"$LOG" 2>&1 \

@@ -42,7 +42,10 @@ def test_bench_emits_single_json_line():
     assert REQUIRED_KEYS <= set(rec)
     assert rec["unit"] == "windows/sec/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
-    assert "_smoke" in rec["metric"]  # shapes differ from flagship
+    # shapes differ from flagship -> shape-keyed series, never the
+    # flagship metric name
+    assert "flagship" not in rec["metric"]
+    assert "_C8_" in rec["metric"]  # BENCH_FEATURES=8 smoke shape
     assert rec["platform"] == "cpu"
     assert rec["mfu"] is None  # no meaningful peak on CPU
     assert rec["model_tflops_per_sec"] > 0
@@ -188,10 +191,29 @@ class TestCaptureMachinery:
     def test_freshest_wins_across_headline_metrics(self, tmp_path,
                                                    monkeypatch):
         bench = self._bench(tmp_path, monkeypatch)
-        a = self._payload("metric_a_bf16", 500.0, "2026-07-28T00:00:00")
-        b = self._payload("metric_b_bf16", 300.0, "2026-07-29T00:00:00")
-        monkeypatch.setattr(bench, "load_tpu_capture",
-                            lambda: {"metric_a_bf16": a, "metric_b_bf16": b})
+        a = self._payload("flagship_a_bf16", 500.0, "2026-07-28T00:00:00")
+        b = self._payload("flagship_b_bf16", 300.0, "2026-07-29T00:00:00")
+        monkeypatch.setattr(
+            bench, "load_tpu_capture",
+            lambda: {"flagship_a_bf16": a, "flagship_b_bf16": b})
         ctx = bench.best_tpu_context()
-        assert ctx["config"] == "metric_b_bf16", \
+        assert ctx["config"] == "flagship_b_bf16", \
             "freshest (not max-value) must win across metrics"
+
+    def test_scale_up_series_persists_but_is_not_headline(
+            self, tmp_path, monkeypatch):
+        """csi800/alpha360 scale-up runs get their own shape-keyed
+        series; they persist, but only the flagship series can be the
+        headline chip context."""
+        bench = self._bench(tmp_path, monkeypatch)
+        scale = "train_throughput_C158_T20_H60_K60_M128_N1020_dps8_bf16"
+        flag = "train_throughput_flagship_K96_H64_Alpha158_bf16"
+        bench.save_tpu_capture(
+            self._payload(scale, 700_000.0, "2026-07-29T03:00:00"))
+        bench.save_tpu_capture(
+            self._payload(flag, 1_000_000.0, "2026-07-29T01:00:00"))
+        caps = bench.load_tpu_capture()
+        assert set(caps) == {scale, flag}
+        ctx = bench.best_tpu_context()
+        assert ctx["config"] == flag, \
+            "scale-up series must never become the headline"
