@@ -292,7 +292,13 @@ def run_bench() -> dict:
     base = (
         "train_throughput_flagship_K96_H64_Alpha158" if flagship else
         f"train_throughput_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
-        f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}_dps{DAYS_PER_STEP}")
+        f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}_dps{DAYS_PER_STEP}"
+        f"_d{NUM_DAYS}e{EPOCHS_TIMED}"
+        # forced kernel mode is part of the key too ("auto" is the
+        # series default): a BENCH_PALLAS=0/1 A/B at the same shape must
+        # not splice into the auto series via best-per-metric
+        + ("" if USE_PALLAS == "auto" else
+           f"_pallas{int(bool(USE_PALLAS))}"))
     return {
         # the dtype is part of the metric NAME so the longitudinal series
         # can't silently splice a dtype change in as a code speedup
@@ -337,11 +343,11 @@ LAST_TPU_MEASUREMENT = {
 def save_tpu_capture(payload: dict) -> None:
     """Persist a successful accelerator measurement (best-per-metric) so a
     later relay death cannot erase it from the round's artifact. Every
-    shape is its own metric key, so entries never mix; only the flagship
-    series can become the headline context (best_tpu_context)."""
+    shape/kernel-mode/layout is its own metric key, so entries never mix
+    (reduced smokes included — they persist under their own key); only
+    the flagship series can become the headline context
+    (best_tpu_context)."""
     metric = payload.get("metric", "?")
-    if "_smoke" in metric:  # legacy reduced-shape tag: never persisted
-        return
     try:
         existing = load_tpu_capture() or {}
     except Exception:

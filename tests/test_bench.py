@@ -150,15 +150,18 @@ class TestCaptureMachinery:
         return {"metric": metric, "value": value, "captured_at": at,
                 "vs_baseline": value / 30000.0, "mfu": 0.1, **kw}
 
-    def test_best_per_metric_and_smoke_exclusion(self, tmp_path,
-                                                 monkeypatch):
+    def test_best_per_metric_keeps_series_separate(self, tmp_path,
+                                                   monkeypatch):
         bench = self._bench(tmp_path, monkeypatch)
         m = "train_throughput_flagship_K96_H64_Alpha158_bf16"
+        smoke = "train_throughput_C8_T4_H8_K4_M4_N16_dps4_d8e1_bf16"
         bench.save_tpu_capture({"metric": m, "value": 100.0})
         bench.save_tpu_capture({"metric": m, "value": 50.0})   # worse
-        bench.save_tpu_capture({"metric": m + "_smoke", "value": 999.0})
+        bench.save_tpu_capture({"metric": smoke, "value": 999.0})
         caps = bench.load_tpu_capture()
-        assert set(caps) == {m}, "smoke runs must never persist"
+        # reduced runs persist under their own shape key, never the
+        # flagship's
+        assert set(caps) == {m, smoke}
         assert caps[m]["value"] == 100.0, "best-per-metric must be kept"
 
     def test_headline_skips_per_day_vmap_control(self, tmp_path,
@@ -206,14 +209,16 @@ class TestCaptureMachinery:
         series; they persist, but only the flagship series can be the
         headline chip context."""
         bench = self._bench(tmp_path, monkeypatch)
-        scale = "train_throughput_C158_T20_H60_K60_M128_N1020_dps8_bf16"
+        scale = "train_throughput_C158_T20_H60_K60_M128_N1020_dps8_d256e3_bf16"
         flag = "train_throughput_flagship_K96_H64_Alpha158_bf16"
-        bench.save_tpu_capture(
-            self._payload(scale, 700_000.0, "2026-07-29T03:00:00"))
-        bench.save_tpu_capture(
-            self._payload(flag, 1_000_000.0, "2026-07-29T01:00:00"))
-        caps = bench.load_tpu_capture()
-        assert set(caps) == {scale, flag}
+        # build the capture dict directly so the scale-up entry is
+        # STRICTLY fresher (save_tpu_capture stamps its own wall-clock
+        # time, which would make this scenario timing-dependent)
+        caps = {
+            scale: self._payload(scale, 700_000.0, "2026-07-29T03:00:00"),
+            flag: self._payload(flag, 1_000_000.0, "2026-07-29T01:00:00"),
+        }
+        monkeypatch.setattr(bench, "load_tpu_capture", lambda: caps)
         ctx = bench.best_tpu_context()
         assert ctx["config"] == flag, \
             "scale-up series must never become the headline"
