@@ -345,10 +345,15 @@ def simulate_topk_account(
             ranked = day[score_col].sort_index().sort_values(
                 ascending=False, kind="mergesort")
         else:
-            # All-NaN score day: qlib's strategy receives no signal and
-            # generates NO trade decision at all — no sells even from a
-            # drifted (above-topk) book, nothing bought. Positions only
-            # mark to market below.
+            # All-NaN score day: CHOSEN INTERPRETATION (pending the qlib
+            # differential, docs/qlib_handoff.md first-checks list): we
+            # model qlib's strategy as emitting no trade decision at all
+            # — no sells even from a drifted (above-topk) book, nothing
+            # bought; positions only mark to market below. qlib's
+            # TopkDropoutStrategy ranks with na_position='last' and
+            # could conceivably still emit sells from an all-NaN
+            # ranking, so this branch is the first scenario to diff
+            # against real qlib when data access lands.
             ranked = pd.Series(dtype=float)
         universe = list(ranked.index)
         day_names = set(universe)

@@ -32,6 +32,7 @@ def seed_sweep(
     score_end: Optional[str] = None,
     logger: Optional[MetricsLogger] = None,
     on_seed=None,
+    prior_records: Optional[dict] = None,
 ) -> pd.DataFrame:
     """Returns a frame indexed by seed with columns
     [rank_ic, rank_ic_ir, best_val]; .attrs['summary'] holds mean/std.
@@ -39,10 +40,38 @@ def seed_sweep(
     ``on_seed(rec)`` (optional) fires after each seed completes so
     long-running sweeps can persist partial results — a multi-hour CPU
     sweep killed at round end should leave its finished seeds on disk.
+
+    ``prior_records`` (optional) maps seed -> an already-finished record
+    (``{"rank_ic": float, ...}``, or a bare rank_ic float as older
+    partial files stored) restored from such a partial file; those
+    seeds are included in the output without retraining, so a restarted
+    sweep resumes instead of redoing finished work.
     """
     logger = logger or MetricsLogger(echo=False)
+    prior_records = prior_records or {}
     records = []
     for seed in seeds:
+        if int(seed) in prior_records or str(seed) in prior_records:
+            prev = prior_records.get(int(seed),
+                                     prior_records.get(str(seed)))
+            if not isinstance(prev, dict):
+                prev = {"rank_ic": prev}
+
+            def _f(v):
+                # JSON round-trips our own NaN placeholders as null
+                # (strict-JSON flushes serialize non-finite as null);
+                # a resume of a resume must not crash on float(None).
+                return float("nan") if v is None else float(v)
+
+            rec = {
+                "seed": int(seed),
+                "rank_ic": _f(prev["rank_ic"]),
+                "rank_ic_ir": _f(prev.get("rank_ic_ir", float("nan"))),
+                "best_val": _f(prev.get("best_val", float("nan"))),
+            }
+            records.append(rec)
+            logger.log("sweep_seed_resumed", **rec)
+            continue
         cfg = dataclasses.replace(
             config, train=dataclasses.replace(config.train, seed=int(seed))
         )
@@ -80,6 +109,10 @@ def seed_sweep(
         "rank_ic_mean": float(df["rank_ic"].mean()),
         "rank_ic_std": float(df["rank_ic"].std(ddof=0)),
         "rank_ic_ir_mean": float(df["rank_ic_ir"].mean()),
+        # Legacy-resumed seeds may lack rank_ic_ir (NaN, skipped by
+        # mean): publish the n that statistic actually covers so it
+        # can't read as a num_seeds-seed figure.
+        "rank_ic_ir_num_seeds": int(df["rank_ic_ir"].notna().sum()),
         "num_seeds": len(df),
     }
     logger.log("sweep_summary", **df.attrs["summary"])

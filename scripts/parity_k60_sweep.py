@@ -175,11 +175,63 @@ def main(argv=None) -> int:
                "reference_rank_ic": ref_ic0,
                "complete": False, "grid": [], "sweeps": {}}
 
+    # Restart resume (ADVICE r4): adopt finished records from a prior
+    # partial run of the SAME protocol so a killed multi-hour run
+    # continues instead of silently redoing every seed. partial_seeds
+    # values are full per-seed records (older files stored bare
+    # rank_ic floats; seed_sweep accepts both via prior_records).
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prev = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            prev = None
+        if prev and prev.get("preset") == PRESET \
+                and prev.get("epochs") == epochs \
+                and prev.get("platform") == results["platform"]:
+            results["grid"] = prev.get("grid", [])
+            results["sweeps"] = prev.get("sweeps", {})
+            n_prior = sum(len(s.get("partial_seeds", {}))
+                          + len(s.get("per_seed_rank_ic", {}))
+                          for s in results["sweeps"].values())
+            print(f"[k60] resuming from {args.out}: "
+                  f"{len(results['grid'])} grid points, "
+                  f"{n_prior} finished sweep seeds adopted")
+        elif prev:
+            # Do NOT overwrite a finished multi-hour artifact in place:
+            # a protocol-mismatched rerun (e.g. --quick smoke against a
+            # completed 50-epoch file) moves the old file aside first.
+            bak = args.out + ".mismatch.bak"
+            n = 1
+            while os.path.exists(bak):
+                n += 1
+                bak = f"{args.out}.mismatch.bak{n}"
+            shutil.move(args.out, bak)
+            print(f"[k60] NOT resuming from {args.out}: protocol "
+                  f"mismatch (epochs {prev.get('epochs')} != {epochs}, "
+                  f"platform {prev.get('platform')} != "
+                  f"{results['platform']}, or preset differs); "
+                  f"moved the old artifact to {bak} and starting fresh "
+                  "— CPU seeds must not silently mix into a TPU "
+                  "statistics artifact or vice versa")
+
+    def _json_safe(o):
+        # Non-finite floats (e.g. NaN rank_ic_ir on seeds resumed from
+        # a legacy bare-float partial) would serialize as the
+        # non-standard `NaN` token and break strict JSON consumers.
+        if isinstance(o, float) and not np.isfinite(o):
+            return None
+        if isinstance(o, dict):
+            return {k: _json_safe(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [_json_safe(v) for v in o]
+        return o
+
     def flush():
         # Incremental persistence: a multi-hour CPU-fallback run killed
         # at round end must leave every finished record on disk.
         with open(args.out, "w") as f:
-            json.dump(results, f, indent=1)
+            json.dump(_json_safe(results), f, indent=1)
 
     def run_point(lr, klw, tag):
         cfg = _cfg_for(cfg0, prefix_dates, window_dates,
@@ -192,23 +244,48 @@ def main(argv=None) -> int:
     def sweep(lr, klw, label):
         from factorvae_tpu.eval.sweep import seed_sweep
 
+        # Resume matches by (lr, kl_weight), not display label:
+        # explicit --sweeps mode and the grid-winner path name the same
+        # point 'lr1e-4_kl1' vs 'winner'/'reference_faithful', and a
+        # label miss would retrain a finished multi-hour sweep.
+        for lbl, e in results["sweeps"].items():
+            if (e.get("lr"), e.get("kl_weight")) == (lr, klw):
+                label = lbl
+                break
+        entry = results["sweeps"].get(label, {})
+        done = entry.get("per_seed_rank_ic", {})
+        if len(done) >= n_seeds:
+            print(f"[k60] sweep {label} already complete "
+                  f"({len(done)} seeds >= {n_seeds}); skipping")
+            return
         cfg = _cfg_for(cfg0, prefix_dates, window_dates,
                        epochs, lr, klw, f"sweep_{label}")
         shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
-        if "per_seed_rank_ic" in results["sweeps"].get(label, {}):
-            print(f"[k60] sweep {label} already complete; skipping")
-            return
         partial = results["sweeps"].setdefault(
             label, {"lr": lr, "kl_weight": klw})
         partial.setdefault("partial_seeds", {})
+        # A finished-but-smaller sweep (e.g. 5 seeds, now asked for 8)
+        # contributes its seeds as priors rather than being redone.
+        for s, v in done.items():
+            partial["partial_seeds"].setdefault(s, {
+                "rank_ic": v,
+                "rank_ic_ir": entry.get(
+                    "per_seed_rank_ic_ir", {}).get(s, float("nan")),
+                "best_val": entry.get(
+                    "per_seed_best_val", {}).get(s, float("nan")),
+            })
+        prior = dict(partial["partial_seeds"])
+        if prior:
+            print(f"[k60] sweep {label}: resuming, "
+                  f"{len(prior)} seeds already on disk")
 
         def on_seed(rec):
-            partial["partial_seeds"][rec["seed"]] = rec["rank_ic"]
+            partial["partial_seeds"][rec["seed"]] = rec
             flush()
 
         df = seed_sweep(cfg, ds, seeds=list(range(n_seeds)),
                         score_start=score_start, score_end=score_end,
-                        on_seed=on_seed)
+                        on_seed=on_seed, prior_records=prior)
         s = df.attrs["summary"]
         mean, std, n = s["rank_ic_mean"], s["rank_ic_std"], s["num_seeds"]
         ref_ic = results["reference_rank_ic"]
@@ -216,6 +293,7 @@ def main(argv=None) -> int:
         rec = {
             "lr": lr, "kl_weight": klw,
             "per_seed_rank_ic": df["rank_ic"].to_dict(),
+            "per_seed_rank_ic_ir": df["rank_ic_ir"].to_dict(),
             "per_seed_best_val": df["best_val"].to_dict(),
             **s,
             "ci95_half_width": float(ci),
@@ -242,7 +320,11 @@ def main(argv=None) -> int:
 
     print(f"[k60] grid search: {len(grid)} points x 1 seed, "
           f"{epochs} epochs each")
+    done_points = {(r["lr"], r["kl_weight"]) for r in results["grid"]}
     for lr, klw in grid:
+        if (lr, klw) in done_points:
+            print(f"[k60] grid lr={lr:g} kl={klw:g} already done; skipping")
+            continue
         rec = run_point(lr, klw, f"lr{lr:g}_kl{klw:g}")
         results["grid"].append(rec)
         flush()

@@ -202,6 +202,36 @@ class TestSeedSweep:
         # different seeds -> different models -> different ICs
         assert df["rank_ic"].iloc[0] != df["rank_ic"].iloc[1]
 
+    def test_resume_skips_finished_seeds(self, tmp_path):
+        """ADVICE r4: a restarted sweep must adopt already-finished
+        seeds (restored from a partial JSON) instead of retraining
+        them. Full-record and legacy bare-float shapes both resume."""
+        from factorvae_tpu.data import PanelDataset, synthetic_panel
+        from factorvae_tpu.eval import seed_sweep
+
+        panel = synthetic_panel(num_days=14, num_instruments=6, num_features=8,
+                                missing_prob=0.0, seed=11)
+        ds = PanelDataset(panel, seq_len=4)
+        cfg = tiny_cfg(tmp_path, seq_len=4)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, data=dataclasses.replace(cfg.data, seq_len=4))
+        # seed 0's record comes from the partial file (sentinel value no
+        # real run would produce); JSON round-trips keys to strings.
+        prior = {"0": {"rank_ic": 0.123456, "rank_ic_ir": 1.0,
+                       "best_val": 0.5}}
+        df = seed_sweep(cfg, ds, seeds=[0, 1], prior_records=prior)
+        assert list(df.index) == [0, 1]
+        assert df.loc[0, "rank_ic"] == pytest.approx(0.123456)
+        assert np.isfinite(df.loc[1, "rank_ic"])
+        assert df.attrs["summary"]["num_seeds"] == 2
+        # legacy shape: bare rank_ic floats, as pre-r5 partial files
+        # stored them (e.g. PARITY_RUN_r04_cpu.json)
+        df2 = seed_sweep(cfg, ds, seeds=[0, 1],
+                         prior_records={0: 0.2, "1": 0.4})
+        # both prior -> no training at all, summary over priors
+        assert df2.attrs["summary"]["rank_ic_mean"] == pytest.approx(0.3)
+        assert np.isnan(df2.loc[0, "best_val"])
+
 
 class TestChunkInvariance:
     def test_scores_invariant_to_chunk_size(self, trained):
