@@ -1,0 +1,225 @@
+"""Differential validation of the built-in backtest simulator vs qlib.
+
+The account simulator (factorvae_tpu/eval/backtest.py) is validated by
+scenario tests authored in this repo; the reference's ground truth is
+qlib's `TopkDropoutStrategy` + `SimulatorExecutor` (backtest.ipynb cell
+6), which is absent from the build sandbox. This script makes the
+differential executable the moment a qlib install + data bundle exist
+(VERDICT r4 next-#6), at zero marginal cost:
+
+(a) run OUR simulator on an exported scores CSV;
+(b) run qlib's strategy/executor on the same signal when qlib is
+    importable (and its bundle initialized);
+(c) diff the daily return / turnover / cost series within stated
+    tolerances, and report per-series max deviations.
+
+When qlib (or its data bundle) is unavailable the script SKIPS cleanly:
+it still runs (a), writes the artifact with `qlib_available: false` and
+the skip reason, and exits 0 — so it can sit in CI unconditionally.
+
+First scenarios to inspect on a real diff (the simulator's two *chosen
+interpretations*, see docs/qlib_handoff.md): the all-NaN-score day and
+the drifted-book-no-signal day.
+
+Usage:
+    python scripts/qlib_differential.py SCORES.csv [--labels PANEL.pkl]
+        [--provider_uri ~/.qlib/qlib_data/cn_data] [--benchmark SH000300]
+        [--topk 50] [--n_drop 10] [--out QLIB_DIFFERENTIAL.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Tolerances for the daily-series diff. Sources of benign divergence the
+# scenario tests cannot remove: qlib deals at bundle prices with integer
+# share rounding (we deal in value space), per-instrument tradable
+# calendars richer than our NaN-label approximation, and float
+# accumulation order. Structural disagreements (wrong holdings, missed
+# rejections) blow straight through these.
+TOLERANCES = {
+    "return": 5e-4,     # |daily gross return delta|
+    "turnover": 2e-2,   # |daily one-side turnover delta|
+    "cost": 2e-4,       # |daily cost-rate delta|
+}
+
+
+def load_scores_csv(path: str, labels_path: str | None = None) -> pd.DataFrame:
+    """(datetime, instrument)-indexed frame with score [+ LABEL0]."""
+    df = pd.read_csv(path, parse_dates=["datetime"])
+    df = df.set_index(["datetime", "instrument"]).sort_index()
+    if "LABEL0" not in df.columns:
+        if not labels_path:
+            raise SystemExit("scores CSV has no LABEL0 column; pass --labels "
+                             "(a reference-schema panel pickle)")
+        from factorvae_tpu.data.panel import load_frame
+
+        frame = load_frame(labels_path)
+        df = df.join(frame["LABEL0"], how="left")
+    return df
+
+
+def run_ours(scores: pd.DataFrame, topk: int, n_drop: int, account: float,
+             open_cost: float, close_cost: float, min_cost: float,
+             limit_threshold: float | None) -> pd.DataFrame:
+    """Path (a): the built-in account simulator's report_normal_df-shaped
+    report (columns return/turnover/cost, return GROSS of cost)."""
+    from factorvae_tpu.eval.backtest import simulate_topk_account
+
+    res = simulate_topk_account(
+        scores, topk=topk, n_drop=n_drop, account=account,
+        open_cost=open_cost, close_cost=close_cost, min_cost=min_cost,
+        limit_threshold=limit_threshold)
+    return res.report
+
+
+def run_qlib(scores: pd.DataFrame, provider_uri: str, benchmark: str,
+             topk: int, n_drop: int, account: float, open_cost: float,
+             close_cost: float, min_cost: float,
+             limit_threshold: float | None):
+    """Path (b): qlib's own simulator on the same signal.
+
+    Returns (report_df, None) on success or (None, reason) when qlib or
+    its data bundle is unavailable — the caller skips cleanly. API per
+    docs/qlib_handoff.md (qlib >= 0.9 daily convenience wrapper; the
+    reference notebook's lower-level backtest+SimulatorExecutor reaches
+    the same simulator)."""
+    try:
+        import qlib  # noqa: F401
+    except ImportError as e:
+        return None, f"qlib not importable: {e}"
+    try:
+        import qlib as _qlib
+        from qlib.contrib.evaluate import backtest_daily
+        from qlib.contrib.strategy import TopkDropoutStrategy
+
+        _qlib.init(provider_uri=os.path.expanduser(provider_uri),
+                   region="cn")
+    except Exception as e:  # missing bundle, version drift, ...
+        return None, f"qlib init failed ({type(e).__name__}: {e})"
+
+    try:
+        pred = scores["score"].dropna()
+        dates = pred.index.get_level_values(0)
+        strategy = TopkDropoutStrategy(signal=pred, topk=topk,
+                                       n_drop=n_drop)
+        report, _positions = backtest_daily(
+            start_time=str(dates.min().date()),
+            end_time=str(dates.max().date()),
+            strategy=strategy,
+            account=account,
+            benchmark=benchmark,
+            exchange_kwargs=dict(
+                limit_threshold=limit_threshold,
+                deal_price="close",
+                open_cost=open_cost, close_cost=close_cost,
+                min_cost=min_cost,
+            ),
+        )
+        return report, None
+    except Exception as e:
+        return None, f"qlib backtest failed ({type(e).__name__}: {e})"
+
+
+def diff_reports(ours: pd.DataFrame, theirs: pd.DataFrame,
+                 tolerances: dict = TOLERANCES) -> dict:
+    """Path (c): per-series diff on the shared trading days.
+
+    Both inputs are report_normal_df-shaped (columns return / turnover /
+    cost; qlib's `return` is gross of cost, as is ours)."""
+    idx = ours.index.intersection(theirs.index)
+    out = {"shared_days": int(len(idx)),
+           "ours_only_days": int(len(ours.index.difference(theirs.index))),
+           "qlib_only_days": int(len(theirs.index.difference(ours.index))),
+           "series": {}, "pass": True}
+    for col, tol in tolerances.items():
+        if col not in ours.columns or col not in theirs.columns:
+            out["series"][col] = {"available": False}
+            out["pass"] = False
+            continue
+        a = ours.loc[idx, col].astype(float)
+        b = theirs.loc[idx, col].astype(float)
+        d = (a - b).abs()
+        worst = d.idxmax() if len(d) else None
+        ok = bool((d <= tol).all()) if len(d) else True
+        out["series"][col] = {
+            "available": True,
+            "tolerance": tol,
+            "max_abs_diff": float(d.max()) if len(d) else 0.0,
+            "mean_abs_diff": float(d.mean()) if len(d) else 0.0,
+            "days_within_tol": int((d <= tol).sum()),
+            "worst_day": str(worst) if worst is not None else None,
+            "pass": ok,
+        }
+        out["pass"] = out["pass"] and ok
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("scores_csv")
+    ap.add_argument("--labels", default=None)
+    ap.add_argument("--provider_uri", default="~/.qlib/qlib_data/cn_data")
+    ap.add_argument("--benchmark", default="SH000300")
+    ap.add_argument("--topk", type=int, default=50)
+    ap.add_argument("--n_drop", type=int, default=10)
+    ap.add_argument("--account", type=float, default=1e8)
+    ap.add_argument("--open_cost", type=float, default=0.0005)
+    ap.add_argument("--close_cost", type=float, default=0.0015)
+    ap.add_argument("--min_cost", type=float, default=5.0)
+    ap.add_argument("--limit_threshold", type=float, default=0.095)
+    ap.add_argument("--out", default="QLIB_DIFFERENTIAL.json")
+    args = ap.parse_args(argv)
+
+    kw = dict(topk=args.topk, n_drop=args.n_drop, account=args.account,
+              open_cost=args.open_cost, close_cost=args.close_cost,
+              min_cost=args.min_cost, limit_threshold=args.limit_threshold)
+
+    scores = load_scores_csv(args.scores_csv, args.labels)
+    ours = run_ours(scores, **kw)
+    print(f"[qlib-diff] ours: {len(ours)} trading days, "
+          f"cum return {float(ours['return'].sum()):+.4f} (sum, gross)")
+
+    theirs, reason = run_qlib(scores, args.provider_uri, args.benchmark,
+                              **kw)
+    results = {
+        "scores_csv": args.scores_csv,
+        "params": kw,
+        "tolerances": TOLERANCES,
+        "ours_days": int(len(ours)),
+        "qlib_available": theirs is not None,
+    }
+    if theirs is None:
+        results["skip_reason"] = reason
+        print(f"[qlib-diff] SKIP qlib leg: {reason}")
+        print("[qlib-diff] path (a) ran; differential pending a qlib "
+              "install + data bundle (docs/qlib_handoff.md)")
+    else:
+        results["diff"] = diff_reports(ours, theirs)
+        verdict = "PASS" if results["diff"]["pass"] else "FAIL"
+        print(f"[qlib-diff] {verdict} over "
+              f"{results['diff']['shared_days']} shared days")
+        for col, rec in results["diff"]["series"].items():
+            if rec.get("available"):
+                print(f"[qlib-diff]   {col}: max|Δ|={rec['max_abs_diff']:.2e} "
+                      f"(tol {rec['tolerance']:.0e}) "
+                      f"{'ok' if rec['pass'] else 'EXCEEDED'}")
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1, default=str)
+    print(f"[qlib-diff] wrote {args.out}")
+    # Skip (qlib absent) exits 0 so this can run unconditionally in CI;
+    # a failed differential exits 1.
+    return 0 if theirs is None or results["diff"]["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

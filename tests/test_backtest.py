@@ -583,3 +583,58 @@ class TestBacktestCLI:
         out = _json.loads(capsys.readouterr().out)
         # the +10% all-NaN day is in the account curve
         assert out["account"]["final_account"] > 1e8 * 1.05
+
+
+class TestQlibDifferential:
+    """scripts/qlib_differential.py: path (a) + the clean-skip path run
+    in this sandbox (qlib absent); the diff logic is tested against
+    itself and a perturbation."""
+
+    def _mod(self):
+        import importlib.util
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[1]
+        spec = importlib.util.spec_from_file_location(
+            "qlib_differential", root / "scripts" / "qlib_differential.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_path_a_and_skip(self, tmp_path, capsys):
+        qd = self._mod()
+        csv = tmp_path / "scores.csv"
+        make_scores(num_days=12, num_inst=10, seed=3).reset_index().to_csv(
+            csv, index=False)
+        out = tmp_path / "diff.json"
+        rc = qd.main([str(csv), "--topk", "4", "--n_drop", "2",
+                      "--out", str(out)])
+        assert rc == 0  # qlib absent -> clean skip, not a failure
+        import json as _json
+
+        rec = _json.loads(out.read_text())
+        assert rec["qlib_available"] is False
+        assert "skip_reason" in rec
+        assert rec["ours_days"] > 0
+        assert "SKIP qlib leg" in capsys.readouterr().out
+
+    def test_diff_reports_self_and_perturbed(self):
+        qd = self._mod()
+        scores = make_scores(num_days=12, num_inst=10, seed=3)
+        rep = qd.run_ours(scores, topk=4, n_drop=2, account=1e8,
+                          open_cost=0.0005, close_cost=0.0015,
+                          min_cost=5.0, limit_threshold=0.095)
+        assert {"return", "turnover", "cost"} <= set(rep.columns)
+        d = qd.diff_reports(rep, rep)
+        assert d["pass"] is True
+        assert d["series"]["return"]["max_abs_diff"] == 0.0
+        assert d["shared_days"] == len(rep)
+        # a structural disagreement must blow through the tolerance
+        bad = rep.copy()
+        bad["return"] = bad["return"] + 0.01
+        d2 = qd.diff_reports(rep, bad)
+        assert d2["pass"] is False
+        assert d2["series"]["return"]["pass"] is False
+        # and a missing column is a failure, not a silent skip
+        d3 = qd.diff_reports(rep, bad.drop(columns=["cost"]))
+        assert d3["pass"] is False
