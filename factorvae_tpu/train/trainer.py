@@ -281,6 +281,17 @@ class Trainer:
                 epoch=epoch,
                 train_loss=train_loss,
                 val_loss=val_loss,
+                # Loss decomposition (already accumulated on device by
+                # loop.train_epoch): recon is a mean over stocks, kl a
+                # sum over K (module.py:261,268) — their relative
+                # magnitude is the K-scaling diagnostic VERDICT r4 #2
+                # asks about, so it belongs in the metric stream.
+                train_recon=float(train_m["recon"]),
+                train_kl=float(train_m["kl"]),
+                val_recon=float(val_m["recon"]) if val_order is not None
+                else float("nan"),
+                val_kl=float(val_m["kl"]) if val_order is not None
+                else float("nan"),
                 lr=lr,
                 step=int(state.step),
                 seconds=dt,

@@ -1,14 +1,29 @@
 """Same-host head-to-head: reference PyTorch implementation vs factorvae_tpu.
 
 Imports the reference code from its read-only mount (running it as a
-baseline; nothing is copied) and times per-day training steps of both
-frameworks on identical synthetic data and flagship shapes, on this
-host's CPU. This pins a *measured* architectural speedup (batched einsum
-heads + whole-epoch scan vs K sequential module calls + per-step host
-sync) independent of accelerator hardware; the TPU bench (bench.py) then
-adds the hardware factor.
+baseline; nothing is copied) and times BOTH frameworks on identical
+synthetic data, on this host's CPU, in the same process environment.
+This pins a *measured* architectural speedup (batched einsum heads +
+whole-epoch scan + cross-day flattening vs K sequential module calls +
+per-step host sync) independent of accelerator hardware; the TPU bench
+(bench.py) then adds the hardware factor.
 
-Usage: python scripts/bench_reference_cpu.py [--days 8] [--stocks 300] ...
+Measured per config (VERDICT r4 next-#5):
+- train: per-day training-step seconds, reference vs ours at
+  days_per_step=1 (reference-faithful) and ours at days_per_step=8
+  (the flattened default operating point; flatten_days=True);
+- scoring: prediction windows/second over a D-day panel, reference
+  (per-day `model.prediction` under no_grad, utils.py:70-87) vs ours
+  (chunked jitted `predict_panel`).
+
+Configs mirror the BASELINE.json preset shapes (presets.py): flagship
+(H=64/K=96), csi300-k60 (H=K=60), csi800-k60 (N=1024) and alpha360-k60
+(C=360, T=60).
+
+Usage:
+    python scripts/bench_reference_cpu.py                # one config
+    python scripts/bench_reference_cpu.py --table        # all 4 + markdown
+    python scripts/bench_reference_cpu.py --config csi800-k60 --reps 2
 """
 
 from __future__ import annotations
@@ -22,9 +37,21 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REFERENCE = os.environ.get("REFERENCE_PATH", "/root/reference")
 
+# Preset-shaped benchmark configs (shapes per factorvae_tpu/presets.py;
+# stock counts per BASELINE.md: CSI300 ~300 names, CSI800 padded 1024).
+CONFIGS = {
+    "flagship": dict(stocks=300, features=158, seq_len=20, hidden=64,
+                     factors=96, portfolios=128),
+    "csi300-k60": dict(stocks=300, features=158, seq_len=20, hidden=60,
+                       factors=60, portfolios=128),
+    "csi800-k60": dict(stocks=1024, features=158, seq_len=20, hidden=60,
+                       factors=60, portfolios=128),
+    "alpha360-k60": dict(stocks=300, features=360, seq_len=60, hidden=60,
+                         factors=60, portfolios=128),
+}
 
-def bench_reference(args, x, y):
-    """Per-day-step seconds for the reference torch implementation."""
+
+def _ref_model(args):
     sys.path.insert(0, REFERENCE)
     import torch
     from module import (
@@ -44,7 +71,14 @@ def bench_reference(args, x, y):
     dec = FactorDecoder(AlphaLayer(args.hidden),
                         BetaLayer(args.hidden, args.factors))
     pred = FactorPredictor(args.hidden, args.factors)
-    model = FactorVAE(fe, enc, dec, pred)
+    return FactorVAE(fe, enc, dec, pred)
+
+
+def bench_reference(args, x, y):
+    """Per-day-step seconds for the reference torch implementation."""
+    import torch
+
+    model = _ref_model(args)
     opt = torch.optim.Adam(model.parameters(), lr=1e-4)
 
     xs = [torch.from_numpy(x[d]) for d in range(args.days)]
@@ -66,28 +100,48 @@ def bench_reference(args, x, y):
     return dt / (args.reps * args.days)
 
 
-def bench_ours(args, x, y):
-    """Per-day-step seconds for factorvae_tpu on the JAX CPU backend."""
+def bench_reference_scoring(args, x):
+    """Prediction windows/sec for the reference (the utils.py:70-87
+    scoring loop: per-day `model.prediction` under no_grad)."""
+    import torch
+
+    model = _ref_model(args)
+    model.eval()
+    xs = [torch.from_numpy(x[d]) for d in range(args.days)]
+    with torch.no_grad():
+        for d in range(min(2, args.days)):  # warmup
+            model.prediction(xs[d])
+        t0 = time.time()
+        for _ in range(args.reps):
+            for d in range(args.days):
+                model.prediction(xs[d])
+        dt = time.time() - t0
+    n_windows = args.reps * args.days * args.stocks
+    return dt / (args.reps * args.days), n_windows / dt
+
+
+def _ours_setup(args, x, y):
+    """Panel built from the SAME arrays the torch path consumes: panel
+    features at day d are x[d, :, -1, :] (the window's last row), so
+    both sides train on identical synthetic data; the window gather
+    reconstructs per-day windows from the panel on device."""
     sys.path.insert(0, REPO)
     from factorvae_tpu.utils.testing import force_host_devices
 
     force_host_devices(1)
 
     import numpy as np
+    import pandas as pd
 
     from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from factorvae_tpu.data import PanelDataset
     from factorvae_tpu.data.panel import Panel
-    from factorvae_tpu.train import Trainer
-    from factorvae_tpu.utils.logging import MetricsLogger
-
-    import pandas as pd
 
     feats = np.swapaxes(x[:, :, -1, :], 0, 1)  # (N, D, C): last window row
     labels = np.swapaxes(y, 0, 1)[..., None]   # (N, D, 1)
-    values = np.concatenate([feats, labels], axis=-1)
+    values = np.concatenate([feats, labels], axis=-1).astype(np.float32)
     panel = Panel(
-        values=values.astype(np.float32),
+        values=values,
         valid=np.ones((args.days, args.stocks), bool),
         dates=pd.bdate_range("2020-01-01", periods=args.days),
         instruments=np.array([f"I{i}" for i in range(args.stocks)]),
@@ -96,17 +150,28 @@ def bench_ours(args, x, y):
     cfg = Config(
         model=ModelConfig(num_features=args.features, hidden_size=args.hidden,
                           num_factors=args.factors,
-                          num_portfolios=args.portfolios, seq_len=args.seq_len),
+                          num_portfolios=args.portfolios, seq_len=args.seq_len,
+                          compute_dtype=getattr(args, "ours_dtype",
+                                                "bfloat16")),
         data=DataConfig(seq_len=args.seq_len, start_time=None, fit_end_time=None,
                         val_start_time=None, val_end_time=None),
         train=TrainConfig(num_epochs=1 + args.reps,
                           days_per_step=args.ours_days_per_step, seed=0,
                           checkpoint_every=0, save_dir="/tmp/factorvae_cmp"),
     )
-    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
-    state = trainer.init_state()
+    return cfg, ds
+
+
+def bench_ours(args, x, y):
+    """Per-day-step seconds for factorvae_tpu on the JAX CPU backend."""
+    cfg, ds = _ours_setup(args, x, y)
     import jax
 
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
     order = trainer._epoch_orders(0)
     state, m = trainer._train_epoch(state, order)  # warmup/compile
     jax.block_until_ready(m["loss"])
@@ -116,6 +181,94 @@ def bench_ours(args, x, y):
     jax.block_until_ready(m["loss"])
     dt = time.time() - t0
     return dt / (args.reps * args.days)
+
+
+def bench_ours_scoring(args, x, y):
+    """Prediction windows/sec for ours (chunked jitted predict_panel —
+    the eval/predict.py scoring path). NOTE: includes the on-device
+    window gather the torch loop gets for free (its loader cost is
+    excluded); see PERF.md round-5 caveats."""
+    from factorvae_tpu.eval.predict import predict_panel
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _ours_setup(args, x, y)
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+    days = ds.split_days(None, None)
+    chunk = min(16, len(days))
+    # predict_panel returns a host numpy array — already synchronized
+    predict_panel(state.params, cfg, ds, days, stochastic=False,
+                  chunk=chunk)  # warmup/compile
+    t0 = time.time()
+    for _ in range(args.reps):
+        predict_panel(state.params, cfg, ds, days, stochastic=False,
+                      chunk=chunk)
+    dt = time.time() - t0
+    n_windows = args.reps * args.days * args.stocks
+    return dt / (args.reps * args.days), n_windows / dt
+
+
+def run_config(name: str, shapes: dict, reps: int, skip: str,
+               ours_dtype: str = "bfloat16", days: int = 8) -> dict:
+    """One head-to-head row: train (dps=1 + dps=8 flattened) + scoring."""
+    import numpy as np
+
+    ns = argparse.Namespace(days=days, reps=reps, ours_days_per_step=1,
+                            ours_dtype=ours_dtype, **shapes)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(ns.days, ns.stocks, ns.seq_len, ns.features)
+                   ).astype(np.float32)
+    y = (rng.normal(size=(ns.days, ns.stocks)) * 0.02).astype(np.float32)
+
+    row = {"config": name, "shapes": shapes, "days": ns.days, "reps": reps,
+           "ours_dtype": ours_dtype}
+    if skip != "reference":
+        row["ref_train_sec_per_day"] = bench_reference(ns, x, y)
+        row["ref_score_sec_per_day"], row["ref_score_windows_per_sec"] = \
+            bench_reference_scoring(ns, x)
+    if skip != "ours":
+        row["ours_train_sec_per_day_dps1"] = bench_ours(ns, x, y)
+        ns8 = argparse.Namespace(**{**vars(ns), "ours_days_per_step": 8})
+        row["ours_train_sec_per_day_dps8_flat"] = bench_ours(ns8, x, y)
+        row["ours_score_sec_per_day"], row["ours_score_windows_per_sec"] = \
+            bench_ours_scoring(ns, x, y)
+    if skip == "none":
+        row["train_speedup_dps1"] = (row["ref_train_sec_per_day"]
+                                     / row["ours_train_sec_per_day_dps1"])
+        row["train_speedup_dps8_flat"] = (
+            row["ref_train_sec_per_day"]
+            / row["ours_train_sec_per_day_dps8_flat"])
+        row["score_speedup"] = (row["ref_score_sec_per_day"]
+                                / row["ours_score_sec_per_day"])
+    return row
+
+
+def markdown_table(rows) -> str:
+    hdr = ("| config | ref train s/day | ours s/day (dps=1) | ours s/day "
+           "(dps=8 flat) | train × (dps=1) | train × (dps=8) | ref score "
+           "w/s | ours score w/s | score × |")
+    sep = "|" + "---|" * 9
+    lines = [hdr, sep]
+
+    def fmt(r, key, spec, suffix=""):
+        # --skip reference/ours rows lack the other side's columns
+        return format(r[key], spec) + suffix if key in r else "—"
+
+    for r in rows:
+        lines.append(
+            "| {config} | {ref} | {o1} | {o8} | {s1} | {s8} | {rw} | "
+            "{ow} | {ss} |".format(
+                config=r["config"],
+                ref=fmt(r, "ref_train_sec_per_day", ".3f"),
+                o1=fmt(r, "ours_train_sec_per_day_dps1", ".3f"),
+                o8=fmt(r, "ours_train_sec_per_day_dps8_flat", ".3f"),
+                s1=fmt(r, "train_speedup_dps1", ".2f", "×"),
+                s8=fmt(r, "train_speedup_dps8_flat", ".2f", "×"),
+                rw=fmt(r, "ref_score_windows_per_sec", ",.0f"),
+                ow=fmt(r, "ours_score_windows_per_sec", ",.0f"),
+                ss=fmt(r, "score_speedup", ".2f", "×")))
+    return "\n".join(lines)
 
 
 def main():
@@ -131,9 +284,45 @@ def main():
     p.add_argument("--ours_days_per_step", type=int, default=1,
                    help="batched-update mode for the jax side (1 = faithful)")
     p.add_argument("--skip", choices=["none", "reference", "ours"], default="none")
+    p.add_argument("--config", choices=sorted(CONFIGS), default=None,
+                   help="use a preset-shaped config instead of the flags")
+    p.add_argument("--table", action="store_true",
+                   help="run ALL preset configs (train dps=1/dps=8 + "
+                        "scoring) and print the PERF.md markdown table")
+    p.add_argument("--ours_dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"],
+                   help="compute dtype for the jax side. bfloat16 is the "
+                        "shipped TPU default but is partly EMULATED on "
+                        "CPU; float32 is the apples-to-apples dtype vs "
+                        "torch's fp32 MKL path")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON result here")
     args = p.parse_args()
 
     import numpy as np
+
+    if args.table:
+        rows = []
+        for name, shapes in CONFIGS.items():
+            print(f"[h2h] {name}: {shapes}", file=sys.stderr)
+            rows.append(run_config(name, shapes, args.reps, args.skip,
+                                   ours_dtype=args.ours_dtype,
+                                   days=args.days))
+            print(json.dumps(rows[-1]), file=sys.stderr)
+        out = {"rows": rows, "markdown": markdown_table(rows),
+               "environment": f"same host, {os.cpu_count()} CPU core(s), "
+                              f"torch fp32 vs jax "
+                              f"({args.ours_dtype} compute)"}
+        print(json.dumps(out))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        print("\n" + out["markdown"], file=sys.stderr)
+        return
+
+    if args.config:
+        for k, v in CONFIGS[args.config].items():
+            setattr(args, k, v)
 
     rng = np.random.default_rng(0)
     # windows for torch path: (D, N, T, C); flat panel features for ours
@@ -152,6 +341,9 @@ def main():
             / out["factorvae_tpu_jax_cpu_sec_per_day_step"]
         )
     print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
 
 
 if __name__ == "__main__":

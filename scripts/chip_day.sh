@@ -6,10 +6,10 @@
 # immediately, and failures don't stop the sequence.
 #
 # Usage:   bash scripts/chip_day.sh [outdir]   (default: repo root)
-# Outputs: BENCH_r04_tpu.json + BENCH_TPU_CAPTURE.json (bench.py side
-#          effect), BENCH_DPS_SWEEP_r04.jsonl, RACE_KERNELS_TPU_r04.json,
-#          INT8_RACE_r04.json, TRACE_r04/ + TRACE_SUMMARY_r04.md,
-#          PARITY_RUN_r04.json — all under [outdir]; CHIP_DAY.log is the
+# Outputs: BENCH_r05_tpu.json + BENCH_TPU_CAPTURE.json (bench.py side
+#          effect), BENCH_DPS_SWEEP_r05.jsonl, RACE_KERNELS_TPU_r05.json,
+#          INT8_RACE_r05.json, TRACE_r05/ + TRACE_SUMMARY_r05.md,
+#          PARITY_RUN_r05.json — all under [outdir]; CHIP_DAY.log is the
 #          session transcript.
 
 set -u
@@ -33,44 +33,44 @@ print('platform:', d.platform)
   exit 1
 fi
 
-say "1/6 flagship bench (flattened default) -> BENCH_r04_tpu.json"
-timeout 1800 python bench.py >"$OUT/BENCH_r04_tpu.json" 2>>"$LOG" \
-  && say "bench ok: $(cat "$OUT/BENCH_r04_tpu.json")" \
+say "1/6 flagship bench (flattened default) -> BENCH_r05_tpu.json"
+timeout 1800 python bench.py >"$OUT/BENCH_r05_tpu.json" 2>>"$LOG" \
+  && say "bench ok: $(cat "$OUT/BENCH_r05_tpu.json")" \
   || say "bench FAILED (rc=$?)"
 
-say "2/6 days_per_step sweep -> BENCH_DPS_SWEEP_r04.jsonl"
-: >"$OUT/BENCH_DPS_SWEEP_r04.jsonl"
+say "2/6 days_per_step sweep -> BENCH_DPS_SWEEP_r05.jsonl"
+: >"$OUT/BENCH_DPS_SWEEP_r05.jsonl"
 for dps in 4 8 16 32; do
   BENCH_DAYS_PER_STEP=$dps timeout 1500 python bench.py \
-    >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+    >>"$OUT/BENCH_DPS_SWEEP_r05.jsonl" 2>>"$LOG" \
     && say "dps=$dps ok" || say "dps=$dps FAILED"
 done
 
-say "2b/6 flatten_days A/B (r3 thesis) -> appended to BENCH_DPS_SWEEP_r04.jsonl"
+say "2b/6 flatten_days A/B (r3 thesis) -> appended to BENCH_DPS_SWEEP_r05.jsonl"
 BENCH_FLATTEN=0 timeout 1500 python bench.py \
-  >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+  >>"$OUT/BENCH_DPS_SWEEP_r05.jsonl" 2>>"$LOG" \
   && say "flatten=0 ok" || say "flatten=0 FAILED"
 
 say "2c/6 preset-scale benches (csi800 N=1024, alpha360 C=360/T=60)"
 BENCH_STOCKS=1020 BENCH_HIDDEN=60 BENCH_FACTORS=60 timeout 1500 \
-  python bench.py >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+  python bench.py >>"$OUT/BENCH_DPS_SWEEP_r05.jsonl" 2>>"$LOG" \
   && say "csi800-scale ok" || say "csi800-scale FAILED"
 BENCH_FEATURES=360 BENCH_SEQ_LEN=60 BENCH_HIDDEN=60 BENCH_FACTORS=60 \
-  timeout 1500 python bench.py >>"$OUT/BENCH_DPS_SWEEP_r04.jsonl" 2>>"$LOG" \
+  timeout 1500 python bench.py >>"$OUT/BENCH_DPS_SWEEP_r05.jsonl" 2>>"$LOG" \
   && say "alpha360-scale ok" || say "alpha360-scale FAILED"
 
-say "3/6 kernel race at flattened shapes -> RACE_KERNELS_TPU_r04.json"
+say "3/6 kernel race at flattened shapes -> RACE_KERNELS_TPU_r05.json"
 timeout 3600 python scripts/race_kernels.py \
-  --out "$OUT/RACE_KERNELS_TPU_r04.json" >>"$LOG" 2>&1 \
+  --out "$OUT/RACE_KERNELS_TPU_r05.json" >>"$LOG" 2>&1 \
   && say "race ok" || say "race FAILED (rc=$?)"
 
-say "4/6 int8 scoring race -> INT8_RACE_r04.json"
+say "4/6 int8 scoring race -> INT8_RACE_r05.json"
 timeout 1200 python scripts/bench_int8_scoring.py \
-  >"$OUT/INT8_RACE_r04.json" 2>>"$LOG" \
+  >"$OUT/INT8_RACE_r05.json" 2>>"$LOG" \
   && say "int8 ok" || say "int8 FAILED (rc=$?)"
 
-say "5/6 profiler trace of flagship training -> TRACE_SUMMARY_r04.md"
-rm -rf "$OUT/TRACE_r04"; mkdir -p /tmp/chipday
+say "5/6 profiler trace of flagship training -> TRACE_SUMMARY_r05.md"
+rm -rf "$OUT/TRACE_r05"; mkdir -p /tmp/chipday
 timeout 900 python - >>"$LOG" 2>&1 <<'EOF'
 from factorvae_tpu.data import synthetic_frame
 synthetic_frame(num_days=80, num_instruments=356, num_features=158,
@@ -83,15 +83,15 @@ timeout 1800 python -m factorvae_tpu.cli \
   --days_per_step 8 --save_dir /tmp/chipday/models \
   --score_start 2020-04-13 --score_end 2020-04-21 \
   --score_dir /tmp/chipday/scores \
-  --profile "$OUT/TRACE_r04" >>"$LOG" 2>&1 \
+  --profile "$OUT/TRACE_r05" >>"$LOG" 2>&1 \
   && say "trace captured" || say "trace FAILED (rc=$?)"
-timeout 600 python -m factorvae_tpu.utils.trace_summary "$OUT/TRACE_r04" \
-  >"$OUT/TRACE_SUMMARY_r04.md" 2>>"$LOG" \
+timeout 600 python -m factorvae_tpu.utils.trace_summary "$OUT/TRACE_r05" \
+  >"$OUT/TRACE_SUMMARY_r05.md" 2>>"$LOG" \
   && say "trace summarized" || say "trace summary FAILED"
 
-say "6/6 k60 parity sweep ON CHIP (full protocol) -> PARITY_RUN_r04.json"
+say "6/6 k60 parity sweep ON CHIP (full protocol) -> PARITY_RUN_r05.json"
 timeout 14400 python scripts/parity_k60_sweep.py \
-  --epochs 50 --seeds 8 --out "$OUT/PARITY_RUN_r04.json" >>"$LOG" 2>&1 \
+  --epochs 50 --seeds 8 --out "$OUT/PARITY_RUN_r05.json" >>"$LOG" 2>&1 \
   && say "parity sweep ok" || say "parity sweep FAILED/partial (rc=$?)"
 
 say "chip day complete; artifacts in $OUT"

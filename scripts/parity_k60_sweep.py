@@ -36,11 +36,14 @@ import sys
 import time
 
 import numpy as np
-import pandas as pd
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from parity_protocol import build_proxy_panel, load_ref_scores  # noqa: E402
+from parity_protocol import (  # noqa: E402
+    build_proxy_panel,
+    load_ref_scores,
+    panel_labels,
+)
 
 PRESET = "csi300-k60"
 
@@ -139,14 +142,7 @@ def main(argv=None) -> int:
     enable_persistent_compile_cache()
     ref = load_ref_scores(args.scores_dir)
     panel, prefix_dates, window_dates = build_proxy_panel(ref)
-    labels = pd.Series(
-        panel.values[..., -1].T[panel.valid],
-        index=pd.MultiIndex.from_arrays(
-            [np.repeat(panel.dates, panel.valid.sum(axis=1)),
-             np.concatenate([panel.instruments[panel.valid[i]]
-                             for i in range(len(panel.dates))])],
-            names=["datetime", "instrument"]),
-        name="LABEL0")
+    labels = panel_labels(panel)
     score_start = str(window_dates[0].date())
     score_end = str(window_dates[-1].date())
 

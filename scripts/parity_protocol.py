@@ -69,6 +69,21 @@ def load_ref_scores(scores_dir: str) -> dict:
     return out
 
 
+def panel_labels(panel) -> pd.Series:
+    """The panel's LABEL0 column as a (datetime, instrument)-indexed
+    Series over valid rows — the shared join target for every
+    proxy-panel Rank-IC computation (this driver, parity_k60_sweep,
+    k60_diagnose); keep the layout in ONE place."""
+    return pd.Series(
+        panel.values[..., -1].T[panel.valid],
+        index=pd.MultiIndex.from_arrays(
+            [np.repeat(panel.dates, panel.valid.sum(axis=1)),
+             np.concatenate([panel.instruments[panel.valid[i]]
+                             for i in range(len(panel.dates))])],
+            names=["datetime", "instrument"]),
+        name="LABEL0")
+
+
 def zscore_by_day(s: pd.Series) -> pd.Series:
     g = s.groupby(level=0)
     return (s - g.transform("mean")) / g.transform("std").replace(0, np.nan)
@@ -154,14 +169,7 @@ def main(argv=None) -> int:
     enable_persistent_compile_cache()
     ref = load_ref_scores(args.scores_dir)
     panel, prefix_dates, window_dates = build_proxy_panel(ref)
-    labels = pd.Series(
-        panel.values[..., -1].T[panel.valid],
-        index=pd.MultiIndex.from_arrays(
-            [np.repeat(panel.dates, panel.valid.sum(axis=1)),
-             np.concatenate([panel.instruments[panel.valid[i]]
-                             for i in range(len(panel.dates))])],
-            names=["datetime", "instrument"]),
-        name="LABEL0")
+    labels = panel_labels(panel)
 
     # split: train on the prefix minus a 60-day validation tail
     fit_end = prefix_dates[-61]
