@@ -47,6 +47,30 @@ class TestTrainerMechanics:
         assert np.isfinite(out["history"][0]["train_loss"])
         assert np.isfinite(out["best_val"])
 
+    def test_epoch_records_decompose_the_loss(self, tiny_dataset, tmp_path):
+        """r5: epoch records carry the on-device recon/kl decomposition
+        (module.py:261,268 structure) and it must actually decompose:
+        loss = recon + kl_weight * kl, train and val both."""
+        import dataclasses
+
+        _, ds = tiny_dataset
+        cfg = small_config(tmp_path)
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, kl_weight=0.25),
+            data=dataclasses.replace(
+                cfg.data, fit_end_time=str(ds.dates[14].date()),
+                val_start_time=str(ds.dates[15].date()),
+                val_end_time=str(ds.dates[-1].date())))
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        _, out = tr.fit()
+        for h in out["history"]:
+            for side in ("train", "val"):
+                loss, recon, kl = (h[f"{side}_loss"], h[f"{side}_recon"],
+                                   h[f"{side}_kl"])
+                assert np.isfinite([loss, recon, kl]).all()
+                np.testing.assert_allclose(loss, recon + 0.25 * kl,
+                                           rtol=2e-5, atol=1e-6)
+
     def test_fit_num_epochs_override_rebuilds_schedule(self, tiny_dataset, tmp_path):
         """fit(num_epochs=N, rescale_schedule=True) must retune the cosine
         horizon to the actual run length; without the flag the horizon
