@@ -27,8 +27,10 @@ force_host_devices(8)
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+# version-tolerant: `jax.shard_map` is public only from jax 0.6
+from factorvae_tpu.parallel.compat import shard_map
 
 from factorvae_tpu.ops.masked import masked_softmax
 from factorvae_tpu.parallel.collective_ops import (

@@ -77,6 +77,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(kept for round-2 command lines)")
     p.add_argument("--max_stocks", type=int, default=None,
                    help="cross-section padding N_max (default: inferred)")
+    p.add_argument("--auto_plan", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="let the execution planner (factorvae_tpu/plan.py) "
+                        "pick days_per_step, compute dtype, day-batch "
+                        "layout and the cross-section pad target for this "
+                        "backend+shape from its measured envelope table "
+                        "(PLAN_TABLE.json / scripts/autotune_plan.py); "
+                        "unmeasured shapes get the conservative "
+                        "per-backend default. Explicitly passed flags "
+                        "(--days_per_step, --bf16/--no-bf16, "
+                        "--max_stocks) still win")
     p.add_argument("--score_only", action="store_true",
                    help="skip training; score [--score_start, --score_end] from the best checkpoint")
     p.add_argument("--score_start", type=str, default="2019-01-01")
@@ -283,8 +294,31 @@ def main(argv=None) -> int:
         return 2
 
     frame = load_frame(cfg.data.dataset_path, cfg.data.select_feature)
+    panel = build_panel(frame)
+
+    auto_plan = None
+    if args.auto_plan:
+        # Adaptive execution planner: measured per-(platform, shape)
+        # knobs, conservative per-backend defaults elsewhere. Explicit
+        # flags keep precedence (their argparse sentinel is None when
+        # not passed).
+        from factorvae_tpu import plan as planlib
+
+        auto_plan = planlib.plan_for_config(
+            cfg, panel.num_instruments,
+            shard=args.mesh_stock if args.mesh else 1)
+        cfg = planlib.apply_plan(
+            cfg, auto_plan,
+            keep_days_per_step=args.days_per_step is not None,
+            keep_dtype=args.bf16 is not None,
+            keep_pad=args.max_stocks is not None,
+            keep_kernels=args.pallas is not None or args.pallas_auto,
+        )
+        logger.log("plan", **auto_plan.describe(
+            planlib.shape_of(cfg, panel.num_instruments)))
+
     dataset = PanelDataset(
-        build_panel(frame),
+        panel,
         seq_len=cfg.data.seq_len,
         max_stocks=cfg.data.max_stocks,
         pad_multiple=cfg.data.pad_multiple,
@@ -338,8 +372,24 @@ def main(argv=None) -> int:
 
     from factorvae_tpu.eval import RankIC, export_scores, generate_prediction_scores
 
+    score_cfg = cfg
+    if auto_plan is not None:
+        # Scoring gets the plan's SCORING knobs — the measured winner
+        # flips between workloads (r05: the scoring dtype/layout winner
+        # differs from the training one). Safe on the same params:
+        # compute_dtype only casts activations and flatten_days keeps an
+        # identical parameter tree. A user-forced dtype still wins.
+        import dataclasses
+
+        from factorvae_tpu import plan as planlib
+
+        m = planlib.score_model_config(cfg.model, auto_plan)
+        if args.bf16 is not None:
+            m = dataclasses.replace(m, compute_dtype=cfg.model.compute_dtype)
+        score_cfg = dataclasses.replace(cfg, model=m)
+
     scores = generate_prediction_scores(
-        params, cfg, dataset,
+        params, score_cfg, dataset,
         start=args.score_start, end=args.score_end,
         stochastic=None,  # defer to cfg.model.stochastic_inference
         with_labels=True,

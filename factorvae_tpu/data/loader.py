@@ -68,6 +68,11 @@ class PanelDataset:
         if n_max < n_inst:
             raise ValueError(f"max_stocks={n_max} < {n_inst} instruments")
         self.n_max = n_max
+        # Padding accounting (bench.py masked-compute reporting): every
+        # matmul runs n_max rows but only n_real carry data — the gap is
+        # dead compute the scale-aware pad policy (plan.pad_target_policy)
+        # exists to minimize.
+        self.n_real = n_inst
 
         d = panel.num_days
         values = np.full((n_max, d, panel.values.shape[-1]), np.nan, np.float32)
@@ -83,6 +88,11 @@ class PanelDataset:
         self.valid = valid
         self.dates = panel.dates
         self.instruments = panel.instruments
+
+    @property
+    def dead_compute_frac(self) -> float:
+        """Fraction of cross-section rows that are permanent padding."""
+        return 1.0 - self.n_real / self.n_max
 
     # ---- splits ----------------------------------------------------------
 

@@ -3,15 +3,25 @@
 Capability parity with reference utils.py:70-93 / backtest.ipynb cell 1
 (`generate_prediction_scores`): run `prediction()` day by day and emit a
 (datetime, instrument)-indexed `score` DataFrame aligned via the sampler's
-index. Here the per-day loop is a chunked, jitted day-batched apply over
-the HBM-resident panel; scores come back as one (D, N_max) array and are
-flattened against the validity mask.
+index. Here the whole scoring pass is ONE jitted program: a `lax.scan`
+over day-chunks gathers each chunk's windows from the HBM-resident panel,
+applies the day-batched prediction model, and stacks the (D, N_max)
+scores on device — a single dispatch and a single device->host sync per
+call, instead of the per-chunk Python dispatch + re-pad + `np.asarray`
+sync the round-1..5 chunk loop paid (which lost to the reference torch
+loop at the k60 preset shapes on CPU; PERF.md round 5).
+
+Deterministic inference (`stochastic=False`, the reproducible-backtest
+mode) takes a fast path that threads no RNG at all — the prediction
+graph draws neither sample nor dropout noise, so the scan carries only
+the day indices.
 
 The reference's predictions are stochastic at inference (module.py:123
 draws a reparameterized sample; SURVEY.md §3.3) — reproduced when
-`stochastic=True`; `stochastic=False` (default from the config) scores
-with the distribution mean, which is deterministic and what you want for
-a reproducible backtest.
+`stochastic=True` with the exact same per-chunk RNG stream as the chunk
+loop (`fold_in(base, chunk_start)`), so both implementations produce
+bitwise-identical scores (tested); the loop survives as
+`impl="chunk_loop"` for A/B timing.
 """
 
 from __future__ import annotations
@@ -29,6 +39,34 @@ from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.models.factorvae import day_prediction
 
 
+def _deterministic(model_cfg: ModelConfig, stochastic: Optional[bool]) -> bool:
+    return not (model_cfg.stochastic_inference if stochastic is None
+                else stochastic)
+
+
+def _make_chunk_scorer(model_cfg: ModelConfig, seq_len: int,
+                       stochastic: Optional[bool]):
+    """(params, panel..., days (B,), key) -> (B, N_max) scores. Shared by
+    the scan path (as the scan body) and the chunk-loop path (jitted
+    directly). The deterministic fast path passes no rngs at all."""
+    model = day_prediction(model_cfg, stochastic=stochastic)
+    det = _deterministic(model_cfg, stochastic)
+
+    from factorvae_tpu.data.windows import gather_day
+
+    def chunk_scores(p, values, last_valid, next_valid, days, key):
+        def one(d):
+            return gather_day(values, last_valid, next_valid, d, seq_len)
+
+        x, _, mask = jax.vmap(one)(jnp.maximum(days, 0))
+        mask = mask & (days >= 0)[:, None]
+        if det:
+            return model.apply(p, x, mask)
+        return model.apply(p, x, mask, rngs={"sample": key})
+
+    return chunk_scores
+
+
 @functools.lru_cache(maxsize=32)
 def _score_chunk_fn(
     model_cfg: ModelConfig,
@@ -36,14 +74,11 @@ def _score_chunk_fn(
     stochastic: Optional[bool],
     int8: bool,
 ):
-    """Jitted chunk scorer, cached so repeated predict_panel calls (seed
-    sweeps, benchmarks, chunked exports) reuse the compiled program
-    instead of re-tracing a fresh closure every call. ModelConfig is a
-    frozen dataclass, so it is its own cache key."""
-    model = day_prediction(model_cfg, stochastic=stochastic)
+    """Jitted single-chunk scorer (the `impl="chunk_loop"` path), cached
+    so repeated calls reuse the compiled program. ModelConfig is a frozen
+    dataclass, so it is its own cache key."""
+    chunk_scores = _make_chunk_scorer(model_cfg, seq_len, stochastic)
     compute_dtype = model_cfg.dtype
-
-    from factorvae_tpu.data.windows import gather_day
 
     # The panel arrays are explicit jit arguments (not closed over) so
     # they never enter the compile payload — see train/loop.py. `params`
@@ -55,15 +90,44 @@ def _score_chunk_fn(
             from factorvae_tpu.ops.quant import dequantize_params
 
             p = dequantize_params(p, compute_dtype)
-
-        def one(d):
-            return gather_day(values, last_valid, next_valid, d, seq_len)
-
-        x, _, mask = jax.vmap(one)(jnp.maximum(day_idx, 0))
-        mask = mask & (day_idx >= 0)[:, None]
-        return model.apply(p, x, mask, rngs={"sample": key})
+        return chunk_scores(p, values, last_valid, next_valid, day_idx, key)
 
     return score_chunk
+
+
+@functools.lru_cache(maxsize=32)
+def _score_scan_fn(
+    model_cfg: ModelConfig,
+    seq_len: int,
+    stochastic: Optional[bool],
+    int8: bool,
+):
+    """Whole-pass jitted scorer: lax.scan over (S, chunk) day indices ->
+    (S, chunk, N_max) scores, one dispatch for the entire date range.
+
+    The day-index and per-chunk key buffers are donated — they are
+    rebuilt per call and XLA may reuse them in place (donation is a
+    no-op on backends without aliasing support, e.g. CPU)."""
+    chunk_scores = _make_chunk_scorer(model_cfg, seq_len, stochastic)
+    compute_dtype = model_cfg.dtype
+    donate = (4, 5) if jax.default_backend() != "cpu" else ()
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def score_scan(p, values, last_valid, next_valid, day_idx, keys):
+        if int8:
+            from factorvae_tpu.ops.quant import dequantize_params
+
+            p = dequantize_params(p, compute_dtype)
+
+        def body(carry, inp):
+            days, key = inp
+            return carry, chunk_scores(
+                p, values, last_valid, next_valid, days, key)
+
+        _, scores = jax.lax.scan(body, 0, (day_idx, keys))
+        return scores
+
+    return score_scan
 
 
 def predict_panel(
@@ -75,8 +139,15 @@ def predict_panel(
     seed: int = 0,
     chunk: int = 32,
     int8: bool = False,
+    impl: str = "scan",
 ) -> np.ndarray:
     """(len(days), N_max) float scores; padded/absent entries are NaN.
+
+    `impl="scan"` (default) runs the whole pass as one jitted scan over
+    day-chunks; `impl="chunk_loop"` is the pre-overhaul per-chunk
+    dispatch loop, kept for A/B timing and pinned exactly equal by
+    tests/test_eval.py (same RNG stream: chunk c0 uses
+    `fold_in(PRNGKey(seed), c0)` on both paths).
 
     `int8=True` stores the weight matrices in HBM as per-channel int8
     (ops/quant.py) and dequantizes them inside the compiled program —
@@ -87,20 +158,48 @@ def predict_panel(
 
         params = quantize_params(params)
 
-    score_chunk = _score_chunk_fn(
-        config.model, config.data.seq_len, stochastic, int8)
-
-    out = np.full((len(days), dataset.n_max), np.nan, np.float32)
+    n_days = len(days)
     base = jax.random.PRNGKey(seed)
-    for c0 in range(0, len(days), chunk):
-        sel = days[c0 : c0 + chunk]
-        padded = np.full(chunk, -1, np.int32)
-        padded[: len(sel)] = sel
-        scores = score_chunk(
-            params, dataset.values, dataset.last_valid, dataset.next_valid,
-            jnp.asarray(padded), jax.random.fold_in(base, c0))
-        out[c0 : c0 + len(sel)] = np.asarray(scores)[: len(sel)]
-    return out
+
+    if impl == "chunk_loop":
+        score_chunk = _score_chunk_fn(
+            config.model, config.data.seq_len, stochastic, int8)
+        out = np.full((n_days, dataset.n_max), np.nan, np.float32)
+        for c0 in range(0, n_days, chunk):
+            sel = days[c0 : c0 + chunk]
+            padded = np.full(chunk, -1, np.int32)
+            padded[: len(sel)] = sel
+            scores = score_chunk(
+                params, dataset.values, dataset.last_valid,
+                dataset.next_valid, jnp.asarray(padded),
+                jax.random.fold_in(base, c0))
+            out[c0 : c0 + len(sel)] = np.asarray(scores)[: len(sel)]
+        return out
+    if impl != "scan":
+        raise ValueError(f"impl must be 'scan' or 'chunk_loop'; got {impl!r}")
+
+    if n_days == 0:
+        return np.full((0, dataset.n_max), np.nan, np.float32)
+    n_chunks = -(-n_days // chunk)
+    padded = np.full(n_chunks * chunk, -1, np.int32)
+    padded[:n_days] = days
+    day_idx = jnp.asarray(padded.reshape(n_chunks, chunk))
+    if _deterministic(config.model, stochastic):
+        # The fast path's scan body never reads the keys — don't pay
+        # one fold_in dispatch per chunk building a buffer of them.
+        keys = jnp.zeros((n_chunks, *base.shape), base.dtype)
+    else:
+        # One vmapped dispatch for the whole key buffer, bitwise-equal
+        # to per-chunk fold_in(base, c0) (pinned by tests/test_eval.py).
+        keys = jax.vmap(lambda c0: jax.random.fold_in(base, c0))(
+            jnp.arange(0, n_chunks * chunk, chunk))
+    score_scan = _score_scan_fn(
+        config.model, config.data.seq_len, stochastic, int8)
+    scores = score_scan(params, dataset.values, dataset.last_valid,
+                        dataset.next_valid, day_idx, keys)
+    out = np.asarray(scores, dtype=np.float32).reshape(
+        n_chunks * chunk, dataset.n_max)
+    return out[:n_days]
 
 
 def generate_prediction_scores(

@@ -37,9 +37,11 @@ def seed_sweep(
     """Returns a frame indexed by seed with columns
     [rank_ic, rank_ic_ir, best_val]; .attrs['summary'] holds mean/std.
 
-    ``on_seed(rec)`` (optional) fires after each seed completes so
-    long-running sweeps can persist partial results — a multi-hour CPU
-    sweep killed at round end should leave its finished seeds on disk.
+    ``on_seed(rec)`` (optional) fires after each seed completes —
+    including seeds adopted from ``prior_records`` — so long-running
+    sweeps can persist partial results: a multi-hour CPU sweep killed at
+    round end should leave its finished seeds on disk, and a resumed
+    sweep's partial file must contain the adopted seeds too.
 
     ``prior_records`` (optional) maps seed -> an already-finished record
     (``{"rank_ic": float, ...}``, or a bare rank_ic float as older
@@ -71,6 +73,13 @@ def seed_sweep(
             }
             records.append(rec)
             logger.log("sweep_seed_resumed", **rec)
+            # Fire on_seed for resumed seeds too (ADVICE r5): callers
+            # that persist partial results inside on_seed would
+            # otherwise write files missing every seed adopted from
+            # prior_records — a resume-of-a-resume would then retrain
+            # them. Persisting an already-finished record is idempotent.
+            if on_seed is not None:
+                on_seed(rec)
             continue
         cfg = dataclasses.replace(
             config, train=dataclasses.replace(config.train, seed=int(seed))

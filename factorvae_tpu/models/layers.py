@@ -113,7 +113,7 @@ class GRU(nn.Module):
     return_sequence: bool = False
     # Fused Pallas recurrence kernel (ops/pallas/gru.py): whole-sequence
     # VMEM-resident scan with custom-VJP BPTT. Last-hidden output only.
-    # False | True | "auto" (per-shape measured choice, ops/pallas/select).
+    # False | True | "auto" (per-shape measured choice, factorvae_tpu/plan).
     use_pallas: Any = False
 
     @nn.compact
@@ -137,7 +137,7 @@ class GRU(nn.Module):
         )
         dtype = self.dtype or x.dtype
 
-        from factorvae_tpu.ops.pallas.select import pallas_gru_wins, resolve
+        from factorvae_tpu.plan import pallas_gru_wins, resolve
 
         use_pallas = resolve(
             self.use_pallas, pallas_gru_wins(n, t, h_dim))

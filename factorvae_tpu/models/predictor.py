@@ -60,10 +60,7 @@ class FactorPredictor(nn.Module):
         return mu, sigma
 
     def _use_pallas(self, n: int) -> bool:
-        from factorvae_tpu.ops.pallas.select import (
-            pallas_attention_wins,
-            resolve,
-        )
+        from factorvae_tpu.plan import pallas_attention_wins, resolve
 
         cfg = self.cfg
         return resolve(

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from factorvae_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
 _NEG_INF = -1e30
 
 
@@ -117,7 +119,7 @@ def multihead_cross_section_attention(
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((k, 1, h), jnp.float32),
         # heads are independent: a megacore TPU may split them
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from factorvae_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
 from factorvae_tpu.ops.pallas.attention import (
     _NEG_INF,
     multihead_cross_section_attention,
@@ -131,7 +133,7 @@ def _bwd_pallas(latent, maskf, dmask, query, w_key, b_key, w_val, b_val, dctx,
         ],
         # dlatent accumulates across the head grid (program_id(0)==0
         # init + += revisits): must stay sequential (no megacore split)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(

@@ -1,0 +1,14 @@
+"""Version-tolerant pallas-TPU names shared by the kernel modules.
+
+`CompilerParams` is the jax>=0.7 name; older releases (the sandbox's
+0.4.x included) call it `TPUCompilerParams`. Same fields either way.
+Kept in one place so the next jax rename is a one-line fix (the
+shard_map analogue lives in factorvae_tpu/parallel/compat.py).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")

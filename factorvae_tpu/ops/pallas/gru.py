@@ -55,6 +55,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from factorvae_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
 _N_BLOCK = 64        # max rows per grid step
 _VMEM_BUDGET = 12 * 2 ** 20   # target bytes for the backward's refs
 # (the v5e scoped-vmem limit is 16 MB; leave headroom for the compiler)
@@ -420,7 +422,7 @@ def _forward_impl(xs, ws, bs, n_rows, t_len, h_dim, nb, n_pad, grid):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_rows + n_pad, h_dim), jnp.float32),
         # row blocks are independent: a megacore TPU may split them
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*xs, *ws, *bs)
@@ -494,7 +496,7 @@ def _bwd_full(xs, ws, bs, n_rows, dh):
         ],
         # dWh/db accumulate across row blocks: the grid must stay
         # sequential (no megacore split)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(*xs, *ws, *bs, dh_in)
@@ -547,7 +549,7 @@ def _bwd_segmented(xs, ws, bs, n_rows, dh):
         # the d_h carry flows across segment iterations and dWh/db
         # accumulate across the whole grid: both axes must stay
         # sequential (no megacore split)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(*xs, *ws, *bs, dh_in, hck)

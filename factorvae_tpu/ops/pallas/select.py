@@ -1,66 +1,36 @@
-"""Measured per-shape kernel selection (ModelConfig.use_pallas_* = "auto").
+"""Measured per-shape kernel selection — thin shim over the planner.
 
-The round-2 race on a real v5e (scripts/race_kernels.py →
-RACE_KERNELS.json; PERF.md "Pallas kernels vs XLA on the chip") showed
-both paths are launch-bound at FactorVAE's op sizes, with reproducible
-per-shape winners on the full fwd+bwd:
-
-- attention: the fused kernel wins at small H (H=20: 1.38×/1.14×),
-  ties at H>=48, and loses slightly at flagship K=96/H=64 backward.
-- GRU: the fused recurrence wins at wide-N small-H short-T
-  (N=1024/T=20/H=20: 1.38×), ties at H=64, and clearly loses at T=60
-  (the VMEM-bounded 24-row backward blocking costs 1.6×).
-
-"auto" applies those measurements INSIDE the measured envelope only
-(VERDICT r3 missing-#4: the round-2 grid raced N ∈ {360, 1024}; the r3
-cross-day flattening moved the GRU's production row count to
-N = B·N_pad = 2880 at flagship, a shape with no race row). Outside the
-envelope auto resolves to the XLA path — extrapolating a win boundary
-to 2.8× the largest raced N would turn an unmeasured kernel on in the
-hot loop. When `scripts/race_kernels.py` (whose grid includes N=2880)
-lands chip rows for the flattened shapes, widen `_GRU_RACED_N_MAX` /
-`_ATTN_RACED_N_MAX` to the new measured envelope and encode any new
-winners here.
-
-Shapes are static under jit, so the choice is made at trace time with
-zero runtime cost. Off-TPU backends resolve to the XLA path (the
-kernels would only run interpreted).
+The envelope predicates behind ``ModelConfig.use_pallas_* = "auto"``
+(round-2 v5e race, RACE_KERNELS.json; PERF.md "Pallas kernels vs XLA on
+the chip") moved to `factorvae_tpu.plan`, which generalizes the same
+measured-envelope idea to the full execution plan (layout, day
+batching, dtype, padding). This module keeps the historical import path
+and the patchable `_on_tpu` seam the kernel tests use; the truth lives
+in plan.py — update envelopes there.
 """
 
 from __future__ import annotations
 
-import jax
+from factorvae_tpu import plan as _plan
+from factorvae_tpu.plan import resolve  # noqa: F401  (re-export)
 
-# Largest N with a measured race row (RACE_KERNELS.json, round-2 v5e).
-_GRU_RACED_N_MAX = 1024
-_ATTN_RACED_N_MAX = 1024
+# Re-exported so existing callers/tests can read the measured envelope.
+_GRU_RACED_N_MAX = _plan._GRU_RACED_N_MAX
+_ATTN_RACED_N_MAX = _plan._ATTN_RACED_N_MAX
 
 
 def _on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
+    """Patch point (tests mock this module's copy)."""
+    return _plan._on_tpu()
 
 
 def pallas_attention_wins(n: int, h: int, k: int) -> bool:
     """True where the fused attention beat XLA in the round-2 race;
-    False outside the raced envelope (no extrapolated wins). The raced
-    N values are {360, 1024} — both bounds are measured points."""
-    return _on_tpu() and 360 <= n <= _ATTN_RACED_N_MAX and h <= 24
+    False outside the raced envelope (no extrapolated wins)."""
+    return _plan.pallas_attention_wins(n, h, k, on_tpu=_on_tpu())
 
 
 def pallas_gru_wins(n: int, t: int, h: int) -> bool:
     """True where the fused GRU recurrence beat XLA in the race;
     False outside the raced envelope (no extrapolated wins)."""
-    return (_on_tpu() and 512 <= n <= _GRU_RACED_N_MAX
-            and h <= 24 and t <= 20)
-
-
-def resolve(flag, measured: bool) -> bool:
-    """Resolve a config tri-state (False | True | 'auto'). Any other
-    string is an error — a truthy fallback would force the kernels on
-    for a typo like "off" or "Auto"."""
-    if isinstance(flag, str):
-        if flag == "auto":
-            return measured
-        raise ValueError(
-            f"use_pallas_* must be False, True or 'auto'; got {flag!r}")
-    return bool(flag)
+    return _plan.pallas_gru_wins(n, t, h, on_tpu=_on_tpu())

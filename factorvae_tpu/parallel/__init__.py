@@ -1,3 +1,4 @@
+from factorvae_tpu.parallel.compat import shard_map
 from factorvae_tpu.parallel.mesh import (
     DATA_AXIS,
     HOST_AXIS,
@@ -41,5 +42,6 @@ __all__ = [
     "replicated",
     "ring_cross_section_attention",
     "shard_dataset",
+    "shard_map",
     "single_device_mesh",
 ]

@@ -1,0 +1,31 @@
+"""Version-tolerant `shard_map` (ISSUE 1 satellite).
+
+`jax.shard_map` became a public API in jax 0.6 (with the `check_vma=`
+keyword); earlier releases — including the sandbox's 0.4.x — only ship
+`jax.experimental.shard_map.shard_map` with the equivalent keyword
+spelled `check_rep=`. Every call site in this package (and the tests /
+examples) goes through this wrapper so both spellings work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+try:  # jax >= 0.6: public API
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental API, check_rep= keyword
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh: Any, in_specs: Any, out_specs: Any,
+              check_vma: Optional[bool] = None):
+    """`jax.shard_map` with the keyword signature of the public (>=0.6)
+    API; `check_vma` maps to `check_rep` on older releases. Leave it
+    None to take the jax default."""
+    kw = {} if check_vma is None else {_CHECK_KW: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -141,8 +141,9 @@ def predictor_prior_ring(
     `cfg` an optional ModelConfig supplying `leaky_relu_slope` (defaults
     to the torch default 0.01 the reference uses).
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from factorvae_tpu.parallel.compat import shard_map
 
     slope = cfg.leaky_relu_slope if cfg is not None else 0.01
     p = params.get("params", params)

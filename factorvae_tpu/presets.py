@@ -31,13 +31,17 @@ PRESETS = {
     "csi300-k20": _csi300(20, 20, "free20"),
     "csi300-k48": _csi300(48, 48, "free48"),
     "csi300-k60": _csi300(60, 60, "free60"),
-    # BASELINE.json config 4: CSI800 full cross-section (N ~= 800)
+    # BASELINE.json config 4: CSI800 full cross-section (N ~= 800).
+    # No fixed max_stocks: the old 1024 pad made 28% of every matmul
+    # dead rows (SCALE_DEMO.json); the scale-aware pad policy
+    # (plan.pad_target_policy) now pads 800 -> 800. Pass --max_stocks
+    # (or a 'stock' mesh axis, which the policy folds in via its shard
+    # argument) when even sharding needs a specific width.
     "csi800-k60": Config(
         model=ModelConfig(num_features=158, hidden_size=60, num_factors=60,
                           num_portfolios=128, seq_len=20,
                           compute_dtype="bfloat16"),
-        data=DataConfig(dataset_path="./data/csi800_data.pkl", seq_len=20,
-                        max_stocks=1024),
+        data=DataConfig(dataset_path="./data/csi800_data.pkl", seq_len=20),
         train=TrainConfig(run_name="csi800_k60"),
     ),
     # BASELINE.json config 5: Alpha360 features, seq_len=60

@@ -86,6 +86,22 @@ class Trainer:
         self._shard_batch = shard_batch
         self._build_step_fns()
 
+        # The effective execution layout, as one structured record — the
+        # training-loop counterpart of bench.py's `plan` block, so a
+        # metrics stream always shows which configuration (planner-chosen
+        # or hand-set) actually ran (cli --auto_plan logs the planner's
+        # decision + provenance separately as "plan").
+        self.logger.log(
+            "execution_layout",
+            flatten_days=config.model.flatten_days,
+            days_per_step=self.batch_days,
+            compute_dtype=config.model.compute_dtype,
+            n_real=getattr(dataset, "n_real", dataset.n_max),
+            n_padded=dataset.n_max,
+            dead_compute_frac=round(
+                getattr(dataset, "dead_compute_frac", 0.0), 4),
+        )
+
     def _build_step_fns(self) -> None:
         """(Re)build optimizer + jitted epoch fns for the current
         `self.total_steps`. Called again by `fit(num_epochs=...)` when the

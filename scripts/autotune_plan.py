@@ -1,0 +1,274 @@
+"""Bounded micro-autotune for the execution planner (factorvae_tpu/plan).
+
+Races the candidate execution paths for one or more preset shapes ON THE
+CURRENT BACKEND and persists the measured winners as envelope-table rows
+(`PLAN_TABLE.json`, env `FACTORVAE_PLAN_TABLE`), so `plan_for` resolves
+them with provenance "measured" instead of falling back to the
+conservative per-backend default. One command, bounded by construction:
+
+- the candidate set is fixed and small — train races
+  {reference-faithful un-flattened dps=1, flattened dps=8} x
+  {float32, bfloat16}; scoring races {un-flattened, flattened} x
+  {float32, bfloat16} over the single-dispatch scan path — 8 timed
+  programs per shape, each on a tiny synthetic panel (default 8 days);
+- the conservative default path is ALWAYS in the raced set, so a
+  written row is never slower than what the fallback would have run
+  (the planner cannot regress a measured shape);
+- every candidate timing is stored on the row (`measured`) for audit;
+  `plan_for` only reads the winner fields.
+
+Kernel on/off stays "auto" (the per-shape raced envelope in plan.py —
+racing interpreted Pallas kernels off-TPU would be meaningless).
+
+Usage:
+    python scripts/autotune_plan.py                       # flagship shape
+    python scripts/autotune_plan.py --config csi300-k60
+    python scripts/autotune_plan.py --all                 # every preset shape
+    python scripts/autotune_plan.py --all --days 4 --reps 1   # quickest
+        [--out PLAN_TABLE.json] [--dry_run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Preset-shaped race configs (shapes per factorvae_tpu/presets.py; real
+# cross-section widths — the pad policy decides the padded width).
+# `stocks` may be a list: each width is raced as its own measured point,
+# and adjacent points with IDENTICAL winners merge into one
+# [n_min, n_max] envelope row (the kernel-envelope precedent: both
+# bounds measured, no extrapolation beyond them). The flagship races
+# both its benchmark widths — 300 (bench_reference_cpu / the torch
+# head-to-head) and 356 (bench.py / the reference score CSVs) — so a
+# fresh autotune covers the shape bench.py actually resolves.
+SHAPES = {
+    "flagship": dict(stocks=[300, 356], features=158, seq_len=20, hidden=64,
+                     factors=96, portfolios=128),
+    "csi300-k60": dict(stocks=300, features=158, seq_len=20, hidden=60,
+                       factors=60, portfolios=128),
+    "csi800-k60": dict(stocks=800, features=158, seq_len=20, hidden=60,
+                       factors=60, portfolios=128),
+    "alpha360-k60": dict(stocks=300, features=360, seq_len=60, hidden=60,
+                         factors=60, portfolios=128),
+}
+
+# The bounded candidate grid. (flatten_days, days_per_step) pairs: the
+# two layouts that exist; dps rides the layout (un-flattened dps=8 and
+# flattened dps=1 are dominated operating points — see PERF.md r05).
+TRAIN_CANDIDATES = [
+    {"flatten_days": False, "days_per_step": 1},
+    {"flatten_days": True, "days_per_step": 8},
+]
+DTYPES = ["float32", "bfloat16"]
+SCORE_CANDIDATES = [{"flatten_days": f} for f in (False, True)]
+
+
+def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int):
+    from factorvae_tpu.config import (
+        Config, DataConfig, ModelConfig, TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+    from factorvae_tpu.plan import pad_target_policy
+
+    cfg = Config(
+        model=ModelConfig(
+            num_features=shape["features"], hidden_size=shape["hidden"],
+            num_factors=shape["factors"],
+            num_portfolios=shape["portfolios"], seq_len=shape["seq_len"],
+            compute_dtype=dtype, flatten_days=flatten,
+        ),
+        data=DataConfig(seq_len=shape["seq_len"], start_time=None,
+                        fit_end_time=None, val_start_time=None,
+                        val_end_time=None),
+        train=TrainConfig(num_epochs=1, days_per_step=dps, seed=0,
+                          checkpoint_every=0,
+                          save_dir="/tmp/factorvae_autotune"),
+    )
+    panel = synthetic_panel_dense(
+        num_days=days, num_instruments=shape["stocks"],
+        num_features=shape["features"])
+    ds = PanelDataset(panel, seq_len=shape["seq_len"],
+                      max_stocks=pad_target_policy(shape["stocks"]))
+    return cfg, ds
+
+
+def time_train(shape: dict, dtype: str, flatten: bool, dps: int,
+               days: int, reps: int) -> float:
+    """Seconds per trained day for one candidate (compile excluded)."""
+    import jax
+
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, dtype, flatten, dps, days)
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+    state, m = trainer._train_epoch(state, trainer._epoch_orders(0))  # warmup
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    for e in range(1, 1 + reps):
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(e))
+    jax.block_until_ready(m["loss"])
+    return (time.time() - t0) / (reps * days)
+
+
+def time_score(shape: dict, dtype: str, flatten: bool,
+               days: int, reps: int) -> float:
+    """Windows/second for one deterministic scoring candidate (the scan
+    path — the production eval/predict.py default)."""
+    from factorvae_tpu.eval.predict import predict_panel
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, dtype, flatten, dps=1, days=days)
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+    day_idx = ds.split_days(None, None)
+    chunk = min(16, len(day_idx))
+    predict_panel(state.params, cfg, ds, day_idx, stochastic=False,
+                  chunk=chunk)  # warmup/compile
+    t0 = time.time()
+    for _ in range(reps):
+        predict_panel(state.params, cfg, ds, day_idx, stochastic=False,
+                      chunk=chunk)
+    dt = time.time() - t0
+    return reps * days * shape["stocks"] / dt
+
+
+def race_shape(name: str, shape: dict, days: int, reps: int) -> dict:
+    """Race all candidates for one shape at ONE width (`shape['stocks']`
+    must be a scalar here — `race_widths` expands lists); return a
+    plan-table row."""
+    from factorvae_tpu.plan import ShapeKey, pad_target_policy, platform_kind
+
+    plat = platform_kind()
+    measured: dict = {"train": {}, "score": {}}
+
+    best_train, best_train_key = None, None
+    for cand in TRAIN_CANDIDATES:
+        for dtype in DTYPES:
+            key = (f"flat={int(cand['flatten_days'])}"
+                   f"_dps{cand['days_per_step']}_{dtype}")
+            sec = time_train(shape, dtype, cand["flatten_days"],
+                             cand["days_per_step"], days, reps)
+            measured["train"][key] = round(sec, 5)
+            print(f"[autotune] {name} train {key}: {sec:.4f} s/day",
+                  file=sys.stderr)
+            if best_train is None or sec < best_train:
+                best_train = sec
+                best_train_key = {**cand, "compute_dtype": dtype}
+
+    best_score, best_score_key = None, None
+    for cand in SCORE_CANDIDATES:
+        for dtype in DTYPES:
+            key = f"flat={int(cand['flatten_days'])}_{dtype}"
+            ws = time_score(shape, dtype, cand["flatten_days"], days, reps)
+            measured["score"][key] = round(ws, 1)
+            print(f"[autotune] {name} score {key}: {ws:,.0f} w/s",
+                  file=sys.stderr)
+            if best_score is None or ws > best_score:
+                best_score = ws
+                best_score_key = {**cand, "compute_dtype": dtype}
+
+    shp = ShapeKey(
+        num_features=shape["features"], seq_len=shape["seq_len"],
+        hidden_size=shape["hidden"], num_factors=shape["factors"],
+        num_portfolios=shape["portfolios"], n_stocks=shape["stocks"])
+    return {
+        "platform": plat,
+        "shape": {"c": shp.num_features, "t": shp.seq_len,
+                  "h": shp.hidden_size, "k": shp.num_factors,
+                  "m": shp.num_portfolios},
+        "n_min": shp.n_stocks, "n_max": shp.n_stocks,
+        "pad_target": pad_target_policy(shp.n_stocks, plat),
+        "train": best_train_key,
+        "score": best_score_key,
+        "measured": measured,
+        "source": f"autotune_plan {name} n={shp.n_stocks} on {plat} "
+                  f"(days={days}, reps={reps}): "
+                  f"train {best_train:.4f} s/day, "
+                  f"score {best_score:,.0f} w/s",
+    }
+
+
+def race_widths(name: str, shape: dict, days: int, reps: int) -> list:
+    """Race every width in `shape['stocks']` (scalar or list) and merge
+    adjacent widths with IDENTICAL winners into one [n_min, n_max]
+    envelope row — both bounds measured, no extrapolation beyond them
+    (the kernel-envelope precedent). Widths whose winners differ stay
+    separate single-width rows: no interpolation between them."""
+    widths = shape["stocks"]
+    if not isinstance(widths, (list, tuple)):
+        widths = [widths]
+    rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps)
+            for w in sorted(widths)]
+    merged = [rows[0]]
+    for r in rows[1:]:
+        p = merged[-1]
+        if (r["train"], r["score"]) != (p["train"], p["score"]):
+            merged.append(r)
+            continue
+        if not any(k.startswith("n=") for k in p["measured"]):
+            p["measured"] = {f"n={p['n_max']}": p["measured"]}
+        p["measured"][f"n={r['n_min']}"] = r["measured"]
+        p["n_max"] = r["n_max"]
+        # pad_target was measured at one width; the merged envelope
+        # spans several, so let plan_for re-derive it per queried width.
+        p.pop("pad_target", None)
+        p["source"] += f"; identical winners at n={r['n_min']}"
+    return merged
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description="bounded per-backend micro-autotune -> PLAN_TABLE.json")
+    p.add_argument("--config", choices=sorted(SHAPES), default="flagship")
+    p.add_argument("--all", action="store_true",
+                   help="race every preset shape (4x the runtime)")
+    p.add_argument("--days", type=int, default=8,
+                   help="synthetic panel days per timed run")
+    p.add_argument("--reps", type=int, default=2,
+                   help="timed repetitions per candidate")
+    p.add_argument("--out", default=None,
+                   help="plan table path (default: the planner's own "
+                        "resolution — FACTORVAE_PLAN_TABLE or "
+                        "PLAN_TABLE.json at the repo root)")
+    p.add_argument("--dry_run", action="store_true",
+                   help="race and print the rows without persisting")
+    args = p.parse_args()
+
+    from factorvae_tpu.plan import save_rows
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # An EXPLICIT CPU request: route through force_host_devices —
+        # the sandbox's axon sitecustomize pins the platform at
+        # jax-config level, so the env var alone doesn't switch (see
+        # utils/testing.py). When JAX_PLATFORMS is unset, leave jax's
+        # auto-detection alone: on a TPU host the race must run on the
+        # chip (forcing CPU here would persist platform="cpu" rows a
+        # TPU plan_for can never match).
+        from factorvae_tpu.utils.testing import force_host_devices
+
+        force_host_devices(1)
+
+    names = sorted(SHAPES) if args.all else [args.config]
+    rows = [r for n in names
+            for r in race_widths(n, SHAPES[n], args.days, args.reps)]
+    print(json.dumps({"rows": rows}, indent=1))
+    if args.dry_run:
+        print("[autotune] --dry_run: table not written", file=sys.stderr)
+        return 0
+    path = save_rows(rows, path=args.out)
+    print(f"[autotune] wrote {len(rows)} row(s) -> {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

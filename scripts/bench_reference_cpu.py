@@ -14,16 +14,26 @@ Measured per config (VERDICT r4 next-#5):
   (the flattened default operating point; flatten_days=True);
 - scoring: prediction windows/second over a D-day panel, reference
   (per-day `model.prediction` under no_grad, utils.py:70-87) vs ours
-  (chunked jitted `predict_panel`).
+  (the single-dispatch jitted-scan `predict_panel`, run with the
+  execution planner's scoring knobs for this backend+shape);
+- plan: what the execution planner (factorvae_tpu/plan.py) chooses for
+  this backend+shape, with provenance ("measured" envelope row vs the
+  conservative per-backend "default"), plus a timed run of the planner's
+  TRAIN choice (`ours_train_sec_per_day_plan`) — the check that a
+  planner decision is never slower than the best measured path.
 
 Configs mirror the BASELINE.json preset shapes (presets.py): flagship
-(H=64/K=96), csi300-k60 (H=K=60), csi800-k60 (N=1024) and alpha360-k60
+(H=64/K=96), csi300-k60 (H=K=60), csi800-k60 (N=800) and alpha360-k60
 (C=360, T=60).
 
 Usage:
     python scripts/bench_reference_cpu.py                # one config
     python scripts/bench_reference_cpu.py --table        # all 4 + markdown
     python scripts/bench_reference_cpu.py --config csi800-k60 --reps 2
+
+`--table` always writes the machine-readable artifact (default
+BENCH_REFERENCE_CPU.json, override with --out): rows + markdown + every
+`plan` block, so future rounds can diff planner decisions.
 """
 
 from __future__ import annotations
@@ -38,13 +48,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REFERENCE = os.environ.get("REFERENCE_PATH", "/root/reference")
 
 # Preset-shaped benchmark configs (shapes per factorvae_tpu/presets.py;
-# stock counts per BASELINE.md: CSI300 ~300 names, CSI800 padded 1024).
+# stock counts per BASELINE.md: CSI300 ~300 names, CSI800 ~800).
 CONFIGS = {
     "flagship": dict(stocks=300, features=158, seq_len=20, hidden=64,
                      factors=96, portfolios=128),
     "csi300-k60": dict(stocks=300, features=158, seq_len=20, hidden=60,
                        factors=60, portfolios=128),
-    "csi800-k60": dict(stocks=1024, features=158, seq_len=20, hidden=60,
+    # N=800: the real CSI800 cross-section. Through r05 this row ran at
+    # 1024 (the old fixed max_stocks pad); the scale-aware pad policy
+    # now pads 800 -> 800, so 1024 no longer corresponds to any
+    # production configuration — absolute csi800 rows are therefore not
+    # comparable with pre-PR-1 tables (the r05-path scoring A/B column
+    # is, it runs both paths at the same width).
+    "csi800-k60": dict(stocks=800, features=158, seq_len=20, hidden=60,
                        factors=60, portfolios=128),
     "alpha360-k60": dict(stocks=300, features=360, seq_len=60, hidden=60,
                          factors=60, portfolios=128),
@@ -152,7 +168,8 @@ def _ours_setup(args, x, y):
                           num_factors=args.factors,
                           num_portfolios=args.portfolios, seq_len=args.seq_len,
                           compute_dtype=getattr(args, "ours_dtype",
-                                                "bfloat16")),
+                                                "bfloat16"),
+                          flatten_days=getattr(args, "ours_flatten", True)),
         data=DataConfig(seq_len=args.seq_len, start_time=None, fit_end_time=None,
                         val_start_time=None, val_end_time=None),
         train=TrainConfig(num_epochs=1 + args.reps,
@@ -184,14 +201,17 @@ def bench_ours(args, x, y):
 
 
 def bench_ours_scoring(args, x, y):
-    """Prediction windows/sec for ours (chunked jitted predict_panel —
-    the eval/predict.py scoring path). NOTE: includes the on-device
-    window gather the torch loop gets for free (its loader cost is
-    excluded); see PERF.md round-5 caveats."""
+    """Prediction windows/sec for ours (the jitted `predict_panel`
+    scoring path; `args.ours_impl` picks "scan" — the single-dispatch
+    overhaul default — or "chunk_loop", the pre-overhaul per-chunk
+    dispatch loop kept for exactly this A/B). NOTE: includes the
+    on-device window gather the torch loop gets for free (its loader
+    cost is excluded); see PERF.md round-5 caveats."""
     from factorvae_tpu.eval.predict import predict_panel
     from factorvae_tpu.train import Trainer
     from factorvae_tpu.utils.logging import MetricsLogger
 
+    impl = getattr(args, "ours_impl", "scan")
     cfg, ds = _ours_setup(args, x, y)
     trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
     state = trainer.init_state()
@@ -199,19 +219,47 @@ def bench_ours_scoring(args, x, y):
     chunk = min(16, len(days))
     # predict_panel returns a host numpy array — already synchronized
     predict_panel(state.params, cfg, ds, days, stochastic=False,
-                  chunk=chunk)  # warmup/compile
+                  chunk=chunk, impl=impl)  # warmup/compile
     t0 = time.time()
     for _ in range(args.reps):
         predict_panel(state.params, cfg, ds, days, stochastic=False,
-                      chunk=chunk)
+                      chunk=chunk, impl=impl)
     dt = time.time() - t0
     n_windows = args.reps * args.days * args.stocks
     return dt / (args.reps * args.days), n_windows / dt
 
 
+def _plan_for_shapes(shapes: dict):
+    """Execution-planner decision for one benchmark config on this host."""
+    sys.path.insert(0, REPO)
+    from factorvae_tpu import plan as planlib
+
+    shape = planlib.ShapeKey(
+        num_features=shapes["features"], seq_len=shapes["seq_len"],
+        hidden_size=shapes["hidden"], num_factors=shapes["factors"],
+        num_portfolios=shapes["portfolios"], n_stocks=shapes["stocks"])
+    pl = planlib.plan_for(shape, platform="cpu")
+    return pl, pl.describe(shape, platform="cpu")
+
+
+def plan_label(plan_block: dict) -> str:
+    """Compact one-cell rendering of a plan block for the markdown table."""
+    d = plan_block
+    dt = {"float32": "f32", "bfloat16": "bf16"}.get(
+        d["compute_dtype"], d["compute_dtype"])
+    sdt = {"float32": "f32", "bfloat16": "bf16"}.get(
+        d["score_compute_dtype"], d["score_compute_dtype"])
+    return (f"dps{d['days_per_step']}·{dt}"
+            f"·{'flat' if d['flatten_days'] else 'unflat'}"
+            f" / score {sdt}"
+            f"·{'flat' if d['score_flatten_days'] else 'unflat'}"
+            f" [{d['provenance']}]")
+
+
 def run_config(name: str, shapes: dict, reps: int, skip: str,
                ours_dtype: str = "bfloat16", days: int = 8) -> dict:
-    """One head-to-head row: train (dps=1 + dps=8 flattened) + scoring."""
+    """One head-to-head row: train (dps=1 + dps=8 flattened + the
+    planner's choice) + scoring (planner knobs)."""
     import numpy as np
 
     ns = argparse.Namespace(days=days, reps=reps, ours_days_per_step=1,
@@ -221,8 +269,9 @@ def run_config(name: str, shapes: dict, reps: int, skip: str,
                    ).astype(np.float32)
     y = (rng.normal(size=(ns.days, ns.stocks)) * 0.02).astype(np.float32)
 
+    pl, plan_block = _plan_for_shapes(shapes)
     row = {"config": name, "shapes": shapes, "days": ns.days, "reps": reps,
-           "ours_dtype": ours_dtype}
+           "ours_dtype": ours_dtype, "plan": plan_block}
     if skip != "reference":
         row["ref_train_sec_per_day"] = bench_reference(ns, x, y)
         row["ref_score_sec_per_day"], row["ref_score_windows_per_sec"] = \
@@ -231,14 +280,40 @@ def run_config(name: str, shapes: dict, reps: int, skip: str,
         row["ours_train_sec_per_day_dps1"] = bench_ours(ns, x, y)
         ns8 = argparse.Namespace(**{**vars(ns), "ours_days_per_step": 8})
         row["ours_train_sec_per_day_dps8_flat"] = bench_ours(ns8, x, y)
+        # The planner's training choice, timed as its own row — with a
+        # "measured" row this must match that row's raced winner; with
+        # the "default" fallback it is the conservative per-backend path.
+        nsp = argparse.Namespace(**{
+            **vars(ns), "ours_days_per_step": pl.days_per_step,
+            "ours_dtype": pl.compute_dtype, "ours_flatten": pl.flatten_days})
+        row["ours_train_sec_per_day_plan"] = bench_ours(nsp, x, y)
+        # Scoring runs the planner's scoring knobs (the production
+        # default: cli --auto_plan / eval wiring score with these).
+        nss = argparse.Namespace(**{
+            **vars(ns), "ours_dtype": pl.score_compute_dtype,
+            "ours_flatten": pl.score_flatten_days})
         row["ours_score_sec_per_day"], row["ours_score_windows_per_sec"] = \
-            bench_ours_scoring(ns, x, y)
+            bench_ours_scoring(nss, x, y)
+        # The r05-era scoring path (bf16, flattened, per-chunk Python
+        # dispatch loop) timed on THIS host, so the artifact carries the
+        # overhaul's own A/B even when the torch reference mount is
+        # absent: new-vs-old ratio on identical hardware.
+        nsr = argparse.Namespace(**{
+            **vars(ns), "ours_dtype": "bfloat16", "ours_flatten": True,
+            "ours_impl": "chunk_loop"})
+        _, row["ours_score_windows_per_sec_r05_path"] = \
+            bench_ours_scoring(nsr, x, y)
+        row["score_path_speedup_vs_r05"] = (
+            row["ours_score_windows_per_sec"]
+            / row["ours_score_windows_per_sec_r05_path"])
     if skip == "none":
         row["train_speedup_dps1"] = (row["ref_train_sec_per_day"]
                                      / row["ours_train_sec_per_day_dps1"])
         row["train_speedup_dps8_flat"] = (
             row["ref_train_sec_per_day"]
             / row["ours_train_sec_per_day_dps8_flat"])
+        row["train_speedup_plan"] = (row["ref_train_sec_per_day"]
+                                     / row["ours_train_sec_per_day_plan"])
         row["score_speedup"] = (row["ref_score_sec_per_day"]
                                 / row["ours_score_sec_per_day"])
     return row
@@ -246,9 +321,9 @@ def run_config(name: str, shapes: dict, reps: int, skip: str,
 
 def markdown_table(rows) -> str:
     hdr = ("| config | ref train s/day | ours s/day (dps=1) | ours s/day "
-           "(dps=8 flat) | train × (dps=1) | train × (dps=8) | ref score "
-           "w/s | ours score w/s | score × |")
-    sep = "|" + "---|" * 9
+           "(dps=8 flat) | ours s/day (plan) | train × (plan) | ref score "
+           "w/s | ours score w/s | score × | plan |")
+    sep = "|" + "---|" * 10
     lines = [hdr, sep]
 
     def fmt(r, key, spec, suffix=""):
@@ -257,17 +332,18 @@ def markdown_table(rows) -> str:
 
     for r in rows:
         lines.append(
-            "| {config} | {ref} | {o1} | {o8} | {s1} | {s8} | {rw} | "
-            "{ow} | {ss} |".format(
+            "| {config} | {ref} | {o1} | {o8} | {op} | {sp} | {rw} | "
+            "{ow} | {ss} | {plan} |".format(
                 config=r["config"],
                 ref=fmt(r, "ref_train_sec_per_day", ".3f"),
                 o1=fmt(r, "ours_train_sec_per_day_dps1", ".3f"),
                 o8=fmt(r, "ours_train_sec_per_day_dps8_flat", ".3f"),
-                s1=fmt(r, "train_speedup_dps1", ".2f", "×"),
-                s8=fmt(r, "train_speedup_dps8_flat", ".2f", "×"),
+                op=fmt(r, "ours_train_sec_per_day_plan", ".3f"),
+                sp=fmt(r, "train_speedup_plan", ".2f", "×"),
                 rw=fmt(r, "ref_score_windows_per_sec", ",.0f"),
                 ow=fmt(r, "ours_score_windows_per_sec", ",.0f"),
-                ss=fmt(r, "score_speedup", ".2f", "×")))
+                ss=fmt(r, "score_speedup", ".2f", "×"),
+                plan=plan_label(r["plan"])))
     return "\n".join(lines)
 
 
@@ -296,10 +372,22 @@ def main():
                         "CPU; float32 is the apples-to-apples dtype vs "
                         "torch's fp32 MKL path")
     p.add_argument("--out", default=None,
-                   help="also write the JSON result here")
+                   help="write the JSON artifact here (--table default: "
+                        "BENCH_REFERENCE_CPU.json at the repo root)")
     args = p.parse_args()
 
     import numpy as np
+
+    # Skip the torch side cleanly when the reference mount is absent
+    # (same idiom as scripts/qlib_differential.py): the ours-side
+    # columns, the plan column and the r05-path scoring A/B still run,
+    # and the artifact records why the ref columns are missing.
+    reference_available = os.path.exists(os.path.join(REFERENCE, "module.py"))
+    if not reference_available and args.skip != "ours":
+        print(f"[h2h] reference mount not found at {REFERENCE} "
+              f"(set REFERENCE_PATH); skipping the torch side",
+              file=sys.stderr)
+        args.skip = "reference"
 
     if args.table:
         rows = []
@@ -309,14 +397,22 @@ def main():
                                    ours_dtype=args.ours_dtype,
                                    days=args.days))
             print(json.dumps(rows[-1]), file=sys.stderr)
+        from factorvae_tpu.plan import table_path
+
         out = {"rows": rows, "markdown": markdown_table(rows),
+               # the artifact future rounds diff planner decisions from
+               "plans": {r["config"]: r["plan"] for r in rows},
+               "plan_table": table_path(),
+               "reference_available": reference_available,
                "environment": f"same host, {os.cpu_count()} CPU core(s), "
                               f"torch fp32 vs jax "
-                              f"({args.ours_dtype} compute)"}
+                              f"({args.ours_dtype} compute; scoring runs "
+                              f"the planner's knobs)"}
         print(json.dumps(out))
-        if args.out:
-            with open(args.out, "w") as f:
-                json.dump(out, f, indent=1)
+        artifact = args.out or os.path.join(REPO, "BENCH_REFERENCE_CPU.json")
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[h2h] artifact -> {artifact}", file=sys.stderr)
         print("\n" + out["markdown"], file=sys.stderr)
         return
 

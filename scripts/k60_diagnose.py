@@ -25,7 +25,9 @@ statistics, and scores the reference window for Rank-IC.
 
 Output: K60_DIAGNOSIS.json — per-config loss curves, per-factor KL
 spectra, active-factor counts, and recovery fractions; the committed
-analysis lives in docs/k60_diagnosis.md.
+analysis of those numbers (posterior collapse from epoch ~2, KL ≈ 0,
+zero active factors at every preset) lives in the round-5 VERDICT.md
+"honest read" entries.
 
 Usage:
     python scripts/k60_diagnose.py [--epochs 18] [--out K60_DIAGNOSIS.json]

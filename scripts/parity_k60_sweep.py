@@ -203,10 +203,17 @@ def main(argv=None) -> int:
                 n += 1
                 bak = f"{args.out}.mismatch.bak{n}"
             shutil.move(args.out, bak)
+            # name only the fields that actually mismatch (ADVICE r5) —
+            # the CHIP_DAY.log reader should not have to guess which of
+            # three candidate causes blocked the resume
+            mismatches = [
+                f"{field} {prev.get(field)!r} != {want!r}"
+                for field, want in (("preset", PRESET), ("epochs", epochs),
+                                    ("platform", results["platform"]))
+                if prev.get(field) != want
+            ]
             print(f"[k60] NOT resuming from {args.out}: protocol "
-                  f"mismatch (epochs {prev.get('epochs')} != {epochs}, "
-                  f"platform {prev.get('platform')} != "
-                  f"{results['platform']}, or preset differs); "
+                  f"mismatch ({'; '.join(mismatches)}); "
                   f"moved the old artifact to {bak} and starting fresh "
                   "— CPU seeds must not silently mix into a TPU "
                   "statistics artifact or vice versa")

@@ -47,7 +47,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # rejections) blow straight through these.
 TOLERANCES = {
     "return": 5e-4,     # |daily gross return delta|
-    "turnover": 2e-2,   # |daily one-side turnover delta|
+    # |daily turnover delta|. Two-sided (buy+sell): simulate_topk_account
+    # reports (sells+buys)/start_value (backtest.py traded accumulator),
+    # matching qlib's convention — NOT the one-side buy fraction the
+    # lighter backtest_topk_dropout report uses.
+    "turnover": 2e-2,
     "cost": 2e-4,       # |daily cost-rate delta|
 }
 

@@ -7,7 +7,10 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+# version-tolerant: `jax.shard_map` is public only from jax 0.6
+# (parallel/compat.py maps check_vma= to the older check_rep=)
+from factorvae_tpu.parallel.compat import shard_map
 
 from factorvae_tpu.ops.masked import masked_mean, masked_mse, masked_softmax
 from factorvae_tpu.parallel.collective_ops import (

@@ -28,7 +28,16 @@ def test_presets_cover_baseline_configs():
     a360 = get_preset("alpha360-k60")
     assert a360.model.num_features == 360 and a360.model.seq_len == 60
     csi800 = get_preset("csi800-k60")
-    assert csi800.data.max_stocks == 1024
+    # No fixed 1024 pad anymore: the scale-aware policy pads the real
+    # CSI800 cross-section 800 -> 800 (zero dead rows) instead of the
+    # 28%-dead 1024 the old preset forced.
+    assert csi800.data.max_stocks is None
+    from factorvae_tpu.plan import pad_target_policy
+
+    assert pad_target_policy(800, "tpu") == 800
+    assert pad_target_policy(800, "cpu") == 800
+    assert pad_target_policy(356, "tpu") == 360   # the measured flagship pad
+    assert pad_target_policy(801, "tpu", shard=16) == 816
 
 
 def test_from_dict_ignores_unknown_keys():

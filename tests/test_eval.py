@@ -225,9 +225,15 @@ class TestSeedSweep:
         assert np.isfinite(df.loc[1, "rank_ic"])
         assert df.attrs["summary"]["num_seeds"] == 2
         # legacy shape: bare rank_ic floats, as pre-r5 partial files
-        # stored them (e.g. PARITY_RUN_r04_cpu.json)
+        # stored them (e.g. PARITY_RUN_r04_cpu.json). ADVICE r5: on_seed
+        # must fire for ADOPTED seeds too — a caller persisting partial
+        # results exclusively via on_seed would otherwise write files
+        # missing every resumed seed.
+        seen = []
         df2 = seed_sweep(cfg, ds, seeds=[0, 1],
-                         prior_records={0: 0.2, "1": 0.4})
+                         prior_records={0: 0.2, "1": 0.4},
+                         on_seed=lambda rec: seen.append(rec["seed"]))
+        assert seen == [0, 1]
         # both prior -> no training at all, summary over priors
         assert df2.attrs["summary"]["rank_ic_mean"] == pytest.approx(0.3)
         assert np.isnan(df2.loc[0, "best_val"])
@@ -247,6 +253,51 @@ class TestChunkInvariance:
         np.testing.assert_allclose(
             a[np.isfinite(a)], b[np.isfinite(b)], rtol=1e-5, atol=1e-7
         )
+
+
+class TestScanVsChunkLoop:
+    """The scoring hot-path overhaul (single jitted lax.scan over
+    day-chunks) must be EXACTLY equal to the pre-overhaul per-chunk
+    dispatch loop it replaced — same RNG stream, including the
+    masked-padding edge days of the final partial chunk."""
+
+    def test_deterministic_exact_equal(self, trained):
+        from factorvae_tpu.eval.predict import predict_panel
+
+        cfg, ds, state = trained
+        days = ds.split_days(None, None)
+        assert len(days) % 4 != 0  # force a padded final chunk
+        a = predict_panel(state.params, cfg, ds, days, stochastic=False,
+                          chunk=4, impl="scan")
+        b = predict_panel(state.params, cfg, ds, days, stochastic=False,
+                          chunk=4, impl="chunk_loop")
+        assert a.shape == b.shape == (len(days), ds.n_max)
+        # NaN-aware exact equality (assert_array_equal treats NaN==NaN)
+        np.testing.assert_array_equal(a, b)
+
+    def test_stochastic_same_rng_stream(self, trained):
+        """Chunk c0 uses fold_in(PRNGKey(seed), c0) on BOTH paths, so
+        even sampled scores are identical."""
+        from factorvae_tpu.eval.predict import predict_panel
+
+        cfg, ds, state = trained
+        days = ds.split_days(None, None)
+        a = predict_panel(state.params, cfg, ds, days, stochastic=True,
+                          seed=7, chunk=4, impl="scan")
+        b = predict_panel(state.params, cfg, ds, days, stochastic=True,
+                          seed=7, chunk=4, impl="chunk_loop")
+        np.testing.assert_array_equal(a, b)
+
+    def test_empty_days_and_bad_impl(self, trained):
+        from factorvae_tpu.eval.predict import predict_panel
+
+        cfg, ds, state = trained
+        out = predict_panel(state.params, cfg, ds,
+                            np.array([], dtype=np.int64))
+        assert out.shape == (0, ds.n_max)
+        with pytest.raises(ValueError, match="impl"):
+            predict_panel(state.params, cfg, ds, ds.split_days(None, None),
+                          impl="vectorized")
 
 
 class TestCompareTool:
