@@ -34,6 +34,14 @@ MFU: an analytic per-day FLOPs model of the flagship network (see
 `model_flops_per_day`) gives model FLOPs/sec; divided by the chip's peak
 (bf16 headline peak — the standard MFU denominator) it yields `mfu` in
 the JSON line. On CPU, `mfu` is null (no meaningful peak to divide by).
+
+Fleet mode (`python bench.py --fleet`, or BENCH_FLEET=1 with
+BENCH_FLEET_SEEDS=1,2,4,8): instead of the single-model headline, train
+seed-parallel fleets (train/fleet.py) at each S and emit the
+windows/sec·seed scaling curve — per-seed rate, fleet aggregate, and
+speedup over the serial S=1 baseline measured in the same run — plus
+the planner's decision block. The same probe/timeout/CPU-fallback
+robustness contract applies.
 """
 
 from __future__ import annotations
@@ -94,6 +102,17 @@ USE_PALLAS = {"0": False, "1": True}.get(_PALLAS_ENV, "auto")
 # BENCH_FLATTEN=0 reverts to the per-day nn.vmap lift so the round-3
 # cross-day-flattening thesis can be A/B-timed on chip in one command.
 USE_FLATTEN = os.environ.get("BENCH_FLATTEN", "1") == "1"
+# Fleet mode (`python bench.py --fleet` or BENCH_FLEET=1): instead of
+# the single-model headline, train seed-parallel fleets (train/fleet.py)
+# at each S in BENCH_FLEET_SEEDS and report windows/sec·seed scaling —
+# the seed-sweep throughput story, where S independent models share one
+# program and every matmul gains an S-fold batch axis. S=1 compiles the
+# un-vmapped serial path, so `speedup_vs_serial` is an honest same-run
+# baseline.
+USE_FLEET = os.environ.get("BENCH_FLEET", "0") == "1"
+FLEET_SEED_COUNTS = tuple(
+    int(s) for s in os.environ.get("BENCH_FLEET_SEEDS", "1,2,4,8").split(",")
+    if s.strip())
 
 
 def resolve_plan(platform: str):
@@ -134,6 +153,7 @@ def resolve_plan(platform: str):
         provenance=pl.provenance, source=pl.source,
         use_pallas_attention=knobs["pallas_attention"],
         use_pallas_gru=knobs["pallas_gru"],
+        seeds_per_program=pl.seeds_per_program,
     )
     return knobs, pl.describe(shape, platform=platform, forced=_FORCED_ENV)
 
@@ -176,6 +196,23 @@ def emit(payload: dict) -> None:
     """The ONE JSON line the driver parses."""
     print(json.dumps(payload))
     sys.stdout.flush()
+
+
+def fail_metric() -> str:
+    """Failure-payload metric key, mode-faithful: a fleet run that dies
+    must not record in the longitudinal stream as a single-model
+    flagship train failure (BENCH_FLEET propagates to every
+    subprocess, so the env read covers the --fleet argv case too)."""
+    fleet = USE_FLEET or os.environ.get("BENCH_FLEET", "0") == "1"
+    return ("fleet_train_throughput_failed" if fleet
+            else "train_throughput_flagship_K96_H64_Alpha158_failed")
+
+
+def fail_unit() -> str:
+    """Unit for failure payloads, matching the mode's success unit so
+    the longitudinal series never mixes units across records."""
+    fleet = USE_FLEET or os.environ.get("BENCH_FLEET", "0") == "1"
+    return "windows/sec*seed" if fleet else "windows/sec/chip"
 
 
 def probe_backend(attempts: int = PROBE_ATTEMPTS,
@@ -277,6 +314,38 @@ def detect_platform() -> tuple[str, float | None]:
     return label, peak
 
 
+def bench_setup(knobs):
+    """(cfg, ds) for a timed run — ONE construction of the bench Config,
+    synthetic panel and dataset, shared by the headline and fleet
+    benches so their configurations can never silently diverge (the
+    fleet's speedup story is only meaningful against the identical
+    workload)."""
+    from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+
+    cfg = Config(
+        model=ModelConfig(
+            num_features=NUM_FEATURES, hidden_size=HIDDEN, num_factors=FACTORS,
+            num_portfolios=PORTFOLIOS, seq_len=SEQ_LEN,
+            compute_dtype=knobs["compute_dtype"],
+            use_pallas_attention=knobs["pallas_attention"],
+            use_pallas_gru=knobs["pallas_gru"],
+            flatten_days=knobs["flatten_days"],
+        ),
+        data=DataConfig(seq_len=SEQ_LEN, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(
+            num_epochs=EPOCHS_TIMED, days_per_step=knobs["days_per_step"],
+            seed=0, checkpoint_every=0, save_dir="/tmp/factorvae_bench",
+        ),
+    )
+    panel = synthetic_panel_dense(
+        num_days=NUM_DAYS, num_instruments=N_STOCKS, num_features=NUM_FEATURES
+    )
+    ds = PanelDataset(panel, seq_len=SEQ_LEN, max_stocks=knobs["pad_target"])
+    return cfg, ds
+
+
 def run_bench() -> dict:
     import jax
 
@@ -284,8 +353,6 @@ def run_bench() -> dict:
 
     enable_persistent_compile_cache()
 
-    from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
-    from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
     from factorvae_tpu.train import Trainer
     from factorvae_tpu.utils.logging import MetricsLogger
 
@@ -299,27 +366,7 @@ def run_bench() -> dict:
     # on every forced run (unforced runs are "auto"/"auto").
     use_pallas = knobs["pallas_attention"]
 
-    cfg = Config(
-        model=ModelConfig(
-            num_features=NUM_FEATURES, hidden_size=HIDDEN, num_factors=FACTORS,
-            num_portfolios=PORTFOLIOS, seq_len=SEQ_LEN,
-            compute_dtype=knobs["compute_dtype"],
-            use_pallas_attention=knobs["pallas_attention"],
-            use_pallas_gru=knobs["pallas_gru"],
-            flatten_days=use_flatten,
-        ),
-        data=DataConfig(seq_len=SEQ_LEN, start_time=None, fit_end_time=None,
-                        val_start_time=None, val_end_time=None),
-        train=TrainConfig(
-            num_epochs=EPOCHS_TIMED, days_per_step=days_per_step, seed=0,
-            checkpoint_every=0, save_dir="/tmp/factorvae_bench",
-        ),
-    )
-    panel = synthetic_panel_dense(
-        num_days=NUM_DAYS, num_instruments=N_STOCKS, num_features=NUM_FEATURES
-    )
-    ds = PanelDataset(panel, seq_len=SEQ_LEN,
-                      max_stocks=knobs["pad_target"])
+    cfg, ds = bench_setup(knobs)
     trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
     state = trainer.init_state()
 
@@ -401,6 +448,107 @@ def run_bench() -> dict:
         # resolution — the observable contract of factorvae_tpu/plan.py.
         "plan": plan_block,
     }
+
+
+def run_fleet_bench() -> dict:
+    """Seed-parallel fleet scaling: train S seeds in one program at each
+    S in FLEET_SEED_COUNTS on the planner-resolved knobs and report
+    windows/sec·seed — per-seed rate and the fleet aggregate — plus the
+    speedup over the serial (S=1, un-vmapped) path measured in the SAME
+    run. One JSON line, same terminal contract as the headline bench."""
+    import jax
+
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    from factorvae_tpu.train import FleetTrainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    platform, peak = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    cfg, ds = bench_setup(knobs)
+
+    scaling = []
+    for s in FLEET_SEED_COUNTS:
+        trainer = FleetTrainer(cfg, ds, seeds=list(range(s)),
+                               logger=MetricsLogger(echo=False))
+        # Raw serial state at S=1: the speedup_vs_serial baseline pays
+        # exactly what the serial Trainer pays.
+        state = trainer.init_run_state()
+        state, m = trainer._run_train_epoch(state, 0)   # warmup/compile
+        jax.block_until_ready(m["loss"])
+        days_per_epoch = float(jax.numpy.asarray(m["days"])[0])
+        t0 = time.time()
+        for epoch in range(1, EPOCHS_TIMED + 1):
+            state, m = trainer._run_train_epoch(state, epoch)
+        jax.block_until_ready(m["loss"])
+        dt = time.time() - t0
+        per_seed = EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt
+        scaling.append({
+            "seeds": s,
+            "windows_per_sec_seed": round(per_seed, 1),
+            "aggregate_windows_per_sec": round(per_seed * s, 1),
+        })
+
+    # Annotate every row against the serial baseline wherever S=1 sits
+    # in BENCH_FLEET_SEEDS (order-independent); without an S=1 run there
+    # is no same-run baseline and the field is honestly absent.
+    serial_aggregate = next(
+        (r["aggregate_windows_per_sec"] for r in scaling if r["seeds"] == 1),
+        None)
+    if serial_aggregate is not None and serial_aggregate > 0:
+        for r in scaling:
+            r["speedup_vs_serial"] = round(
+                r["aggregate_windows_per_sec"] / serial_aggregate, 3)
+
+    best = max(scaling, key=lambda r: r["aggregate_windows_per_sec"])
+    n_pad = int(ds.n_max)
+    mfu = None
+    if peak:
+        # Fleet MFU: S models' FLOPs in flight over the same wall clock.
+        flops = (3.0 * model_flops_per_day(n_pad)
+                 * best["aggregate_windows_per_sec"] / N_STOCKS)
+        mfu = round(flops / peak, 4)
+    # Same metric-key discipline as run_bench: every knob that changes
+    # the numbers (dps, kernel forcing, dtype, layout) is part of the
+    # NAME, so a BENCH_BF16/BENCH_FLATTEN A/B at the same shape can
+    # never splice into the default series as a phantom speedup.
+    use_pallas = knobs["pallas_attention"]
+    return {
+        "metric": (
+            f"fleet_train_throughput_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+            f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}"
+            f"_dps{knobs['days_per_step']}_d{NUM_DAYS}e{EPOCHS_TIMED}"
+            + ("" if use_pallas == "auto" else
+               f"_pallas{int(bool(use_pallas))}")
+            + ("_bf16" if knobs["compute_dtype"] == "bfloat16" else "")
+            + ("" if knobs["flatten_days"] else "_per_day_vmap")
+            # `value` is the best aggregate over the raced seed set, so
+            # a forced non-default BENCH_FLEET_SEEDS is part of the key
+            # too — a {1,2} race and a {1,2,4,8} race are different
+            # experiments and must not splice into one series.
+            + ("" if "BENCH_FLEET_SEEDS" not in os.environ else
+               "_S" + "-".join(str(s) for s in FLEET_SEED_COUNTS))
+            + ("_cpu_fallback" if FORCED_CPU else "")),
+        "value": best["aggregate_windows_per_sec"],
+        "unit": "windows/sec*seed",
+        "vs_baseline": round(
+            best["aggregate_windows_per_sec"] / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        "best_seeds_per_program": best["seeds"],
+        "scaling": scaling,
+        "mfu": mfu,
+        "n_real": N_STOCKS,
+        "n_padded": n_pad,
+        "plan": plan_block,
+    }
+
+
+def bench_payload() -> dict:
+    """Fleet mode (--fleet / BENCH_FLEET=1) or the single-model
+    headline."""
+    return run_fleet_bench() if USE_FLEET else run_bench()
 
 
 # The most recent REAL-TPU measurement, carried as clearly-labeled
@@ -502,9 +650,9 @@ def cpu_fallback_payload(error: str) -> dict:
     except Exception as e:  # pragma: no cover - defensive
         detail = f"{type(e).__name__}: {e}"
     return {
-        "metric": "train_throughput_flagship_K96_H64_Alpha158_failed",
+        "metric": fail_metric(),
         "value": 0.0,
-        "unit": "windows/sec/chip",
+        "unit": fail_unit(),
         "vs_baseline": 0.0,
         "accelerator_error": error,
         "cpu_fallback_error": detail,
@@ -542,10 +690,16 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
+    global USE_FLEET
+    if "--fleet" in sys.argv:
+        # Propagate into the probe/accel/fallback subprocesses too.
+        USE_FLEET = True
+        os.environ["BENCH_FLEET"] = "1"
+
     if ACCEL_CHILD:
         # Child: backend already validated by the parent's probe; any crash
         # here surfaces as rc!=0 and the parent falls back to CPU.
-        emit(run_bench())
+        emit(bench_payload())
         return
 
     if FORCED_CPU:
@@ -556,12 +710,12 @@ def main() -> None:
 
         force_host_devices(1)
         try:
-            emit(run_bench())
+            emit(bench_payload())
         except Exception as e:
             emit({
-                "metric": "train_throughput_flagship_K96_H64_Alpha158_failed",
+                "metric": fail_metric(),
                 "value": 0.0,
-                "unit": "windows/sec/chip",
+                "unit": fail_unit(),
                 "vs_baseline": 0.0,
                 "cpu_fallback_error": f"{type(e).__name__}: {e}",
             })

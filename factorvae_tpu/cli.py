@@ -10,6 +10,7 @@ compatibility and ignored: there are no loader workers in this design
 Usage:
     python -m factorvae_tpu.cli --num_epochs 30 --dataset ./data/csi_data.pkl
     python -m factorvae_tpu.cli --score_only --resume ...
+    python -m factorvae_tpu.cli --fleet_seeds 8 --auto_plan ...  # seed fleet
 """
 
 from __future__ import annotations
@@ -52,11 +53,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size of the 'stock' (cross-section) mesh axis")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest full-state checkpoint")
+    p.add_argument("--fleet_seeds", type=int, default=None,
+                   help="train N independent seeds ([seed, seed+N)) "
+                        "simultaneously in one seed-parallel program "
+                        "(train/fleet.py: stacked params, vmapped epoch, "
+                        "one HBM panel copy), report the per-seed "
+                        "Rank-IC sweep, then score/export with the best "
+                        "seed's best-val weights. With --auto_plan the "
+                        "planner's raced seeds_per_program knob sizes "
+                        "the programs; otherwise all N share one")
     p.add_argument("--kl_weight", type=float, default=None,
                    help="scale on the summed-over-K KL term (default 1.0 "
-                        "= reference-faithful loss; the k60 parity sweep's "
-                        "lever — at large K the unweighted KL sum dominates "
-                        "the mean-over-N MSE gradient)")
+                        "= reference-faithful loss). Measured null for "
+                        "k60 parity (r4 sweep: recovery 0.31 -> 0.33, "
+                        "within noise) — the r5 diagnosis shows KL~=0 "
+                        "from epoch 2, so this lever has nothing to "
+                        "rescale there; kept as a general loss knob")
     p.add_argument("--recon_loss", choices=["mse", "nll"], default=None,
                    help="mse = reference-faithful single-sample MSE; nll = "
                         "Gaussian NLL (default: mse, or the preset's choice)")
@@ -342,6 +354,84 @@ def main(argv=None) -> int:
             print(f"error: no checkpoint at {path}; train first", file=sys.stderr)
             return 2
         _, params = load_model(cfg, checkpoint_path=path, n_max=dataset.n_max)
+    elif args.fleet_seeds and args.fleet_seeds > 1:
+        # Seed-parallel fleet (train/fleet.py): one program trains the
+        # whole seed range [seed, seed+N), the sweep frame picks the
+        # winner by Rank-IC, and the rest of the pipeline (scoring /
+        # backtest / export) runs on that winner's best-val weights
+        # under its own per-seed checkpoint name.
+        import dataclasses
+
+        from factorvae_tpu.eval.sweep import seed_sweep
+        from factorvae_tpu.models.factorvae import load_model
+
+        if args.mesh:
+            # FleetTrainer does not compose the seed axis with a
+            # ('data','stock') mesh; training would silently run
+            # unsharded (and every pod process would race the same
+            # checkpoint paths). Fail loudly instead.
+            print(
+                "error: --mesh is not supported with --fleet_seeds "
+                "(the fleet is the single-chip seed-parallel mode); "
+                "drop one of the two flags", file=sys.stderr)
+            return 2
+        seeds = list(range(cfg.train.seed, cfg.train.seed + args.fleet_seeds))
+        spp = auto_plan.seeds_per_program if auto_plan is not None else None
+        import contextlib
+
+        from factorvae_tpu.utils.profiling import debug_nans, trace
+
+        nan_ctx = debug_nans() if args.debug_nans else contextlib.nullcontext()
+        try:
+            with trace(args.profile), nan_ctx:
+                df = seed_sweep(
+                    cfg, dataset, seeds=seeds,
+                    score_start=args.score_start, score_end=args.score_end,
+                    logger=logger, fleet=True, seeds_per_program=spp,
+                    # --resume: each group restores from its lockstep
+                    # per-seed full-state checkpoints when present.
+                    fleet_resume=args.resume)
+        except ValueError as e:
+            if "empty training split" in str(e):
+                print(
+                    f"error: no trading days in [{cfg.data.start_time}, "
+                    f"{cfg.data.fit_end_time}]; adjust --start_time/"
+                    f"--fit_end_time", file=sys.stderr)
+                return 2
+            raise
+        # Winner = best rank_ic among the seeds with a finite best_val
+        # AND a best-val checkpoint on disk. The finite-best_val filter
+        # matters beyond NaN hygiene: a seed whose validation never
+        # improved was scored on FINAL-epoch params and wrote no fresh
+        # checkpoint this run — a stale same-name directory from an
+        # earlier run would otherwise pass the isdir test and export
+        # weights that never produced the winning rank_ic.
+        def _ckpt(seed):
+            c = dataclasses.replace(
+                cfg, train=dataclasses.replace(cfg.train, seed=int(seed)))
+            return os.path.join(c.train.save_dir, c.checkpoint_name())
+
+        import numpy as np
+
+        ranked = df["rank_ic"].dropna()
+        ranked = ranked[np.isfinite(df.loc[ranked.index, "best_val"])]
+        ranked = ranked[[os.path.isdir(_ckpt(s)) for s in ranked.index]]
+        if ranked.empty:
+            # Every seed's scores were NaN (e.g. a divergent lr) or no
+            # checkpoint survived: there is no winner to pick — fail
+            # like every other CLI path, with a message instead of an
+            # int(NaN) traceback.
+            print("error: no fleet seed with finite rank_ic and a "
+                  "best-val checkpoint; nothing to score/export "
+                  "(check lr / data ranges)", file=sys.stderr)
+            return 2
+        best_seed = int(ranked.idxmax())
+        logger.log("fleet_sweep", best_seed=best_seed,
+                   seeds=seeds, **df.attrs["summary"])
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, seed=best_seed))
+        _, params = load_model(cfg, checkpoint_path=_ckpt(best_seed),
+                               n_max=dataset.n_max)
     else:
         from factorvae_tpu.utils.profiling import trace
 

@@ -16,6 +16,11 @@ mode) takes a fast path that threads no RNG at all — the prediction
 graph draws neither sample nor dropout noise, so the scan carries only
 the day indices.
 
+`predict_panel_fleet` is the seed-batched variant (train/fleet.py): S
+stacked param trees ride one day-chunk scan — the panel, day indices
+and keys broadcast — so a seed sweep's whole scoring pass is a single
+dispatch producing S score frames.
+
 The reference's predictions are stochastic at inference (module.py:123
 draws a reparameterized sample; SURVEY.md §3.3) — reproduced when
 `stochastic=True` with the exact same per-chunk RNG stream as the chunk
@@ -96,6 +101,38 @@ def _score_chunk_fn(
 
 
 @functools.lru_cache(maxsize=32)
+def _score_scan_fleet_fn(
+    model_cfg: ModelConfig,
+    seq_len: int,
+    stochastic: Optional[bool],
+):
+    """Seed-batched whole-pass scorer (train/fleet.py counterpart): S
+    stacked param trees x ONE day-chunk scan -> (S, n_chunks, chunk,
+    N_max) scores in a single dispatch. The panel, day indices and key
+    buffer are broadcast (in_axes=None) — every seed scores the same
+    days with the same RNG stream, exactly what `seed_sweep` does
+    serially — so HBM holds one panel copy while every matmul in the
+    scan body gains an S-fold leading batch axis."""
+    chunk_scores = _make_chunk_scorer(model_cfg, seq_len, stochastic)
+
+    @jax.jit
+    def score_scan_fleet(stacked_p, values, last_valid, next_valid,
+                         day_idx, keys):
+        def one_seed(p):
+            def body(carry, inp):
+                days, key = inp
+                return carry, chunk_scores(
+                    p, values, last_valid, next_valid, days, key)
+
+            _, scores = jax.lax.scan(body, 0, (day_idx, keys))
+            return scores
+
+        return jax.vmap(one_seed)(stacked_p)
+
+    return score_scan_fleet
+
+
+@functools.lru_cache(maxsize=32)
 def _score_scan_fn(
     model_cfg: ModelConfig,
     seq_len: int,
@@ -128,6 +165,30 @@ def _score_scan_fn(
         return scores
 
     return score_scan
+
+
+def _scan_inputs(days: np.ndarray, chunk: int, base: jax.Array,
+                 deterministic: bool):
+    """(day_idx (n_chunks, chunk), keys) for the whole-pass scan — ONE
+    definition of the chunk padding (-1 = pad) and the per-chunk RNG
+    stream, shared by the serial and fleet scan paths: their equality
+    contract (S=1 bitwise, S>1 f32-close, tests/test_fleet.py) depends
+    on these staying identical."""
+    n_days = len(days)
+    n_chunks = -(-n_days // chunk)
+    padded = np.full(n_chunks * chunk, -1, np.int32)
+    padded[:n_days] = days
+    day_idx = jnp.asarray(padded.reshape(n_chunks, chunk))
+    if deterministic:
+        # The fast path's scan body never reads the keys — don't pay
+        # one fold_in dispatch per chunk building a buffer of them.
+        keys = jnp.zeros((n_chunks, *base.shape), base.dtype)
+    else:
+        # One vmapped dispatch for the whole key buffer, bitwise-equal
+        # to per-chunk fold_in(base, c0) (pinned by tests/test_eval.py).
+        keys = jax.vmap(lambda c0: jax.random.fold_in(base, c0))(
+            jnp.arange(0, n_chunks * chunk, chunk))
+    return day_idx, keys
 
 
 def predict_panel(
@@ -180,26 +241,99 @@ def predict_panel(
 
     if n_days == 0:
         return np.full((0, dataset.n_max), np.nan, np.float32)
-    n_chunks = -(-n_days // chunk)
-    padded = np.full(n_chunks * chunk, -1, np.int32)
-    padded[:n_days] = days
-    day_idx = jnp.asarray(padded.reshape(n_chunks, chunk))
-    if _deterministic(config.model, stochastic):
-        # The fast path's scan body never reads the keys — don't pay
-        # one fold_in dispatch per chunk building a buffer of them.
-        keys = jnp.zeros((n_chunks, *base.shape), base.dtype)
-    else:
-        # One vmapped dispatch for the whole key buffer, bitwise-equal
-        # to per-chunk fold_in(base, c0) (pinned by tests/test_eval.py).
-        keys = jax.vmap(lambda c0: jax.random.fold_in(base, c0))(
-            jnp.arange(0, n_chunks * chunk, chunk))
+    day_idx, keys = _scan_inputs(
+        days, chunk, base, _deterministic(config.model, stochastic))
     score_scan = _score_scan_fn(
         config.model, config.data.seq_len, stochastic, int8)
     scores = score_scan(params, dataset.values, dataset.last_valid,
                         dataset.next_valid, day_idx, keys)
     out = np.asarray(scores, dtype=np.float32).reshape(
-        n_chunks * chunk, dataset.n_max)
+        -1, dataset.n_max)
     return out[:n_days]
+
+
+def predict_panel_fleet(
+    stacked_params,
+    config: Config,
+    dataset: PanelDataset,
+    days: np.ndarray,
+    stochastic: Optional[bool] = None,
+    seed: int = 0,
+    chunk: int = 32,
+    num_seeds: Optional[int] = None,
+) -> np.ndarray:
+    """(S, len(days), N_max) scores for S stacked param trees (leading
+    seed axis on every leaf, as train/fleet.py produces) in ONE
+    dispatch. Per-seed rows equal `predict_panel` on the unstacked tree:
+    bitwise at S=1 (which routes through the serial scan — vmap's
+    batched-dot reassociation would break the oracle), f32-close at S>1
+    (pinned by tests/test_fleet.py). `seed` is the SCORING seed (the
+    RNG stream of the stochastic path), shared across the fleet like
+    the serial sweep shares it across solo runs."""
+    s = num_seeds
+    if s is None:
+        leaf = jax.tree.leaves(stacked_params)[0]
+        s = int(leaf.shape[0])
+    if s == 1:
+        one = jax.tree.map(lambda x: x[0], stacked_params)
+        return predict_panel(one, config, dataset, days, stochastic, seed,
+                             chunk=chunk)[None]
+
+    n_days = len(days)
+    if n_days == 0:
+        return np.full((s, 0, dataset.n_max), np.nan, np.float32)
+    base = jax.random.PRNGKey(seed)
+    day_idx, keys = _scan_inputs(
+        days, chunk, base, _deterministic(config.model, stochastic))
+    score_scan = _score_scan_fleet_fn(
+        config.model, config.data.seq_len, stochastic)
+    scores = score_scan(stacked_params, dataset.values, dataset.last_valid,
+                        dataset.next_valid, day_idx, keys)
+    out = np.asarray(scores, dtype=np.float32).reshape(
+        s, -1, dataset.n_max)
+    return out[:, :n_days]
+
+
+def _frame_pieces(dataset: PanelDataset, days: np.ndarray,
+                  with_labels: bool):
+    """(index, valid mask, flat labels-or-None) shared by the serial and
+    fleet frame builders — one definition of the score-frame schema."""
+    idx = dataset.index_frame(days)
+    valid = dataset.valid[days]                      # (D, N_max)
+    labels = (np.asarray(dataset.values[:, :, -1]).T[days][valid]
+              if with_labels else None)
+    return idx, valid, labels
+
+
+def _score_frame(scores: np.ndarray, idx, valid, labels) -> pd.DataFrame:
+    """(D, N_max) scores -> the (datetime, instrument)-indexed frame
+    (plus LABEL0 when labels are given)."""
+    df = pd.DataFrame({"score": scores[valid]}, index=idx)
+    if labels is not None:
+        df["LABEL0"] = labels
+    return df
+
+
+def fleet_prediction_scores(
+    stacked_params,
+    config: Config,
+    dataset: PanelDataset,
+    start: Optional[str] = None,
+    end: Optional[str] = None,
+    stochastic: Optional[bool] = None,
+    seed: int = 0,
+    with_labels: bool = False,
+) -> list:
+    """Per-seed score DataFrames (same schema as
+    `generate_prediction_scores` — shared frame builder) from one
+    seed-batched scoring pass: S frames for the price of one program
+    dispatch."""
+    days = dataset.split_days(start, end)
+    scores = predict_panel_fleet(stacked_params, config, dataset, days,
+                                 stochastic, seed)
+    idx, valid, labels = _frame_pieces(dataset, days, with_labels)
+    return [_score_frame(scores[i], idx, valid, labels)
+            for i in range(scores.shape[0])]
 
 
 def generate_prediction_scores(
@@ -219,14 +353,8 @@ def generate_prediction_scores(
     days = dataset.split_days(start, end)
     scores = predict_panel(params, config, dataset, days, stochastic, seed,
                            int8=int8)
-    idx = dataset.index_frame(days)
-    valid = dataset.valid[days]                      # (D, N_max)
-    flat_scores = scores[valid]
-    df = pd.DataFrame({"score": flat_scores}, index=idx)
-    if with_labels:
-        labels = np.asarray(dataset.values[:, :, -1]).T[days]  # (D, N_max)
-        df["LABEL0"] = labels[valid]
-    return df
+    idx, valid, labels = _frame_pieces(dataset, days, with_labels)
+    return _score_frame(scores, idx, valid, labels)
 
 
 def export_scores(df: pd.DataFrame, config: Config, out_dir: str = "./scores") -> str:

@@ -5,6 +5,18 @@ PRNGs), so parity is *statistical*: the same Rank-IC within tolerance
 across seeds (SURVEY.md §7 hard-part 3). This harness trains S seeds of a
 config, scores each deterministically, and reports per-seed Rank-IC plus
 the mean ± std the parity comparison needs.
+
+Execution modes:
+- serial (default): one `Trainer` per seed, strictly sequential — the
+  resume-compatible equality oracle.
+- ``fleet=True``: seeds not adopted from ``prior_records`` train
+  together in seed-parallel programs of ``seeds_per_program`` (the
+  planner's raced knob, plan.py) via `train.fleet.FleetTrainer`, then
+  score in one seed-batched scan (`eval.predict.predict_panel_fleet`).
+  Output frame, per-seed artifacts (best-val checkpoints under the
+  serial names), ``on_seed`` firing and resumed-seed adoption are
+  preserved; per-seed numbers match the serial sweep at f32 tolerance
+  (bitwise for a 1-seed program), pinned by tests/test_fleet.py.
 """
 
 from __future__ import annotations
@@ -24,6 +36,101 @@ from factorvae_tpu.train.trainer import Trainer
 from factorvae_tpu.utils.logging import MetricsLogger
 
 
+def _adopted_record(seed: int, prev, logger: MetricsLogger,
+                    on_seed) -> dict:
+    """Record for a seed adopted from ``prior_records`` without
+    retraining (shared by the serial and fleet paths)."""
+    if not isinstance(prev, dict):
+        prev = {"rank_ic": prev}
+
+    def _f(v):
+        # JSON round-trips our own NaN placeholders as null
+        # (strict-JSON flushes serialize non-finite as null);
+        # a resume of a resume must not crash on float(None).
+        return float("nan") if v is None else float(v)
+
+    rec = {
+        "seed": int(seed),
+        "rank_ic": _f(prev["rank_ic"]),
+        "rank_ic_ir": _f(prev.get("rank_ic_ir", float("nan"))),
+        "best_val": _f(prev.get("best_val", float("nan"))),
+    }
+    logger.log("sweep_seed_resumed", **rec)
+    # Fire on_seed for resumed seeds too (ADVICE r5): callers that
+    # persist partial results inside on_seed would otherwise write
+    # files missing every seed adopted from prior_records — a
+    # resume-of-a-resume would then retrain them. Persisting an
+    # already-finished record is idempotent.
+    if on_seed is not None:
+        on_seed(rec)
+    return rec
+
+
+def _fleet_records(
+    config: Config,
+    dataset: PanelDataset,
+    pending: Sequence[int],
+    seeds_per_program: Optional[int],
+    score_start: Optional[str],
+    score_end: Optional[str],
+    logger: MetricsLogger,
+    on_seed,
+    fleet_resume: bool = False,
+) -> list:
+    """Train `pending` seeds in seed-parallel programs and score each
+    group in one seed-batched scan. Returns records in `pending` order."""
+    import jax
+    import numpy as np
+
+    from factorvae_tpu.eval.predict import fleet_prediction_scores
+    from factorvae_tpu.train.fleet import FleetTrainer
+
+    spp = len(pending) if not seeds_per_program else max(
+        1, int(seeds_per_program))
+    records = []
+    for g0 in range(0, len(pending), spp):
+        group = list(pending[g0:g0 + spp])
+        trainer = FleetTrainer(config, dataset, group, logger=logger)
+        state, out = trainer.fit(resume=fleet_resume)
+        best_val = np.asarray(out["best_val"])
+        # Score with the per-seed BEST-VALIDATION snapshot (the serial
+        # selection rule). A seed whose selection never improved (NaN
+        # loss stream) falls back to its FINAL-epoch params, with the
+        # same warning the serial path logs for a missing checkpoint.
+        scoring = out["best_params"]
+        for i, seed in enumerate(group):
+            if not np.isfinite(best_val[i]):
+                logger.log(
+                    "sweep_warning", seed=int(seed),
+                    note="best-val selection never improved; scoring "
+                         "FINAL-epoch params")
+                scoring = jax.tree.map(
+                    lambda b, p: b.at[i].set(p[i]), scoring, state.params)
+        # Scoring emits NaN BY DESIGN (padded/absent stocks), so a
+        # caller-armed --debug_nans guard must not trip here — the
+        # serial CLI likewise scores outside its NaN context; only the
+        # training epochs above run guarded.
+        from factorvae_tpu.utils.profiling import debug_nans
+
+        with debug_nans(False):
+            frames = fleet_prediction_scores(
+                scoring, config, dataset, start=score_start,
+                end=score_end, stochastic=False, with_labels=True)
+        for i, seed in enumerate(group):
+            ic = rank_ic_frame(frames[i].dropna(), "LABEL0", "score")
+            rec = {
+                "seed": int(seed),
+                "rank_ic": float(ic["RankIC"].iloc[0]),
+                "rank_ic_ir": float(ic["RankIC_IR"].iloc[0]),
+                "best_val": float(best_val[i]),
+            }
+            records.append(rec)
+            logger.log("sweep_seed", **rec)
+            if on_seed is not None:
+                on_seed(rec)
+    return records
+
+
 def seed_sweep(
     config: Config,
     dataset: PanelDataset,
@@ -33,6 +140,9 @@ def seed_sweep(
     logger: Optional[MetricsLogger] = None,
     on_seed=None,
     prior_records: Optional[dict] = None,
+    fleet: bool = False,
+    seeds_per_program: Optional[int] = None,
+    fleet_resume: bool = False,
 ) -> pd.DataFrame:
     """Returns a frame indexed by seed with columns
     [rank_ic, rank_ic_ir, best_val]; .attrs['summary'] holds mean/std.
@@ -48,38 +158,28 @@ def seed_sweep(
     partial files stored) restored from such a partial file; those
     seeds are included in the output without retraining, so a restarted
     sweep resumes instead of redoing finished work.
+
+    ``fleet=True`` trains the non-adopted seeds in seed-parallel
+    programs of ``seeds_per_program`` (None/0 = one program for all of
+    them) and scores each program in one seed-batched scan; the output
+    frame keeps the ``seeds`` order either way. ``fleet_resume=True``
+    additionally lets each group restore from its lockstep per-seed
+    full-state checkpoints (FleetTrainer.fit(resume=True)) — a killed
+    fleet sweep continues mid-group instead of retraining the group,
+    provided ``checkpoint_every`` was on and the save_dir survived.
     """
     logger = logger or MetricsLogger(echo=False)
     prior_records = prior_records or {}
     records = []
+    pending = []
     for seed in seeds:
         if int(seed) in prior_records or str(seed) in prior_records:
             prev = prior_records.get(int(seed),
                                      prior_records.get(str(seed)))
-            if not isinstance(prev, dict):
-                prev = {"rank_ic": prev}
-
-            def _f(v):
-                # JSON round-trips our own NaN placeholders as null
-                # (strict-JSON flushes serialize non-finite as null);
-                # a resume of a resume must not crash on float(None).
-                return float("nan") if v is None else float(v)
-
-            rec = {
-                "seed": int(seed),
-                "rank_ic": _f(prev["rank_ic"]),
-                "rank_ic_ir": _f(prev.get("rank_ic_ir", float("nan"))),
-                "best_val": _f(prev.get("best_val", float("nan"))),
-            }
-            records.append(rec)
-            logger.log("sweep_seed_resumed", **rec)
-            # Fire on_seed for resumed seeds too (ADVICE r5): callers
-            # that persist partial results inside on_seed would
-            # otherwise write files missing every seed adopted from
-            # prior_records — a resume-of-a-resume would then retrain
-            # them. Persisting an already-finished record is idempotent.
-            if on_seed is not None:
-                on_seed(rec)
+            records.append(_adopted_record(seed, prev, logger, on_seed))
+            continue
+        if fleet:
+            pending.append(int(seed))
             continue
         cfg = dataclasses.replace(
             config, train=dataclasses.replace(config.train, seed=int(seed))
@@ -112,6 +212,16 @@ def seed_sweep(
         logger.log("sweep_seed", **rec)
         if on_seed is not None:
             on_seed(rec)
+
+    if pending:
+        records.extend(_fleet_records(
+            config, dataset, pending, seeds_per_program,
+            score_start, score_end, logger, on_seed,
+            fleet_resume=fleet_resume))
+        # The frame keeps the caller's seed order regardless of how the
+        # fleet grouped the training (equality with the serial sweep).
+        order = {int(s): i for i, s in enumerate(seeds)}
+        records.sort(key=lambda r: order[r["seed"]])
 
     df = pd.DataFrame(records).set_index("seed")
     df.attrs["summary"] = {

@@ -134,6 +134,14 @@ class Plan:
     `provenance` is "measured" (a table row matched) or "default" (the
     conservative per-backend fallback); `source` says where the row came
     from.
+
+    `seeds_per_program` is the fleet knob (train/fleet.py): how many
+    independent seeds one training program should batch when a caller
+    runs a multi-seed workload (seed sweeps, the k60 parity protocol).
+    1 = serial (the conservative default everywhere); raced values come
+    from `scripts/autotune_plan.py --fleet` rows (a `"fleet"` block on
+    the row — absent on pre-fleet rows, which keep resolving exactly as
+    before).
     """
 
     flatten_days: bool
@@ -146,6 +154,7 @@ class Plan:
     source: str
     use_pallas_attention: Union[bool, str] = "auto"
     use_pallas_gru: Union[bool, str] = "auto"
+    seeds_per_program: int = 1
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -367,6 +376,10 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 source=str(row.get("source", "plan table")),
                 use_pallas_attention=row.get("use_pallas_attention", "auto"),
                 use_pallas_gru=row.get("use_pallas_gru", "auto"),
+                # Pre-fleet rows have no "fleet" block: resolve to the
+                # serial default (no schema break for existing tables).
+                seeds_per_program=int(
+                    (row.get("fleet") or {}).get("seeds_per_program") or 1),
             )
     default = _TPU_DEFAULT if plat == "tpu" else _CPU_DEFAULT
     src = ("per-backend default: round-2 measured TPU winners (PERF.md)"

@@ -1,4 +1,5 @@
 from factorvae_tpu.train.checkpoint import Checkpointer, load_params, save_params
+from factorvae_tpu.train.fleet import FleetTrainer, stack_states, unstack_state
 from factorvae_tpu.train.loop import StepFns, make_step_fns
 from factorvae_tpu.train.state import (
     TrainState,
@@ -10,6 +11,7 @@ from factorvae_tpu.train.trainer import Trainer
 
 __all__ = [
     "Checkpointer",
+    "FleetTrainer",
     "StepFns",
     "TrainState",
     "Trainer",
@@ -19,4 +21,6 @@ __all__ = [
     "make_optimizer",
     "make_step_fns",
     "save_params",
+    "stack_states",
+    "unstack_state",
 ]

@@ -44,6 +44,11 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Every retained step, ascending (the fleet group-resume picks
+        the max step common to all members, train/fleet.py)."""
+        return sorted(self._mgr.all_steps())
+
     def restore(
         self, template: TrainState, step: Optional[int] = None
     ) -> Tuple[TrainState, dict]:

@@ -1,0 +1,517 @@
+"""Seed-parallel fleet training: S independent models in one program.
+
+The round-2 trace shows the chip ~93% idle at MFU 7.1%: every FactorVAE
+matmul is launch/tile-bound because the contraction dims (158/64/96)
+under-fill the 128x128 MXU (PERF.md), while the evaluation protocol
+(statistical parity across seeds, eval/sweep.py) needs MANY independent
+trainings that the serial path runs one after another — each paying its
+own compile, dispatch tail and scoring pass. Batching S seeds into one
+jitted program fattens every matmul S-fold with ZERO cross-model
+communication: the `TrainState` is stacked along a leading seed axis
+(vmapped init -> stacked params/opt_state/rng) and the existing
+`train_epoch` / `eval_epoch` scan bodies (train/loop.py) are vmapped
+over that axis with the HBM panel held broadcast — one copy, not S.
+
+Semantics contract (tests/test_fleet.py):
+- Each seed's trajectory is the INDEPENDENT trajectory its solo run
+  produces: per-seed init keys, per-seed threaded RNG, per-seed shuffled
+  day order per epoch, per-seed eval keys. vmap reassociates the matmul
+  reductions, so S>1 rows match their solo runs at f32 tolerance, not
+  bitwise.
+- S=1 is the equality oracle: the fleet compiles the UN-vmapped epoch
+  functions (vmap buys nothing at S=1 and its batched-dot reassociation
+  would break the bitwise contract), so a single-seed fleet reproduces
+  the serial `Trainer` bit-for-bit — params, metrics, best-val
+  selection.
+- Best-validation tracking runs ON DEVICE per seed: best epochs differ
+  across seeds, so after every epoch a `jnp.where`-select snapshots the
+  improved seeds' params into the stacked best-params buffer (the
+  device-side analogue of trainer.py's `improved` branch).
+- Checkpoints unstack per seed under the SAME per-seed names the serial
+  path writes (`Config.checkpoint_name()` encodes the seed), so
+  `seed_sweep`'s best-val selection rule and resume semantics are
+  preserved: a fleet-trained sweep leaves artifacts a serial run (or a
+  serial resume) can consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from factorvae_tpu.config import Config
+from factorvae_tpu.data.loader import PanelDataset
+from factorvae_tpu.models.factorvae import day_forward
+from factorvae_tpu.train.checkpoint import Checkpointer, save_params
+from factorvae_tpu.train.loop import make_step_fns
+from factorvae_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    learning_rate_at,
+    make_optimizer,
+)
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+def stack_states(states: Sequence[TrainState]) -> TrainState:
+    """Stack per-seed TrainStates along a new leading seed axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def unstack_state(fleet_state, i: int):
+    """Extract seed row `i` from a stacked fleet state (or any stacked
+    pytree — params trees work too)."""
+    return jax.tree.map(lambda x: x[i], fleet_state)
+
+
+def _bcast(flags: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
+    """(S,) bool -> broadcastable against an (S, ...) leaf."""
+    return flags.reshape(flags.shape + (1,) * (leaf.ndim - 1))
+
+
+@jax.jit
+def select_best(best_params, best_val, params, selection_loss):
+    """Per-seed on-device best-val snapshot: where seed s improved
+    (selection_loss[s] < best_val[s], the serial Trainer's strict-`<`
+    rule), adopt its current params into the stacked best buffer. A pure
+    select — no numeric drift vs the serial host-side branch."""
+    improved = selection_loss < best_val
+    new_best_val = jnp.where(improved, selection_loss, best_val)
+    new_best = jax.tree.map(
+        lambda b, p: jnp.where(_bcast(improved, p), p, b), best_params, params
+    )
+    return new_best, new_best_val
+
+
+class FleetTrainer:
+    """Train S seeds of one Config simultaneously in one jitted program.
+
+    `config.train.seed` is ignored; `seeds` names the fleet. Meshes are
+    not composed with the seed axis (fleet is the single-chip
+    seed-parallel mode; a ('data','stock') mesh run stays on the serial
+    `Trainer`).
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        dataset: PanelDataset,
+        seeds: Sequence[int],
+        logger: Optional[MetricsLogger] = None,
+    ):
+        if len(seeds) == 0:
+            raise ValueError("empty fleet: need at least one seed")
+        if len(set(int(s) for s in seeds)) != len(seeds):
+            raise ValueError(f"duplicate seeds in fleet: {list(seeds)}")
+        self.cfg = config
+        self.ds = dataset
+        self.seeds = [int(s) for s in seeds]
+        self.num_seeds = len(self.seeds)
+        self.logger = logger or MetricsLogger(echo=False)
+
+        self.train_days = dataset.split_days(
+            config.data.start_time, config.data.fit_end_time
+        )
+        self.val_days = dataset.split_days(
+            config.data.val_start_time, config.data.val_end_time
+        )
+        if len(self.train_days) == 0:
+            raise ValueError("empty training split")
+
+        self.batch_days = max(1, config.train.days_per_step)
+        self.steps_per_epoch = -(-len(self.train_days) // self.batch_days)
+        self.total_steps = self.steps_per_epoch * config.train.num_epochs
+
+        self.model = day_forward(config.model, train=True)
+        self.model_eval = day_forward(config.model, train=False)
+        self._build_step_fns()
+
+        self.logger.log(
+            "fleet_execution_layout",
+            seeds=self.seeds,
+            seeds_per_program=self.num_seeds,
+            flatten_days=config.model.flatten_days,
+            days_per_step=self.batch_days,
+            compute_dtype=config.model.compute_dtype,
+            n_real=getattr(dataset, "n_real", dataset.n_max),
+            n_padded=dataset.n_max,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _build_step_fns(self) -> None:
+        """(Re)build optimizer + jitted fleet-epoch fns for the current
+        `self.total_steps` (same cosine-horizon contract as
+        Trainer._build_step_fns)."""
+        cfg = self.cfg
+        self.tx = make_optimizer(cfg.train, self.total_steps)
+        self.fns = make_step_fns(
+            self.model, self.model_eval, self.tx, cfg.data.seq_len
+        )
+        if self.num_seeds == 1:
+            # Bitwise-oracle path: identical jits to the serial Trainer.
+            self._train_epoch_jit = jax.jit(
+                self.fns.train_epoch, donate_argnums=(0,))
+            self._eval_epoch_jit = jax.jit(self.fns.eval_epoch)
+        else:
+            # Panel broadcast (in_axes=None): ONE HBM copy serves every
+            # seed; state and day orders carry the seed axis.
+            self._train_epoch_jit = jax.jit(
+                jax.vmap(self.fns.train_epoch, in_axes=(0, 0, None)),
+                donate_argnums=(0,),
+            )
+            # params/key are per-seed; the validation order is shared
+            # (shuffle=False, seed 0 — identical across seeds).
+            self._eval_epoch_jit = jax.jit(
+                jax.vmap(self.fns.eval_epoch, in_axes=(0, None, 0, None))
+            )
+
+    def panel_args(self):
+        return (self.ds.values, self.ds.last_valid, self.ds.next_valid)
+
+    # ------------------------------------------------------------------
+
+    def init_fleet_state(self) -> TrainState:
+        """Vmapped seeded init: each seed reproduces the serial
+        `Trainer.init_state` key schedule (PRNGKey(seed) split 3 ways)
+        exactly — vmapped threefry is elementwise per key, so the stacked
+        init is bitwise the per-seed serial inits (tested)."""
+        cfg = self.cfg
+        b, n = self.batch_days, self.ds.n_max
+        x = jnp.zeros((b, n, cfg.data.seq_len, cfg.model.num_features))
+        y = jnp.zeros((b, n))
+        mask = jnp.ones((b, n), bool)
+
+        def init_one(seed):
+            key = jax.random.PRNGKey(seed)
+            k_param, k_sample, k_drop = jax.random.split(key, 3)
+            params = self.model.init(
+                {"params": k_param, "sample": k_sample, "dropout": k_drop},
+                x, y, mask,
+            )
+            return create_train_state(params, self.tx, seed)
+
+        seeds = jnp.asarray(self.seeds, jnp.uint32)
+        return jax.jit(jax.vmap(init_one))(seeds)
+
+    def _epoch_orders(self, epoch: int) -> jnp.ndarray:
+        """(S, steps, B) stacked day orders — each seed shuffles with its
+        OWN seed, matching its solo run's epoch stream."""
+        cfg = self.cfg
+        orders = [
+            self.ds.epoch_order(
+                self.train_days, shuffle=True, seed=s, epoch=epoch,
+                pad_to=self.batch_days,
+            ).reshape(-1, self.batch_days)
+            for s in self.seeds
+        ]
+        return jnp.asarray(np.stack(orders))
+
+    def _val_order(self):
+        if len(self.val_days) == 0:
+            return None
+        order = self.ds.epoch_order(
+            self.val_days, shuffle=False, seed=0, epoch=0,
+            pad_to=self.batch_days,
+        ).reshape(-1, self.batch_days)
+        return jnp.asarray(order)
+
+    def _eval_keys(self, epoch: int) -> jax.Array:
+        """(S, key) per-seed eval keys, bitwise the serial
+        fold_in(PRNGKey(seed + 1), epoch) stream."""
+        seeds = jnp.asarray(self.seeds, jnp.uint32)
+        return jax.vmap(
+            lambda s: jax.random.fold_in(jax.random.PRNGKey(s + 1), epoch)
+        )(seeds)
+
+    # ------------------------------------------------------------------
+    # The "run state" is the representation the epoch loop carries:
+    # the stacked fleet state at S>1, the RAW TrainState at S==1 — the
+    # serial layout, so the S=1 oracle (and the raced S=1 baseline in
+    # autotune/bench) pays exactly what the serial Trainer pays: no
+    # per-epoch stack/unstack dispatches. Stacking happens only at
+    # boundaries (init/restore/checkpoint/return).
+
+    def init_run_state(self) -> TrainState:
+        state = self.init_fleet_state()
+        return state if self.num_seeds > 1 else unstack_state(state, 0)
+
+    def _stacked(self, run_state):
+        """Stacked (S, ...) view of a run state, for the per-seed
+        unstack consumers (checkpoints, the returned fleet state)."""
+        if self.num_seeds > 1:
+            return run_state
+        return jax.tree.map(lambda x: x[None], run_state)
+
+    def _run_train_epoch(self, run_state, epoch):
+        orders = self._epoch_orders(epoch)
+        if self.num_seeds == 1:
+            st, m = self._train_epoch_jit(
+                run_state, orders[0], self.panel_args())
+            return st, {k: v[None] for k, v in m.items()}
+        return self._train_epoch_jit(run_state, orders, self.panel_args())
+
+    def _run_eval_epoch(self, run_params, val_order, epoch):
+        keys = self._eval_keys(epoch)
+        if self.num_seeds == 1:
+            m = self._eval_epoch_jit(
+                run_params, val_order, keys[0], self.panel_args())
+            return {k: v[None] for k, v in m.items()}
+        return self._eval_epoch_jit(run_params, val_order, keys,
+                                    self.panel_args())
+
+    # ------------------------------------------------------------------
+
+    def fit(
+        self,
+        num_epochs: Optional[int] = None,
+        rescale_schedule: bool = False,
+        resume: bool = False,
+    ):
+        """Train the whole fleet. Returns (fleet_state, out) where `out`
+        has `history` (per-epoch records with per-seed value lists),
+        `best_val` (S,), and `best_params` (stacked, `jnp.where`-selected
+        per-seed best-validation snapshots). Per-seed best-val weights
+        are also written to disk under the serial naming scheme.
+
+        `num_epochs` / `rescale_schedule` follow the serial Trainer's
+        contract: N alone runs the first N epochs of the configured
+        cosine horizon; rescale_schedule=True makes N the whole horizon.
+
+        ``resume=True`` restores the whole group from its per-seed
+        full-state checkpoints when EVERY member has one at the SAME
+        epoch — the lockstep layout this fit writes every
+        `checkpoint_every` epochs — so a killed multi-hour fleet run
+        continues instead of retraining from zero (mixed or missing
+        epochs fall back to a fresh start, logged). Restored members
+        continue bit-for-bit like an unbroken fleet run.
+        """
+        cfg = self.cfg
+        epochs = cfg.train.num_epochs if num_epochs is None else num_epochs
+        total = self.steps_per_epoch * (
+            epochs if rescale_schedule else cfg.train.num_epochs
+        )
+        if total != self.total_steps:
+            self.total_steps = total
+            self._build_step_fns()
+
+        state = self.init_fleet_state()
+        best_val = jnp.full((self.num_seeds,), jnp.inf, jnp.float32)
+        # A fresh copy, not an alias: the epoch jit donates its input
+        # state, and an aliased best_params buffer would be reused by
+        # XLA on backends with donation support.
+        best_params = jax.tree.map(jnp.copy, state.params)
+        start_epoch = 0
+        if resume and cfg.train.checkpoint_every:
+            restored = self._restore_checkpoints(state)
+            if restored is not None:
+                state, bv, start_epoch = restored
+                best_val = jnp.asarray(bv)
+                best_params = self._load_best(state.params, bv)
+                self.logger.log("fleet_resume", epoch=start_epoch,
+                                seeds=self.seeds,
+                                best_val=[float(v) for v in bv])
+        run_state = (state if self.num_seeds > 1
+                     else unstack_state(state, 0))
+        val_order = self._val_order()
+        ckpt_every = max(1, cfg.train.checkpoint_every or 0)
+        history = []
+        for epoch in range(start_epoch, epochs):
+            t0 = time.time()
+            run_state, train_m = self._run_train_epoch(run_state, epoch)
+            if val_order is not None:
+                val_m = self._run_eval_epoch(run_state.params, val_order,
+                                             epoch)
+                selection = val_m["loss"]
+            else:
+                val_m = None
+                selection = train_m["loss"]
+            prev_best = np.asarray(best_val)
+            if self.num_seeds == 1:
+                # Serial-style host branch: no stacked select dispatches
+                # on the oracle path; copy only on improvement (x[None]
+                # allocates fresh buffers, so the snapshot survives the
+                # next epoch's donation).
+                sel_f = float(np.asarray(selection)[0])
+                if sel_f < float(prev_best[0]):
+                    best_val = jnp.full((1,), sel_f, jnp.float32)
+                    best_params = jax.tree.map(lambda x: x[None],
+                                               run_state.params)
+            else:
+                best_params, best_val = select_best(
+                    best_params, best_val, run_state.params, selection)
+            dt = time.time() - t0
+            step = int(np.asarray(run_state.step).reshape(-1)[0])
+            lr = learning_rate_at(cfg.train, self.total_steps, step)
+            rec = dict(
+                epoch=epoch,
+                train_loss=[float(v) for v in np.asarray(train_m["loss"])],
+                val_loss=([float(v) for v in np.asarray(val_m["loss"])]
+                          if val_m is not None
+                          else [float("nan")] * self.num_seeds),
+                train_recon=[float(v) for v in np.asarray(train_m["recon"])],
+                train_kl=[float(v) for v in np.asarray(train_m["kl"])],
+                lr=lr,
+                step=step,
+                seconds=dt,
+                # aggregate fleet throughput: every seed trains the same
+                # day count, so seed-days/sec = S * days / dt.
+                seed_days_per_sec=(
+                    self.num_seeds * float(np.asarray(train_m["days"])[0])
+                    / max(dt, 1e-9)),
+            )
+            history.append(rec)
+            self.logger.log("fleet_epoch", **rec)
+            # Serial save cadence, fleet-wide: improved seeds' best-val
+            # snapshots hit disk THIS epoch (a killed multi-hour run
+            # keeps every seed's best so far, exactly like the serial
+            # Trainer's improved-branch save), and full-state resume
+            # checkpoints land every checkpoint_every epochs.
+            best_val_np = np.asarray(best_val)
+            improved = [i for i in range(self.num_seeds)
+                        if np.isfinite(best_val_np[i])
+                        and best_val_np[i] < prev_best[i]]
+            self._save_best(best_params, best_val_np, only=improved)
+            if cfg.train.checkpoint_every and (
+                    epoch % ckpt_every == 0 or epoch == epochs - 1):
+                self._save_checkpoints(self._stacked(run_state), epoch,
+                                       best_val_np)
+
+        best_val_np = np.asarray(best_val)
+        self.logger.log(
+            "fleet_best",
+            seeds=self.seeds,
+            best_val=[float(v) for v in best_val_np],
+        )
+        return self._stacked(run_state), {
+            "history": history,
+            "best_val": best_val_np,
+            "best_params": best_params,
+        }
+
+    # ------------------------------------------------------------------
+
+    def seed_config(self, seed: int) -> Config:
+        """The per-seed Config a solo run of this fleet member would use
+        (what `checkpoint_name()` keys on)."""
+        return dataclasses.replace(
+            self.cfg,
+            train=dataclasses.replace(self.cfg.train, seed=int(seed)),
+        )
+
+    def _save_best(self, best_params, best_val: np.ndarray,
+                   only=None) -> None:
+        """Per-seed best-val weights under the serial naming scheme —
+        the artifact `seed_sweep` / the backtest selection rule loads.
+        `only` restricts the write to the seeds that improved THIS
+        epoch (the serial save cadence — everyone else's file is
+        already current). Seeds that never improved (best_val still
+        inf/NaN — zero epochs or an all-NaN loss stream) get NO best
+        checkpoint, exactly like the serial Trainer, whose save runs
+        only inside the `improved` branch; consumers then fall back to
+        final-epoch params."""
+        rows = range(self.num_seeds) if only is None else only
+        for i in rows:
+            if not np.isfinite(best_val[i]):
+                continue
+            cfg_s = self.seed_config(self.seeds[i])
+            save_params(
+                cfg_s.train.save_dir, cfg_s.checkpoint_name(),
+                unstack_state(best_params, i),
+            )
+
+    def _restore_checkpoints(self, template_state):
+        """(stacked state, best_val (S,), start_epoch) from the per-seed
+        full-state checkpoints, or None when no step is common to every
+        member. The restore epoch is the MAX step present in ALL
+        members' dirs: a kill mid-way through the per-seed save loop
+        leaves the members one epoch apart, and the Checkpointer keeps
+        several steps (keep_checkpoints), so rewinding everyone to the
+        newest common epoch loses at most one epoch instead of the
+        whole run — mixed-latest resumes would silently desynchronize
+        the cosine schedule."""
+        ckpt_dirs = []
+        common = None
+        for seed in self.seeds:
+            cfg_s = self.seed_config(seed)
+            d = f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt"
+            if not os.path.isdir(d):
+                return None
+            ckpt = Checkpointer(d, keep=cfg_s.train.keep_checkpoints)
+            steps = set(ckpt.all_steps())
+            ckpt.close()
+            if not steps:
+                return None
+            ckpt_dirs.append(d)
+            common = steps if common is None else common & steps
+        if not common:
+            self.logger.log(
+                "fleet_resume_skipped", seeds=self.seeds,
+                note="no checkpoint step common to every fleet member; "
+                     "starting the group fresh")
+            return None
+        epoch = max(common)
+        states, best_vals = [], []
+        for i, seed in enumerate(self.seeds):
+            cfg_s = self.seed_config(seed)
+            ckpt = Checkpointer(ckpt_dirs[i],
+                                keep=cfg_s.train.keep_checkpoints)
+            st, meta = ckpt.restore(unstack_state(template_state, i),
+                                    step=epoch)
+            ckpt.close()
+            states.append(st)
+            best_vals.append(float(meta.get("best_val", float("inf"))))
+            saved_cfg = meta.get("config")
+            if saved_cfg is not None and saved_cfg != cfg_s.to_dict():
+                self.logger.log(
+                    "fleet_resume_config_mismatch", seed=seed,
+                    note="resuming with a different config than the "
+                         "checkpoint was written with")
+        return (stack_states(states),
+                np.asarray(best_vals, np.float32), epoch + 1)
+
+    def _load_best(self, params_template, best_val: np.ndarray):
+        """Stacked best-params buffer rebuilt from the per-seed best-val
+        checkpoints written before a kill (seeds without one — never
+        improved — keep their current params as the running snapshot,
+        matching a fresh run's initialization of the buffer)."""
+        from factorvae_tpu.train.checkpoint import load_params
+
+        rows = []
+        for i, seed in enumerate(self.seeds):
+            template = unstack_state(params_template, i)
+            cfg_s = self.seed_config(seed)
+            path = os.path.join(cfg_s.train.save_dir,
+                                cfg_s.checkpoint_name())
+            if np.isfinite(best_val[i]) and os.path.isdir(path):
+                rows.append(load_params(path, template))
+            else:
+                rows.append(jax.tree.map(jnp.copy, template))
+        return stack_states(rows)
+
+    def _save_checkpoints(self, fleet_state, epoch: int,
+                          best_val: np.ndarray) -> None:
+        """Lockstep full-state checkpoint per seed (every
+        `checkpoint_every` epochs + the final one), format-compatible
+        with the serial Checkpointer layout so a serial `Trainer` resume
+        can continue any fleet member — and `fit(resume=True)` can
+        restore the whole group."""
+        for i, seed in enumerate(self.seeds):
+            cfg_s = self.seed_config(seed)
+            ckpt = Checkpointer(
+                f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt",
+                keep=cfg_s.train.keep_checkpoints,
+            )
+            ckpt.save(
+                epoch,
+                unstack_state(fleet_state, i),
+                {"epoch": epoch, "best_val": float(best_val[i]),
+                 "config": cfg_s.to_dict()},
+            )
+            ckpt.close()

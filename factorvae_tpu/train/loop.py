@@ -16,6 +16,14 @@ Semantics knobs:
   sharded and XLA all-reduces the gradients over ICI.
 - day index -1 marks epoch padding (so the scan length is static and
   divisible); padded days get loss weight 0 and contribute no gradient.
+
+Fleet contract (train/fleet.py): `train_epoch` and `eval_epoch` are
+vmappable over a leading seed axis on (state, order) / (params, key)
+with the panel held broadcast — nothing in the bodies closes over
+per-seed state, and every metric in the returned dicts is a scalar, so
+the vmapped entry points return (S,)-shaped metric dicts with the same
+keys. Keep new metrics scalar (accumulate inside the scan) so the fleet
+path keeps working unchanged.
 """
 
 from __future__ import annotations

@@ -6,6 +6,12 @@ Capability parity with reference main.py:19-87 (seeding, module assembly,
 loader construction, Adam + cosine schedule, epoch loop, best-val save,
 optional wandb), plus what the reference lacks: full-state resume, mesh
 parallelism and on-device epoch execution.
+
+Multi-seed workloads (seed sweeps, the parity protocol) have a
+seed-parallel sibling: `train.fleet.FleetTrainer` trains S seeds of one
+config simultaneously by vmapping this module's epoch functions over a
+stacked TrainState — same artifacts, per-seed names; a 1-seed fleet is
+bitwise this Trainer.
 """
 
 from __future__ import annotations

@@ -20,11 +20,20 @@ conservative per-backend default. One command, bounded by construction:
 Kernel on/off stays "auto" (the per-shape raced envelope in plan.py —
 racing interpreted Pallas kernels off-TPU would be meaningless).
 
+`--fleet` additionally races the seed-parallel program width
+(`seeds_per_program` in {1, 2, 4, 8}, train/fleet.py) on each shape's
+winning train knobs and persists the aggregate-seed-throughput winner
+as the row's `fleet` block; S=1 (serial) is always in the raced set, so
+a written knob never regresses a multi-seed workload below the serial
+path. Rows without a `fleet` block (every pre-fleet table) keep
+resolving exactly as before: `plan_for` defaults them to serial.
+
 Usage:
     python scripts/autotune_plan.py                       # flagship shape
     python scripts/autotune_plan.py --config csi300-k60
     python scripts/autotune_plan.py --all                 # every preset shape
     python scripts/autotune_plan.py --all --days 4 --reps 1   # quickest
+    python scripts/autotune_plan.py --fleet               # + fleet knob race
         [--out PLAN_TABLE.json] [--dry_run]
 """
 
@@ -68,6 +77,10 @@ TRAIN_CANDIDATES = [
 ]
 DTYPES = ["float32", "bfloat16"]
 SCORE_CANDIDATES = [{"flatten_days": f} for f in (False, True)]
+# --fleet: seed-parallel program widths raced on top of the winning
+# train knobs (train/fleet.py). S=1 is the serial path itself, so the
+# persisted winner can never be slower than what the fallback runs.
+FLEET_CANDIDATES = [1, 2, 4, 8]
 
 
 def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int):
@@ -142,7 +155,61 @@ def time_score(shape: dict, dtype: str, flatten: bool,
     return reps * days * shape["stocks"] / dt
 
 
-def race_shape(name: str, shape: dict, days: int, reps: int) -> dict:
+def time_fleet(shape: dict, train_knobs: dict, num_seeds: int,
+               days: int, reps: int) -> float:
+    """Aggregate seed-throughput (windows/sec·seed summed over the
+    fleet) for one seed-parallel program width, on the winning train
+    knobs (compile excluded)."""
+    import jax
+
+    from factorvae_tpu.train.fleet import FleetTrainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, train_knobs["compute_dtype"],
+                     train_knobs["flatten_days"],
+                     train_knobs["days_per_step"], days)
+    trainer = FleetTrainer(cfg, ds, seeds=list(range(num_seeds)),
+                           logger=MetricsLogger(echo=False))
+    # init_run_state: at S=1 this is the RAW serial state, so the
+    # baseline the race normalizes against pays exactly what the
+    # serial Trainer pays (no stack/unstack overhead biasing the
+    # persisted winner toward S>1).
+    state = trainer.init_run_state()
+    state, m = trainer._run_train_epoch(state, 0)  # warmup/compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    for e in range(1, 1 + reps):
+        state, m = trainer._run_train_epoch(state, e)
+    jax.block_until_ready(m["loss"])
+    dt = time.time() - t0
+    return reps * days * shape["stocks"] * num_seeds / dt
+
+
+def race_fleet(name: str, shape: dict, train_knobs: dict,
+               days: int, reps: int) -> dict:
+    """Race `seeds_per_program` over FLEET_CANDIDATES; return the row's
+    `fleet` block (winner + every candidate timing for audit)."""
+    measured = {}
+    best_s, best_wps = 1, None
+    for s in FLEET_CANDIDATES:
+        wps = time_fleet(shape, train_knobs, s, days, reps)
+        measured[f"S={s}"] = round(wps, 1)
+        print(f"[autotune] {name} fleet S={s}: {wps:,.0f} w/s·seed "
+              f"aggregate", file=sys.stderr)
+        if best_wps is None or wps > best_wps:
+            best_s, best_wps = s, wps
+    return {
+        "seeds_per_program": best_s,
+        "measured": measured,
+        "source": f"fleet race on {train_knobs['compute_dtype']} "
+                  f"flat={int(train_knobs['flatten_days'])} "
+                  f"dps{train_knobs['days_per_step']}: best S={best_s} "
+                  f"at {best_wps:,.0f} w/s·seed",
+    }
+
+
+def race_shape(name: str, shape: dict, days: int, reps: int,
+               fleet: bool = False) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
     plan-table row."""
@@ -177,11 +244,17 @@ def race_shape(name: str, shape: dict, days: int, reps: int) -> dict:
                 best_score = ws
                 best_score_key = {**cand, "compute_dtype": dtype}
 
+    fleet_block = None
+    if fleet:
+        fleet_block = race_fleet(name, shape, best_train_key, days, reps)
+
     shp = ShapeKey(
         num_features=shape["features"], seq_len=shape["seq_len"],
         hidden_size=shape["hidden"], num_factors=shape["factors"],
         num_portfolios=shape["portfolios"], n_stocks=shape["stocks"])
-    return {
+    if fleet_block is not None:
+        measured["fleet"] = fleet_block.pop("measured")
+    row = {
         "platform": plat,
         "shape": {"c": shp.num_features, "t": shp.seq_len,
                   "h": shp.hidden_size, "k": shp.num_factors,
@@ -196,9 +269,15 @@ def race_shape(name: str, shape: dict, days: int, reps: int) -> dict:
                   f"train {best_train:.4f} s/day, "
                   f"score {best_score:,.0f} w/s",
     }
+    if fleet_block is not None:
+        row["fleet"] = {"seeds_per_program":
+                        fleet_block["seeds_per_program"]}
+        row["source"] += f"; {fleet_block['source']}"
+    return row
 
 
-def race_widths(name: str, shape: dict, days: int, reps: int) -> list:
+def race_widths(name: str, shape: dict, days: int, reps: int,
+                fleet: bool = False) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
     envelope row — both bounds measured, no extrapolation beyond them
@@ -207,12 +286,14 @@ def race_widths(name: str, shape: dict, days: int, reps: int) -> list:
     widths = shape["stocks"]
     if not isinstance(widths, (list, tuple)):
         widths = [widths]
-    rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps)
+    rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
+                       fleet=fleet)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
         p = merged[-1]
-        if (r["train"], r["score"]) != (p["train"], p["score"]):
+        if (r["train"], r["score"], r.get("fleet")) != (
+                p["train"], p["score"], p.get("fleet")):
             merged.append(r)
             continue
         if not any(k.startswith("n=") for k in p["measured"]):
@@ -240,6 +321,14 @@ def main() -> int:
                    help="plan table path (default: the planner's own "
                         "resolution — FACTORVAE_PLAN_TABLE or "
                         "PLAN_TABLE.json at the repo root)")
+    p.add_argument("--fleet", action="store_true",
+                   help="also race the seed-parallel fleet knob "
+                        "(seeds_per_program in {1, 2, 4, 8}, "
+                        "train/fleet.py) on each shape's winning train "
+                        "knobs; the aggregate-seed-throughput winner is "
+                        "persisted on the row's 'fleet' block "
+                        "(plan_for -> Plan.seeds_per_program; rows "
+                        "without the block resolve to serial)")
     p.add_argument("--dry_run", action="store_true",
                    help="race and print the rows without persisting")
     args = p.parse_args()
@@ -260,7 +349,8 @@ def main() -> int:
 
     names = sorted(SHAPES) if args.all else [args.config]
     rows = [r for n in names
-            for r in race_widths(n, SHAPES[n], args.days, args.reps)]
+            for r in race_widths(n, SHAPES[n], args.days, args.reps,
+                                 fleet=args.fleet)]
     print(json.dumps({"rows": rows}, indent=1))
     if args.dry_run:
         print("[autotune] --dry_run: table not written", file=sys.stderr)

@@ -20,9 +20,21 @@ Output: PARITY_RUN_r04.json (grid table + both sweeps + the recovery
 fraction vs the reference's 0.0794). Runs are float32 regardless of the
 preset's bench dtype.
 
+The seed sweeps run seed-parallel by default when the planner says the
+fleet pays at this shape (`--fleet auto`: `seeds_per_program` from the
+raced plan row, train/fleet.py; `--fleet on|off` forces it) — the
+epoch-matched 50-epoch control (VERDICT r5 weak-#3: the missing
+experiment separating "collapsed" from "undertrained") is affordable
+exactly because S seeds share one program. Partial-result files stay
+format-compatible either way: `on_seed` fires per seed in both modes.
+Restart granularity differs: serial loses at most the in-flight seed,
+fleet at most the in-flight GROUP (bounded by the planner's
+seeds_per_program — these runs keep checkpoint_every=0 for speed, so
+mid-group state is not checkpointed here).
+
 Usage:
     python scripts/parity_k60_sweep.py [--epochs 50] [--seeds 8]
-        [--out PARITY_RUN_r04.json] [--quick]
+        [--fleet auto|on|off] [--out PARITY_RUN_r04.json] [--quick]
 """
 
 from __future__ import annotations
@@ -119,8 +131,19 @@ DEFAULT_GRID = "1e-4:1,1e-4:0.1,1e-4:0.02,3e-4:1,3e-4:0.1,3e-4:0.02"
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scores_dir", default="/root/reference/scores")
-    ap.add_argument("--epochs", type=int, default=50)
+    ap.add_argument("--epochs", "--num_epochs", dest="epochs", type=int,
+                    default=50,
+                    help="epochs per run (--num_epochs is an alias so "
+                         "epoch-matched controls can use the CLI's flag "
+                         "name)")
     ap.add_argument("--seeds", type=int, default=8)
+    ap.add_argument("--fleet", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="seed-parallel sweep execution (train/fleet.py)."
+                         " auto = follow the planner's raced "
+                         "seeds_per_program for this shape (serial when "
+                         "the plan says 1); on = one program for all "
+                         "seeds; off = serial")
     ap.add_argument("--grid", default=DEFAULT_GRID,
                     help="comma-separated lr:kl_weight grid points; "
                          "'' skips the grid phase")
@@ -150,6 +173,23 @@ def main(argv=None) -> int:
     # _cfg_for forces compute_dtype=float32 on every run (presets are
     # bf16 for bench; parity should not fold a dtype change in).
     ds = PanelDataset(panel, seq_len=cfg0.model.seq_len, pad_multiple=8)
+
+    # Fleet execution (train/fleet.py): --fleet auto follows the
+    # planner's raced seeds_per_program for this shape; partial-result
+    # files stay format-compatible (on_seed fires per seed either way).
+    from factorvae_tpu.plan import plan_for_config
+
+    plan = plan_for_config(cfg0, getattr(ds, "n_real", ds.n_max))
+    if args.fleet == "on":
+        use_fleet, spp = True, None      # one program for all seeds
+    elif args.fleet == "off":
+        use_fleet, spp = False, None
+    else:
+        spp = plan.seeds_per_program
+        use_fleet = spp > 1
+    print(f"[k60] sweep execution: "
+          f"{'fleet (seeds_per_program=%s)' % (spp or 'all') if use_fleet else 'serial'}"
+          f" [plan {plan.provenance}: seeds_per_program={plan.seeds_per_program}]")
 
     epochs = 2 if args.quick else args.epochs
     n_seeds = 2 if args.quick else args.seeds
@@ -288,7 +328,8 @@ def main(argv=None) -> int:
 
         df = seed_sweep(cfg, ds, seeds=list(range(n_seeds)),
                         score_start=score_start, score_end=score_end,
-                        on_seed=on_seed, prior_records=prior)
+                        on_seed=on_seed, prior_records=prior,
+                        fleet=use_fleet, seeds_per_program=spp)
         s = df.attrs["summary"]
         mean, std, n = s["rank_ic_mean"], s["rank_ic_std"], s["num_seeds"]
         ref_ic = results["reference_rank_ic"]

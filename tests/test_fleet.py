@@ -1,0 +1,392 @@
+"""Fleet-trainer contract (train/fleet.py):
+
+- S=1 is the equality ORACLE: a single-seed fleet compiles the
+  un-vmapped epoch functions, so it must reproduce the serial `Trainer`
+  bit-for-bit — params, metric histories, best-val selection, scores.
+- S>1 rows are INDEPENDENT trajectories: each seed matches its solo run
+  at f32 tolerance (vmap batches the matmuls, which reassociates the
+  reductions — equality is numerical, not bitwise).
+- Per-seed best-val snapshots unstack into checkpoints under the serial
+  per-seed names and round-trip through orbax exactly.
+- `seed_sweep(fleet=True)` returns the serial sweep's frame (same index
+  order, f32-close values), including resumed-seed adoption.
+"""
+
+import dataclasses
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.train import FleetTrainer, Trainer, load_params
+from factorvae_tpu.train.fleet import stack_states, unstack_state
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+@pytest.fixture(scope="module")
+def fleet_ds():
+    panel = synthetic_panel(
+        num_days=20, num_instruments=6, num_features=8, missing_prob=0.1,
+        seed=0,
+    )
+    return PanelDataset(panel, seq_len=5)
+
+
+def fleet_config(save_dir, ds, **train_kw) -> Config:
+    defaults = dict(num_epochs=3, lr=1e-3, seed=3, save_dir=str(save_dir),
+                    checkpoint_every=0)
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5),
+        data=DataConfig(seq_len=5, start_time=None,
+                        fit_end_time=str(ds.dates[12].date()),
+                        val_start_time=str(ds.dates[13].date()),
+                        val_end_time=str(ds.dates[-1].date())),
+        train=TrainConfig(**defaults),
+    )
+
+
+def seed_cfg(cfg: Config, seed: int) -> Config:
+    return dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, seed=seed))
+
+
+def assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def assert_trees_close(a, b, rtol=5e-3, atol=5e-3):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+class TestFleetS1Oracle:
+    """Single-seed fleet == serial Trainer, bitwise."""
+
+    @pytest.fixture(scope="class")
+    def runs(self, fleet_ds, tmp_path_factory):
+        d_serial = tmp_path_factory.mktemp("serial")
+        d_fleet = tmp_path_factory.mktemp("fleet1")
+        cfg_s = fleet_config(d_serial, fleet_ds)
+        tr = Trainer(cfg_s, fleet_ds, logger=MetricsLogger(echo=False))
+        state_s, out_s = tr.fit()
+        cfg_f = fleet_config(d_fleet, fleet_ds)
+        ft = FleetTrainer(cfg_f, fleet_ds, seeds=[3],
+                          logger=MetricsLogger(echo=False))
+        state_f, out_f = ft.fit()
+        return cfg_s, cfg_f, state_s, out_s, state_f, out_f
+
+    def test_final_params_bitwise(self, runs):
+        _, _, state_s, _, state_f, _ = runs
+        assert_trees_bitwise(state_s.params, unstack_state(state_f, 0).params)
+
+    def test_metric_history_bitwise(self, runs):
+        _, _, _, out_s, _, out_f = runs
+        for h_s, h_f in zip(out_s["history"], out_f["history"]):
+            assert h_s["train_loss"] == h_f["train_loss"][0]
+            assert h_s["val_loss"] == h_f["val_loss"][0]
+            assert h_s["train_recon"] == h_f["train_recon"][0]
+            assert h_s["train_kl"] == h_f["train_kl"][0]
+            assert h_s["step"] == h_f["step"]
+            assert h_s["lr"] == h_f["lr"]
+
+    def test_best_val_bitwise(self, runs):
+        _, _, _, out_s, _, out_f = runs
+        assert out_s["best_val"] == float(out_f["best_val"][0])
+
+    def test_best_checkpoint_bitwise(self, runs):
+        """The on-device where-selected best snapshot, written under the
+        serial name, is bitwise the serial best-val artifact."""
+        cfg_s, cfg_f, state_s, _, _, out_f = runs
+        p_serial = load_params(
+            os.path.join(cfg_s.train.save_dir, cfg_s.checkpoint_name()),
+            state_s.params)
+        p_fleet = load_params(
+            os.path.join(cfg_f.train.save_dir, cfg_f.checkpoint_name()),
+            state_s.params)
+        assert_trees_bitwise(p_serial, p_fleet)
+        assert_trees_bitwise(p_fleet, unstack_state(out_f["best_params"], 0))
+
+    def test_scores_bitwise(self, runs, fleet_ds):
+        """Seed-batched scoring at S=1 routes through the serial scan —
+        scores stay bitwise."""
+        from factorvae_tpu.eval.predict import (
+            predict_panel,
+            predict_panel_fleet,
+        )
+
+        cfg_s, _, state_s, _, _, out_f = runs
+        days = fleet_ds.split_days(cfg_s.data.val_start_time, None)
+        best_serial = load_params(
+            os.path.join(cfg_s.train.save_dir, cfg_s.checkpoint_name()),
+            state_s.params)
+        s_serial = predict_panel(best_serial, cfg_s, fleet_ds, days,
+                                 stochastic=False)
+        s_fleet = predict_panel_fleet(out_f["best_params"], cfg_s, fleet_ds,
+                                      days, stochastic=False)
+        assert s_fleet.shape == (1,) + s_serial.shape
+        np.testing.assert_array_equal(s_serial, s_fleet[0])
+
+
+class TestFleetIndependence:
+    """S>1: every seed's trajectory equals its solo run at f32."""
+
+    @pytest.fixture(scope="class")
+    def runs(self, fleet_ds, tmp_path_factory):
+        d_solo = tmp_path_factory.mktemp("solo")
+        d_fleet = tmp_path_factory.mktemp("fleet2")
+        solos = {}
+        for seed in (3, 7):
+            cfg = seed_cfg(fleet_config(d_solo, fleet_ds), seed)
+            tr = Trainer(cfg, fleet_ds, logger=MetricsLogger(echo=False))
+            solos[seed] = tr.fit()
+        cfg_f = fleet_config(d_fleet, fleet_ds)
+        ft = FleetTrainer(cfg_f, fleet_ds, seeds=[3, 7],
+                          logger=MetricsLogger(echo=False))
+        fleet = ft.fit()
+        return solos, fleet
+
+    def test_per_seed_params_close(self, runs):
+        solos, (state_f, _) = runs
+        for i, seed in enumerate((3, 7)):
+            state_solo, _ = solos[seed]
+            assert_trees_close(state_solo.params,
+                               unstack_state(state_f, i).params)
+
+    def test_per_seed_history_close(self, runs):
+        solos, (_, out_f) = runs
+        for i, seed in enumerate((3, 7)):
+            _, out_solo = solos[seed]
+            for h_s, h_f in zip(out_solo["history"], out_f["history"]):
+                np.testing.assert_allclose(
+                    h_s["train_loss"], h_f["train_loss"][i], rtol=5e-3)
+                np.testing.assert_allclose(
+                    h_s["val_loss"], h_f["val_loss"][i], rtol=5e-3)
+            np.testing.assert_allclose(
+                out_solo["best_val"], float(out_f["best_val"][i]), rtol=5e-3)
+
+    def test_seeds_actually_differ(self, runs):
+        """The fleet rows are different models (per-seed init + RNG +
+        day order actually happened), not S copies of one trajectory."""
+        _, (state_f, out_f) = runs
+        p0 = jax.tree.leaves(unstack_state(state_f, 0).params)
+        p1 = jax.tree.leaves(unstack_state(state_f, 1).params)
+        assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+                   for a, b in zip(p0, p1))
+        assert float(out_f["best_val"][0]) != float(out_f["best_val"][1])
+
+    def test_duplicate_seeds_rejected(self, fleet_ds, tmp_path):
+        with pytest.raises(ValueError, match="duplicate"):
+            FleetTrainer(fleet_config(tmp_path, fleet_ds), fleet_ds,
+                         seeds=[3, 3])
+
+
+class TestFleetCheckpoints:
+    """Per-seed unstack + round-trip of the best-val snapshot and the
+    full-state resume checkpoint."""
+
+    def test_best_val_unstack_roundtrip(self, fleet_ds, tmp_path):
+        cfg = fleet_config(tmp_path, fleet_ds, num_epochs=2,
+                           checkpoint_every=1)
+        ft = FleetTrainer(cfg, fleet_ds, seeds=[1, 5],
+                          logger=MetricsLogger(echo=False))
+        state_f, out_f = ft.fit()
+        for i, seed in enumerate((1, 5)):
+            cfg_s = seed_cfg(cfg, seed)
+            path = os.path.join(cfg_s.train.save_dir,
+                                cfg_s.checkpoint_name())
+            assert os.path.isdir(path), "per-seed best checkpoint missing"
+            template = unstack_state(out_f["best_params"], i)
+            loaded = load_params(path, template)
+            assert_trees_bitwise(template, loaded)
+
+    def test_full_state_resume_format(self, fleet_ds, tmp_path):
+        """The fleet's final-epoch full-state checkpoint restores through
+        the serial Checkpointer layout (a serial Trainer can resume a
+        fleet member)."""
+        from factorvae_tpu.train.checkpoint import Checkpointer
+
+        cfg = fleet_config(tmp_path, fleet_ds, num_epochs=2,
+                           checkpoint_every=1)
+        ft = FleetTrainer(cfg, fleet_ds, seeds=[1, 5],
+                          logger=MetricsLogger(echo=False))
+        state_f, _ = ft.fit()
+        for i, seed in enumerate((1, 5)):
+            cfg_s = seed_cfg(cfg, seed)
+            ckpt = Checkpointer(
+                f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt")
+            template = unstack_state(state_f, i)
+            restored, meta = ckpt.restore(template)
+            ckpt.close()
+            assert meta["epoch"] == 1
+            assert meta["config"]["train"]["seed"] == seed
+            assert_trees_bitwise(template.params, restored.params)
+            assert int(restored.step) == int(np.asarray(state_f.step)[i])
+
+    def test_group_resume_bitwise(self, fleet_ds, tmp_path):
+        """A killed fleet run resumed via fit(resume=True) continues
+        bit-for-bit like an unbroken run: the lockstep per-seed
+        full-state checkpoints restore the whole group (params, opt
+        state, RNG, best-val) and the remaining epochs replay exactly."""
+        cfg_a = fleet_config(tmp_path / "a", fleet_ds, num_epochs=4,
+                             checkpoint_every=1)
+        ft_a = FleetTrainer(cfg_a, fleet_ds, seeds=[3, 7],
+                            logger=MetricsLogger(echo=False))
+        state_a, out_a = ft_a.fit()
+
+        cfg_b = fleet_config(tmp_path / "b", fleet_ds, num_epochs=4,
+                             checkpoint_every=1)
+        ft_b1 = FleetTrainer(cfg_b, fleet_ds, seeds=[3, 7],
+                             logger=MetricsLogger(echo=False))
+        ft_b1.fit(num_epochs=2)        # "killed" after epoch 1
+        ft_b2 = FleetTrainer(cfg_b, fleet_ds, seeds=[3, 7],
+                             logger=MetricsLogger(echo=False))
+        state_b, out_b = ft_b2.fit(resume=True)
+
+        assert len(out_b["history"]) == 2   # epochs 2..3 only
+        assert out_b["history"][0]["epoch"] == 2
+        assert_trees_bitwise(state_a.params, state_b.params)
+        np.testing.assert_array_equal(out_a["best_val"], out_b["best_val"])
+        assert_trees_bitwise(out_a["best_params"], out_b["best_params"])
+
+    def test_group_resume_rewinds_to_max_common_epoch(self, fleet_ds,
+                                                      tmp_path):
+        """A kill mid-way through the per-seed save loop leaves members
+        one epoch apart; resume must rewind everyone to the newest
+        COMMON epoch (losing one epoch), not throw the run away."""
+        cfg = fleet_config(tmp_path, fleet_ds, num_epochs=4,
+                           checkpoint_every=1)
+        ft = FleetTrainer(cfg, fleet_ds, seeds=[3, 7],
+                          logger=MetricsLogger(echo=False))
+        ft.fit(num_epochs=3)   # members checkpointed at epochs 0,1,2
+        # simulate the kill: seed 7 never got its epoch-2 checkpoint
+        cfg7 = seed_cfg(cfg, 7)
+        shutil.rmtree(os.path.join(
+            cfg7.train.save_dir, cfg7.checkpoint_name() + "_ckpt", "2"))
+        ft2 = FleetTrainer(cfg, fleet_ds, seeds=[3, 7],
+                           logger=MetricsLogger(echo=False))
+        _, out = ft2.fit(resume=True)
+        # rewound to common epoch 1, replayed epochs 2..3
+        assert [h["epoch"] for h in out["history"]] == [2, 3]
+
+    def test_resume_on_fresh_dir_starts_fresh(self, fleet_ds, tmp_path):
+        """resume=True with no checkpoints (or checkpointing off) is a
+        fresh run, not an error."""
+        cfg = fleet_config(tmp_path, fleet_ds, num_epochs=1,
+                           checkpoint_every=1)
+        ft = FleetTrainer(cfg, fleet_ds, seeds=[1, 2],
+                          logger=MetricsLogger(echo=False))
+        _, out = ft.fit(resume=True)
+        assert len(out["history"]) == 1
+        assert out["history"][0]["epoch"] == 0
+
+    def test_stack_unstack_inverse(self, fleet_ds, tmp_path):
+        cfg = fleet_config(tmp_path, fleet_ds)
+        ft = FleetTrainer(cfg, fleet_ds, seeds=[2, 4],
+                          logger=MetricsLogger(echo=False))
+        state = ft.init_fleet_state()
+        restacked = stack_states([unstack_state(state, 0),
+                                  unstack_state(state, 1)])
+        assert_trees_bitwise(state, restacked)
+
+
+class TestFleetSweep:
+    """seed_sweep(fleet=True) == the serial sweep on the same seeds,
+    including resumed-seed adoption."""
+
+    def test_fleet_sweep_matches_serial(self, fleet_ds, tmp_path):
+        from factorvae_tpu.eval.sweep import seed_sweep
+
+        prior = {5: {"rank_ic": 0.123, "rank_ic_ir": 1.0, "best_val": 0.5}}
+        fired = {"serial": [], "fleet": []}
+        kw = dict(score_start=str(fleet_ds.dates[13].date()),
+                  logger=MetricsLogger(echo=False), prior_records=prior)
+        df_s = seed_sweep(
+            fleet_config(tmp_path / "s", fleet_ds, num_epochs=2),
+            fleet_ds, seeds=[3, 5, 7],
+            on_seed=lambda r: fired["serial"].append(r["seed"]), **kw)
+        df_f = seed_sweep(
+            fleet_config(tmp_path / "f", fleet_ds, num_epochs=2),
+            fleet_ds, seeds=[3, 5, 7],
+            on_seed=lambda r: fired["fleet"].append(r["seed"]),
+            fleet=True, seeds_per_program=2, **kw)
+        # same index order, resumed seed adopted verbatim in both
+        assert list(df_s.index) == [3, 5, 7] == list(df_f.index)
+        assert df_f.loc[5, "rank_ic"] == 0.123
+        np.testing.assert_allclose(df_s["rank_ic"], df_f["rank_ic"],
+                                   rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(df_s["best_val"], df_f["best_val"],
+                                   rtol=5e-3)
+        assert df_s.attrs["summary"]["num_seeds"] == \
+            df_f.attrs["summary"]["num_seeds"] == 3
+        # on_seed fired for every seed in both modes (resumed included)
+        assert sorted(fired["serial"]) == sorted(fired["fleet"]) == [3, 5, 7]
+
+    def test_fleet_grouping_covers_all_pending(self, fleet_ds, tmp_path):
+        """seeds_per_program smaller than the pending set still trains
+        every seed (multiple programs)."""
+        from factorvae_tpu.eval.sweep import seed_sweep
+
+        df = seed_sweep(
+            fleet_config(tmp_path, fleet_ds, num_epochs=1),
+            fleet_ds, seeds=[0, 1, 2],
+            score_start=str(fleet_ds.dates[13].date()),
+            logger=MetricsLogger(echo=False),
+            fleet=True, seeds_per_program=2)
+        assert list(df.index) == [0, 1, 2]
+        assert np.isfinite(df["rank_ic"]).all()
+
+
+class TestFleetScoring:
+    def test_fleet_scores_match_per_seed(self, fleet_ds, tmp_path):
+        """S>1 seed-batched scan == per-seed serial scoring at f32."""
+        from factorvae_tpu.eval.predict import (
+            predict_panel,
+            predict_panel_fleet,
+        )
+
+        cfg = fleet_config(tmp_path, fleet_ds, num_epochs=1)
+        ft = FleetTrainer(cfg, fleet_ds, seeds=[0, 1, 2],
+                          logger=MetricsLogger(echo=False))
+        state_f, _ = ft.fit()
+        days = fleet_ds.split_days(cfg.data.val_start_time, None)
+        batched = predict_panel_fleet(state_f.params, cfg, fleet_ds, days,
+                                      stochastic=False)
+        assert batched.shape[0] == 3
+        for i in range(3):
+            solo = predict_panel(unstack_state(state_f.params, i), cfg,
+                                 fleet_ds, days, stochastic=False)
+            np.testing.assert_allclose(solo, batched[i],
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_stochastic_fleet_scores_share_rng(self, fleet_ds, tmp_path):
+        """The stochastic path threads the SAME per-chunk RNG stream as
+        the serial scan (scoring seed shared fleet-wide)."""
+        from factorvae_tpu.eval.predict import (
+            predict_panel,
+            predict_panel_fleet,
+        )
+
+        cfg = fleet_config(tmp_path, fleet_ds, num_epochs=1)
+        ft = FleetTrainer(cfg, fleet_ds, seeds=[0, 1],
+                          logger=MetricsLogger(echo=False))
+        state_f, _ = ft.fit()
+        days = fleet_ds.split_days(cfg.data.val_start_time, None)
+        batched = predict_panel_fleet(state_f.params, cfg, fleet_ds, days,
+                                      stochastic=True, seed=11)
+        for i in range(2):
+            solo = predict_panel(unstack_state(state_f.params, i), cfg,
+                                 fleet_ds, days, stochastic=True, seed=11)
+            np.testing.assert_allclose(solo, batched[i],
+                                       rtol=2e-4, atol=2e-5)

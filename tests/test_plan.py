@@ -267,3 +267,46 @@ class TestObservability:
             planlib.resolve("Auto", True)
         assert planlib.resolve("auto", True) is True
         assert planlib.resolve(False, True) is False
+
+
+class TestFleetKnob:
+    """seeds_per_program (train/fleet.py's planner knob): raced rows
+    carry a 'fleet' block; pre-fleet rows (every existing table) must
+    keep resolving exactly as before — serial."""
+
+    def test_fleet_row_resolves_seeds_per_program(self):
+        table = [row(fleet={"seeds_per_program": 4})]
+        p = plan_for(K60, "cpu", table=table)
+        assert p.provenance == "measured"
+        assert p.seeds_per_program == 4
+        assert p.describe(K60, platform="cpu")["seeds_per_program"] == 4
+
+    def test_pre_fleet_row_defaults_to_serial(self):
+        """No schema break: a row written before the fleet knob existed
+        (no 'fleet' key) resolves with the same knobs plus serial
+        seeds_per_program."""
+        p = plan_for(K60, "cpu", table=[row()])
+        assert p.provenance == "measured"
+        assert p.seeds_per_program == 1
+
+    def test_default_plan_is_serial(self):
+        assert plan_for(FLAGSHIP, "cpu", table=[]).seeds_per_program == 1
+        assert plan_for(FLAGSHIP, "tpu", table=[]).seeds_per_program == 1
+
+    def test_null_fleet_block_tolerated(self):
+        """A hand-edited row with fleet: null (or an empty block) must
+        not crash the planner."""
+        assert plan_for(K60, "cpu",
+                        table=[row(fleet=None)]).seeds_per_program == 1
+        assert plan_for(K60, "cpu",
+                        table=[row(fleet={})]).seeds_per_program == 1
+
+    def test_pre_fleet_table_file_round_trip(self, tmp_path):
+        """load_table on a persisted pre-fleet file: rows still match
+        and resolve (no migration needed)."""
+        path = tmp_path / "table.json"
+        save_rows([row()], path=str(path))
+        rows = load_table(str(path))
+        p = plan_for(K60, "cpu", table=rows)
+        assert p.provenance == "measured"
+        assert p.seeds_per_program == 1
