@@ -1,0 +1,55 @@
+"""graftlint — a JAX-aware static-analysis pass for this repo.
+
+The three hot paths (serial trainer, fleet, scan scoring) depend on
+invariants nothing in Python enforces: no host sync inside jitted epoch
+bodies, donated buffers never read after the donating call, every PRNG
+key consumed exactly once, jits constructed once per config (not per
+call), and hot-path array constructors pinned to an explicit dtype so a
+bf16 plan is not silently f32. A stray `.item()` or reused key costs the
+chip-day win or breaks seed independence without failing a single test —
+so the invariants are checked at the AST level instead, on every tier-1
+run.
+
+Rule catalog (docs/analysis.md has the long-form version):
+
+- JGL001  host sync in traced code (float()/.item()/np.asarray/
+          jax.device_get/block_until_ready under jit/scan/vmap), plus
+          the per-element host-pull loop flavor outside traced code.
+- JGL002  PRNG key reuse: a key consumed twice with no interleaving
+          split/fold_in rebind.
+- JGL003  jit-cache hazards: jax.jit constructed in a per-call scope
+          (no lru_cache on the factory, not instance-cached), and
+          unhashable literals passed at static_argnums positions.
+- JGL004  donated-buffer read-after-donation.
+- JGL005  dtype drift: array constructors without an explicit dtype in
+          plan-governed hot paths.
+- JGL000  meta: unparseable file, or a `graftlint: disable` suppression
+          carrying no justification. Never suppressible.
+
+Suppression syntax (same line, or a standalone comment on the line
+above)::
+
+    x = host_read(y)  # graftlint: disable=JGL001 one scalar per epoch
+
+The justification text after the rule list is REQUIRED — a bare disable
+is itself a finding.
+
+CLI::
+
+    python -m factorvae_tpu.analysis factorvae_tpu scripts --format human
+
+The engine itself is stdlib-only (ast + tokenize) and never executes or
+imports the code under analysis, so the whole-repo pass takes well
+under a second. (Reaching it through `python -m factorvae_tpu.analysis`
+still imports the parent package — and therefore jax/flax; in-process
+callers like the tier-1 gate pay nothing extra.)
+"""
+
+from factorvae_tpu.analysis.engine import (
+    Finding,
+    analyze_paths,
+    analyze_source,
+    main,
+)
+
+__all__ = ["Finding", "analyze_paths", "analyze_source", "main"]

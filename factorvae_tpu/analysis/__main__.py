@@ -1,0 +1,8 @@
+"""CLI entry: `python -m factorvae_tpu.analysis [paths] --format human|json`."""
+
+import sys
+
+from factorvae_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,616 @@
+"""graftlint engine: module model, traced-reachability, suppressions, CLI.
+
+The unit of analysis is one module. For each file the engine builds a
+`ModuleModel`: the parsed AST, the import-alias table (`jnp` ->
+`jax.numpy`, ...), every function (nested defs and lambdas included), a
+name-based call graph, the set of functions reachable from a trace
+context (jit / scan / vmap / grad bodies), and the jit wrappers
+constructed in the module together with their `donate_argnums` /
+`static_argnums`. The rules in rules.py consume that model and emit
+`Finding`s; the engine then applies the suppression comments and decides
+the exit code.
+
+Name resolution is deliberately module-local and name-based: a call to
+`chunk_scores(...)` links to ANY local `def chunk_scores` — including a
+closure returned by a factory — because that is exactly the idiom the
+hot paths use (eval/predict.py's lru_cached jit factories). The
+over-approximation this buys (same-named unrelated functions link too)
+is the standard lint trade-off; suppressions carry the rare false
+positive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# canonical JAX surface the rules key on
+
+# wrapper -> positions of the function-valued argument(s) that get traced
+TRACED_FN_ARGS: Dict[str, Tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.pjit": (0,),
+    "jax.experimental.pjit.pjit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.custom_vjp": (0,),
+    "jax.custom_jvp": (0,),
+}
+
+# the subset that actually COMPILES (JGL003 cares about these only)
+JIT_WRAPPERS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+# key-deriving calls: reading a key here is sanctioned, not consumption
+KEY_DERIVERS = {
+    "jax.random.split",
+    "jax.random.fold_in",
+    "jax.random.clone",
+}
+# key-producing calls: assignment targets become tracked keys
+KEY_PRODUCERS = KEY_DERIVERS | {
+    "jax.random.PRNGKey",
+    "jax.random.key",
+    "jax.random.wrap_key_data",
+}
+
+CACHE_DECORATORS = {
+    "functools.lru_cache",
+    "functools.cache",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"graftlint:\s*disable=([A-Za-z0-9_,]+)[ \t]*(.*)$"
+)
+_HOT_PRAGMA_RE = re.compile(r"graftlint:\s*hot-path\b")
+
+# plan-governed hot paths for JGL005 (see docs/analysis.md): modules whose
+# compute dtype the execution planner owns. A module outside these opts in
+# with a `# graftlint: hot-path` pragma anywhere in the file.
+HOT_PATH_PATTERNS = (
+    "factorvae_tpu/train/",
+    "factorvae_tpu/eval/predict",
+    "factorvae_tpu/ops/",
+    "factorvae_tpu/data/windows",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int          # code line the suppression applies to
+    rules: Set[str]
+    justification: str
+    comment_line: int  # where the comment physically lives
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    node: ast.AST                      # FunctionDef | AsyncFunctionDef | Lambda
+    name: str                          # "<lambda>" for lambdas
+    qualname: str
+    parent: Optional["FuncInfo"]
+    traced: bool = False
+
+    def decorator_list(self) -> list:
+        return getattr(self.node, "decorator_list", [])
+
+
+class ModuleModel:
+    """Everything the rules need to know about one parsed module."""
+
+    def __init__(self, path: str, src: str, tree: ast.Module,
+                 hot_path: Optional[bool] = None):
+        self.path = path
+        self.src = src
+        self.tree = tree
+        self.aliases = _collect_aliases(tree)
+        self.functions: List[FuncInfo] = []
+        self._func_by_node: Dict[ast.AST, FuncInfo] = {}
+        self._funcs_by_name: Dict[str, List[FuncInfo]] = {}
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._collect_functions()
+        # donators/static: callable name -> argument positions
+        self.donators: Dict[str, Tuple[int, ...]] = {}
+        self.static_args: Dict[str, Tuple[int, ...]] = {}
+        self._collect_jit_wrappers()
+        self._mark_traced()
+        norm = path.replace(os.sep, "/")
+        if hot_path is None:
+            hot_path = any(p in norm for p in HOT_PATH_PATTERNS) or bool(
+                _HOT_PRAGMA_RE.search(src)
+            )
+        self.hot_path = hot_path
+
+    # -- structure ---------------------------------------------------------
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        """Dotted name of an expression through the import-alias table
+        (`jnp.zeros` -> "jax.numpy.zeros"), or None for non-name exprs."""
+        parts = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        parts.append(expr.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    def funcs_named(self, name: str) -> List[FuncInfo]:
+        return self._funcs_by_name.get(name, [])
+
+    def enclosing_function(self, node: ast.AST) -> Optional[FuncInfo]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            info = self._func_by_node.get(cur)
+            if info is not None:
+                return info
+            cur = self._parents.get(cur)
+        return None
+
+    def func_of(self, node: ast.AST) -> Optional[FuncInfo]:
+        return self._func_by_node.get(node)
+
+    def _collect_functions(self) -> None:
+        def visit(node, parent_info, prefix):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    info = FuncInfo(child, child.name, qn, parent_info)
+                    self._register(info)
+                    visit(child, info, qn + ".")
+                elif isinstance(child, ast.Lambda):
+                    qn = f"{prefix}<lambda@{child.lineno}>"
+                    info = FuncInfo(child, "<lambda>", qn, parent_info)
+                    self._register(info)
+                    visit(child, info, qn + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, parent_info, f"{prefix}{child.name}.")
+                else:
+                    visit(child, parent_info, prefix)
+
+        visit(self.tree, None, "")
+
+    def _register(self, info: FuncInfo) -> None:
+        self.functions.append(info)
+        self._func_by_node[info.node] = info
+        self._funcs_by_name.setdefault(info.name, []).append(info)
+
+    # -- jit wrappers (donation / static args) -----------------------------
+
+    def _jit_call_info(self, call: ast.Call) -> Optional[dict]:
+        """If `call` is jax.jit(...)/pjit(...), its keyword config."""
+        if self.resolve(call.func) not in JIT_WRAPPERS:
+            return None
+        out = {"donate": (), "static": ()}
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "static_argnums"):
+                key = "donate" if kw.arg == "donate_argnums" else "static"
+                out[key] = _int_tuple(kw.value)
+        return out
+
+    def _collect_jit_wrappers(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                info = self._jit_call_info(node.value)
+                if info is None:
+                    continue
+                for tgt in node.targets:
+                    name = _target_callable_name(tgt)
+                    if name is None:
+                        continue
+                    if info["donate"]:
+                        self.donators[name] = info["donate"]
+                    if info["static"]:
+                        self.static_args[name] = info["static"]
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    info = None
+                    if isinstance(dec, ast.Call):
+                        if self.resolve(dec.func) in (
+                            "functools.partial", "partial"
+                        ) and dec.args and self.resolve(
+                            dec.args[0]
+                        ) in JIT_WRAPPERS:
+                            info = {"donate": (), "static": ()}
+                            for kw in dec.keywords:
+                                if kw.arg == "donate_argnums":
+                                    info["donate"] = _int_tuple(kw.value)
+                                if kw.arg == "static_argnums":
+                                    info["static"] = _int_tuple(kw.value)
+                        else:
+                            jinfo = self._jit_call_info(dec)
+                            if jinfo is not None:
+                                info = jinfo
+                    if info is None:
+                        continue
+                    if info["donate"]:
+                        self.donators[node.name] = info["donate"]
+                    if info["static"]:
+                        self.static_args[node.name] = info["static"]
+
+    # -- traced reachability ----------------------------------------------
+
+    def _decorated_traced(self, fn: FuncInfo) -> bool:
+        for dec in fn.decorator_list():
+            name = self.resolve(dec)
+            if name in TRACED_FN_ARGS:
+                return True
+            if isinstance(dec, ast.Call):
+                if self.resolve(dec.func) in TRACED_FN_ARGS:
+                    return True
+                if self.resolve(dec.func) in ("functools.partial", "partial") \
+                        and dec.args \
+                        and self.resolve(dec.args[0]) in TRACED_FN_ARGS:
+                    return True
+        return False
+
+    def _mark_traced(self) -> None:
+        seeds: Set[ast.AST] = set()
+        for fn in self.functions:
+            if not isinstance(fn.node, ast.Lambda) and self._decorated_traced(fn):
+                seeds.add(fn.node)
+        # function-valued args of trace wrappers, anywhere in the module
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            wrapper = self.resolve(node.func)
+            positions = TRACED_FN_ARGS.get(wrapper or "")
+            if not positions:
+                continue
+            for pos in positions:
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                if isinstance(arg, ast.Lambda):
+                    seeds.add(arg)
+                else:
+                    name = _terminal_name(arg)
+                    if name:
+                        for f in self.funcs_named(name):
+                            seeds.add(f.node)
+
+        for fn in self.functions:
+            if fn.node in seeds:
+                fn.traced = True
+
+        # propagate: through local calls by name + nested defs
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if not fn.traced:
+                    # nested def inside a traced function runs under trace
+                    if fn.parent is not None and fn.parent.traced:
+                        fn.traced = True
+                        changed = True
+                    continue
+                for call in _local_nodes(fn.node, ast.Call):
+                    name = _terminal_name(call.func)
+                    if not name:
+                        continue
+                    for callee in self.funcs_named(name):
+                        if not callee.traced:
+                            callee.traced = True
+                            changed = True
+
+    def traced_entry_names(self) -> Set[str]:
+        """Names whose call returns device values fresh off a compiled
+        program: traced defs + names bound to jax.jit wrappers."""
+        out = {f.name for f in self.functions
+               if f.traced and f.name != "<lambda>"}
+        out.update(self.donators)
+        out.update(self.static_args)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if self.resolve(node.value.func) in JIT_WRAPPERS:
+                    for tgt in node.targets:
+                        name = _target_callable_name(tgt)
+                        if name:
+                            out.add(name.split(".")[-1])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers shared with rules.py
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _terminal_name(expr: ast.AST) -> Optional[str]:
+    """`foo` -> "foo"; `self.fns.foo` -> "foo" (the name-match key)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _target_callable_name(tgt: ast.AST) -> Optional[str]:
+    """Assignment-target key for the donator table: a plain name, or
+    `self.x` recorded as "self.x" (matched against self-attr call sites
+    anywhere in the module — methods of one class in practice)."""
+    if isinstance(tgt, ast.Name):
+        return tgt.id
+    if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+            and tgt.value.id == "self":
+        return f"self.{tgt.attr}"
+    return None
+
+
+def _int_tuple(expr: ast.AST) -> Tuple[int, ...]:
+    """Literal int / tuple-of-int value of an AST node, else ()."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return (expr.value,)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for el in expr.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+def _local_nodes(fn_node: ast.AST, *types) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested def/lambda
+    (those are separate FuncInfos and get their own pass)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if not types or isinstance(node, tuple(types)):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+def _parse_suppressions(src: str) -> List[Suppression]:
+    """All `# graftlint: disable=...` comments. A comment on a code line
+    applies to that line; a standalone comment line applies to the next
+    line that carries code. The caller turns empty justifications into
+    JGL000 findings."""
+    lines = src.splitlines()
+    sups: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sups
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        justification = m.group(2).strip().lstrip("-— ").strip()
+        standalone = lines[lineno - 1][: tok.start[1]].strip() == ""
+        target = lineno
+        if standalone:
+            for nxt in range(lineno, len(lines)):
+                stripped = lines[nxt].strip()
+                if stripped and not stripped.startswith("#"):
+                    target = nxt + 1
+                    break
+        sups.append(Suppression(target, rules, justification, lineno))
+    return sups
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def _innermost_stmt_starts(tree: ast.Module) -> Dict[int, int]:
+    """line -> first line of the INNERMOST statement spanning it (so a
+    suppression on any physical line of a wrapped statement matches
+    findings anchored to any other line of the same statement, without
+    letting a big compound statement — a whole function body — swallow
+    suppressions meant for one inner statement)."""
+    best: Dict[int, Tuple[int, int]] = {}  # line -> (span_len, start)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        # decorator lines belong to the decorated statement: findings on
+        # a decorated def anchor at the `def` line, but the natural
+        # suppression placement is on the decorator
+        first = node.lineno
+        for dec in getattr(node, "decorator_list", []):
+            first = min(first, dec.lineno)
+        end = getattr(node, "end_lineno", None) or node.lineno
+        span = (end - first, node.lineno)
+        for ln in range(first, end + 1):
+            if ln not in best or span < best[ln]:
+                best[ln] = span
+    return {ln: start for ln, (_, start) in best.items()}
+
+
+def analyze_source(src: str, path: str = "<string>",
+                   hot_path: Optional[bool] = None) -> List[Finding]:
+    """Run every rule over one module's source. Findings covered by a
+    justified suppression come back with suppressed=True; an unjustified
+    suppression is itself a JGL000 finding."""
+    from factorvae_tpu.analysis import rules as _rules
+
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("JGL000", path, e.lineno or 1,
+                        f"unparseable file: {e.msg}")]
+    model = ModuleModel(path, src, tree, hot_path=hot_path)
+    findings: List[Finding] = []
+    for rule_fn in _rules.ALL_RULES:
+        findings.extend(rule_fn(model))
+
+    sups = _parse_suppressions(src)
+    meta: List[Finding] = []
+    for s in sups:
+        if not s.justification:
+            meta.append(Finding(
+                "JGL000", path, s.comment_line,
+                "graftlint suppression without a justification — say WHY "
+                "the rule does not apply here",
+            ))
+
+    # A suppression covers a finding on the same physical line OR on the
+    # same (innermost) multi-line statement: with wrapped calls the
+    # finding anchors at the statement's first line while the trailing
+    # comment physically sits on the last — both must match.
+    stmt_of = _innermost_stmt_starts(tree)
+
+    def covers(s: Suppression, f: Finding) -> bool:
+        if not s.justification or not (f.rule in s.rules or "all" in s.rules):
+            return False
+        if s.line == f.line:
+            return True
+        s_stmt = stmt_of.get(s.line)
+        return s_stmt is not None and s_stmt == stmt_of.get(f.line)
+
+    out: List[Finding] = []
+    for f in findings:
+        sup = next((s for s in sups if covers(s, f)), None)
+        if sup is not None:
+            out.append(dataclasses.replace(
+                f, suppressed=True, justification=sup.justification))
+        else:
+            out.append(f)
+    out.extend(meta)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def _walk_py_files(root_dir: str) -> Iterable[str]:
+    for root, dirs, files in os.walk(root_dir):
+        dirs[:] = sorted(
+            d for d in dirs
+            if d != "__pycache__" and not d.startswith(".")
+        )
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+
+
+def analyze_paths(paths: Sequence[str]) -> List[Finding]:
+    """Analyze every .py file under `paths`. A path that is missing, not
+    a Python file, or a directory with no Python files is itself a
+    JGL000 finding — a typo'd path must fail the gate loudly, never turn
+    it into a green no-op."""
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if not p.endswith(".py"):
+                findings.append(Finding(
+                    "JGL000", p, 1, "not a Python file — nothing analyzed"))
+                continue
+            files = [p]
+        elif os.path.isdir(p):
+            files = list(_walk_py_files(p))
+            if not files:
+                findings.append(Finding(
+                    "JGL000", p, 1,
+                    "no Python files under this path — the gate would "
+                    "check nothing here"))
+                continue
+        else:
+            findings.append(Finding(
+                "JGL000", p, 1,
+                "path does not exist — a typo here would silently turn "
+                "the lint gate into a no-op"))
+            continue
+        for fp in files:
+            try:
+                with open(fp, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except (OSError, UnicodeDecodeError) as e:
+                findings.append(Finding(
+                    "JGL000", fp, 1, f"unreadable file: {e}"))
+                continue
+            findings.extend(analyze_source(src, fp))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m factorvae_tpu.analysis",
+        description="graftlint: JAX-aware static analysis "
+                    "(tracer/host-sync/RNG/donation/dtype discipline)",
+    )
+    parser.add_argument("paths", nargs="+",
+                        help="files or directories to analyze")
+    parser.add_argument("--format", choices=("human", "json"),
+                        default="human")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also list findings silenced by justified "
+                             "suppressions")
+    args = parser.parse_args(argv)
+
+    findings = analyze_paths(args.paths)
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in active],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "counts": {"active": len(active), "suppressed": len(suppressed)},
+        }, indent=2))
+    else:
+        for f in active:
+            print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+        if args.show_suppressed:
+            for f in suppressed:
+                print(f"{f.path}:{f.line}: {f.rule} [suppressed: "
+                      f"{f.justification}] {f.message}")
+        print(f"{len(active)} finding(s), {len(suppressed)} suppressed")
+    return 1 if active else 0

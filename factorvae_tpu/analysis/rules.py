@@ -1,0 +1,656 @@
+"""graftlint rules JGL001–JGL005.
+
+Each rule is a function `(ModuleModel) -> list[Finding]`. JGL002 (key
+reuse), JGL004 (read-after-donation) and the loop flavor of JGL001 share
+`_Flow`, a small sequential abstract interpreter over a function body:
+statements are processed in source order, `if` branches run on forked
+state and merge conservatively (union of bad states), and loop bodies
+are walked twice so a second iteration observes the state the first one
+left behind — that second pass is what catches cross-iteration key reuse
+and donated-buffer re-pass without any fixpoint machinery.
+
+All rules are heuristic and name-based (see engine.py's module-local
+resolution contract). They are tuned so the repo's sanctioned idioms —
+`fold_in(base, c0)` streams, `k, sub = split(k)` rebinds, donate-then-
+rebind epoch loops, one-scalar-per-epoch host reads — produce no
+findings, while each documented failure mode does.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from factorvae_tpu.analysis.engine import (
+    CACHE_DECORATORS,
+    JIT_WRAPPERS,
+    KEY_DERIVERS,
+    KEY_PRODUCERS,
+    Finding,
+    FuncInfo,
+    ModuleModel,
+    _local_nodes,
+    _terminal_name,
+)
+
+HOST_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+}
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "to_py"}
+HOST_CASTS = {"float", "int", "bool"}
+
+# jnp constructor -> index of the positional dtype argument
+DTYPE_POSITIONAL = {
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "arange": 3,
+    "eye": 3,
+    "linspace": 5,
+}
+
+
+def _target_names(targets) -> List[str]:
+    out: List[str] = []
+
+    def rec(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                rec(e)
+        elif isinstance(t, ast.Starred):
+            rec(t.value)
+
+    for t in targets:
+        rec(t)
+    return out
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    """`out.factor_mu[j, kf]` -> "out"."""
+    while isinstance(expr, (ast.Subscript, ast.Attribute)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _terminates(stmts) -> bool:
+    """Does a statement list end by leaving the enclosing block?"""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+def _chain_cached(model: ModuleModel, fn: Optional[FuncInfo]) -> bool:
+    """Is `fn` or any enclosing function decorated with lru_cache/cache?"""
+    cur = fn
+    while cur is not None:
+        for dec in cur.decorator_list():
+            name = model.resolve(dec)
+            if name is None and isinstance(dec, ast.Call):
+                name = model.resolve(dec.func)
+            if name in CACHE_DECORATORS:
+                return True
+        cur = cur.parent
+    return False
+
+
+def _has_jit_decorator(model: ModuleModel, fn: FuncInfo) -> bool:
+    for dec in fn.decorator_list():
+        if model.resolve(dec) in JIT_WRAPPERS:
+            return True
+        if isinstance(dec, ast.Call):
+            if model.resolve(dec.func) in JIT_WRAPPERS:
+                return True
+            if model.resolve(dec.func) == "functools.partial" and dec.args \
+                    and model.resolve(dec.args[0]) in JIT_WRAPPERS:
+                return True
+    return False
+
+
+def _callee_key(model: ModuleModel, call: ast.Call) -> Optional[str]:
+    """Lookup key for the donator/static tables: plain name, or
+    "self.attr" for instance-cached wrappers."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self":
+        return f"self.{f.attr}"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# sequential flow walker
+
+
+class _Flow:
+    """Source-order walk of one function body. Subclasses override
+    `use(expr)` (an expression is evaluated), `assign(targets, value)`
+    and `clear(names)`; state forking uses snapshot/restore/merge."""
+
+    def __init__(self, model: ModuleModel, fn: FuncInfo):
+        self.model = model
+        self.fn = fn
+        self.loop_depth = 0
+        self.findings: Dict[tuple, Finding] = {}
+
+    # -- hooks -------------------------------------------------------------
+
+    def use(self, expr: ast.AST) -> None:
+        raise NotImplementedError
+
+    def assign(self, targets, value) -> None:
+        self.clear(_target_names(targets))
+
+    def clear(self, names: List[str]) -> None:
+        raise NotImplementedError
+
+    def snapshot(self):
+        raise NotImplementedError
+
+    def restore(self, snap) -> None:
+        raise NotImplementedError
+
+    def merge(self, other) -> None:
+        raise NotImplementedError
+
+    def report(self, rule: str, line: int, message: str, key=None) -> None:
+        k = key if key is not None else (line, message)
+        if k not in self.findings:
+            self.findings[k] = Finding(rule, self.model.path, line, message)
+
+    # -- walk --------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            self.use(node.body)
+        else:
+            self.block(node.body)
+        return list(self.findings.values())
+
+    def block(self, stmts) -> None:
+        for st in stmts:
+            self.stmt(st)
+
+    def stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Import, ast.ImportFrom,
+                           ast.Global, ast.Nonlocal, ast.Pass)):
+            return
+        if isinstance(st, ast.Assign):
+            self.use(st.value)
+            self.assign(st.targets, st.value)
+        elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+            if st.value is not None:
+                self.use(st.value)
+                self.assign([st.target], st.value)
+        elif isinstance(st, ast.Expr):
+            self.use(st.value)
+        elif isinstance(st, ast.Return):
+            if st.value is not None:
+                self.use(st.value)
+        elif isinstance(st, ast.If):
+            self.use(st.test)
+            before = self.snapshot()
+            self.block(st.body)
+            after_body = self.snapshot()
+            self.restore(before)
+            self.block(st.orelse)
+            # a branch that terminates (return/raise/...) never reaches the
+            # code after the if — its state must not leak into the merge
+            body_term = _terminates(st.body)
+            orelse_term = bool(st.orelse) and _terminates(st.orelse)
+            if body_term and not orelse_term:
+                pass  # fall-through comes only from the orelse path
+            elif orelse_term and not body_term:
+                self.restore(after_body)
+            else:
+                self.merge(after_body)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self.use(st.iter)
+            self.loop_depth += 1
+            for _ in range(2):
+                self.clear(_target_names([st.target]))
+                self.block(st.body)
+            self.loop_depth -= 1
+            self.block(st.orelse)
+        elif isinstance(st, ast.While):
+            self.loop_depth += 1
+            for _ in range(2):
+                self.use(st.test)
+                self.block(st.body)
+            self.loop_depth -= 1
+            self.block(st.orelse)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.use(item.context_expr)
+                if item.optional_vars is not None:
+                    self.clear(_target_names([item.optional_vars]))
+            self.block(st.body)
+        elif isinstance(st, ast.Try):
+            self.block(st.body)
+            for h in st.handlers:
+                self.block(h.body)
+            self.block(st.orelse)
+            self.block(st.finalbody)
+        elif isinstance(st, ast.Match):
+            self.use(st.subject)
+            before = self.snapshot()
+            arm_states = []
+            for case in st.cases:
+                self.restore(before)
+                if case.guard is not None:
+                    self.use(case.guard)
+                self.block(case.body)
+                if not _terminates(case.body):
+                    arm_states.append(self.snapshot())
+            # fall-through (no arm matched) + every non-terminating arm
+            self.restore(before)
+            for arm in arm_states:
+                self.merge(arm)
+        elif isinstance(st, ast.Delete):
+            self.clear(_target_names(st.targets))
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self.use(child)
+
+
+# ---------------------------------------------------------------------------
+# JGL001 — host sync
+
+
+def _shape_like(expr: ast.AST) -> bool:
+    """float()/int() of a shape/len expression is static under trace."""
+    if isinstance(expr, ast.Constant):
+        return True
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in ("shape", "ndim",
+                                                            "size", "dtype"):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "len":
+            return True
+    return False
+
+
+class _HostLoopFlow(_Flow):
+    """Loop flavor: per-element host pulls (float()/int()/.item(), or a
+    np.asarray/device_get of a SLICE) inside a Python loop strictly
+    deeper than the jitted call that produced the value — the
+    eval/factors.py round-trip-per-row pattern. The sanctioned shape is
+    one bulk `jax.device_get`/np.asarray per producing call (same loop
+    depth — each chunk pulls its own output once), which rebinds the
+    root to host numpy and clears the taint."""
+
+    HOST_PULLS = {"jax.device_get", "numpy.asarray", "numpy.array"}
+
+    def __init__(self, model, fn, entry_names: Set[str]):
+        super().__init__(model, fn)
+        self.entry_names = entry_names
+        self.device_vars: Dict[str, tuple] = {}  # name -> (line, loop_depth)
+
+    def _flag(self, node, root, what):
+        line, depth = self.device_vars[root]
+        if self.loop_depth > depth:
+            self.report(
+                "JGL001", node.lineno,
+                f"per-element {what} on '{root}' (device output of a jitted "
+                f"call, line {line}) inside a Python loop — pull the whole "
+                "chunk once with jax.device_get and index numpy arrays",
+            )
+
+    def use(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id in HOST_CASTS \
+                    and len(node.args) == 1:
+                root = _root_name(node.args[0])
+                if root in self.device_vars:
+                    self._flag(node, root, f"{node.func.id}() sync")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                root = _root_name(node.func.value)
+                if root in self.device_vars:
+                    self._flag(node, root, ".item() sync")
+            elif self.model.resolve(node.func) in self.HOST_PULLS \
+                    and node.args:
+                # a host pull in a loop DEEPER than the producing call is
+                # one device fetch per iteration, not a bulk pull
+                root = _root_name(node.args[0])
+                if root in self.device_vars:
+                    self._flag(node, root, "host pull")
+
+    def assign(self, targets, value) -> None:
+        names = _target_names(targets)
+        self.clear(names)
+        if not isinstance(value, ast.Call):
+            return
+        resolved = self.model.resolve(value.func)
+        if resolved in self.HOST_PULLS:
+            return  # host numpy now
+        key = _callee_key(self.model, value) or _terminal_name(value.func)
+        if key in self.entry_names:
+            for n in names:
+                self.device_vars[n] = (value.lineno, self.loop_depth)
+
+    def clear(self, names) -> None:
+        for n in names:
+            self.device_vars.pop(n, None)
+
+    def snapshot(self):
+        return dict(self.device_vars)
+
+    def restore(self, snap) -> None:
+        self.device_vars = dict(snap)
+
+    def merge(self, other) -> None:
+        for k, v in other.items():
+            self.device_vars.setdefault(k, v)
+
+
+def rule_jgl001(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    # (a) host-sync primitives in traced code
+    for fn in model.functions:
+        if not fn.traced:
+            continue
+        for call in _local_nodes(fn.node, ast.Call):
+            resolved = model.resolve(call.func)
+            if resolved in HOST_SYNC_CALLS:
+                findings.append(Finding(
+                    "JGL001", model.path, call.lineno,
+                    f"{HOST_SYNC_CALLS[resolved]} inside traced code "
+                    f"('{fn.qualname}' is jit/scan/vmap-reachable) forces a "
+                    "host sync or fails under trace",
+                ))
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in HOST_SYNC_METHODS:
+                findings.append(Finding(
+                    "JGL001", model.path, call.lineno,
+                    f".{call.func.attr}() inside traced code "
+                    f"('{fn.qualname}') forces a host sync on a traced value",
+                ))
+            elif isinstance(call.func, ast.Name) \
+                    and call.func.id in HOST_CASTS and len(call.args) == 1 \
+                    and not _shape_like(call.args[0]):
+                findings.append(Finding(
+                    "JGL001", model.path, call.lineno,
+                    f"{call.func.id}() on a traced value in "
+                    f"'{fn.qualname}' breaks under jit "
+                    "(ConcretizationTypeError) — keep it a jnp op",
+                ))
+    # (b) per-element pulls in host loops
+    entry_names = model.traced_entry_names()
+    for fn in model.functions:
+        if fn.traced or isinstance(fn.node, ast.Lambda):
+            continue
+        findings.extend(_HostLoopFlow(model, fn, entry_names).run())
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JGL002 — PRNG key reuse
+
+
+class _KeyFlow(_Flow):
+    FRESH = None  # sentinel: tracked, not yet consumed
+
+    def __init__(self, model, fn):
+        super().__init__(model, fn)
+        self.keys: Dict[str, Optional[int]] = {}
+
+    def use(self, expr: ast.AST) -> None:
+        for name_node in self._consuming_names(expr):
+            name = name_node.id
+            if name not in self.keys:
+                continue
+            first = self.keys[name]
+            if first is self.FRESH:
+                self.keys[name] = name_node.lineno
+            else:
+                # second consumption — including the SAME line seen on the
+                # walker's second loop pass (cross-iteration reuse)
+                self.report(
+                    "JGL002", name_node.lineno,
+                    f"PRNG key '{name}' already consumed at line {first} — "
+                    "interleave a split/fold_in (rebinding the name) before "
+                    "reusing it",
+                    key=("JGL002", name_node.lineno, name),
+                )
+
+    def _consuming_names(self, expr):
+        """Name loads that constitute consumption: appearing inside a
+        call that is not a key-deriving split/fold_in."""
+        out: List[ast.Name] = []
+
+        def walk_call(call: ast.Call):
+            deriver = self.model.resolve(call.func) in KEY_DERIVERS
+            walk(call.func)
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                if deriver and isinstance(a, ast.Name):
+                    continue  # sanctioned derivation read
+                walk(a)
+
+        def walk(n):
+            if isinstance(n, ast.Call):
+                walk_call(n)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                out.append(n)
+            else:
+                for c in ast.iter_child_nodes(n):
+                    walk(c)
+
+        def top(n):
+            if isinstance(n, ast.Call):
+                walk_call(n)
+            else:
+                for c in ast.iter_child_nodes(n):
+                    top(c)
+
+        top(expr)
+        return out
+
+    def assign(self, targets, value) -> None:
+        names = _target_names(targets)
+        producer = isinstance(value, ast.Call) \
+            and self.model.resolve(value.func) in KEY_PRODUCERS
+        if producer:
+            for n in names:
+                self.keys[n] = self.FRESH
+        else:
+            self.clear(names)
+
+    def clear(self, names) -> None:
+        for n in names:
+            self.keys.pop(n, None)
+
+    def snapshot(self):
+        return dict(self.keys)
+
+    def restore(self, snap) -> None:
+        self.keys = dict(snap)
+
+    def merge(self, other) -> None:
+        for name, st in other.items():
+            if name in self.keys:
+                cur = self.keys[name]
+                if cur is self.FRESH and st is not self.FRESH:
+                    self.keys[name] = st
+            else:
+                self.keys[name] = st
+
+
+def rule_jgl002(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in model.functions:
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        findings.extend(_KeyFlow(model, fn).run())
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JGL003 — jit cache hazards
+
+
+def rule_jgl003(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    # (a) jax.jit(...) constructed in a per-call scope
+    for node in ast.walk(model.tree):
+        if not (isinstance(node, ast.Call)
+                and model.resolve(node.func) in JIT_WRAPPERS):
+            continue
+        enc = model.enclosing_function(node)
+        if enc is None or _chain_cached(model, enc):
+            continue
+        parent = model._parents.get(node)
+        if isinstance(parent, ast.Assign) and all(
+            isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+            and t.value.id == "self" for t in parent.targets
+        ):
+            continue  # instance-cached wrapper (built once per object)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # decorator form — handled below with the def's line
+        findings.append(Finding(
+            "JGL003", model.path, node.lineno,
+            f"jax.jit constructed inside '{enc.qualname}' — a fresh jit per "
+            "call retraces and recompiles every time; hoist to module "
+            "level, lru_cache the factory, or store it on the instance",
+        ))
+    # (b) @jax.jit on a def nested in an uncached per-call scope
+    for fn in model.functions:
+        if isinstance(fn.node, ast.Lambda) or fn.parent is None:
+            continue
+        if _has_jit_decorator(model, fn) and not _chain_cached(model, fn):
+            findings.append(Finding(
+                "JGL003", model.path, fn.node.lineno,
+                f"@jax.jit def '{fn.qualname}' nested in an uncached "
+                "per-call scope recompiles on every call of "
+                f"'{fn.parent.qualname}' — lru_cache the factory or hoist",
+            ))
+    # (c) unhashable literals at static_argnums positions
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        key = _callee_key(model, node)
+        positions = model.static_args.get(key or "")
+        if not positions:
+            continue
+        for p in positions:
+            if p < len(node.args) and isinstance(
+                node.args[p],
+                (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                 ast.SetComp),
+            ):
+                findings.append(Finding(
+                    "JGL003", model.path, node.args[p].lineno,
+                    f"unhashable literal passed at static_argnums position "
+                    f"{p} of '{key}' — static args are jit-cache keys and "
+                    "must be hashable (use a tuple)",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JGL004 — donated-buffer read-after-donation
+
+
+class _DonationFlow(_Flow):
+    def __init__(self, model, fn):
+        super().__init__(model, fn)
+        self.donated: Dict[str, int] = {}
+
+    def use(self, expr: ast.AST) -> None:
+        # reads first: a donated name loaded ANYWHERE (including as an
+        # argument to the next donating call) is a read-after-donation
+        if self.donated:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in self.donated:
+                    self.report(
+                        "JGL004", node.lineno,
+                        f"'{node.id}' was donated at line "
+                        f"{self.donated[node.id]} (donate_argnums) and read "
+                        "afterwards — XLA may have reused the buffer; "
+                        "rebind the name from the call's output first",
+                        key=("JGL004", node.lineno, node.id),
+                    )
+        # then register this statement's donations
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            key = _callee_key(self.model, node)
+            positions = self.model.donators.get(key or "")
+            if not positions:
+                continue
+            for p in positions:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    self.donated[node.args[p].id] = node.lineno
+
+    def clear(self, names) -> None:
+        for n in names:
+            self.donated.pop(n, None)
+
+    def snapshot(self):
+        return dict(self.donated)
+
+    def restore(self, snap) -> None:
+        self.donated = dict(snap)
+
+    def merge(self, other) -> None:
+        for k, v in other.items():
+            self.donated.setdefault(k, v)
+
+
+def rule_jgl004(model: ModuleModel) -> List[Finding]:
+    if not model.donators:
+        return []
+    findings: List[Finding] = []
+    for fn in model.functions:
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        findings.extend(_DonationFlow(model, fn).run())
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JGL005 — dtype drift in plan-governed hot paths
+
+
+def rule_jgl005(model: ModuleModel) -> List[Finding]:
+    if not model.hot_path:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = model.resolve(node.func)
+        if not resolved or not resolved.startswith("jax.numpy."):
+            continue
+        ctor = resolved[len("jax.numpy."):]
+        pos = DTYPE_POSITIONAL.get(ctor)
+        if pos is None:
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        if len(node.args) > pos:
+            continue
+        findings.append(Finding(
+            "JGL005", model.path, node.lineno,
+            f"jnp.{ctor} without an explicit dtype in a plan-governed hot "
+            "path — this silently pins the backend default dtype (f32 for "
+            "float fills, int32 for integer ranges) regardless of what the "
+            "plan chose; pass dtype= explicitly",
+        ))
+    return findings
+
+
+ALL_RULES = (rule_jgl001, rule_jgl002, rule_jgl003, rule_jgl004, rule_jgl005)

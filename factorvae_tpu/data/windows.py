@@ -88,7 +88,7 @@ def window_fill_indices(
     """
     d_total = last_valid.shape[0]
     t = step_len
-    p = day - t + 1 + jnp.arange(t)                      # (T,) window days
+    p = day - t + 1 + jnp.arange(t, dtype=jnp.int32)     # (T,) window days
     pc = jnp.clip(p, 0, d_total - 1)
     lv = last_valid[pc]                                   # (T, I)
     w_start = day - t + 1

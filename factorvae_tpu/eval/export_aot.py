@@ -10,17 +10,47 @@ that needs the full module assembly code to use, utils.py:57-67).
 Artifacts are platform-tagged: exporting under a TPU backend produces a
 TPU-servable function; pass `platforms=("tpu",)` to cross-export from a
 CPU host.
+
+AOT cache behavior: the traceable core (`_predict_fn`) is hoisted and
+lru_cached on the frozen ModelConfig, consistent with the scoring
+path's jit factories (eval/predict.py) — but the jit+trace itself runs
+ONCE PER `export_prediction` CALL, unavoidably: the weights are baked
+into the StableHLO as constants, so there is no hashable cache key a
+param tree could provide. Callers that export repeatedly should cache
+the returned bytes, not call this in a loop. Donation is deliberately
+omitted (unlike the scoring scan's rebuilt-per-call index/key buffers):
+the serving consumer owns the input buffers, and neither input can
+alias the (D, N) f32 output anyway (x differs in shape, mask in dtype).
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
-from factorvae_tpu.config import Config
+from factorvae_tpu.config import Config, ModelConfig
 from factorvae_tpu.models.factorvae import day_prediction
+
+
+@functools.lru_cache(maxsize=8)
+def _predict_fn(model_cfg: ModelConfig, stochastic: bool, int8: bool):
+    """Traceable scoring core with params EXPLICIT: (params, x, mask) ->
+    (D, N) scores. One per (config, mode), shared across exports — the
+    hoistable part of the export pipeline."""
+    model = day_prediction(model_cfg, stochastic=stochastic)
+    key = jax.random.PRNGKey(0)  # consumed only when stochastic
+
+    def predict(params, x, mask):
+        if int8:
+            from factorvae_tpu.ops.quant import dequantize_params
+
+            params = dequantize_params(params, model_cfg.dtype)
+        return model.apply(params, x, mask, rngs={"sample": key})
+
+    return predict
 
 
 def export_prediction(
@@ -38,23 +68,23 @@ def export_prediction(
     `int8=True` bakes the weight matrices as per-channel int8 constants
     (ops/quant.py) with the dequantize folded into the program — a ~4x
     smaller artifact with the tested rank-fidelity of the int8 scoring
-    path."""
+    path.
+
+    See the module docstring for the AOT cache contract: one trace per
+    call is inherent (weights become export constants); cache the
+    returned bytes if you export the same params repeatedly."""
     from jax import export as jexport
 
     cfg = config.model
-    model = day_prediction(cfg, stochastic=stochastic)
-    key = jax.random.PRNGKey(0)  # used only when stochastic
+    predict = _predict_fn(cfg, bool(stochastic), bool(int8))
 
     if int8:
-        from factorvae_tpu.ops.quant import dequantize_params, quantize_params
+        from factorvae_tpu.ops.quant import quantize_params
 
-        qparams = quantize_params(params)
+        params = quantize_params(params)
 
-    def predict(x, mask):
-        p = dequantize_params(qparams, cfg.dtype) if int8 else params
-        return model.apply(p, x, mask, rngs={"sample": key})
-
-    fn = jax.jit(predict)
+    # graftlint: disable=JGL003 weights are baked as export-time constants, so no hashable jit cache key exists; the per-artifact trace is the documented AOT contract above
+    fn = jax.jit(functools.partial(predict, params))
     args = (
         jax.ShapeDtypeStruct((1, n_max, cfg.seq_len, cfg.num_features),
                              jnp.float32),

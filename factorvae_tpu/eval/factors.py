@@ -6,10 +6,21 @@ decoder's internals (alpha, beta exposures), extracted as aligned pandas
 artifacts for factor analysis: which latent factors the posterior loads
 on, how the prior tracks it, and each stock's exposures — the
 interpretability surface of a dynamic factor model.
+
+Host-transfer discipline (JGL001): each chunk's outputs cross the
+device->host boundary ONCE, as a single `jax.device_get` of the whole
+output pytree; the frame-building loops below index host numpy arrays.
+The original path called `float()` per row *and per factor* on device
+arrays — one blocking device round-trip per scalar, ~K x D + 3 x D
+dispatches per chunk for zero extra information. The emitted frames are
+bitwise identical (pinned by tests/test_analysis.py): `float()` of a
+numpy f32 scalar widens exactly like `float()` of the same device
+scalar.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -17,10 +28,57 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-from factorvae_tpu.config import Config
+from factorvae_tpu.config import Config, ModelConfig
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.data.windows import gather_day
 from factorvae_tpu.models.factorvae import day_forward
+
+
+@functools.lru_cache(maxsize=8)
+def _chunk_runner(model_cfg: ModelConfig, seq_len: int):
+    """Jitted (params, values, last_valid, next_valid, day_idx (B,), key)
+    -> (out, alpha_mu, alpha_sigma, beta) for one day-chunk. Cached on
+    the (frozen) ModelConfig like eval/predict's scorer factories, so
+    repeated `decompose` calls with one config reuse one compiled
+    program; params and the panel are runtime arguments, not compile
+    payload (see train/loop.py)."""
+    from factorvae_tpu.models.decoder import AlphaLayer, BetaLayer
+    from factorvae_tpu.models.extractor import FeatureExtractor
+
+    model = day_forward(model_cfg, train=False)
+
+    @jax.jit
+    def run_chunk(params, values, last_valid, next_valid, day_idx, key):
+        inner = params["params"]["model"]
+
+        def one(d):
+            return gather_day(values, last_valid, next_valid, d, seq_len)
+
+        x, y, mask = jax.vmap(one)(jnp.maximum(day_idx, 0))
+        mask = mask & (day_idx >= 0)[:, None]
+        k1, k2 = jax.random.split(key)
+        out = model.apply(
+            params, x, jnp.nan_to_num(y), mask,
+            rngs={"sample": k1, "dropout": k2},
+        )
+
+        # decoder internals per stock (vmapped over days)
+        def internals(xd):
+            latent = FeatureExtractor(model_cfg).apply(
+                {"params": inner["feature_extractor"]}, xd
+            )
+            amu, asig = AlphaLayer(model_cfg).apply(
+                {"params": inner["factor_decoder"]["alpha_layer"]}, latent
+            )
+            beta = BetaLayer(model_cfg).apply(
+                {"params": inner["factor_decoder"]["beta_layer"]}, latent
+            )
+            return amu, asig, beta
+
+        amu, asig, beta = jax.vmap(internals)(x)
+        return out, amu, asig, beta
+
+    return run_chunk
 
 
 def decompose(
@@ -41,56 +99,22 @@ def decompose(
       columns) plus the idiosyncratic alpha_mu/alpha_sigma.
     - 'loss': per-day [loss, recon, kl].
     """
-    cfg = config.model
-    seq_len = config.data.seq_len
-    model = day_forward(cfg, train=False)
-
-    from factorvae_tpu.models.decoder import AlphaLayer, BetaLayer
-    from factorvae_tpu.models.extractor import FeatureExtractor
-
-    inner = params["params"]["model"]
-
-    @jax.jit
-    def run_chunk(day_idx, key):
-        def one(d):
-            return gather_day(
-                dataset.values, dataset.last_valid, dataset.next_valid, d, seq_len
-            )
-
-        x, y, mask = jax.vmap(one)(jnp.maximum(day_idx, 0))
-        mask = mask & (day_idx >= 0)[:, None]
-        k1, k2 = jax.random.split(key)
-        out = model.apply(
-            params, x, jnp.nan_to_num(y), mask,
-            rngs={"sample": k1, "dropout": k2},
-        )
-        # decoder internals per stock (vmapped over days)
-        def internals(xd):
-            latent = FeatureExtractor(cfg).apply(
-                {"params": inner["feature_extractor"]}, xd
-            )
-            amu, asig = AlphaLayer(cfg).apply(
-                {"params": inner["factor_decoder"]["alpha_layer"]}, latent
-            )
-            beta = BetaLayer(cfg).apply(
-                {"params": inner["factor_decoder"]["beta_layer"]}, latent
-            )
-            return amu, asig, beta
-
-        amu, asig, beta = jax.vmap(internals)(x)
-        return out, amu, asig, beta
+    run_chunk = _chunk_runner(config.model, config.data.seq_len)
 
     days = dataset.split_days(start, end)
-    k_factors = cfg.num_factors
+    k_factors = config.model.num_factors
     rows_f, rows_l, exp_frames = [], [], []
     base = jax.random.PRNGKey(seed)
     for c0 in range(0, len(days), chunk):
         sel = days[c0 : c0 + chunk]
         padded = np.full(chunk, -1, np.int32)
         padded[: len(sel)] = sel
-        out, amu, asig, beta = run_chunk(
+        # ONE host sync for the whole chunk: the output pytree lands as
+        # numpy; every scalar below is a host index, not a device fetch.
+        out, amu, asig, beta = jax.device_get(run_chunk(
+            params, dataset.values, dataset.last_valid, dataset.next_valid,
             jnp.asarray(padded), jax.random.fold_in(base, c0)
-        )
+        ))
         for j, d in enumerate(sel):
             date = dataset.dates[int(d)]
             for kf in range(k_factors):
@@ -107,12 +131,12 @@ def decompose(
                 names=["datetime", "instrument"],
             )
             ef = pd.DataFrame(
-                np.asarray(beta[j])[valid],
+                beta[j][valid],
                 index=idx,
                 columns=[f"beta_{kf}" for kf in range(k_factors)],
             )
-            ef["alpha_mu"] = np.asarray(amu[j])[valid]
-            ef["alpha_sigma"] = np.asarray(asig[j])[valid]
+            ef["alpha_mu"] = amu[j][valid]
+            ef["alpha_sigma"] = asig[j][valid]
             exp_frames.append(ef)
 
     factors = pd.DataFrame(

@@ -187,7 +187,7 @@ def _scan_inputs(days: np.ndarray, chunk: int, base: jax.Array,
         # One vmapped dispatch for the whole key buffer, bitwise-equal
         # to per-chunk fold_in(base, c0) (pinned by tests/test_eval.py).
         keys = jax.vmap(lambda c0: jax.random.fold_in(base, c0))(
-            jnp.arange(0, n_chunks * chunk, chunk))
+            jnp.arange(0, n_chunks * chunk, chunk, dtype=jnp.int32))
     return day_idx, keys
 
 

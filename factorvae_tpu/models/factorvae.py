@@ -324,10 +324,14 @@ def load_model(config, checkpoint_path=None, n_max: int = 8):
         config = Config(model=config)
     cfg = config.model
     template_model = day_forward(cfg, train=False)
+    # Trainer.init_state's key schedule (split 3): a fresh factory init is
+    # bitwise the trainer's params, and no stream reuses a key (JGL002 —
+    # the old path fed the SAME key to params/sample/dropout).
     key = jax.random.PRNGKey(config.train.seed)
+    k_param, k_sample, k_drop = jax.random.split(key, 3)
     x = jnp.zeros((1, n_max, cfg.seq_len, cfg.num_features))
     params = template_model.init(
-        {"params": key, "sample": key, "dropout": key},
+        {"params": k_param, "sample": k_sample, "dropout": k_drop},
         x, jnp.zeros((1, n_max)), jnp.ones((1, n_max), bool),
     )
     if checkpoint_path is not None:

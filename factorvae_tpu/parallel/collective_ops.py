@@ -18,7 +18,6 @@ Every cross-stock reduction in the model family is covered:
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

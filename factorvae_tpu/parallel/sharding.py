@@ -22,7 +22,7 @@ from typing import Callable, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from factorvae_tpu.parallel.mesh import DATA_AXIS, STOCK_AXIS, batch_axes
+from factorvae_tpu.parallel.mesh import STOCK_AXIS, batch_axes
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -184,8 +184,11 @@ class FleetTrainer:
         init is bitwise the per-seed serial inits (tested)."""
         cfg = self.cfg
         b, n = self.batch_days, self.ds.n_max
-        x = jnp.zeros((b, n, cfg.data.seq_len, cfg.model.num_features))
-        y = jnp.zeros((b, n))
+        # f32 init dummies, matching Trainer.init_state: param init must
+        # not depend on the plan's compute dtype
+        x = jnp.zeros((b, n, cfg.data.seq_len, cfg.model.num_features),
+                      jnp.float32)
+        y = jnp.zeros((b, n), jnp.float32)
         mask = jnp.ones((b, n), bool)
 
         def init_one(seed):
@@ -198,6 +201,7 @@ class FleetTrainer:
             return create_train_state(params, self.tx, seed)
 
         seeds = jnp.asarray(self.seeds, jnp.uint32)
+        # graftlint: disable=JGL003 init traces once per fit by design — it closes over the (unhashable) model/tx, and its cost is one S-wide init vs hours of training
         return jax.jit(jax.vmap(init_one))(seeds)
 
     def _epoch_orders(self, epoch: int) -> jnp.ndarray:

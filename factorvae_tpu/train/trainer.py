@@ -21,7 +21,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from factorvae_tpu.config import Config
 from factorvae_tpu.data.loader import PanelDataset
@@ -186,8 +185,11 @@ class Trainer:
         key = jax.random.PRNGKey(cfg.train.seed)
         k_param, k_sample, k_drop = jax.random.split(key, 3)
         b, n = self.batch_days, self.ds.n_max
-        x = jnp.zeros((b, n, cfg.data.seq_len, cfg.model.num_features))
-        y = jnp.zeros((b, n))
+        # init dummies are pinned f32 regardless of the plan's compute
+        # dtype: param init must not depend on the execution layout
+        x = jnp.zeros((b, n, cfg.data.seq_len, cfg.model.num_features),
+                      jnp.float32)
+        y = jnp.zeros((b, n), jnp.float32)
         mask = jnp.ones((b, n), bool)
         params = self.model.init(
             {"params": k_param, "sample": k_sample, "dropout": k_drop}, x, y, mask

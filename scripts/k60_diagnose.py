@@ -105,6 +105,7 @@ def probe_factors(params, cfg, ds, days, chunk=16):
     seq_len = cfg.data.seq_len
 
     @jax.jit
+    # graftlint: disable=JGL003 diagnostic probe: built once per process for one checkpoint/shape; a config-keyed cache would outlive the single probe call
     def run(params, days, values, last_valid, next_valid, key):
         safe = jnp.maximum(days, 0)
         x, y, mask = jax.vmap(

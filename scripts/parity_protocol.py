@@ -152,7 +152,7 @@ def main(argv=None) -> int:
     ap.add_argument("--tolerance", type=float, default=0.002)
     args = ap.parse_args(argv)
 
-    from factorvae_tpu.config import Config, DataConfig, TrainConfig
+    from factorvae_tpu.config import Config
     from factorvae_tpu.data.loader import PanelDataset
     from factorvae_tpu.eval.compare import compare_scores
     from factorvae_tpu.eval.metrics import daily_rank_ic

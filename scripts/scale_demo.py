@@ -30,8 +30,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SHAPES = {
@@ -47,7 +45,6 @@ SHAPES = {
 def run_config(name: str, days: int, epochs: int, days_per_step: int,
                bf16: bool, mesh_stock: int = 1) -> dict:
     import jax
-    import jax.numpy as jnp
 
     from factorvae_tpu.config import (
         Config, DataConfig, MeshConfig, ModelConfig, TrainConfig,

@@ -41,7 +41,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from parity_protocol import (  # noqa: E402
     ALPHA_SOURCE,
     FEATURE_STRENGTH,
-    PREFIX_DAYS,
     SIGNAL,
     build_proxy_panel,
     load_ref_scores,

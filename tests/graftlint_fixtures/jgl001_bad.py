@@ -1,0 +1,30 @@
+"""JGL001 seeded violations: host sync in traced code (both flavors).
+
+Flavor (a): `.item()` / `float()` / `np.asarray` inside a jitted body —
+breaks under trace (ConcretizationTypeError) or forces a blocking sync.
+Flavor (b): per-element `float()` round-trips over a jitted call's
+output inside a Python loop — the eval/factors.py pattern this rule was
+built from (one device fetch per scalar).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_host_sync(x):
+    total = jnp.sum(x)
+    scale = float(total)          # JGL001(a): float() on a traced value
+    host = np.asarray(x)          # JGL001(a): host materialization in jit
+    peek = total.item()           # JGL001(a): blocking scalar sync
+    return x * scale + host.mean() + peek
+
+
+def per_element_pull(x):
+    rows = []
+    for _ in range(4):
+        out = traced_host_sync(x)
+        for j in range(out.shape[0]):
+            rows.append(float(out[j]))   # JGL001(b): one sync per element
+    return rows

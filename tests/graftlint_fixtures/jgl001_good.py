@@ -1,0 +1,22 @@
+"""JGL001 corrected twin: device math stays jnp inside the trace; the
+host pull happens ONCE per chunk via jax.device_get, and the Python loop
+indexes numpy."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def traced_device_math(x):
+    total = jnp.sum(x)
+    n = float(x.shape[0])         # shape access is static — not a sync
+    return x * total / n
+
+
+def bulk_pull(x):
+    rows = []
+    for _ in range(4):
+        out = jax.device_get(traced_device_math(x))   # one sync per chunk
+        for j in range(out.shape[0]):
+            rows.append(float(out[j]))                # host numpy index
+    return rows

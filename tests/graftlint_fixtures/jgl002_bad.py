@@ -1,0 +1,25 @@
+"""JGL002 seeded violations: PRNG key reuse.
+
+Two consumers read the same key with no interleaving split/fold_in —
+their "independent" noise is bitwise identical, the exact failure that
+silently breaks seed independence in a sweep. Includes the
+cross-iteration flavor: consuming a loop-invariant key inside a loop.
+"""
+
+import jax
+
+
+def double_draw(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)      # JGL002: key consumed twice
+    return a, b
+
+
+def loop_reuse(shape, n):
+    base = jax.random.PRNGKey(1)
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(base, shape))   # JGL002: every
+        # iteration draws the SAME noise — base is never re-derived
+    return out

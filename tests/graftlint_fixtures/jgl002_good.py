@@ -1,0 +1,32 @@
+"""JGL002 corrected twin: every consumer gets its own derived key.
+
+`k, sub = split(k)` rebinds the carried name, and `fold_in(base, i)` is
+the sanctioned loop stream — reading `base` through a deriver is not
+consumption."""
+
+import jax
+
+
+def independent_draws(shape):
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a, b
+
+
+def loop_stream(shape, n):
+    base = jax.random.PRNGKey(1)
+    out = []
+    for i in range(n):
+        out.append(jax.random.normal(jax.random.fold_in(base, i), shape))
+    return out
+
+
+def carried_split(shape, n):
+    key = jax.random.PRNGKey(2)
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, shape))
+    return out

@@ -1,0 +1,29 @@
+"""JGL003 seeded violations: jit-cache hazards.
+
+A jax.jit constructed in a per-call scope gets a fresh trace+compile on
+every call of the enclosing function (the pre-fix eval/export_aot.py
+failure mode); an unhashable literal at a static_argnums position
+raises at call time because static args are jit-cache keys.
+"""
+
+import jax
+import jax.numpy as jnp
+
+scaled = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+
+def score_once(params, x):
+    fn = jax.jit(lambda p, v: (p * v).sum())    # JGL003: fresh jit per call
+    return fn(params, x)
+
+
+def nested_decorated(x):
+    @jax.jit
+    def body(v):                                # JGL003: recompiles per call
+        return jnp.tanh(v)
+
+    return body(x)
+
+
+def bad_static_arg(x):
+    return scaled(x, [2, 3])                    # JGL003: unhashable static

@@ -1,0 +1,36 @@
+"""JGL003 corrected twin: jits live at module scope, behind an
+lru_cached factory (the eval/predict.py idiom), or on the instance —
+each traces once per config — and static args are hashable."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+scaled = jax.jit(lambda x, n: x * n, static_argnums=(1,))
+
+
+@jax.jit
+def module_level(params, x):
+    return (params * x).sum()
+
+
+@functools.lru_cache(maxsize=8)
+def cached_factory(power):
+    @jax.jit
+    def body(v):
+        return jnp.tanh(v) ** power
+
+    return body
+
+
+class Holder:
+    def __init__(self):
+        self.fn = jax.jit(lambda v: v * 2)      # built once per instance
+
+    def __call__(self, x):
+        return self.fn(x)
+
+
+def good_static_arg(x):
+    return scaled(x, (2, 3))

@@ -1,0 +1,34 @@
+"""JGL004 seeded violation: donated-buffer read-after-donation.
+
+`donate_argnums=(0,)` lets XLA reuse the input buffer for the output —
+reading the donated python name afterwards observes freed/overwritten
+memory (an error on TPU, silently stale on some backends). This is the
+trainer epoch-loop contract: the state passed to the donating epoch jit
+is DEAD until rebound from the call's output.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(state, grads):
+    return jax.tree.map(lambda s, g: s - 0.1 * g, state, grads)
+
+
+step = jax.jit(lambda s: jax.tree.map(jnp.tanh, s), donate_argnums=(0,))
+
+
+def train(state, grads):
+    new_state = update(state, grads)
+    drift = jnp.sum(state["w"])        # JGL004: donated buffer read
+    return new_state, drift
+
+
+def loop(state, n):
+    for _ in range(n):
+        step(state)                    # JGL004 (2nd iter): donated name
+        # re-passed without rebinding from the call's output
+    return state

@@ -1,0 +1,28 @@
+"""JGL004 corrected twin: every read happens before the donation, or on
+the rebound output name — the donate-then-rebind epoch loop the serial
+and fleet trainers run."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(state, grads):
+    return jax.tree.map(lambda s, g: s - 0.1 * g, state, grads)
+
+
+step = jax.jit(lambda s: jax.tree.map(jnp.tanh, s), donate_argnums=(0,))
+
+
+def train(state, grads):
+    drift = jnp.sum(state["w"])        # read BEFORE the donating call
+    state = update(state, grads)
+    return state, drift
+
+
+def loop(state, n):
+    for _ in range(n):
+        state = step(state)            # rebound every iteration
+    return state

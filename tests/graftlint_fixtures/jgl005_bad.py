@@ -1,0 +1,18 @@
+"""JGL005 seeded violations: dtype drift in a plan-governed hot path.
+
+The pragma below opts this module into the hot-path set (in-tree, the
+train/ + eval/predict + ops/ + data/windows modules are in by path).
+`jnp.zeros(shape)` pins the JAX default (f32) no matter what
+compute_dtype the execution plan chose — a bf16 plan silently runs a
+f32 graph wherever such a constructor feeds the model.
+"""
+# graftlint: hot-path
+
+import jax.numpy as jnp
+
+
+def make_buffers(b, n):
+    x = jnp.zeros((b, n))          # JGL005: dtype silently f32
+    steps = jnp.arange(n)          # JGL005: dtype silently int32/f32
+    pad = jnp.full((b,), -1.0)     # JGL005
+    return x, steps, pad

@@ -1,0 +1,13 @@
+"""JGL005 corrected twin: every constructor names its dtype, so the
+buffer layout is what the plan says, visibly."""
+# graftlint: hot-path
+
+import jax.numpy as jnp
+
+
+def make_buffers(b, n, compute_dtype=jnp.float32):
+    x = jnp.zeros((b, n), compute_dtype)
+    steps = jnp.arange(n, dtype=jnp.int32)
+    pad = jnp.full((b,), -1.0, dtype=compute_dtype)
+    mask = jnp.ones((b, n), bool)
+    return x, steps, pad, mask

@@ -1,0 +1,12 @@
+"""A justified suppression silences its finding — both inline and
+standalone-comment-above forms."""
+
+import jax
+
+
+@jax.jit
+def traced(x):
+    peek = x.item()  # graftlint: disable=JGL001 fixture: demonstrates a justified inline suppression
+    # graftlint: disable=JGL001 fixture: standalone form applies to the next code line
+    host = float(x)
+    return peek + host

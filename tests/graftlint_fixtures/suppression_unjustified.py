@@ -1,0 +1,9 @@
+"""A bare `disable` with no justification is itself a finding (JGL000)
+and does NOT silence the underlying rule."""
+
+import jax
+
+
+@jax.jit
+def traced(x):
+    return float(x)  # graftlint: disable=JGL001
