@@ -42,6 +42,16 @@ windows/sec·seed scaling curve — per-seed rate, fleet aggregate, and
 speedup over the serial S=1 baseline measured in the same run — plus
 the planner's decision block. The same probe/timeout/CPU-fallback
 robustness contract applies.
+
+Stream mode (`python bench.py --stream`, or BENCH_STREAM=1 with
+BENCH_STREAM_CHUNK=n): A/B the panel residency — HBM-resident
+whole-epoch scan vs the out-of-core stream path (data/stream.py,
+docs/streaming.md) — at the same planner knobs, reporting both rates,
+host->device transfer bytes/sec and `overlap_frac` (how much of the
+gather+put work hid behind compute). Degrades cleanly on CPU hosts
+(`no_transfer_gap: true`): producer and consumer share cores there, so
+the A/B is a correctness/ceiling probe, not a speedup claim. Same
+robustness contract.
 """
 
 from __future__ import annotations
@@ -113,6 +123,16 @@ USE_FLEET = os.environ.get("BENCH_FLEET", "0") == "1"
 FLEET_SEED_COUNTS = tuple(
     int(s) for s in os.environ.get("BENCH_FLEET_SEEDS", "1,2,4,8").split(",")
     if s.strip())
+# Stream mode (`python bench.py --stream` or BENCH_STREAM=1): A/B the
+# panel residency — the HBM-resident whole-epoch scan vs the out-of-core
+# stream path (host-pinned panel, double-buffered prefetched chunks,
+# data/stream.py) — at the same planner-resolved knobs, and report the
+# transfer ledger: host->device bytes/sec and overlap_frac (fraction of
+# transfer work hidden behind compute). On hosts where producer and
+# consumer share cores (the CPU sandbox) there is no real transfer gap;
+# the numbers are still reported, flagged `no_transfer_gap`.
+USE_STREAM = os.environ.get("BENCH_STREAM", "0") == "1"
+STREAM_CHUNK_DAYS = int(os.environ.get("BENCH_STREAM_CHUNK", 0))
 
 
 def resolve_plan(platform: str):
@@ -199,13 +219,15 @@ def emit(payload: dict) -> None:
 
 
 def fail_metric() -> str:
-    """Failure-payload metric key, mode-faithful: a fleet run that dies
-    must not record in the longitudinal stream as a single-model
-    flagship train failure (BENCH_FLEET propagates to every
-    subprocess, so the env read covers the --fleet argv case too)."""
-    fleet = USE_FLEET or os.environ.get("BENCH_FLEET", "0") == "1"
-    return ("fleet_train_throughput_failed" if fleet
-            else "train_throughput_flagship_K96_H64_Alpha158_failed")
+    """Failure-payload metric key, mode-faithful: a fleet or stream run
+    that dies must not record in the longitudinal stream as a
+    single-model flagship train failure (the mode env vars propagate to
+    every subprocess, so the env reads cover the argv cases too)."""
+    if USE_FLEET or os.environ.get("BENCH_FLEET", "0") == "1":
+        return "fleet_train_throughput_failed"
+    if USE_STREAM or os.environ.get("BENCH_STREAM", "0") == "1":
+        return "stream_train_throughput_failed"
+    return "train_throughput_flagship_K96_H64_Alpha158_failed"
 
 
 def fail_unit() -> str:
@@ -314,12 +336,14 @@ def detect_platform() -> tuple[str, float | None]:
     return label, peak
 
 
-def bench_setup(knobs):
+def bench_setup(knobs, residency: str = "hbm", chunk_days: int = 32,
+                panel=None):
     """(cfg, ds) for a timed run — ONE construction of the bench Config,
-    synthetic panel and dataset, shared by the headline and fleet
-    benches so their configurations can never silently diverge (the
-    fleet's speedup story is only meaningful against the identical
-    workload)."""
+    synthetic panel and dataset, shared by the headline, fleet and
+    stream benches so their configurations can never silently diverge
+    (the fleet/stream comparison stories are only meaningful against
+    the identical workload). Pass `panel` to reuse one synthetic panel
+    across residency A/B datasets."""
     from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
     from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
 
@@ -333,16 +357,20 @@ def bench_setup(knobs):
             flatten_days=knobs["flatten_days"],
         ),
         data=DataConfig(seq_len=SEQ_LEN, start_time=None, fit_end_time=None,
-                        val_start_time=None, val_end_time=None),
+                        val_start_time=None, val_end_time=None,
+                        panel_residency=residency,
+                        stream_chunk_days=chunk_days),
         train=TrainConfig(
             num_epochs=EPOCHS_TIMED, days_per_step=knobs["days_per_step"],
             seed=0, checkpoint_every=0, save_dir="/tmp/factorvae_bench",
         ),
     )
-    panel = synthetic_panel_dense(
-        num_days=NUM_DAYS, num_instruments=N_STOCKS, num_features=NUM_FEATURES
-    )
-    ds = PanelDataset(panel, seq_len=SEQ_LEN, max_stocks=knobs["pad_target"])
+    if panel is None:
+        panel = synthetic_panel_dense(
+            num_days=NUM_DAYS, num_instruments=N_STOCKS,
+            num_features=NUM_FEATURES)
+    ds = PanelDataset(panel, seq_len=SEQ_LEN, max_stocks=knobs["pad_target"],
+                      residency=residency)
     return cfg, ds
 
 
@@ -545,10 +573,103 @@ def run_fleet_bench() -> dict:
     }
 
 
+def run_stream_bench() -> dict:
+    """Panel-residency A/B (BENCH_STREAM): train the same workload with
+    the HBM-resident whole-epoch scan and with the out-of-core stream
+    path at the same planner-resolved knobs, and report both rates plus
+    the transfer ledger (host->device bytes/sec, overlap_frac,
+    chunk_days). One JSON line, same terminal contract as the headline
+    bench; `value` is the STREAM rate (the path under test)."""
+    import jax
+
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    from factorvae_tpu.data import synthetic_panel_dense
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    platform, peak = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    chunk_days = STREAM_CHUNK_DAYS or int(
+        plan_block.get("stream_chunk_days") or 32)
+    panel = synthetic_panel_dense(
+        num_days=NUM_DAYS, num_instruments=N_STOCKS,
+        num_features=NUM_FEATURES)
+
+    results = {}
+    transfer = {"bytes": 0, "produce_s": 0.0, "wait_s": 0.0}
+    panel_bytes = 0
+    for mode in ("hbm", "stream"):
+        cfg, ds = bench_setup(knobs, residency=mode, chunk_days=chunk_days,
+                              panel=panel)
+        panel_bytes = ds.panel_nbytes
+        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = trainer.init_state()
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(0))
+        jax.block_until_ready(m["loss"])
+        days_per_epoch = float(m["days"])
+        t0 = time.time()
+        for epoch in range(1, EPOCHS_TIMED + 1):
+            state, m = trainer._train_epoch(
+                state, trainer._epoch_orders(epoch))
+            if mode == "stream":
+                st = trainer.last_stream_stats
+                transfer["bytes"] += st.bytes_put
+                transfer["produce_s"] += st.produce_seconds
+                transfer["wait_s"] += st.wait_seconds
+        jax.block_until_ready(m["loss"])
+        dt = time.time() - t0
+        results[mode] = EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt
+        results[mode + "_seconds"] = dt
+
+    from factorvae_tpu.data.stream import overlap_frac
+
+    overlap = overlap_frac(transfer["wait_s"], transfer["produce_s"])
+    use_pallas = knobs["pallas_attention"]
+    return {
+        "metric": (
+            f"stream_train_throughput_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+            f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}"
+            f"_dps{knobs['days_per_step']}_d{NUM_DAYS}e{EPOCHS_TIMED}"
+            f"_c{chunk_days}"
+            + ("" if use_pallas == "auto" else
+               f"_pallas{int(bool(use_pallas))}")
+            + ("_bf16" if knobs["compute_dtype"] == "bfloat16" else "")
+            + ("" if knobs["flatten_days"] else "_per_day_vmap")
+            + ("_cpu_fallback" if FORCED_CPU else "")),
+        "value": round(results["stream"], 1),
+        "unit": "windows/sec/chip",
+        "vs_baseline": round(results["stream"] / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        "hbm_windows_per_sec": round(results["hbm"], 1),
+        "stream_windows_per_sec": round(results["stream"], 1),
+        "stream_vs_hbm": round(results["stream"] / max(results["hbm"], 1e-9),
+                               3),
+        "chunk_days": chunk_days,
+        "panel_bytes": panel_bytes,
+        "transfer_bytes": transfer["bytes"],
+        "transfer_bytes_per_sec": round(
+            transfer["bytes"] / max(results["stream_seconds"], 1e-9), 1),
+        "overlap_frac": round(overlap, 4),
+        # A CPU host's producer and consumer share the same cores: the
+        # stream path pays the gather in serial and there is no real
+        # transfer gap to hide — the A/B is a correctness/ceiling probe
+        # there, not a speedup claim.
+        "no_transfer_gap": platform == "cpu",
+        "plan": plan_block,
+    }
+
+
 def bench_payload() -> dict:
-    """Fleet mode (--fleet / BENCH_FLEET=1) or the single-model
-    headline."""
-    return run_fleet_bench() if USE_FLEET else run_bench()
+    """Fleet mode (--fleet / BENCH_FLEET=1), stream-residency A/B
+    (--stream / BENCH_STREAM=1), or the single-model headline."""
+    if USE_FLEET:
+        return run_fleet_bench()
+    if USE_STREAM:
+        return run_stream_bench()
+    return run_bench()
 
 
 # The most recent REAL-TPU measurement, carried as clearly-labeled
@@ -690,11 +811,14 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
-    global USE_FLEET
+    global USE_FLEET, USE_STREAM
     if "--fleet" in sys.argv:
         # Propagate into the probe/accel/fallback subprocesses too.
         USE_FLEET = True
         os.environ["BENCH_FLEET"] = "1"
+    if "--stream" in sys.argv:
+        USE_STREAM = True
+        os.environ["BENCH_STREAM"] = "1"
 
     if ACCEL_CHILD:
         # Child: backend already validated by the parent's probe; any crash

@@ -279,6 +279,20 @@ def _shape_like(expr: ast.AST) -> bool:
     return False
 
 
+def _subscript_has_slice(expr: ast.Subscript) -> bool:
+    sl = expr.slice
+    if isinstance(sl, ast.Slice):
+        return True
+    return isinstance(sl, ast.Tuple) and any(
+        isinstance(e, ast.Slice) for e in sl.elts)
+
+
+def _scalar_subscript(expr: ast.AST) -> bool:
+    """`x[i]` / `x[i, j]` (one element) but NOT `x[lo:hi]` / `x[i, :]`
+    (a chunk) — the granularity line JGL001's transfer flavor draws."""
+    return isinstance(expr, ast.Subscript) and not _subscript_has_slice(expr)
+
+
 class _HostLoopFlow(_Flow):
     """Loop flavor: per-element host pulls (float()/int()/.item(), or a
     np.asarray/device_get of a SLICE) inside a Python loop strictly
@@ -286,7 +300,15 @@ class _HostLoopFlow(_Flow):
     eval/factors.py round-trip-per-row pattern. The sanctioned shape is
     one bulk `jax.device_get`/np.asarray per producing call (same loop
     depth — each chunk pulls its own output once), which rebinds the
-    root to host numpy and clears the taint."""
+    root to host numpy and clears the taint.
+
+    Also the PUSH direction: `jax.device_put(x[i])` (a scalar-indexed
+    element) inside a host loop is one tiny host->device transfer per
+    element. CHUNK-granularity puts — a slice (`x[lo:hi]`) or a whole
+    buffer per iteration — are the sanctioned out-of-core idiom
+    (data/stream.py's double-buffered prefetch loop ships one gathered
+    chunk per `device_put` while the device consumes the previous one)
+    and stay silent."""
 
     HOST_PULLS = {"jax.device_get", "numpy.asarray", "numpy.array"}
 
@@ -326,6 +348,17 @@ class _HostLoopFlow(_Flow):
                 root = _root_name(node.args[0])
                 if root in self.device_vars:
                     self._flag(node, root, "host pull")
+            elif self.model.resolve(node.func) == "jax.device_put" \
+                    and node.args and self.loop_depth > 0 \
+                    and _scalar_subscript(node.args[0]):
+                self.report(
+                    "JGL001", node.lineno,
+                    "per-element jax.device_put inside a host loop — one "
+                    "tiny host->device transfer per element; ship "
+                    "chunk-granularity slices and double-buffer the next "
+                    "chunk while the device consumes the current one "
+                    "(the data/stream.py ChunkStream idiom)",
+                )
 
     def assign(self, targets, value) -> None:
         names = _target_names(targets)

@@ -89,6 +89,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(kept for round-2 command lines)")
     p.add_argument("--max_stocks", type=int, default=None,
                    help="cross-section padding N_max (default: inferred)")
+    p.add_argument("--panel_residency", choices=["hbm", "stream"],
+                   default=None,
+                   help="where the feature panel lives: 'hbm' ships it "
+                        "to the device once (default); 'stream' keeps it "
+                        "host-resident and double-buffers prefetched "
+                        "day-chunks (data/stream.py) — bitwise-identical "
+                        "results with O(2 chunks) device residency, for "
+                        "universes/histories past the HBM wall. "
+                        "--auto_plan may pick it from a measured row")
+    p.add_argument("--stream_chunk_days", type=int, default=None,
+                   help="days per host->device transfer chunk under "
+                        "--panel_residency stream (default 32, or the "
+                        "planner's raced value; docs/streaming.md has "
+                        "the budget math)")
     p.add_argument("--auto_plan", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="let the execution planner (factorvae_tpu/plan.py) "
@@ -218,6 +232,12 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 val_start_time=resolve("val_start_time", cfg.data.val_start_time),
                 val_end_time=resolve("val_end_time", cfg.data.val_end_time),
                 end_time=resolve("end_time", cfg.data.end_time),
+                panel_residency=(cfg.data.panel_residency
+                                 if args.panel_residency is None
+                                 else args.panel_residency),
+                stream_chunk_days=(cfg.data.stream_chunk_days
+                                   if args.stream_chunk_days is None
+                                   else args.stream_chunk_days),
             ),
             train=dataclasses.replace(
                 cfg.train,
@@ -260,6 +280,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             end_time=resolve("end_time"),
             seq_len=args.seq_len,
             max_stocks=args.max_stocks,
+            panel_residency=args.panel_residency or "hbm",
+            stream_chunk_days=(32 if args.stream_chunk_days is None
+                               else args.stream_chunk_days),
         ),
         train=TrainConfig(
             num_epochs=resolve("num_epochs"),
@@ -325,7 +348,26 @@ def main(argv=None) -> int:
             keep_dtype=args.bf16 is not None,
             keep_pad=args.max_stocks is not None,
             keep_kernels=args.pallas is not None or args.pallas_auto,
+            keep_residency=(args.panel_residency is not None
+                            or args.stream_chunk_days is not None),
         )
+        if args.mesh and args.panel_residency is None \
+                and cfg.data.panel_residency == "stream":
+            # Stream residency does not compose with a device mesh (the
+            # sharded path needs the panel in HBM to shard it); a
+            # measured stream row must not break --mesh runs — fall
+            # back to HBM and say so. Only the PLAN's choice is
+            # overridden: an EXPLICIT --panel_residency stream with
+            # --mesh still fails loudly in Trainer, same as without
+            # --auto_plan.
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, data=dataclasses.replace(
+                cfg.data, panel_residency="hbm"))
+            logger.log(
+                "plan_residency_override", residency="hbm",
+                note="plan chose panel_residency=stream but --mesh needs "
+                     "the HBM panel; keeping hbm")
         logger.log("plan", **auto_plan.describe(
             planlib.shape_of(cfg, panel.num_instruments)))
 
@@ -334,6 +376,7 @@ def main(argv=None) -> int:
         seq_len=cfg.data.seq_len,
         max_stocks=cfg.data.max_stocks,
         pad_multiple=cfg.data.pad_multiple,
+        residency=cfg.data.panel_residency,
     )
     if dataset.panel.num_features != cfg.model.num_features:
         print(

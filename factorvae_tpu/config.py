@@ -102,6 +102,15 @@ class DataConfig:
     # Round N_max up to a multiple of this for TPU-friendly tiling (the MXU
     # operates on 128-lane tiles) and for even sharding over a 'stock' axis.
     pad_multiple: int = 8
+    # Panel residency (plan.panel_residency): "hbm" ships the whole
+    # (N_max, D, C+1) panel to the device once (today's path); "stream"
+    # keeps it host-resident and double-buffers prefetched day-chunk
+    # batches onto the device (data/stream.py) — bitwise-equal training/
+    # scoring with O(2 chunks) device residency instead of O(D).
+    panel_residency: str = "hbm"
+    # Stream chunk size in DAYS per host->device transfer (the planner's
+    # raced knob; docs/streaming.md has the HBM-budget math).
+    stream_chunk_days: int = 32
 
 
 @dataclass(frozen=True)
@@ -126,6 +135,11 @@ class TrainConfig:
     # which is all the reference ever saved; main.py:73-80).
     checkpoint_every: int = 1
     keep_checkpoints: int = 3
+    # Async checkpointing (train/checkpoint.py): save() snapshots to host
+    # and serializes on a background thread, overlapping the next epoch;
+    # False restores the old blocking save (bitwise-identical artifacts
+    # either way — tested).
+    async_checkpointing: bool = True
 
 
 @dataclass(frozen=True)

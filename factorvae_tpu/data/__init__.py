@@ -1,5 +1,6 @@
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.data.panel import Panel, build_panel, load_frame, panel_to_frame
+from factorvae_tpu.data.stream import ChunkStream, chunk_slices, stream_epoch_batches
 from factorvae_tpu.data.synthetic import (
     synthetic_frame,
     synthetic_panel,
@@ -9,20 +10,27 @@ from factorvae_tpu.data.windows import (
     compute_fill_maps,
     fill_indices_host,
     gather_day,
+    gather_days_host,
     window_fill_indices,
+    window_fill_indices_np,
 )
 
 __all__ = [
+    "ChunkStream",
     "Panel",
     "PanelDataset",
     "build_panel",
+    "chunk_slices",
     "compute_fill_maps",
     "fill_indices_host",
     "gather_day",
+    "gather_days_host",
     "load_frame",
     "panel_to_frame",
+    "stream_epoch_batches",
     "synthetic_frame",
     "synthetic_panel",
     "synthetic_panel_dense",
     "window_fill_indices",
+    "window_fill_indices_np",
 ]

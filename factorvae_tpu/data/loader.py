@@ -1,13 +1,17 @@
-"""Device-resident day-batch dataset.
+"""Residency-governed day-batch dataset.
 
 Replaces the reference's TSDatasetH + DateGroupedBatchSampler + DataLoader
 assembly (dataset.py:187-274). The semantic is identical — one batch =
 one trading day's full cross-section, optionally day-shuffled
-(dataset.py:227-234) — but the mechanics are TPU-first: the whole panel
-sits in HBM as static-shape arrays, a "batch" is just a day index, and the
-window gather runs inside the jitted train step (windows.py). There are no
-worker processes, no host->device copies per step, and no variable batch
-shapes.
+(dataset.py:227-234) — but the mechanics are TPU-first: under the default
+"hbm" residency the whole panel sits in HBM as static-shape arrays, a
+"batch" is just a day index, and the window gather runs inside the jitted
+train step (windows.py). There are no worker processes, no host->device
+copies per step, and no variable batch shapes. Under the "stream"
+residency (plan.panel_residency; data/stream.py, docs/streaming.md) the
+panel stays host-resident and epochs consume double-buffered prefetched
+mini-panel chunks — bitwise-identical results with device residency
+independent of history length.
 """
 
 from __future__ import annotations
@@ -47,11 +51,23 @@ def _round_up(n: int, multiple: int) -> int:
 
 
 class PanelDataset:
-    """HBM-resident panel + split bookkeeping.
+    """Panel + split bookkeeping, resident per the plan's residency knob.
 
     The cross-section is padded to ``n_max`` (a multiple of `pad_multiple`
     for MXU tiling / even 'stock'-axis sharding); padded instruments are
     permanently invalid.
+
+    ``residency`` (plan.panel_residency) picks where the panel lives:
+
+    - ``"hbm"`` (default, today's path kept bitwise): the whole
+      (n_max, D, C+1) panel ships to the default device once and every
+      jitted step gathers from it — zero per-step host traffic, but the
+      panel must fit in device memory alongside activations.
+    - ``"stream"``: the panel stays HOST-resident numpy; training/
+      scoring consume host-gathered day-chunk batches double-buffered
+      onto the device (data/stream.py), so device residency is
+      O(2 chunks) regardless of history length D. Bitwise-equal results
+      to ``"hbm"`` (tests/test_stream.py).
     """
 
     def __init__(
@@ -60,9 +76,14 @@ class PanelDataset:
         seq_len: int = 20,
         max_stocks: Optional[int] = None,
         pad_multiple: int = 8,
+        residency: str = "hbm",
     ):
+        if residency not in ("hbm", "stream"):
+            raise ValueError(
+                f"residency must be 'hbm' or 'stream'; got {residency!r}")
         self.panel = panel
         self.seq_len = seq_len
+        self.residency = residency
         n_inst = panel.num_instruments
         n_max = max_stocks or _round_up(n_inst, pad_multiple)
         if n_max < n_inst:
@@ -81,13 +102,35 @@ class PanelDataset:
         valid[:, :n_inst] = panel.valid
         last_valid, next_valid = compute_fill_maps(valid)
 
-        # Ship to the default device once; everything downstream indexes it.
-        self.values = jnp.asarray(values)
-        self.last_valid = jnp.asarray(last_valid)
-        self.next_valid = jnp.asarray(next_valid)
+        if residency == "hbm":
+            # Ship to the default device once; everything downstream
+            # indexes it.
+            self.values = jnp.asarray(values)
+            self.last_valid = jnp.asarray(last_valid)
+            self.next_valid = jnp.asarray(next_valid)
+        else:
+            # Host-pinned residency: the device never holds the panel —
+            # only the per-chunk batches the prefetcher ships.
+            self.values_np = values
+            self.last_valid_np = last_valid
+            self.next_valid_np = next_valid
         self.valid = valid
         self.dates = panel.dates
         self.instruments = panel.instruments
+
+    def __getattr__(self, name):
+        if name in ("values", "last_valid", "next_valid"):
+            raise AttributeError(
+                f"PanelDataset.{name}: no device-resident panel under "
+                "residency='stream' — this consumer needs the HBM path "
+                "(rebuild the dataset with residency='hbm') or the "
+                "streaming variant (data/stream.py)")
+        if name in ("values_np", "last_valid_np", "next_valid_np"):
+            raise AttributeError(
+                f"PanelDataset.{name}: host panel copies are only kept "
+                "under residency='stream' (the HBM path ships them to "
+                "device and drops the host side)")
+        raise AttributeError(name)
 
     @property
     def dead_compute_frac(self) -> float:
@@ -111,10 +154,40 @@ class PanelDataset:
     # ---- batching --------------------------------------------------------
 
     def day_batch(self, day) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-        """(x, y, mask) for one day; usable eagerly or under jit."""
+        """(x, y, mask) for one day; usable eagerly or under jit (hbm).
+        Stream datasets resolve it from the host panel — same values."""
+        if self.residency == "stream":
+            x, y, mask, _ = self.gather_batch_host(np.asarray([day]))
+            return jnp.asarray(x[0]), jnp.asarray(y[0]), jnp.asarray(mask[0])
         return gather_day(
             self.values, self.last_valid, self.next_valid, day, self.seq_len
         )
+
+    def gather_batch_host(self, days: np.ndarray):
+        """(x, y, mask, day_w) for a day batch, gathered on HOST from the
+        stream-resident panel (windows.gather_days_host; -1 = padding).
+        Bitwise the device gather's batches."""
+        from factorvae_tpu.data.windows import gather_days_host
+
+        return gather_days_host(
+            self.values_np, self.last_valid_np, self.next_valid_np,
+            np.asarray(days, np.int32), self.seq_len)
+
+    @property
+    def panel_nbytes(self) -> int:
+        """Bytes of the dense (n_max, D, C+1) panel — the HBM residency
+        the stream path avoids (bench.py transfer accounting)."""
+        arr = self.values_np if self.residency == "stream" else self.values
+        return int(arr.size) * int(arr.dtype.itemsize)
+
+    def day_labels(self, days: np.ndarray) -> np.ndarray:
+        """(len(days), n_max) label column in day-major order, resolved
+        from whichever residency holds the panel (one definition for the
+        score-frame builders, eval/predict._frame_pieces)."""
+        days = np.asarray(days, dtype=np.intp)
+        if self.residency == "stream":
+            return self.values_np[:, :, -1].T[days]
+        return np.asarray(self.values[:, :, -1]).T[days]
 
     def iter_days(
         self, days: np.ndarray, shuffle: bool = False, seed: int = 0

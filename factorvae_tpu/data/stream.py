@@ -1,0 +1,134 @@
+"""Out-of-core panel streaming: double-buffered host->device chunks.
+
+The HBM residency path ships the whole (n_max, D, C+1) panel to the
+device once (loader.py). This module is the `panel_residency="stream"`
+counterpart: the panel stays host-resident numpy, the epoch is consumed
+as day-chunk batches, and a single background worker produces chunk k+1
+(host window gather + `jax.device_put`) while the jitted consumer runs
+chunk k. Double buffering by construction: at most two chunks are alive
+on device, so device residency is O(2 * chunk) regardless of history
+length D.
+
+The sanctioned transfer idiom is CHUNK-granularity: one `device_put` of
+the whole gathered batch per chunk (graftlint JGL001 flags per-element
+`device_put` pulls/pushes inside host loops; this loop is the corrected
+shape it points to).
+
+`ChunkStream` also keeps the transfer ledger bench.py reports:
+`bytes_put` (host->device traffic), `produce_seconds` (gather + put
+time on the worker), `wait_seconds` (consumer stalls on an unfinished
+chunk). `overlap_frac = 1 - wait/produce` is the fraction of transfer
+work hidden behind compute — ~1.0 when the pipeline fully overlaps,
+~0.0 when every chunk is a synchronous stall. On hosts where producer
+and consumer share the same cores (the CPU sandbox) there is no real
+transfer gap to hide and the number is reported as-is, not a claim.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterator
+
+import jax
+
+
+def _tree_nbytes(tree: Any) -> int:
+    return sum(
+        int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree.leaves(tree)
+    )
+
+
+def overlap_frac(wait_seconds: float, produce_seconds: float) -> float:
+    """Fraction of produce (gather+put) time hidden behind consumer
+    compute, clamped to [0, 1]; 0.0 when nothing was produced. ONE
+    definition of the ledger's headline ratio — ChunkStream and the
+    bench.py BENCH_STREAM payload both report exactly this."""
+    if produce_seconds <= 0.0:
+        return 0.0
+    return max(0.0, min(1.0, 1.0 - wait_seconds / produce_seconds))
+
+
+class ChunkStream:
+    """Iterate device-resident chunk batches with one chunk of lookahead.
+
+    ``make_chunk(i)`` builds the i-th HOST chunk (a numpy pytree; for
+    epochs, the remapped mini-panel from windows.chunk_mini_panel). The
+    worker thread runs ``device_put(make_chunk(i+1))`` while the
+    consumer holds chunk i.
+    Iteration is strictly in order — chunk order is the SGD step order,
+    part of the bitwise contract with the HBM path.
+    """
+
+    def __init__(self, make_chunk: Callable[[int], Any], n_chunks: int):
+        self._make_chunk = make_chunk
+        self.n_chunks = int(n_chunks)
+        self.bytes_put = 0
+        self.produce_seconds = 0.0
+        self.wait_seconds = 0.0
+
+    def _produce(self, i: int):
+        t0 = time.perf_counter()
+        host = self._make_chunk(i)
+        self.bytes_put += _tree_nbytes(host)
+        # ONE chunk-granularity transfer; async on accelerators, so the
+        # copy itself also overlaps the worker's next gather.
+        dev = jax.device_put(host)
+        self.produce_seconds += time.perf_counter() - t0
+        return dev
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.n_chunks <= 0:
+            return
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(self._produce, 0)
+            for i in range(self.n_chunks):
+                nxt = (ex.submit(self._produce, i + 1)
+                       if i + 1 < self.n_chunks else None)
+                t0 = time.perf_counter()
+                batch = fut.result()
+                self.wait_seconds += time.perf_counter() - t0
+                yield batch
+                fut = nxt
+
+    @property
+    def overlap_frac(self) -> float:
+        return overlap_frac(self.wait_seconds, self.produce_seconds)
+
+
+def chunk_slices(n_steps: int, steps_per_chunk: int) -> list:
+    """[(start, stop)] covering range(n_steps) in order. The tail chunk
+    is SHORTER, never padded: padding would add SGD steps (extra RNG
+    advances + optimizer updates) and break the bitwise contract with
+    the whole-epoch scan; the cost is one extra compiled scan length."""
+    if steps_per_chunk <= 0:
+        raise ValueError(f"steps_per_chunk must be >= 1; got {steps_per_chunk}")
+    return [(s, min(s + steps_per_chunk, n_steps))
+            for s in range(0, n_steps, steps_per_chunk)]
+
+
+def stream_epoch_batches(dataset, order, steps_per_chunk: int) -> ChunkStream:
+    """ChunkStream over an epoch's (n_steps, B) day order for a
+    stream-resident dataset. Each chunk is
+    ``(order_local (k, B), (cvalues, clv, cnv))`` — the chunk's slice of
+    the step order remapped onto a relocatable mini-panel
+    (windows.chunk_mini_panel), which the chunked epoch fns
+    (train/loop.py train_chunk / eval_chunk) consume through the SAME
+    device gather the HBM path runs."""
+    import numpy as np
+
+    from factorvae_tpu.data.windows import chunk_mini_panel
+
+    order = np.asarray(order, np.int32)
+    slices = chunk_slices(order.shape[0], steps_per_chunk)
+    b = order.shape[1]
+
+    def make_chunk(i: int):
+        lo, hi = slices[i]
+        days = order[lo:hi].reshape(-1)
+        local_days, cvalues, clv, cnv = chunk_mini_panel(
+            dataset.values_np, dataset.last_valid_np, dataset.next_valid_np,
+            days, dataset.seq_len)
+        return local_days.reshape(hi - lo, b), (cvalues, clv, cnv)
+
+    return ChunkStream(make_chunk, len(slices))

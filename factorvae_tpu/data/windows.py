@@ -77,6 +77,125 @@ def fill_indices_host(valid: np.ndarray, day: int, step_len: int) -> np.ndarray:
     return out
 
 
+def window_fill_indices_np(
+    last_valid: np.ndarray, next_valid: np.ndarray, day: int, step_len: int
+) -> np.ndarray:
+    """Host twin of `window_fill_indices`: identical index math in numpy,
+    for the out-of-core stream path (data/stream.py) where the panel
+    never leaves host memory. Pure integer selection — bitwise-equal
+    fill maps by construction (pinned in tests/test_stream.py)."""
+    d_total = last_valid.shape[0]
+    t = step_len
+    day = int(day)
+    p = day - t + 1 + np.arange(t, dtype=np.int32)       # (T,) window days
+    pc = np.clip(p, 0, d_total - 1)
+    lv = last_valid[pc]                                   # (T, I)
+    w_start = day - t + 1
+    ff_ok = (p >= 0)[:, None] & (lv >= max(w_start, 0))
+    fv = next_valid[min(max(w_start, 0), d_total - 1)]    # (I,)
+    bf_ok = fv <= day
+    fallback = np.where(bf_ok, fv, day)[None, :]
+    fill = np.where(ff_ok, lv, fallback)                  # (T, I)
+    return fill.T.astype(np.int32)                        # (I, T)
+
+
+def gather_days_host(
+    values: np.ndarray,
+    last_valid: np.ndarray,
+    next_valid: np.ndarray,
+    days: np.ndarray,
+    step_len: int,
+):
+    """Host twin of the device per-day gather vmapped over a day batch
+    (train/loop.py `batch_for`): (x, y, mask, day_w) for `days` (B,)
+    int32 with -1 epoch padding, gathered from the HOST-resident panel.
+
+    Gather/select/NaN-fill only — no float arithmetic — so the batches
+    are bitwise what the device gather produces from the same panel;
+    the jitted consumers then run the identical model graph on them.
+      x     (B, I, T, C)  float32, NaN-free
+      y     (B, I)        float32 day labels (NaN where absent)
+      mask  (B, I)        bool, instrument has a row AND day is real
+      day_w (B,)          float32 1/0 real-day weights
+    """
+    days = np.asarray(days, np.int32)
+    safe = np.maximum(days, 0)
+    xs, ys, masks = [], [], []
+    for d in safe:
+        fill = window_fill_indices_np(last_valid, next_valid, int(d), step_len)
+        window = np.take_along_axis(values, fill[:, :, None], axis=1)
+        xs.append(np.nan_to_num(window[:, :, :-1]))
+        ys.append(values[:, int(d), -1])
+        masks.append(last_valid[int(d)] == int(d))
+    x = np.stack(xs)
+    y = np.stack(ys)
+    mask = np.stack(masks) & (days >= 0)[:, None]
+    day_w = (days >= 0).astype(np.float32)
+    return x, y, mask, day_w
+
+
+def chunk_mini_panel(
+    values: np.ndarray,
+    last_valid: np.ndarray,
+    next_valid: np.ndarray,
+    days: np.ndarray,
+    step_len: int,
+):
+    """Relocatable mini-panel for a chunk of (possibly shuffled) days —
+    the out-of-core stream path's transfer unit (data/stream.py).
+
+    Returns ``(local_days, cvalues, clv, cnv)`` such that running the
+    UNCHANGED device gather (`gather_day` / loop.batch_for) on the mini
+    panel with `local_days` yields bitwise the batches the full panel
+    yields for `days`. Each day gets its own T-row slab: day s (flat
+    chunk position) lives at local days [s*T, (s+1)*T), its query day at
+    s*T + T - 1, and the fill maps are REMAPPED so the device's
+    ffill/bfill arithmetic resolves to the same original rows:
+
+      clv[s*T + t] = s*T + (last_valid[clip(w+t)] - w)  where the HBM
+                     path's ffill would accept that row, else -1
+      cnv[s*T]     = s*T + (next_valid[clip(w, 0, D-1)] - w) where its
+                     bfill would accept, else D' (out of range)
+
+    with w = day - T + 1. Keeping the gather ON DEVICE (rather than
+    shipping pre-gathered windows) matters: the chunked scan then traces
+    the exact graph the whole-epoch scan traces, which is what keeps the
+    stream residency bitwise-equal (see loop.train_chunk).
+
+    Padded entries (day == -1) keep local day -1; their slab duplicates
+    day 0's clipped window (the consumer zero-weights them exactly like
+    the HBM path zero-weights its day-0 gather for pads).
+    """
+    days = np.asarray(days, np.int32)
+    m = len(days)
+    t = int(step_len)
+    d_total = last_valid.shape[0]
+    safe = np.maximum(days, 0).astype(np.int64)
+    w_start = safe - t + 1                                   # (m,)
+    p = w_start[:, None] + np.arange(t)                      # (m, T) unclipped
+    pc = np.clip(p, 0, d_total - 1)
+    cvalues = np.ascontiguousarray(values[:, pc.reshape(-1), :])
+    base = (np.arange(m, dtype=np.int64) * t)[:, None]       # (m, 1)
+
+    lv = last_valid[pc]                                      # (m, T, I)
+    ff_ok = (p >= 0)[:, :, None] & (
+        lv >= np.maximum(w_start, 0)[:, None, None])
+    clv = np.where(
+        ff_ok, base[:, :, None] + (lv - w_start[:, None, None]), -1
+    ).reshape(m * t, -1).astype(np.int32)
+
+    cnv = np.full((m * t, lv.shape[-1]), m * t, np.int32)
+    fv = next_valid[np.clip(w_start, 0, d_total - 1)]        # (m, I)
+    bf_ok = fv <= safe[:, None]
+    cnv[np.arange(m) * t] = np.where(
+        bf_ok, base + (fv - w_start[:, None]), m * t).astype(np.int32)
+
+    local_days = np.where(
+        days >= 0, np.arange(m, dtype=np.int32) * t + t - 1, -1
+    ).astype(np.int32)
+    return local_days, cvalues, clv, cnv
+
+
 def window_fill_indices(
     last_valid: jnp.ndarray, next_valid: jnp.ndarray, day, step_len: int
 ) -> jnp.ndarray:

@@ -101,6 +101,80 @@ def _score_chunk_fn(
 
 
 @functools.lru_cache(maxsize=32)
+def _score_chunk_fleet_fn(
+    model_cfg: ModelConfig,
+    seq_len: int,
+    stochastic: Optional[bool],
+):
+    """Seed-batched single-chunk scorer for STREAM-resident datasets:
+    S stacked param trees x one prefetched mini-panel chunk, panel and
+    key broadcast — the per-chunk twin of `_score_scan_fleet_fn`."""
+    chunk_scores = _make_chunk_scorer(model_cfg, seq_len, stochastic)
+
+    @jax.jit
+    def score_chunk_fleet(stacked_p, values, last_valid, next_valid,
+                          day_idx, key):
+        def one_seed(p):
+            return chunk_scores(p, values, last_valid, next_valid,
+                                day_idx, key)
+
+        return jax.vmap(one_seed)(stacked_p)
+
+    return score_chunk_fleet
+
+
+def _stream_chunks(dataset, days: np.ndarray, chunk: int):
+    """ChunkStream of (local day_idx (chunk,), mini-panel) for a scoring
+    pass over a stream-resident dataset — the same chunk partitioning
+    and -1 padding as `_scan_inputs`/the chunk loop, remapped onto
+    relocatable mini-panels (data/windows.chunk_mini_panel) so the
+    jitted scorer runs the identical in-graph gather."""
+    from factorvae_tpu.data.stream import ChunkStream
+    from factorvae_tpu.data.windows import chunk_mini_panel
+
+    starts = list(range(0, len(days), chunk))
+
+    def make_chunk(i):
+        c0 = starts[i]
+        sel = days[c0:c0 + chunk]
+        padded = np.full(chunk, -1, np.int32)
+        padded[:len(sel)] = sel
+        local_days, cvalues, clv, cnv = chunk_mini_panel(
+            dataset.values_np, dataset.last_valid_np, dataset.next_valid_np,
+            padded, dataset.seq_len)
+        return local_days, (cvalues, clv, cnv)
+
+    return starts, ChunkStream(make_chunk, len(starts))
+
+
+def _predict_stream(params, config, dataset, days, stochastic, seed,
+                    chunk, int8=False, stacked=False):
+    """Scoring pass over a STREAM-resident dataset: per-chunk mini-panels
+    double-buffered to the device, scored by the chunk scorer with the
+    chunk loop's exact per-chunk RNG stream (`fold_in(base, c0)`), so
+    scores are bitwise the HBM paths' (pinned in tests/test_stream.py).
+    `stacked=True` scores S stacked param trees per chunk (fleet)."""
+    n_days = len(days)
+    lead = ()
+    if stacked:
+        lead = (int(jax.tree.leaves(params)[0].shape[0]),)
+        score_chunk = _score_chunk_fleet_fn(
+            config.model, config.data.seq_len, stochastic)
+    else:
+        score_chunk = _score_chunk_fn(
+            config.model, config.data.seq_len, stochastic, int8)
+    base = jax.random.PRNGKey(seed)
+    out = np.full(lead + (n_days, dataset.n_max), np.nan, np.float32)
+    starts, chunks = _stream_chunks(dataset, days, chunk)
+    for c0, (day_idx, (cvalues, clv, cnv)) in zip(starts, chunks):
+        n_sel = min(chunk, n_days - c0)
+        scores = score_chunk(params, cvalues, clv, cnv, day_idx,
+                             jax.random.fold_in(base, c0))
+        out[..., c0:c0 + n_sel, :] = np.asarray(scores)[..., :n_sel, :]
+    return out
+
+
+@functools.lru_cache(maxsize=32)
 def _score_scan_fleet_fn(
     model_cfg: ModelConfig,
     seq_len: int,
@@ -214,12 +288,23 @@ def predict_panel(
     (ops/quant.py) and dequantizes them inside the compiled program —
     4x smaller parameter residency for a read-only workload; score
     fidelity vs the float path is rank-correlation ~1 (tested)."""
+    if impl not in ("scan", "chunk_loop"):
+        raise ValueError(f"impl must be 'scan' or 'chunk_loop'; got {impl!r}")
     if int8:
         from factorvae_tpu.ops.quant import quantize_params
 
         params = quantize_params(params)
 
     n_days = len(days)
+    if getattr(dataset, "residency", "hbm") == "stream":
+        # Stream-resident panel: prefetched mini-panel chunks through
+        # the chunk scorer (same structure either impl would run; the
+        # RNG stream and chunk partitioning match both, which are
+        # mutually bitwise anyway).
+        if n_days == 0:
+            return np.full((0, dataset.n_max), np.nan, np.float32)
+        return _predict_stream(params, config, dataset, days, stochastic,
+                               seed, chunk, int8=int8)
     base = jax.random.PRNGKey(seed)
 
     if impl == "chunk_loop":
@@ -236,8 +321,6 @@ def predict_panel(
                 jax.random.fold_in(base, c0))
             out[c0 : c0 + len(sel)] = np.asarray(scores)[: len(sel)]
         return out
-    if impl != "scan":
-        raise ValueError(f"impl must be 'scan' or 'chunk_loop'; got {impl!r}")
 
     if n_days == 0:
         return np.full((0, dataset.n_max), np.nan, np.float32)
@@ -282,6 +365,9 @@ def predict_panel_fleet(
     n_days = len(days)
     if n_days == 0:
         return np.full((s, 0, dataset.n_max), np.nan, np.float32)
+    if getattr(dataset, "residency", "hbm") == "stream":
+        return _predict_stream(stacked_params, config, dataset, days,
+                               stochastic, seed, chunk, stacked=True)
     base = jax.random.PRNGKey(seed)
     day_idx, keys = _scan_inputs(
         days, chunk, base, _deterministic(config.model, stochastic))
@@ -300,8 +386,7 @@ def _frame_pieces(dataset: PanelDataset, days: np.ndarray,
     fleet frame builders — one definition of the score-frame schema."""
     idx = dataset.index_frame(days)
     valid = dataset.valid[days]                      # (D, N_max)
-    labels = (np.asarray(dataset.values[:, :, -1]).T[days][valid]
-              if with_labels else None)
+    labels = (dataset.day_labels(days)[valid] if with_labels else None)
     return idx, valid, labels
 
 

@@ -142,6 +142,15 @@ class Plan:
     from `scripts/autotune_plan.py --fleet` rows (a `"fleet"` block on
     the row — absent on pre-fleet rows, which keep resolving exactly as
     before).
+
+    `panel_residency` / `stream_chunk_days` are the out-of-core knobs
+    (data/stream.py, docs/streaming.md): "hbm" keeps the whole panel on
+    device (today's path), "stream" keeps it host-resident and
+    double-buffers prefetched day-chunks of `stream_chunk_days` days —
+    bitwise-equal results. Raced values come from
+    `scripts/autotune_plan.py --stream` rows (a `"stream"` block;
+    absent on pre-stream rows, which resolve to "hbm" — no schema
+    break).
     """
 
     flatten_days: bool
@@ -155,6 +164,8 @@ class Plan:
     use_pallas_attention: Union[bool, str] = "auto"
     use_pallas_gru: Union[bool, str] = "auto"
     seeds_per_program: int = 1
+    panel_residency: str = "hbm"
+    stream_chunk_days: int = 32
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -380,6 +391,13 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 # serial default (no schema break for existing tables).
                 seeds_per_program=int(
                     (row.get("fleet") or {}).get("seeds_per_program") or 1),
+                # Pre-stream rows have no "stream" block: resolve to the
+                # HBM residency (same backward-compatibility rule).
+                panel_residency=str(
+                    (row.get("stream") or {}).get("panel_residency")
+                    or "hbm"),
+                stream_chunk_days=int(
+                    (row.get("stream") or {}).get("chunk_days") or 32),
             )
     default = _TPU_DEFAULT if plat == "tpu" else _CPU_DEFAULT
     src = ("per-backend default: round-2 measured TPU winners (PERF.md)"
@@ -417,7 +435,8 @@ def plan_for_config(config, n_stocks: int, platform: Optional[str] = None,
 
 def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
                keep_dtype: bool = False, keep_layout: bool = False,
-               keep_pad: bool = False, keep_kernels: bool = False):
+               keep_pad: bool = False, keep_kernels: bool = False,
+               keep_residency: bool = False):
     """Return a Config with the plan's TRAINING knobs applied. `keep_*`
     leaves an explicitly user-set knob alone (CLI flag precedence)."""
     model_kw: dict = {}
@@ -435,8 +454,14 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
         if model_kw else config.model
     train = config.train if keep_days_per_step else dataclasses.replace(
         config.train, days_per_step=plan.days_per_step)
-    data = config.data if keep_pad else dataclasses.replace(
-        config.data, max_stocks=plan.pad_target)
+    data_kw: dict = {}
+    if not keep_pad:
+        data_kw["max_stocks"] = plan.pad_target
+    if not keep_residency:
+        data_kw["panel_residency"] = plan.panel_residency
+        data_kw["stream_chunk_days"] = plan.stream_chunk_days
+    data = dataclasses.replace(config.data, **data_kw) \
+        if data_kw else config.data
     return dataclasses.replace(config, model=model, train=train, data=data)
 
 

@@ -7,6 +7,12 @@ checkpoint carries the complete `TrainState` (params, optimizer state,
 step, threaded PRNG key) plus a JSON metadata blob (epoch, best-val,
 config), making resume deterministic: a run killed at epoch k continues
 exactly as if it had never died.
+
+Saves are ASYNC by default: serialization overlaps the next epoch's
+compute and the barrier lives on the read side (restore/steps/close) —
+see `Checkpointer`. Crash semantics are unchanged because orbax commits
+step directories atomically: a kill mid-save is a lost step, never a
+corrupt one.
 """
 
 from __future__ import annotations
@@ -20,18 +26,61 @@ import orbax.checkpoint as ocp
 from factorvae_tpu.train.state import TrainState
 
 
+def _own_buffers(tree):
+    """Deep-copy restored leaves into XLA-owned buffers. On CPU,
+    jax.device_put of an aligned numpy array is ZERO-COPY: the restored
+    jax.Array aliases host memory that orbax's restore machinery still
+    owns. The training jits then DONATE that state (donate_argnums), so
+    XLA reuses/frees a buffer numpy still references — the observed
+    resume-then-train corruption (NaN epoch losses, at-exit/mid-epoch
+    SIGSEGV on the CPU sandbox). A fresh copy severs the alias."""
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
+
+
 class Checkpointer:
-    def __init__(self, directory: str, keep: int = 3):
+    """Full-state checkpoint manager, ASYNC by default.
+
+    ``save()`` snapshots the state to host synchronously (orbax copies
+    device buffers before returning, so the caller may immediately
+    donate/overwrite them) and serializes to disk on a background
+    thread — the epoch loop never blocks on checkpoint I/O. The barrier
+    moves to the READ side: ``latest_step``/``all_steps``/``restore``
+    first drain any in-flight save, and ``close()`` finalizes. A kill
+    mid-save loses only the uncommitted step: orbax commits a step
+    directory atomically on finalize, so readers (including the fleet's
+    group-resume max-common-step scan) only ever see COMPLETE steps
+    (tested: tests/test_stream.py kill-between-saves).
+
+    ``async_save=False`` restores the old blocking behavior
+    (TrainConfig.async_checkpointing wires it through the trainers).
+    """
+
+    def __init__(self, directory: str, keep: int = 3,
+                 async_save: bool = True):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep, create=True, enable_async_checkpointing=False
+                max_to_keep=keep, create=True,
+                enable_async_checkpointing=async_save,
             ),
         )
+        self._async = async_save
 
     def save(self, step: int, state: TrainState, meta: dict) -> None:
+        if self._async:
+            # Snapshot to OWNED host buffers before handing orbax the
+            # tree: its background writer would otherwise hold zero-copy
+            # views of CPU jax arrays that the next epoch's jit donates
+            # (the same alias class the restore-side _own_buffers
+            # severs). One host memcpy up front; serialization and disk
+            # I/O then overlap the next epoch freely.
+            import numpy as np
+
+            state = jax.tree.map(lambda x: np.array(x), state)
         self._mgr.save(
             step,
             args=ocp.args.Composite(
@@ -39,14 +88,22 @@ class Checkpointer:
                 meta=ocp.args.JsonSave(meta),
             ),
         )
+        if not self._async:
+            self._mgr.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
+        """Drain any in-flight async save (the moved barrier)."""
         self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
     def all_steps(self) -> list:
-        """Every retained step, ascending (the fleet group-resume picks
-        the max step common to all members, train/fleet.py)."""
+        """Every retained COMPLETE step, ascending (the fleet
+        group-resume picks the max step common to all members,
+        train/fleet.py)."""
+        self._mgr.wait_until_finished()
         return sorted(self._mgr.all_steps())
 
     def restore(
@@ -54,6 +111,7 @@ class Checkpointer:
     ) -> Tuple[TrainState, dict]:
         """`template` supplies the pytree structure/shapes (an abstract
         eval_shape of the state works)."""
+        self._mgr.wait_until_finished()
         step = self._mgr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
@@ -65,7 +123,7 @@ class Checkpointer:
                 meta=ocp.args.JsonRestore(),
             ),
         )
-        return out["state"], out["meta"]
+        return _own_buffers(out["state"]), out["meta"]
 
     def close(self):
         self._mgr.close()

@@ -49,7 +49,7 @@ from factorvae_tpu.config import Config
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.models.factorvae import day_forward
 from factorvae_tpu.train.checkpoint import Checkpointer, save_params
-from factorvae_tpu.train.loop import make_step_fns
+from factorvae_tpu.train.loop import concat_auxes, make_step_fns
 from factorvae_tpu.train.state import (
     TrainState,
     create_train_state,
@@ -128,6 +128,13 @@ class FleetTrainer:
         self.steps_per_epoch = -(-len(self.train_days) // self.batch_days)
         self.total_steps = self.steps_per_epoch * config.train.num_epochs
 
+        # Streaming residency (plan.panel_residency="stream"): per-seed
+        # mini-panels ride one prefetched chunk stream; the vmapped
+        # chunk fns consume them through the same device gather.
+        self.stream = getattr(dataset, "residency", "hbm") == "stream"
+        self.steps_per_chunk = max(
+            1, config.data.stream_chunk_days // self.batch_days)
+
         self.model = day_forward(config.model, train=True)
         self.model_eval = day_forward(config.model, train=False)
         self._build_step_fns()
@@ -159,6 +166,12 @@ class FleetTrainer:
             self._train_epoch_jit = jax.jit(
                 self.fns.train_epoch, donate_argnums=(0,))
             self._eval_epoch_jit = jax.jit(self.fns.eval_epoch)
+            if self.stream:
+                self._train_chunk_jit = jax.jit(
+                    self.fns.train_chunk, donate_argnums=(0,))
+                self._eval_chunk_jit = jax.jit(self.fns.eval_chunk)
+                self._finalize_train_jit = jax.jit(self.fns.finalize_train)
+                self._finalize_eval_jit = jax.jit(self.fns.finalize_eval)
         else:
             # Panel broadcast (in_axes=None): ONE HBM copy serves every
             # seed; state and day orders carry the seed axis.
@@ -171,6 +184,22 @@ class FleetTrainer:
             self._eval_epoch_jit = jax.jit(
                 jax.vmap(self.fns.eval_epoch, in_axes=(0, None, 0, None))
             )
+            if self.stream:
+                # Train mini-panels are PER-SEED (each seed shuffles its
+                # own day order, so its chunk gathers different slabs);
+                # the shared validation order keeps one broadcast panel.
+                self._train_chunk_jit = jax.jit(
+                    jax.vmap(self.fns.train_chunk, in_axes=(0, 0, 0)),
+                    donate_argnums=(0,),
+                )
+                self._eval_chunk_jit = jax.jit(
+                    jax.vmap(self.fns.eval_chunk,
+                             in_axes=(0, None, 0, None))
+                )
+                self._finalize_train_jit = jax.jit(
+                    jax.vmap(self.fns.finalize_train))
+                self._finalize_eval_jit = jax.jit(
+                    jax.vmap(self.fns.finalize_eval))
 
     def panel_args(self):
         return (self.ds.values, self.ds.last_valid, self.ds.next_valid)
@@ -255,6 +284,8 @@ class FleetTrainer:
 
     def _run_train_epoch(self, run_state, epoch):
         orders = self._epoch_orders(epoch)
+        if self.stream:
+            return self._stream_train_epoch(run_state, orders)
         if self.num_seeds == 1:
             st, m = self._train_epoch_jit(
                 run_state, orders[0], self.panel_args())
@@ -263,12 +294,85 @@ class FleetTrainer:
 
     def _run_eval_epoch(self, run_params, val_order, epoch):
         keys = self._eval_keys(epoch)
+        if self.stream:
+            return self._stream_eval_epoch(run_params, val_order, keys)
         if self.num_seeds == 1:
             m = self._eval_epoch_jit(
                 run_params, val_order, keys[0], self.panel_args())
             return {k: v[None] for k, v in m.items()}
         return self._eval_epoch_jit(run_params, val_order, keys,
                                     self.panel_args())
+
+    # ---- streaming residency -----------------------------------------
+
+    def _stream_train_epoch(self, run_state, orders):
+        """Chunked stream fleet epoch: per-seed mini-panels (each seed's
+        shuffled order gathers different slabs) stacked into one
+        prefetched chunk, consumed by the vmapped chunk scan. S=1 runs
+        the serial chunk fns on the raw state — the bitwise oracle."""
+        from factorvae_tpu.data.stream import (
+            ChunkStream,
+            chunk_slices,
+            stream_epoch_batches,
+        )
+        from factorvae_tpu.data.windows import chunk_mini_panel
+
+        parts = []
+        if self.num_seeds == 1:
+            chunks = stream_epoch_batches(
+                self.ds, np.asarray(orders[0]), self.steps_per_chunk)
+            for order_local, panel_chunk in chunks:
+                run_state, aux = self._train_chunk_jit(
+                    run_state, order_local, panel_chunk)
+                parts.append(aux)
+            self.last_stream_stats = chunks
+            m = self._finalize_train_jit(concat_auxes(parts))
+            return run_state, {k: v[None] for k, v in m.items()}
+
+        orders_np = np.asarray(orders, np.int32)   # (S, steps, B)
+        s, steps, b = orders_np.shape
+        slices = chunk_slices(steps, self.steps_per_chunk)
+
+        def make_chunk(i):
+            lo, hi = slices[i]
+            rows = [chunk_mini_panel(
+                self.ds.values_np, self.ds.last_valid_np,
+                self.ds.next_valid_np, orders_np[j, lo:hi].reshape(-1),
+                self.ds.seq_len) for j in range(s)]
+            order_local = np.stack(
+                [r[0].reshape(hi - lo, b) for r in rows])
+            panel = tuple(np.stack([r[k] for r in rows])
+                          for k in (1, 2, 3))
+            return order_local, panel
+
+        chunks = ChunkStream(make_chunk, len(slices))
+        for order_local, panel_chunk in chunks:
+            run_state, aux = self._train_chunk_jit(
+                run_state, order_local, panel_chunk)
+            parts.append(aux)
+        self.last_stream_stats = chunks
+        return run_state, self._finalize_train_jit(
+            concat_auxes(parts, 1))
+
+    def _stream_eval_epoch(self, run_params, val_order, keys):
+        """Shared validation order -> ONE broadcast mini-panel per chunk;
+        keys thread across chunks per seed, preserving the whole-epoch
+        key stream."""
+        from factorvae_tpu.data.stream import stream_epoch_batches
+
+        serial = self.num_seeds == 1
+        chunks = stream_epoch_batches(
+            self.ds, np.asarray(val_order), self.steps_per_chunk)
+        key = keys[0] if serial else keys
+        parts = []
+        for order_local, panel_chunk in chunks:
+            key, aux = self._eval_chunk_jit(
+                run_params, order_local, key, panel_chunk)
+            parts.append(aux)
+        if serial:
+            m = self._finalize_eval_jit(concat_auxes(parts))
+            return {k: v[None] for k, v in m.items()}
+        return self._finalize_eval_jit(concat_auxes(parts, 1))
 
     # ------------------------------------------------------------------
 
@@ -387,6 +491,9 @@ class FleetTrainer:
                 self._save_checkpoints(self._stacked(run_state), epoch,
                                        best_val_np)
 
+        # Finalize any in-flight async checkpoint saves (the barrier the
+        # per-epoch loop no longer pays).
+        self._close_checkpointers()
         best_val_np = np.asarray(best_val)
         self.logger.log(
             "fleet_best",
@@ -499,23 +606,41 @@ class FleetTrainer:
                 rows.append(jax.tree.map(jnp.copy, template))
         return stack_states(rows)
 
+    def _seed_checkpointer(self, seed: int) -> Checkpointer:
+        """Per-seed Checkpointer, cached for the life of this trainer so
+        ASYNC saves (checkpoint.py) actually overlap the next epoch —
+        open/close per save would re-impose the barrier at close()."""
+        if not hasattr(self, "_ckpts"):
+            self._ckpts = {}
+        if seed not in self._ckpts:
+            cfg_s = self.seed_config(seed)
+            self._ckpts[seed] = Checkpointer(
+                f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt",
+                keep=cfg_s.train.keep_checkpoints,
+                async_save=cfg_s.train.async_checkpointing,
+            )
+        return self._ckpts[seed]
+
+    def _close_checkpointers(self) -> None:
+        for ckpt in getattr(self, "_ckpts", {}).values():
+            ckpt.close()
+        self._ckpts = {}
+
     def _save_checkpoints(self, fleet_state, epoch: int,
                           best_val: np.ndarray) -> None:
         """Lockstep full-state checkpoint per seed (every
         `checkpoint_every` epochs + the final one), format-compatible
         with the serial Checkpointer layout so a serial `Trainer` resume
         can continue any fleet member — and `fit(resume=True)` can
-        restore the whole group."""
+        restore the whole group. Saves are async: a kill mid-way leaves
+        members at MOST one complete epoch apart (uncommitted steps are
+        invisible to readers), exactly the case the group-resume
+        max-common-step rule rewinds over."""
         for i, seed in enumerate(self.seeds):
             cfg_s = self.seed_config(seed)
-            ckpt = Checkpointer(
-                f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt",
-                keep=cfg_s.train.keep_checkpoints,
-            )
-            ckpt.save(
+            self._seed_checkpointer(seed).save(
                 epoch,
                 unstack_state(fleet_state, i),
                 {"epoch": epoch, "best_val": float(best_val[i]),
                  "config": cfg_s.to_dict()},
             )
-            ckpt.close()

@@ -38,11 +38,30 @@ from factorvae_tpu.data.windows import gather_day
 from factorvae_tpu.train.state import TrainState
 
 
+def concat_auxes(parts, axis: int = 0):
+    """Per-chunk (k, ...) aux stacks -> one (steps, ...) epoch stack
+    (device concat: no host sync inside the epoch loop). `axis=1` for
+    fleet auxes carrying a leading seed axis."""
+    if len(parts) == 1:
+        return parts[0]
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=axis), *parts)
+
+
 class StepFns(NamedTuple):
     train_step: Callable        # (state, days, panel) -> (state, aux)
     train_epoch: Callable       # (state, order (S,B), panel) -> (state, metrics)
     eval_epoch: Callable        # (params, order (S,B), key, panel) -> metrics
     batch_for: Callable         # (days (B,), panel) -> (x, y, mask)
+    # Streaming-residency chunk fns (plan.panel_residency="stream"): the
+    # SAME scan bodies over a (k, B) slice of the epoch order, fed a
+    # per-chunk mini-panel (data/stream.py) instead of the full HBM
+    # panel. Per-step aux comes back un-reduced so the epoch metrics can
+    # be finalized over the full step axis exactly like the whole-epoch
+    # scan does.
+    train_chunk: Callable       # (state, order (k,B), panel) -> (state, auxes)
+    eval_chunk: Callable        # (params, order (k,B), key, panel) -> (key, auxes)
+    finalize_train: Callable    # (auxes (steps,)) -> metrics
+    finalize_eval: Callable     # (auxes (steps,)) -> metrics
 
 
 def make_step_fns(
@@ -117,31 +136,20 @@ def make_step_fns(
         )
         return state, aux
 
-    def train_epoch(state: TrainState, order: jnp.ndarray, panel):
-        """order: (S, B) int32 day indices (-1 = pad)."""
-        def body(st, days):
-            st, aux = train_step(st, days, panel)
-            return st, aux
-
-        state, auxes = jax.lax.scan(body, state, order)
+    def finalize_train(auxes):
+        """Per-step aux (steps,) -> epoch metrics. ONE definition shared
+        by the whole-epoch scan (inside its jit) and the stream path
+        (jitted over the chunk-concatenated aux): the metric reduction
+        over the full step axis is identical either way."""
         days = jnp.maximum(jnp.sum(auxes["days"]), 1.0)
-        metrics = {
+        return {
             "loss": jnp.sum(auxes["loss_sum"]) / days,
             "recon": jnp.sum(auxes["recon_sum"]) / days,
             "kl": jnp.sum(auxes["kl_sum"]) / days,
             "days": jnp.sum(auxes["days"]),
         }
-        return state, metrics
 
-    def eval_epoch(params, order: jnp.ndarray, key: jax.Array, panel):
-        """Validation mean loss (reference validate(), train_model.py:40-60:
-        dropout off, reconstruction still sampled)."""
-        def body(k, days):
-            k, sub = jax.random.split(k)
-            _, aux = weighted_day_loss(params, days, sub, panel, False)
-            return k, aux
-
-        _, auxes = jax.lax.scan(body, key, order)
+    def finalize_eval(auxes):
         days = jnp.maximum(jnp.sum(auxes["days"]), 1.0)
         return {
             "loss": jnp.sum(auxes["loss_sum"]) / days,
@@ -154,9 +162,52 @@ def make_step_fns(
             / jnp.maximum(jnp.sum(auxes["samples"]), 1.0),
         }
 
+    def train_chunk(state: TrainState, order: jnp.ndarray, panel):
+        """One epoch SEGMENT: the epoch scan body over a (k, B) slice of
+        the step order, returning the UN-reduced per-step aux so the
+        caller can finalize over the whole epoch. The stream path runs
+        this over per-chunk mini-panels (data/windows.chunk_mini_panel)
+        whose gather resolves to the same values as the full panel's —
+        the traced graph is IDENTICAL to the whole-epoch scan's body, so
+        per-step updates stay bitwise (pre-gathered batches as jit
+        inputs were measured to perturb XLA's backward fusion by ~1 ulp;
+        keeping the gather in-graph is what makes stream == hbm exact).
+        """
+        def body(st, days):
+            st, aux = train_step(st, days, panel)
+            return st, aux
+
+        return jax.lax.scan(body, state, order)
+
+    def train_epoch(state: TrainState, order: jnp.ndarray, panel):
+        """order: (S, B) int32 day indices (-1 = pad)."""
+        state, auxes = train_chunk(state, order, panel)
+        return state, finalize_train(auxes)
+
+    def eval_chunk(params, order: jnp.ndarray, key: jax.Array, panel):
+        """Eval epoch segment. The key threads ACROSS chunks (returned
+        with the aux), so the concatenated per-step key stream is
+        exactly the whole-epoch scan's."""
+        def body(k, days):
+            k, sub = jax.random.split(k)
+            _, aux = weighted_day_loss(params, days, sub, panel, False)
+            return k, aux
+
+        return jax.lax.scan(body, key, order)
+
+    def eval_epoch(params, order: jnp.ndarray, key: jax.Array, panel):
+        """Validation mean loss (reference validate(), train_model.py:40-60:
+        dropout off, reconstruction still sampled)."""
+        _, auxes = eval_chunk(params, order, key, panel)
+        return finalize_eval(auxes)
+
     return StepFns(
         train_step=train_step,
         train_epoch=train_epoch,
         eval_epoch=eval_epoch,
         batch_for=batch_for,
+        train_chunk=train_chunk,
+        eval_chunk=eval_chunk,
+        finalize_train=finalize_train,
+        finalize_eval=finalize_eval,
     )

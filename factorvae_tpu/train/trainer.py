@@ -21,6 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from factorvae_tpu.config import Config
 from factorvae_tpu.data.loader import PanelDataset
@@ -34,7 +35,7 @@ from factorvae_tpu.parallel.sharding import (
     shard_dataset,
 )
 from factorvae_tpu.train.checkpoint import Checkpointer, save_params
-from factorvae_tpu.train.loop import make_step_fns
+from factorvae_tpu.train.loop import concat_auxes, make_step_fns
 from factorvae_tpu.train.state import (
     TrainState,
     create_train_state,
@@ -70,10 +71,22 @@ class Trainer:
         self.steps_per_epoch = -(-len(self.train_days) // self.batch_days)
         self.total_steps = self.steps_per_epoch * config.train.num_epochs
 
+        # Streaming residency (plan.panel_residency="stream"): the panel
+        # is host-resident and epochs consume double-buffered prefetched
+        # chunks (data/stream.py) — bitwise the HBM epochs.
+        self.stream = getattr(dataset, "residency", "hbm") == "stream"
+        self.steps_per_chunk = max(
+            1, config.data.stream_chunk_days // self.batch_days)
+
         # mesh (optional; single device works without one)
         self.mesh = mesh if mesh is not None else (
             make_mesh(config.mesh) if use_mesh else None
         )
+        if self.stream and self.mesh is not None:
+            raise ValueError(
+                "panel_residency='stream' does not compose with a device "
+                "mesh (the sharded path needs the panel in HBM to shard "
+                "it); use residency='hbm' or drop the mesh")
         shard_batch = None
         if self.mesh is not None:
             dp = data_parallel_size(self.mesh)
@@ -142,6 +155,15 @@ class Trainer:
             self._train_epoch_jit = jax.jit(
                 self.fns.train_epoch, donate_argnums=donate)
             self._eval_epoch_jit = jax.jit(self.fns.eval_epoch)
+        if self.stream:
+            # Chunked stream-epoch programs: the same step bodies scanned
+            # over prefetched batches + the shared metric finalizers
+            # (train/loop.py docstrings spell out the bitwise contract).
+            self._train_chunk_jit = jax.jit(
+                self.fns.train_chunk, donate_argnums=donate)
+            self._eval_chunk_jit = jax.jit(self.fns.eval_chunk)
+            self._finalize_train_jit = jax.jit(self.fns.finalize_train)
+            self._finalize_eval_jit = jax.jit(self.fns.finalize_eval)
 
     def panel_args(self):
         """The HBM panel as explicit jit arguments (loop.py: passing these
@@ -163,6 +185,8 @@ class Trainer:
         )
 
     def _train_epoch(self, state, order):
+        if self.stream:
+            return self._train_epoch_stream(state, order)
         if self.mesh is not None:
             state = self._globalize(state, replicated(self.mesh))
             order = self._globalize(
@@ -170,12 +194,46 @@ class Trainer:
         return self._train_epoch_jit(state, order, self.panel_args())
 
     def _eval_epoch(self, params, order, key):
+        if self.stream:
+            return self._eval_epoch_stream(params, order, key)
         if self.mesh is not None:
             params = self._globalize(params, replicated(self.mesh))
             key = self._globalize(key, replicated(self.mesh))
             order = self._globalize(
                 jnp.asarray(order), order_sharding(self.mesh))
         return self._eval_epoch_jit(params, order, key, self.panel_args())
+
+    # ---- streaming residency -----------------------------------------
+
+    def _train_epoch_stream(self, state, order):
+        """Chunked stream epoch: the prefetcher gathers + device_puts
+        chunk k+1 on a worker thread while the jitted scan consumes
+        chunk k. Step order, RNG stream, updates and the metric
+        reduction are exactly the whole-epoch scan's (bitwise; pinned
+        in tests/test_stream.py)."""
+        from factorvae_tpu.data.stream import stream_epoch_batches
+
+        chunks = stream_epoch_batches(
+            self.ds, np.asarray(order), self.steps_per_chunk)
+        parts = []
+        for order_local, panel_chunk in chunks:
+            state, aux = self._train_chunk_jit(state, order_local,
+                                               panel_chunk)
+            parts.append(aux)
+        self.last_stream_stats = chunks
+        return state, self._finalize_train_jit(concat_auxes(parts))
+
+    def _eval_epoch_stream(self, params, order, key):
+        from factorvae_tpu.data.stream import stream_epoch_batches
+
+        chunks = stream_epoch_batches(
+            self.ds, np.asarray(order), self.steps_per_chunk)
+        parts = []
+        for order_local, panel_chunk in chunks:
+            key, aux = self._eval_chunk_jit(params, order_local, key,
+                                            panel_chunk)
+            parts.append(aux)
+        return self._finalize_eval_jit(concat_auxes(parts))
 
     # ------------------------------------------------------------------
 
@@ -255,6 +313,7 @@ class Trainer:
             ckpt = Checkpointer(
                 f"{cfg.train.save_dir}/{cfg.checkpoint_name()}_ckpt",
                 keep=cfg.train.keep_checkpoints,
+                async_save=cfg.train.async_checkpointing,
             )
         if state is None:
             state = self.init_state()

@@ -28,12 +28,19 @@ a written knob never regresses a multi-seed workload below the serial
 path. Rows without a `fleet` block (every pre-fleet table) keep
 resolving exactly as before: `plan_for` defaults them to serial.
 
+`--stream` races the panel residency (HBM vs the out-of-core stream
+path at several chunk sizes, data/stream.py) on the winning train knobs
+and persists the winner as the row's `stream` block
+(`Plan.panel_residency` / `Plan.stream_chunk_days`); HBM is always in
+the raced set, and rows without the block keep resolving to HBM.
+
 Usage:
     python scripts/autotune_plan.py                       # flagship shape
     python scripts/autotune_plan.py --config csi300-k60
     python scripts/autotune_plan.py --all                 # every preset shape
     python scripts/autotune_plan.py --all --days 4 --reps 1   # quickest
     python scripts/autotune_plan.py --fleet               # + fleet knob race
+    python scripts/autotune_plan.py --stream              # + residency race
         [--out PLAN_TABLE.json] [--dry_run]
 """
 
@@ -81,9 +88,15 @@ SCORE_CANDIDATES = [{"flatten_days": f} for f in (False, True)]
 # train knobs (train/fleet.py). S=1 is the serial path itself, so the
 # persisted winner can never be slower than what the fallback runs.
 FLEET_CANDIDATES = [1, 2, 4, 8]
+# --stream: panel-residency race on the winning train knobs — HBM vs
+# the out-of-core stream path at several chunk sizes (days per
+# host->device transfer, data/stream.py). HBM is always in the raced
+# set, so a persisted row can never regress an in-memory workload.
+STREAM_CHUNK_CANDIDATES = [16, 32, 64]
 
 
-def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int):
+def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int,
+           residency: str = "hbm", chunk_days: int = 32):
     from factorvae_tpu.config import (
         Config, DataConfig, ModelConfig, TrainConfig,
     )
@@ -99,7 +112,8 @@ def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int):
         ),
         data=DataConfig(seq_len=shape["seq_len"], start_time=None,
                         fit_end_time=None, val_start_time=None,
-                        val_end_time=None),
+                        val_end_time=None, panel_residency=residency,
+                        stream_chunk_days=chunk_days),
         train=TrainConfig(num_epochs=1, days_per_step=dps, seed=0,
                           checkpoint_every=0,
                           save_dir="/tmp/factorvae_autotune"),
@@ -108,7 +122,8 @@ def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int):
         num_days=days, num_instruments=shape["stocks"],
         num_features=shape["features"])
     ds = PanelDataset(panel, seq_len=shape["seq_len"],
-                      max_stocks=pad_target_policy(shape["stocks"]))
+                      max_stocks=pad_target_policy(shape["stocks"]),
+                      residency=residency)
     return cfg, ds
 
 
@@ -185,6 +200,59 @@ def time_fleet(shape: dict, train_knobs: dict, num_seeds: int,
     return reps * days * shape["stocks"] * num_seeds / dt
 
 
+def time_stream(shape: dict, train_knobs: dict, residency: str,
+                chunk_days: int, days: int, reps: int) -> float:
+    """Seconds per trained day for one residency candidate on the
+    winning train knobs (compile excluded)."""
+    import jax
+
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, train_knobs["compute_dtype"],
+                     train_knobs["flatten_days"],
+                     train_knobs["days_per_step"], days,
+                     residency=residency, chunk_days=chunk_days)
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+    state, m = trainer._train_epoch(state, trainer._epoch_orders(0))  # warmup
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    for e in range(1, 1 + reps):
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(e))
+    jax.block_until_ready(m["loss"])
+    return (time.time() - t0) / (reps * days)
+
+
+def race_stream(name: str, shape: dict, train_knobs: dict,
+                days: int, reps: int) -> dict:
+    """Race panel residency (hbm vs stream x chunk sizes); return the
+    row's `stream` block (winner + every candidate timing for audit)."""
+    measured = {}
+    candidates = [("hbm", 0)] + [("stream", c)
+                                 for c in STREAM_CHUNK_CANDIDATES]
+    best, best_sec = ("hbm", 0), None
+    for residency, chunk in candidates:
+        sec = time_stream(shape, train_knobs, residency, chunk or 32,
+                          days, reps)
+        key = residency if residency == "hbm" else f"stream_c{chunk}"
+        measured[key] = round(sec, 5)
+        print(f"[autotune] {name} residency {key}: {sec:.4f} s/day",
+              file=sys.stderr)
+        if best_sec is None or sec < best_sec:
+            best, best_sec = (residency, chunk), sec
+    return {
+        "panel_residency": best[0],
+        "chunk_days": best[1] or 32,
+        "measured": measured,
+        "source": f"residency race on {train_knobs['compute_dtype']} "
+                  f"flat={int(train_knobs['flatten_days'])} "
+                  f"dps{train_knobs['days_per_step']}: best "
+                  f"{best[0]}{f' c{best[1]}' if best[0] == 'stream' else ''}"
+                  f" at {best_sec:.4f} s/day",
+    }
+
+
 def race_fleet(name: str, shape: dict, train_knobs: dict,
                days: int, reps: int) -> dict:
     """Race `seeds_per_program` over FLEET_CANDIDATES; return the row's
@@ -209,7 +277,7 @@ def race_fleet(name: str, shape: dict, train_knobs: dict,
 
 
 def race_shape(name: str, shape: dict, days: int, reps: int,
-               fleet: bool = False) -> dict:
+               fleet: bool = False, stream: bool = False) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
     plan-table row."""
@@ -247,6 +315,9 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
     fleet_block = None
     if fleet:
         fleet_block = race_fleet(name, shape, best_train_key, days, reps)
+    stream_block = None
+    if stream:
+        stream_block = race_stream(name, shape, best_train_key, days, reps)
 
     shp = ShapeKey(
         num_features=shape["features"], seq_len=shape["seq_len"],
@@ -254,6 +325,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         num_portfolios=shape["portfolios"], n_stocks=shape["stocks"])
     if fleet_block is not None:
         measured["fleet"] = fleet_block.pop("measured")
+    if stream_block is not None:
+        measured["stream"] = stream_block.pop("measured")
     row = {
         "platform": plat,
         "shape": {"c": shp.num_features, "t": shp.seq_len,
@@ -273,11 +346,15 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         row["fleet"] = {"seeds_per_program":
                         fleet_block["seeds_per_program"]}
         row["source"] += f"; {fleet_block['source']}"
+    if stream_block is not None:
+        row["stream"] = {"panel_residency": stream_block["panel_residency"],
+                         "chunk_days": stream_block["chunk_days"]}
+        row["source"] += f"; {stream_block['source']}"
     return row
 
 
 def race_widths(name: str, shape: dict, days: int, reps: int,
-                fleet: bool = False) -> list:
+                fleet: bool = False, stream: bool = False) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
     envelope row — both bounds measured, no extrapolation beyond them
@@ -287,13 +364,13 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
     if not isinstance(widths, (list, tuple)):
         widths = [widths]
     rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
-                       fleet=fleet)
+                       fleet=fleet, stream=stream)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
         p = merged[-1]
-        if (r["train"], r["score"], r.get("fleet")) != (
-                p["train"], p["score"], p.get("fleet")):
+        if (r["train"], r["score"], r.get("fleet"), r.get("stream")) != (
+                p["train"], p["score"], p.get("fleet"), p.get("stream")):
             merged.append(r)
             continue
         if not any(k.startswith("n=") for k in p["measured"]):
@@ -329,6 +406,14 @@ def main() -> int:
                         "persisted on the row's 'fleet' block "
                         "(plan_for -> Plan.seeds_per_program; rows "
                         "without the block resolve to serial)")
+    p.add_argument("--stream", action="store_true",
+                   help="also race the panel residency (hbm vs the "
+                        "out-of-core stream path at chunk sizes "
+                        f"{STREAM_CHUNK_CANDIDATES}, data/stream.py) on "
+                        "each shape's winning train knobs; the winner is "
+                        "persisted on the row's 'stream' block (plan_for "
+                        "-> Plan.panel_residency/stream_chunk_days; rows "
+                        "without the block resolve to hbm)")
     p.add_argument("--dry_run", action="store_true",
                    help="race and print the rows without persisting")
     args = p.parse_args()
@@ -350,7 +435,7 @@ def main() -> int:
     names = sorted(SHAPES) if args.all else [args.config]
     rows = [r for n in names
             for r in race_widths(n, SHAPES[n], args.days, args.reps,
-                                 fleet=args.fleet)]
+                                 fleet=args.fleet, stream=args.stream)]
     print(json.dumps({"rows": rows}, indent=1))
     if args.dry_run:
         print("[autotune] --dry_run: table not written", file=sys.stderr)

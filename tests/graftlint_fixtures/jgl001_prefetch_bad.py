@@ -1,0 +1,23 @@
+"""JGL001 seeded violation: per-element `jax.device_put` in a host loop.
+
+One tiny host->device transfer per element — the transfer-granularity
+mirror of the per-element pull flavor. The corrected twin
+(jgl001_prefetch_good.py) ships chunk slices with one-chunk lookahead,
+the data/stream.py double-buffered prefetch idiom.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def consume(batch):
+    return jnp.sum(batch)
+
+
+def per_element_push(panel):
+    totals = []
+    for i in range(panel.shape[0]):
+        dev = jax.device_put(panel[i])   # JGL001: one transfer per element
+        totals.append(consume(dev))
+    return totals

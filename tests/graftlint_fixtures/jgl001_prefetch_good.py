@@ -1,0 +1,29 @@
+"""JGL001 corrected twin: chunk-granularity `jax.device_put` (slices,
+not elements) with one-chunk lookahead on a worker thread — the
+sanctioned double-buffered prefetch idiom (data/stream.py ChunkStream):
+the device consumes chunk k while the worker puts chunk k+1."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def consume(batch):
+    return jnp.sum(batch)
+
+
+def double_buffered(panel, chunk):
+    totals = []
+    n = panel.shape[0]
+    with ThreadPoolExecutor(max_workers=1) as ex:
+        def put(lo):
+            return jax.device_put(panel[lo:lo + chunk])  # one put per CHUNK
+
+        fut = ex.submit(put, 0)
+        for lo in range(0, n, chunk):
+            nxt = ex.submit(put, lo + chunk) if lo + chunk < n else None
+            totals.append(consume(fut.result()))
+            fut = nxt
+    return totals

@@ -38,6 +38,9 @@ def _rules(findings):
 RULE_FIXTURES = [
     # (rule, bad file, expected findings of that rule, good file)
     ("JGL001", "jgl001_bad.py", 4, "jgl001_good.py"),
+    # transfer-granularity flavor: per-element device_put in a host loop
+    # vs the sanctioned double-buffered chunk prefetch (data/stream.py)
+    ("JGL001", "jgl001_prefetch_bad.py", 1, "jgl001_prefetch_good.py"),
     ("JGL002", "jgl002_bad.py", 2, "jgl002_good.py"),
     ("JGL003", "jgl003_bad.py", 3, "jgl003_good.py"),
     # 3 = read-after in train(), loop re-pass, and the post-loop return

@@ -51,6 +51,29 @@ def test_bench_emits_single_json_line():
     assert rec["model_tflops_per_sec"] > 0
 
 
+def test_bench_stream_block_contract():
+    """BENCH_STREAM mode: the residency A/B payload carries both rates
+    and the transfer ledger, keeps the one-JSON-line contract, and
+    degrades cleanly on hosts with no real transfer gap (this CPU
+    sandbox): numbers reported, `no_transfer_gap` flagged — never a
+    crash or a speedup claim."""
+    rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_STREAM": "1",
+                "BENCH_STREAM_CHUNK": "4"})
+    assert REQUIRED_KEYS <= set(rec)
+    assert rec["metric"].startswith("stream_train_throughput_")
+    assert "_c4" in rec["metric"]
+    assert rec["unit"] == "windows/sec/chip"
+    assert rec["value"] == rec["stream_windows_per_sec"] > 0
+    assert rec["hbm_windows_per_sec"] > 0
+    assert rec["stream_vs_hbm"] > 0
+    assert rec["transfer_bytes"] > 0
+    assert rec["transfer_bytes_per_sec"] > 0
+    assert 0.0 <= rec["overlap_frac"] <= 1.0
+    assert rec["no_transfer_gap"] is True
+    assert rec["panel_bytes"] > 0
+    assert rec["plan"]["panel_residency"] in ("hbm", "stream")
+
+
 def test_bench_survives_backend_init_failure():
     # A bogus platform makes every probe attempt fail fast (the round-1
     # failure mode); the bench must fall back to pinned host CPU and emit

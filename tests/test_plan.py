@@ -310,3 +310,65 @@ class TestFleetKnob:
         p = plan_for(K60, "cpu", table=rows)
         assert p.provenance == "measured"
         assert p.seeds_per_program == 1
+
+
+class TestStreamKnob:
+    """panel_residency / stream_chunk_days (data/stream.py's planner
+    knobs): raced rows carry a 'stream' block; pre-stream rows (every
+    existing table) must keep resolving exactly as before — HBM."""
+
+    def test_stream_row_resolves_residency_and_chunk(self):
+        table = [row(stream={"panel_residency": "stream",
+                             "chunk_days": 64})]
+        p = plan_for(K60, "cpu", table=table)
+        assert p.provenance == "measured"
+        assert p.panel_residency == "stream"
+        assert p.stream_chunk_days == 64
+        d = p.describe(K60, platform="cpu")
+        assert d["panel_residency"] == "stream"
+        assert d["stream_chunk_days"] == 64
+
+    def test_pre_stream_row_defaults_to_hbm(self):
+        p = plan_for(K60, "cpu", table=[row()])
+        assert p.provenance == "measured"
+        assert p.panel_residency == "hbm"
+        assert p.stream_chunk_days == 32
+
+    def test_default_plan_is_hbm(self):
+        for plat in ("cpu", "tpu"):
+            p = plan_for(FLAGSHIP, plat, table=[])
+            assert p.panel_residency == "hbm"
+
+    def test_null_stream_block_tolerated(self):
+        assert plan_for(K60, "cpu",
+                        table=[row(stream=None)]).panel_residency == "hbm"
+        assert plan_for(K60, "cpu",
+                        table=[row(stream={})]).stream_chunk_days == 32
+
+    def test_apply_plan_sets_and_keeps_residency(self):
+        import dataclasses
+
+        from factorvae_tpu.config import Config
+
+        cfg = Config()
+        table = [row(stream={"panel_residency": "stream",
+                             "chunk_days": 16})]
+        p = plan_for(K60, "cpu", table=table)
+        applied = planlib.apply_plan(cfg, p)
+        assert applied.data.panel_residency == "stream"
+        assert applied.data.stream_chunk_days == 16
+        # explicit user residency wins
+        user = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data,
+                                          panel_residency="hbm"))
+        kept = planlib.apply_plan(user, p, keep_residency=True)
+        assert kept.data.panel_residency == "hbm"
+        assert kept.data.stream_chunk_days == 32
+
+    def test_stream_table_file_round_trip(self, tmp_path):
+        path = tmp_path / "table.json"
+        save_rows([row(stream={"panel_residency": "stream",
+                               "chunk_days": 16})], path=str(path))
+        p = plan_for(K60, "cpu", table=load_table(str(path)))
+        assert p.panel_residency == "stream"
+        assert p.stream_chunk_days == 16

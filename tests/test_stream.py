@@ -1,0 +1,454 @@
+"""Overlapped-pipeline contracts (PR 4):
+
+- stream ≡ hbm BITWISE: the out-of-core panel residency
+  (data/stream.py mini-panels + chunked epoch scans) reproduces the
+  HBM-resident whole-epoch scan bit-for-bit — serial Trainer params/
+  metrics, FleetTrainer at S=1 and S>1, and every scoring path.
+- The host gather twins (windows.gather_days_host /
+  windows.chunk_mini_panel) are bitwise the device gather.
+- Async checkpointing: identical artifacts to sync saves, resume stays
+  bitwise, and a kill between saves lands restore on the latest
+  COMPLETE step (orbax atomic commit).
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.data.stream import ChunkStream, chunk_slices
+from factorvae_tpu.data.windows import (
+    chunk_mini_panel,
+    gather_day,
+    gather_days_host,
+)
+from factorvae_tpu.train import FleetTrainer, Trainer
+from factorvae_tpu.train.checkpoint import Checkpointer
+from factorvae_tpu.utils.logging import MetricsLogger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(
+        num_days=20, num_instruments=6, num_features=8, missing_prob=0.2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def ds_pair(panel):
+    return (PanelDataset(panel, seq_len=5),
+            PanelDataset(panel, seq_len=5, residency="stream"))
+
+
+def stream_config(save_dir, residency, ds, chunk_days=4, **train_kw):
+    defaults = dict(num_epochs=2, lr=1e-3, seed=0, save_dir=str(save_dir),
+                    checkpoint_every=0, days_per_step=2)
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5),
+        data=DataConfig(seq_len=5, start_time=None,
+                        fit_end_time=str(ds.dates[12].date()),
+                        val_start_time=str(ds.dates[13].date()),
+                        val_end_time=str(ds.dates[-1].date()),
+                        panel_residency=residency,
+                        stream_chunk_days=chunk_days),
+        train=TrainConfig(**defaults),
+    )
+
+
+def assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# host gather twins
+
+
+class TestHostGatherTwins:
+    def test_gather_days_host_bitwise(self, ds_pair):
+        ds_h, ds_s = ds_pair
+        days = np.array([0, 3, 7, 19, -1], np.int32)
+        safe = jnp.maximum(jnp.asarray(days), 0)
+        x_d, y_d, m_d = jax.vmap(
+            lambda d: gather_day(ds_h.values, ds_h.last_valid,
+                                 ds_h.next_valid, d, 5))(safe)
+        m_d = m_d & (jnp.asarray(days) >= 0)[:, None]
+        x_s, y_s, m_s, day_w = ds_s.gather_batch_host(days)
+        np.testing.assert_array_equal(np.asarray(x_d), x_s)
+        np.testing.assert_array_equal(np.asarray(y_d), y_s)
+        np.testing.assert_array_equal(np.asarray(m_d), m_s)
+        np.testing.assert_array_equal(day_w, [1, 1, 1, 1, 0])
+
+    def test_mini_panel_gather_bitwise(self, ds_pair):
+        """The relocatable mini-panel resolves the UNCHANGED device
+        gather to the same rows as the full panel — early days (window
+        clipping), missing data (ffill+bfill) and pads included."""
+        ds_h, ds_s = ds_pair
+        days = np.array([0, 1, 4, 19, 13, 2, -1, 7], np.int32)
+        ld, cv, clv, cnv = chunk_mini_panel(
+            ds_s.values_np, ds_s.last_valid_np, ds_s.next_valid_np, days, 5)
+        xh, yh, mh = jax.vmap(
+            lambda d: gather_day(ds_h.values, ds_h.last_valid,
+                                 ds_h.next_valid, d, 5)
+        )(jnp.maximum(jnp.asarray(days), 0))
+        xs, ys, ms = jax.vmap(
+            lambda d: gather_day(jnp.asarray(cv), jnp.asarray(clv),
+                                 jnp.asarray(cnv), d, 5)
+        )(jnp.maximum(jnp.asarray(ld), 0))
+        real = days >= 0
+        np.testing.assert_array_equal(np.asarray(xh)[real],
+                                      np.asarray(xs)[real])
+        np.testing.assert_array_equal(np.asarray(yh)[real],
+                                      np.asarray(ys)[real])
+        np.testing.assert_array_equal(np.asarray(mh)[real],
+                                      np.asarray(ms)[real])
+
+    def test_day_batch_stream_matches_hbm(self, ds_pair):
+        ds_h, ds_s = ds_pair
+        for d in (0, 7, 19):
+            xh, yh, mh = ds_h.day_batch(d)
+            xs, ys, ms = ds_s.day_batch(d)
+            np.testing.assert_array_equal(np.asarray(xh), np.asarray(xs))
+            np.testing.assert_array_equal(np.asarray(yh), np.asarray(ys))
+            np.testing.assert_array_equal(np.asarray(mh), np.asarray(ms))
+
+    def test_stream_dataset_has_no_device_panel(self, ds_pair):
+        _, ds_s = ds_pair
+        with pytest.raises(AttributeError, match="residency='stream'"):
+            ds_s.values
+        assert ds_s.panel_nbytes == ds_s.values_np.nbytes
+
+    def test_residency_validated(self, panel):
+        with pytest.raises(ValueError, match="residency"):
+            PanelDataset(panel, seq_len=5, residency="disk")
+
+
+# ---------------------------------------------------------------------------
+# chunk stream mechanics
+
+
+class TestChunkStream:
+    def test_order_stats_and_tail(self):
+        seen = []
+
+        def make(i):
+            seen.append(i)
+            return np.full((2,), i, np.float32)
+
+        cs = ChunkStream(make, 5)
+        out = [int(np.asarray(c)[0]) for c in cs]
+        assert out == [0, 1, 2, 3, 4]
+        assert seen == [0, 1, 2, 3, 4]
+        assert cs.bytes_put == 5 * 8
+        assert cs.produce_seconds > 0
+        assert 0.0 <= cs.overlap_frac <= 1.0
+
+    def test_chunk_slices(self):
+        assert chunk_slices(7, 3) == [(0, 3), (3, 6), (6, 7)]
+        assert chunk_slices(4, 8) == [(0, 4)]
+        with pytest.raises(ValueError):
+            chunk_slices(4, 0)
+
+    def test_empty_stream(self):
+        assert list(ChunkStream(lambda i: i, 0)) == []
+
+
+# ---------------------------------------------------------------------------
+# stream == hbm, serial trainer
+
+
+class TestSerialStreamOracle:
+    @pytest.fixture(scope="class")
+    def runs(self, ds_pair, tmp_path_factory):
+        ds_h, ds_s = ds_pair
+        tr_h = Trainer(stream_config(tmp_path_factory.mktemp("h"), "hbm",
+                                     ds_h), ds_h,
+                       logger=MetricsLogger(echo=False))
+        tr_s = Trainer(stream_config(tmp_path_factory.mktemp("s"), "stream",
+                                     ds_s), ds_s,
+                       logger=MetricsLogger(echo=False))
+        st_h, out_h = tr_h.fit()
+        st_s, out_s = tr_s.fit()
+        return tr_h, tr_s, st_h, out_h, st_s, out_s
+
+    def test_params_bitwise(self, runs):
+        _, _, st_h, _, st_s, _ = runs
+        assert_trees_bitwise(st_h.params, st_s.params)
+
+    def test_metric_history_bitwise(self, runs):
+        _, _, _, out_h, _, out_s = runs
+        for h, s in zip(out_h["history"], out_s["history"]):
+            for k in ("train_loss", "val_loss", "train_recon", "train_kl",
+                      "val_recon", "val_kl", "step", "lr"):
+                assert h[k] == s[k], (k, h[k], s[k])
+        assert out_h["best_val"] == out_s["best_val"]
+
+    def test_evaluate_bitwise(self, runs):
+        tr_h, tr_s, st_h, _, st_s, _ = runs
+        m_h = tr_h.evaluate(st_h.params)
+        m_s = tr_s.evaluate(st_s.params)
+        assert m_h == m_s
+
+    def test_stream_stats_recorded(self, runs):
+        _, tr_s, _, _, _, _ = runs
+        stats = tr_s.last_stream_stats
+        assert stats.bytes_put > 0
+        assert 0.0 <= stats.overlap_frac <= 1.0
+
+    def test_tail_chunk_not_padded(self, ds_pair, tmp_path):
+        """A chunk size that does not divide the epoch must not add SGD
+        steps (extra RNG advances would break the bitwise contract) —
+        the tail chunk is shorter instead."""
+        ds_h, ds_s = ds_pair
+        cfg_s = stream_config(tmp_path / "s", "stream", ds_s, chunk_days=10,
+                              days_per_step=3, num_epochs=1)
+        cfg_h = stream_config(tmp_path / "h", "hbm", ds_h, days_per_step=3,
+                              num_epochs=1)
+        tr_s = Trainer(cfg_s, ds_s, logger=MetricsLogger(echo=False))
+        tr_h = Trainer(cfg_h, ds_h, logger=MetricsLogger(echo=False))
+        st_s, _ = tr_s.fit()
+        st_h, _ = tr_h.fit()
+        assert int(st_s.step) == int(st_h.step) == tr_h.steps_per_epoch
+        assert_trees_bitwise(st_h.params, st_s.params)
+
+    def test_stream_rejects_mesh(self, ds_pair, tmp_path):
+        _, ds_s = ds_pair
+        from factorvae_tpu.parallel.mesh import make_mesh
+
+        cfg = stream_config(tmp_path, "stream", ds_s)
+        with pytest.raises(ValueError, match="stream"):
+            Trainer(cfg, ds_s, mesh=make_mesh(cfg.mesh),
+                    logger=MetricsLogger(echo=False))
+
+
+# ---------------------------------------------------------------------------
+# stream == hbm, fleet
+
+
+class TestFleetStreamOracle:
+    @pytest.mark.parametrize("num_seeds", [1, 2])
+    def test_fleet_stream_bitwise(self, ds_pair, tmp_path, num_seeds):
+        ds_h, ds_s = ds_pair
+        seeds = list(range(3, 3 + num_seeds))
+        runs = {}
+        for tag, res, ds in (("h", "hbm", ds_h), ("s", "stream", ds_s)):
+            cfg = stream_config(tmp_path / f"{tag}{num_seeds}", res, ds,
+                                num_epochs=3, days_per_step=1)
+            ft = FleetTrainer(cfg, ds, seeds=seeds,
+                              logger=MetricsLogger(echo=False))
+            runs[tag] = ft.fit()
+        (st_h, out_h), (st_s, out_s) = runs["h"], runs["s"]
+        assert_trees_bitwise(st_h.params, st_s.params)
+        assert_trees_bitwise(out_h["best_params"], out_s["best_params"])
+        np.testing.assert_array_equal(out_h["best_val"], out_s["best_val"])
+        for h, s in zip(out_h["history"], out_s["history"]):
+            assert h["train_loss"] == s["train_loss"]
+            assert h["val_loss"] == s["val_loss"]
+
+
+# ---------------------------------------------------------------------------
+# stream == hbm, scoring
+
+
+class TestStreamScoring:
+    @pytest.fixture(scope="class")
+    def params(self, ds_pair, tmp_path_factory):
+        ds_h, _ = ds_pair
+        cfg = stream_config(tmp_path_factory.mktemp("p"), "hbm", ds_h,
+                            num_epochs=1)
+        tr = Trainer(cfg, ds_h, logger=MetricsLogger(echo=False))
+        state, _ = tr.fit()
+        return cfg, state.params
+
+    @pytest.mark.parametrize("stochastic", [False, True])
+    def test_predict_panel_bitwise(self, ds_pair, params, stochastic):
+        from factorvae_tpu.eval.predict import predict_panel
+
+        ds_h, ds_s = ds_pair
+        cfg, p = params
+        days = ds_h.split_days(None, None)
+        a = predict_panel(p, cfg, ds_h, days, stochastic=stochastic, chunk=7)
+        b = predict_panel(p, cfg, ds_s, days, stochastic=stochastic, chunk=7)
+        np.testing.assert_array_equal(a, b)
+
+    def test_predict_fleet_bitwise(self, ds_pair, params):
+        from factorvae_tpu.eval.predict import predict_panel_fleet
+
+        ds_h, ds_s = ds_pair
+        cfg, p = params
+        stacked = jax.tree.map(lambda x: jnp.stack([x, x]), p)
+        days = ds_h.split_days(None, None)
+        a = predict_panel_fleet(stacked, cfg, ds_h, days, stochastic=True,
+                                chunk=7)
+        b = predict_panel_fleet(stacked, cfg, ds_s, days, stochastic=True,
+                                chunk=7)
+        np.testing.assert_array_equal(a, b)
+
+    def test_score_frames_equal(self, ds_pair, params):
+        from factorvae_tpu.eval.predict import generate_prediction_scores
+
+        ds_h, ds_s = ds_pair
+        cfg, p = params
+        a = generate_prediction_scores(p, cfg, ds_h, with_labels=True)
+        b = generate_prediction_scores(p, cfg, ds_s, with_labels=True)
+        assert a.equals(b)
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+
+
+class TestAsyncCheckpointing:
+    def _fit(self, ds, save_dir, async_ckpt, epochs=4, resume=False):
+        cfg = stream_config(save_dir, "hbm", ds, num_epochs=epochs,
+                            checkpoint_every=1,
+                            async_checkpointing=async_ckpt)
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        return cfg, tr.fit(resume=resume)
+
+    def test_async_matches_sync_bitwise(self, ds_pair, tmp_path):
+        """Async saves must change WHEN serialization happens, never
+        what lands on disk: final state and every retained checkpoint
+        restore bitwise-identical across the two modes."""
+        ds_h, _ = ds_pair
+        cfg_a, (st_a, _) = self._fit(ds_h, tmp_path / "a", True)
+        cfg_s, (st_s, _) = self._fit(ds_h, tmp_path / "s", False)
+        assert_trees_bitwise(st_a.params, st_s.params)
+        ck_a = Checkpointer(
+            f"{cfg_a.train.save_dir}/{cfg_a.checkpoint_name()}_ckpt")
+        ck_s = Checkpointer(
+            f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt")
+        assert ck_a.all_steps() == ck_s.all_steps()
+        for step in ck_a.all_steps():
+            sa, ma = ck_a.restore(st_a, step=step)
+            ss, ms = ck_s.restore(st_s, step=step)
+            assert_trees_bitwise(sa, ss)
+            assert ma["epoch"] == ms["epoch"]
+            assert ma["best_val"] == ms["best_val"]
+        ck_a.close()
+        ck_s.close()
+
+    def test_async_resume_bitwise(self, ds_pair, tmp_path):
+        """2 epochs + async-checkpoint resume of 2 == 4 unbroken epochs,
+        bit for bit (the moved barrier must not lose or corrupt the
+        state the resumed run restores)."""
+        ds_h, _ = ds_pair
+        _, (st_full, _) = self._fit(ds_h, tmp_path / "full", True)
+        cfg = stream_config(tmp_path / "half", "hbm", ds_h, num_epochs=4,
+                            checkpoint_every=1, async_checkpointing=True)
+        tr1 = Trainer(cfg, ds_h, logger=MetricsLogger(echo=False))
+        tr1.fit(num_epochs=2)
+        tr2 = Trainer(cfg, ds_h, logger=MetricsLogger(echo=False))
+        st_res, out = tr2.fit(resume=True)
+        assert [h["epoch"] for h in out["history"]] == [2, 3]
+        assert_trees_bitwise(st_full.params, st_res.params)
+
+    def test_save_is_nonblocking_then_barriered(self, ds_pair, tmp_path):
+        """The async contract: save() hands back control with the write
+        possibly in flight; the read-side barrier (all_steps/restore)
+        always sees a complete step."""
+        ds_h, _ = ds_pair
+        cfg = stream_config(tmp_path, "hbm", ds_h, num_epochs=1,
+                            checkpoint_every=1)
+        tr = Trainer(cfg, ds_h, logger=MetricsLogger(echo=False))
+        state = tr.init_state()
+        ck = Checkpointer(str(tmp_path / "ck"), async_save=True)
+        assert ck._async
+        ck.save(0, state, {"epoch": 0, "best_val": 1.0})
+        # immediately mutate the live state (the donation pattern the
+        # epoch loop applies) — the snapshot must be unaffected
+        state2 = tr._train_epoch(state, tr._epoch_orders(0))[0]
+        restored, meta = ck.restore(state2, step=0)
+        assert meta["epoch"] == 0
+        assert int(np.asarray(restored.step)) == 0
+        ck.close()
+
+
+@pytest.mark.slow
+class TestKillBetweenSaves:
+    def test_restore_lands_on_latest_complete_step(self, ds_pair, tmp_path):
+        """A process killed with an async save in flight must leave the
+        directory restorable at the newest COMMITTED step: the child
+        commits epochs 0..1, initiates a save of step 5 and hard-exits
+        without the barrier; whatever the parent then restores must
+        bitwise-match the deterministic recomputation of that step."""
+        ds_h, _ = ds_pair
+        child = f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+from factorvae_tpu.utils.testing import force_host_devices
+force_host_devices(1)
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.train import Trainer
+from factorvae_tpu.train.checkpoint import Checkpointer
+from factorvae_tpu.utils.logging import MetricsLogger
+panel = synthetic_panel(num_days=20, num_instruments=6, num_features=8,
+                        missing_prob=0.2, seed=0)
+ds = PanelDataset(panel, seq_len=5)
+cfg = Config(
+    model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                      num_portfolios=6, seq_len=5),
+    data=DataConfig(seq_len=5, start_time=None,
+                    fit_end_time=str(ds.dates[12].date()),
+                    val_start_time=str(ds.dates[13].date()),
+                    val_end_time=str(ds.dates[-1].date())),
+    train=TrainConfig(num_epochs=2, lr=1e-3, seed=0,
+                      save_dir={str(tmp_path / 'child')!r},
+                      checkpoint_every=1, days_per_step=2),
+)
+tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+state, _ = tr.fit()
+ck = Checkpointer({str(tmp_path / 'child')!r} + "/kill_ckpt",
+                  async_save=True)
+ck.save(4, state, dict(epoch=4, best_val=0.0))
+ck.wait_until_finished()            # step 4 is committed
+ck.save(5, state, dict(epoch=5, best_val=0.0))
+os._exit(0)   # hard kill with step 5 possibly in flight
+"""
+        r = subprocess.run([sys.executable, "-c", child],
+                           capture_output=True, text=True, timeout=600,
+                           env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        # recompute the deterministic reference state in-process
+        cfg = stream_config(tmp_path / "ref", "hbm", ds_h, num_epochs=2,
+                            checkpoint_every=1)
+        tr = Trainer(cfg, ds_h, logger=MetricsLogger(echo=False))
+        st_ref, _ = tr.fit()
+
+        ck = Checkpointer(str(tmp_path / "child" / "kill_ckpt"))
+        steps = ck.all_steps()
+        # step 4 committed before the kill; step 5 may or may not have —
+        # either way restore must land on a COMPLETE step that matches
+        # the deterministic recomputation bit for bit (both saved the
+        # same final state)
+        assert 4 in steps, steps
+        assert set(steps) <= {4, 5}
+        restored, meta = ck.restore(st_ref, step=steps[-1])
+        assert_trees_bitwise(restored.params, st_ref.params)
+        assert int(meta["epoch"]) == steps[-1]
+        ck.close()
+
+        # the fit's own checkpoints (epochs 0..1) committed normally
+        ck2 = Checkpointer(
+            str(tmp_path / "child") + f"/{cfg.checkpoint_name()}_ckpt")
+        assert ck2.all_steps() == [0, 1]
+        restored, meta = ck2.restore(st_ref, step=1)
+        assert meta["epoch"] == 1
+        ck2.close()
