@@ -133,6 +133,13 @@ FLEET_SEED_COUNTS = tuple(
 # the numbers are still reported, flagged `no_transfer_gap`.
 USE_STREAM = os.environ.get("BENCH_STREAM", "0") == "1"
 STREAM_CHUNK_DAYS = int(os.environ.get("BENCH_STREAM_CHUNK", 0))
+# Obs mode (`python bench.py --obs` or BENCH_OBS=1): A/B the on-device
+# health probes (obs/probes.py via TrainConfig.obs_probes) — train the
+# same workload with probes off and on at the same planner-resolved
+# knobs and report `probe_overhead_frac`, so the cost of watching is
+# itself a tracked number (the acceptance envelope is <= 5% windows/sec
+# on the flagship shape). Same robustness contract.
+USE_OBS = os.environ.get("BENCH_OBS", "0") == "1"
 
 
 def resolve_plan(platform: str):
@@ -227,6 +234,8 @@ def fail_metric() -> str:
         return "fleet_train_throughput_failed"
     if USE_STREAM or os.environ.get("BENCH_STREAM", "0") == "1":
         return "stream_train_throughput_failed"
+    if USE_OBS or os.environ.get("BENCH_OBS", "0") == "1":
+        return "obs_train_throughput_failed"
     return "train_throughput_flagship_K96_H64_Alpha158_failed"
 
 
@@ -337,7 +346,7 @@ def detect_platform() -> tuple[str, float | None]:
 
 
 def bench_setup(knobs, residency: str = "hbm", chunk_days: int = 32,
-                panel=None):
+                panel=None, obs: bool = False):
     """(cfg, ds) for a timed run — ONE construction of the bench Config,
     synthetic panel and dataset, shared by the headline, fleet and
     stream benches so their configurations can never silently diverge
@@ -363,6 +372,7 @@ def bench_setup(knobs, residency: str = "hbm", chunk_days: int = 32,
         train=TrainConfig(
             num_epochs=EPOCHS_TIMED, days_per_step=knobs["days_per_step"],
             seed=0, checkpoint_every=0, save_dir="/tmp/factorvae_bench",
+            obs_probes=obs,
         ),
     )
     if panel is None:
@@ -662,13 +672,82 @@ def run_stream_bench() -> dict:
     }
 
 
+def run_obs_bench() -> dict:
+    """Probe-overhead A/B (BENCH_OBS): train the same workload with the
+    on-device health probes compiled out (the default) and in
+    (TrainConfig.obs_probes), at the same planner-resolved knobs, and
+    report both rates plus `probe_overhead_frac` — the windows/sec the
+    probes cost. One JSON line, same terminal contract; `value` is the
+    PROBES-ON rate (the path under test)."""
+    import jax
+
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    from factorvae_tpu.data import synthetic_panel_dense
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    platform, _ = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    panel = synthetic_panel_dense(
+        num_days=NUM_DAYS, num_instruments=N_STOCKS,
+        num_features=NUM_FEATURES)
+
+    results = {}
+    for obs in (False, True):
+        cfg, ds = bench_setup(knobs, panel=panel, obs=obs)
+        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = trainer.init_state()
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(0))
+        jax.block_until_ready(m["loss"])
+        days_per_epoch = float(m["days"])
+        t0 = time.time()
+        for epoch in range(1, EPOCHS_TIMED + 1):
+            state, m = trainer._train_epoch(
+                state, trainer._epoch_orders(epoch))
+        jax.block_until_ready(m["loss"])
+        dt = time.time() - t0
+        results["on" if obs else "off"] = (
+            EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt)
+
+    overhead = 1.0 - results["on"] / max(results["off"], 1e-9)
+    use_pallas = knobs["pallas_attention"]
+    return {
+        "metric": (
+            f"obs_train_throughput_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+            f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}"
+            f"_dps{knobs['days_per_step']}_d{NUM_DAYS}e{EPOCHS_TIMED}"
+            + ("" if use_pallas == "auto" else
+               f"_pallas{int(bool(use_pallas))}")
+            + ("_bf16" if knobs["compute_dtype"] == "bfloat16" else "")
+            + ("" if knobs["flatten_days"] else "_per_day_vmap")
+            + ("_cpu_fallback" if FORCED_CPU else "")),
+        "value": round(results["on"], 1),
+        "unit": "windows/sec/chip",
+        "vs_baseline": round(results["on"] / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        "windows_per_sec_obs_off": round(results["off"], 1),
+        "windows_per_sec_obs_on": round(results["on"], 1),
+        # negative values are same-run timing noise (the probes cannot
+        # speed training up); reported as measured, not clamped.
+        "probe_overhead_frac": round(overhead, 4),
+        "probe_overhead_ok": overhead <= 0.05,
+        "plan": plan_block,
+    }
+
+
 def bench_payload() -> dict:
     """Fleet mode (--fleet / BENCH_FLEET=1), stream-residency A/B
-    (--stream / BENCH_STREAM=1), or the single-model headline."""
+    (--stream / BENCH_STREAM=1), probe-overhead A/B (--obs /
+    BENCH_OBS=1), or the single-model headline."""
     if USE_FLEET:
         return run_fleet_bench()
     if USE_STREAM:
         return run_stream_bench()
+    if USE_OBS:
+        return run_obs_bench()
     return run_bench()
 
 
@@ -811,7 +890,7 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
-    global USE_FLEET, USE_STREAM
+    global USE_FLEET, USE_STREAM, USE_OBS
     if "--fleet" in sys.argv:
         # Propagate into the probe/accel/fallback subprocesses too.
         USE_FLEET = True
@@ -819,6 +898,9 @@ def main() -> None:
     if "--stream" in sys.argv:
         USE_STREAM = True
         os.environ["BENCH_STREAM"] = "1"
+    if "--obs" in sys.argv:
+        USE_OBS = True
+        os.environ["BENCH_OBS"] = "1"
 
     if ACCEL_CHILD:
         # Child: backend already validated by the parent's probe; any crash

@@ -58,6 +58,15 @@ TRACED_FN_ARGS: Dict[str, Tuple[int, ...]] = {
 # the subset that actually COMPILES (JGL003 cares about these only)
 JIT_WRAPPERS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
 
+# One-level instrumentation wrappers that pass the jit through
+# TRANSPARENTLY — same calling convention, same argument positions
+# (obs/watchdog.py). These are the ONLY outer calls the donation/static
+# tables and JGL003's instance-cache exemption look through: an
+# arbitrary enclosing call (a functools.partial re-mapping argument
+# positions, or an immediate invocation `jax.jit(f)(x)`) must NOT
+# inherit the jit's config or its caching exemption.
+INSTRUMENTATION_WRAPPERS = {"watch_jit"}
+
 # key-deriving calls: reading a key here is sanctioned, not consumption
 KEY_DERIVERS = {
     "jax.random.split",
@@ -221,10 +230,31 @@ class ModuleModel:
                 out[key] = _int_tuple(kw.value)
         return out
 
+    def _unwrap_jit_call(self, call: ast.Call) -> Optional[ast.Call]:
+        """`call` itself when it is jax.jit(...), else the jit call
+        passed to a KNOWN transparent instrumentation wrapper —
+        `self._f = watch_jit(jax.jit(g, donate_argnums=(0,)), "g")`
+        (obs/watchdog.py) must keep its donation/static tracking, or
+        wrapping a jit would silently blind JGL004. Only
+        INSTRUMENTATION_WRAPPERS qualify: a functools.partial (or any
+        other call) around a jit re-maps argument positions, so
+        inheriting the jit's argnums there would mis-attribute
+        donations."""
+        if self.resolve(call.func) in JIT_WRAPPERS:
+            return call
+        if _terminal_name(call.func) not in INSTRUMENTATION_WRAPPERS:
+            return None
+        for a in call.args:
+            if isinstance(a, ast.Call) and self.resolve(a.func) in JIT_WRAPPERS:
+                return a
+        return None
+
     def _collect_jit_wrappers(self) -> None:
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                info = self._jit_call_info(node.value)
+                jit_call = self._unwrap_jit_call(node.value)
+                info = self._jit_call_info(jit_call) \
+                    if jit_call is not None else None
                 if info is None:
                     continue
                 for tgt in node.targets:
@@ -335,7 +365,7 @@ class ModuleModel:
         out.update(self.static_args)
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                if self.resolve(node.value.func) in JIT_WRAPPERS:
+                if self._unwrap_jit_call(node.value) is not None:
                     for tgt in node.targets:
                         name = _target_callable_name(tgt)
                         if name:

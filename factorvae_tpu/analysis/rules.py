@@ -1,4 +1,4 @@
-"""graftlint rules JGL001–JGL005.
+"""graftlint rules JGL001–JGL006.
 
 Each rule is a function `(ModuleModel) -> list[Finding]`. JGL002 (key
 reuse), JGL004 (read-after-donation) and the loop flavor of JGL001 share
@@ -19,10 +19,12 @@ findings, while each documented failure mode does.
 from __future__ import annotations
 
 import ast
+import os
 from typing import Dict, List, Optional, Set
 
 from factorvae_tpu.analysis.engine import (
     CACHE_DECORATORS,
+    INSTRUMENTATION_WRAPPERS,
     JIT_WRAPPERS,
     KEY_DERIVERS,
     KEY_PRODUCERS,
@@ -544,6 +546,16 @@ def rule_jgl003(model: ModuleModel) -> List[Finding]:
         if enc is None or _chain_cached(model, enc):
             continue
         parent = model._parents.get(node)
+        # Look through one-level instrumentation wrappers
+        # (`self._f = watch_jit(jax.jit(...), name)`, obs/watchdog.py):
+        # the instance-cached exemption keys on the ASSIGNMENT target,
+        # not on the transparent wrapper in between. ONLY known
+        # wrappers qualify — climbing through arbitrary calls would
+        # exempt `self.out = jax.jit(f)(batch)` (a fresh jit invoked
+        # per call), exactly what this rule exists to flag.
+        while isinstance(parent, ast.Call) \
+                and _terminal_name(parent.func) in INSTRUMENTATION_WRAPPERS:
+            parent = model._parents.get(parent)
         if isinstance(parent, ast.Assign) and all(
             isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
             and t.value.id == "self" for t in parent.targets
@@ -686,4 +698,73 @@ def rule_jgl005(model: ModuleModel) -> List[Finding]:
     return findings
 
 
-ALL_RULES = (rule_jgl001, rule_jgl002, rule_jgl003, rule_jgl004, rule_jgl005)
+# ---------------------------------------------------------------------------
+# JGL006 — bare print() in library modules
+
+
+# Exempt by construction: CLI surfaces whose job IS stdout.
+JGL006_EXEMPT_BASENAMES = {"cli.py", "__main__.py"}
+# The metrics sink itself: MetricsLogger's echo/degradation prints are
+# the terminal end of the routing this rule enforces.
+JGL006_EXEMPT_SUFFIXES = ("factorvae_tpu/utils/logging.py",)
+
+
+def _dunder_main_ranges(tree: ast.Module) -> List[tuple]:
+    """(first, last) line ranges of top-level `if __name__ == ...`
+    blocks — module smoke entries run as scripts, not as library code."""
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.If) and any(
+            isinstance(n, ast.Name) and n.id == "__name__"
+            for n in ast.walk(node.test)
+        ):
+            out.append((node.lineno,
+                        getattr(node, "end_lineno", node.lineno)))
+    return out
+
+
+def rule_jgl006(model: ModuleModel) -> List[Finding]:
+    """Bare `print(` in a factorvae_tpu library module. Library output
+    belongs on the MetricsLogger/timeline event stream (one RUN.jsonl
+    per run, machine-readable, wandb-forwardable); stray prints
+    interleave unstructured text into whatever stdout the caller owns
+    (the bench's one-JSON-line contract, autotune's table output).
+    Exempt: CLI entry files (cli.py, __main__.py), `main()` functions
+    and anything nested in one, module-level `if __name__ == "__main__"`
+    smoke blocks, and the logger module itself (the sink)."""
+    norm = model.path.replace(os.sep, "/")
+    if "factorvae_tpu/" not in norm:
+        return []  # scripts/, tests/, bench.py own their stdout
+    if os.path.basename(norm) in JGL006_EXEMPT_BASENAMES or any(
+            norm.endswith(s) for s in JGL006_EXEMPT_SUFFIXES):
+        return []
+    guards = _dunder_main_ranges(model.tree)
+    findings: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            continue
+        if any(lo <= node.lineno <= hi for lo, hi in guards):
+            continue
+        fn = model.enclosing_function(node)
+        cur, in_main = fn, False
+        while cur is not None:
+            if cur.name == "main":
+                in_main = True
+                break
+            cur = cur.parent
+        if in_main:
+            continue
+        where = f"'{fn.qualname}'" if fn is not None else "module level"
+        findings.append(Finding(
+            "JGL006", model.path, node.lineno,
+            f"bare print() at {where} in a library module — route it "
+            "through MetricsLogger.log (metrics/events) or the timeline "
+            "so runs yield one coherent RUN.jsonl; CLI mains are exempt",
+        ))
+    return findings
+
+
+ALL_RULES = (rule_jgl001, rule_jgl002, rule_jgl003, rule_jgl004,
+             rule_jgl005, rule_jgl006)

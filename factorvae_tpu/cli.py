@@ -136,6 +136,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "the float path. Also applies to --export "
                         "(int8-baked serving artifact)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--obs", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="run observatory (factorvae_tpu/obs): compile the "
+                        "on-device health probes into the epoch scan "
+                        "(grad/update/param norms, non-finite counters, "
+                        "factor-posterior spread — zero extra dispatches; "
+                        "overhead measured by bench.py --obs) and emit "
+                        "the host timeline (epoch/stream/checkpoint/"
+                        "compile spans) into the metrics stream; "
+                        "--metrics_jsonl defaults to RUN.jsonl when set. "
+                        "Render with python -m factorvae_tpu.obs.report / "
+                        ".timeline. --no-obs pins probes off even when a "
+                        "measured plan row enables them")
     p.add_argument("--preset", type=str, default=None,
                    help="named config preset (see factorvae_tpu.presets). The "
                         "preset fixes the model architecture; explicitly "
@@ -248,6 +261,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 save_dir=resolve("save_dir", cfg.train.save_dir),
                 days_per_step=resolve("days_per_step", cfg.train.days_per_step),
                 wandb=args.wandb,
+                obs_probes=(cfg.train.obs_probes if args.obs is None
+                            else args.obs),
             ),
         )
     return Config(
@@ -292,6 +307,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             run_name=resolve("run_name"),
             save_dir=resolve("save_dir"),
             wandb=args.wandb,
+            obs_probes=bool(args.obs),
         ),
         mesh=MeshConfig(stock_axis=args.mesh_stock),
     )
@@ -308,281 +324,308 @@ def main(argv=None) -> int:
 
     from factorvae_tpu.data import PanelDataset, build_panel, load_frame
     from factorvae_tpu.train import Trainer, load_params
-    from factorvae_tpu.utils.logging import MetricsLogger
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
 
+    # --obs with no explicit metrics path still needs somewhere for the
+    # RUN stream to land; RUN.jsonl is the documented default.
+    metrics_path = args.metrics_jsonl or ("RUN.jsonl" if args.obs else None)
     logger = MetricsLogger(
-        jsonl_path=args.metrics_jsonl,
+        jsonl_path=metrics_path,
         use_wandb=cfg.train.wandb,
         run_name=cfg.train.run_name,
         config=cfg.to_dict(),
     )
-    logger.log("config", **{"json": cfg.to_json()})
+    prev_tl = None
+    if metrics_path:
+        # Host timeline: Trainer/fleet epochs, ChunkStream prefetch,
+        # async checkpoint saves and the jit compile watchdog all emit
+        # spans into the same stream the metrics land in.
+        prev_tl = install_timeline(Timeline(logger))
+    # try/finally so EVERY exit path — including the early `return 2`
+    # error paths — detaches the timeline and closes the metrics stream
+    # (the close-on-error contract MetricsLogger now carries).
+    try:
+        logger.log("config", **{"json": cfg.to_json()})
+        if args.obs:
+            logger.log("obs", probes=cfg.train.obs_probes,
+                       run_jsonl=metrics_path)
 
-    import os
+        import os
 
-    if not os.path.exists(cfg.data.dataset_path):
-        print(
-            f"error: dataset not found: {cfg.data.dataset_path} "
-            f"(see data/README.md for the qlib ETL recipe)",
-            file=sys.stderr,
+        if not os.path.exists(cfg.data.dataset_path):
+            print(
+                f"error: dataset not found: {cfg.data.dataset_path} "
+                f"(see data/README.md for the qlib ETL recipe)",
+                file=sys.stderr,
+            )
+            return 2
+
+        frame = load_frame(cfg.data.dataset_path, cfg.data.select_feature)
+        panel = build_panel(frame)
+
+        auto_plan = None
+        if args.auto_plan:
+            # Adaptive execution planner: measured per-(platform, shape)
+            # knobs, conservative per-backend defaults elsewhere. Explicit
+            # flags keep precedence (their argparse sentinel is None when
+            # not passed).
+            from factorvae_tpu import plan as planlib
+
+            auto_plan = planlib.plan_for_config(
+                cfg, panel.num_instruments,
+                shard=args.mesh_stock if args.mesh else 1)
+            cfg = planlib.apply_plan(
+                cfg, auto_plan,
+                keep_days_per_step=args.days_per_step is not None,
+                keep_dtype=args.bf16 is not None,
+                keep_pad=args.max_stocks is not None,
+                keep_kernels=args.pallas is not None or args.pallas_auto,
+                keep_residency=(args.panel_residency is not None
+                                or args.stream_chunk_days is not None),
+                keep_obs=args.obs is not None,
+            )
+            if args.mesh and args.panel_residency is None \
+                    and cfg.data.panel_residency == "stream":
+                # Stream residency does not compose with a device mesh (the
+                # sharded path needs the panel in HBM to shard it); a
+                # measured stream row must not break --mesh runs — fall
+                # back to HBM and say so. Only the PLAN's choice is
+                # overridden: an EXPLICIT --panel_residency stream with
+                # --mesh still fails loudly in Trainer, same as without
+                # --auto_plan.
+                import dataclasses
+
+                cfg = dataclasses.replace(cfg, data=dataclasses.replace(
+                    cfg.data, panel_residency="hbm"))
+                logger.log(
+                    "plan_residency_override", residency="hbm",
+                    note="plan chose panel_residency=stream but --mesh needs "
+                         "the HBM panel; keeping hbm")
+            logger.log("plan", **auto_plan.describe(
+                planlib.shape_of(cfg, panel.num_instruments)))
+
+        dataset = PanelDataset(
+            panel,
+            seq_len=cfg.data.seq_len,
+            max_stocks=cfg.data.max_stocks,
+            pad_multiple=cfg.data.pad_multiple,
+            residency=cfg.data.panel_residency,
         )
-        return 2
+        if dataset.panel.num_features != cfg.model.num_features:
+            print(
+                f"error: model expects {cfg.model.num_features} features "
+                f"(--num_latent/preset) but {cfg.data.dataset_path} has "
+                f"{dataset.panel.num_features}",
+                file=sys.stderr,
+            )
+            return 2
 
-    frame = load_frame(cfg.data.dataset_path, cfg.data.select_feature)
-    panel = build_panel(frame)
+        if args.score_only:
+            # Scoring needs no training split — restore the best-val weights
+            # through the model factory (reference utils.load_model analogue).
+            from factorvae_tpu.models.factorvae import load_model
 
-    auto_plan = None
-    if args.auto_plan:
-        # Adaptive execution planner: measured per-(platform, shape)
-        # knobs, conservative per-backend defaults elsewhere. Explicit
-        # flags keep precedence (their argparse sentinel is None when
-        # not passed).
-        from factorvae_tpu import plan as planlib
-
-        auto_plan = planlib.plan_for_config(
-            cfg, panel.num_instruments,
-            shard=args.mesh_stock if args.mesh else 1)
-        cfg = planlib.apply_plan(
-            cfg, auto_plan,
-            keep_days_per_step=args.days_per_step is not None,
-            keep_dtype=args.bf16 is not None,
-            keep_pad=args.max_stocks is not None,
-            keep_kernels=args.pallas is not None or args.pallas_auto,
-            keep_residency=(args.panel_residency is not None
-                            or args.stream_chunk_days is not None),
-        )
-        if args.mesh and args.panel_residency is None \
-                and cfg.data.panel_residency == "stream":
-            # Stream residency does not compose with a device mesh (the
-            # sharded path needs the panel in HBM to shard it); a
-            # measured stream row must not break --mesh runs — fall
-            # back to HBM and say so. Only the PLAN's choice is
-            # overridden: an EXPLICIT --panel_residency stream with
-            # --mesh still fails loudly in Trainer, same as without
-            # --auto_plan.
+            path = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
+            if not os.path.isdir(path):
+                print(f"error: no checkpoint at {path}; train first", file=sys.stderr)
+                return 2
+            _, params = load_model(cfg, checkpoint_path=path, n_max=dataset.n_max)
+        elif args.fleet_seeds and args.fleet_seeds > 1:
+            # Seed-parallel fleet (train/fleet.py): one program trains the
+            # whole seed range [seed, seed+N), the sweep frame picks the
+            # winner by Rank-IC, and the rest of the pipeline (scoring /
+            # backtest / export) runs on that winner's best-val weights
+            # under its own per-seed checkpoint name.
             import dataclasses
 
-            cfg = dataclasses.replace(cfg, data=dataclasses.replace(
-                cfg.data, panel_residency="hbm"))
-            logger.log(
-                "plan_residency_override", residency="hbm",
-                note="plan chose panel_residency=stream but --mesh needs "
-                     "the HBM panel; keeping hbm")
-        logger.log("plan", **auto_plan.describe(
-            planlib.shape_of(cfg, panel.num_instruments)))
+            from factorvae_tpu.eval.sweep import seed_sweep
+            from factorvae_tpu.models.factorvae import load_model
 
-    dataset = PanelDataset(
-        panel,
-        seq_len=cfg.data.seq_len,
-        max_stocks=cfg.data.max_stocks,
-        pad_multiple=cfg.data.pad_multiple,
-        residency=cfg.data.panel_residency,
-    )
-    if dataset.panel.num_features != cfg.model.num_features:
-        print(
-            f"error: model expects {cfg.model.num_features} features "
-            f"(--num_latent/preset) but {cfg.data.dataset_path} has "
-            f"{dataset.panel.num_features}",
-            file=sys.stderr,
-        )
-        return 2
+            if args.mesh:
+                # FleetTrainer does not compose the seed axis with a
+                # ('data','stock') mesh; training would silently run
+                # unsharded (and every pod process would race the same
+                # checkpoint paths). Fail loudly instead.
+                print(
+                    "error: --mesh is not supported with --fleet_seeds "
+                    "(the fleet is the single-chip seed-parallel mode); "
+                    "drop one of the two flags", file=sys.stderr)
+                return 2
+            seeds = list(range(cfg.train.seed, cfg.train.seed + args.fleet_seeds))
+            spp = auto_plan.seeds_per_program if auto_plan is not None else None
+            import contextlib
 
-    if args.score_only:
-        # Scoring needs no training split — restore the best-val weights
-        # through the model factory (reference utils.load_model analogue).
-        from factorvae_tpu.models.factorvae import load_model
+            from factorvae_tpu.utils.profiling import debug_nans, trace
 
-        path = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
-        if not os.path.isdir(path):
-            print(f"error: no checkpoint at {path}; train first", file=sys.stderr)
-            return 2
-        _, params = load_model(cfg, checkpoint_path=path, n_max=dataset.n_max)
-    elif args.fleet_seeds and args.fleet_seeds > 1:
-        # Seed-parallel fleet (train/fleet.py): one program trains the
-        # whole seed range [seed, seed+N), the sweep frame picks the
-        # winner by Rank-IC, and the rest of the pipeline (scoring /
-        # backtest / export) runs on that winner's best-val weights
-        # under its own per-seed checkpoint name.
-        import dataclasses
+            nan_ctx = debug_nans() if args.debug_nans else contextlib.nullcontext()
+            try:
+                with trace(args.profile), nan_ctx:
+                    df = seed_sweep(
+                        cfg, dataset, seeds=seeds,
+                        score_start=args.score_start, score_end=args.score_end,
+                        logger=logger, fleet=True, seeds_per_program=spp,
+                        # --resume: each group restores from its lockstep
+                        # per-seed full-state checkpoints when present.
+                        fleet_resume=args.resume)
+            except ValueError as e:
+                if "empty training split" in str(e):
+                    print(
+                        f"error: no trading days in [{cfg.data.start_time}, "
+                        f"{cfg.data.fit_end_time}]; adjust --start_time/"
+                        f"--fit_end_time", file=sys.stderr)
+                    return 2
+                raise
+            # Winner = best rank_ic among the seeds with a finite best_val
+            # AND a best-val checkpoint on disk. The finite-best_val filter
+            # matters beyond NaN hygiene: a seed whose validation never
+            # improved was scored on FINAL-epoch params and wrote no fresh
+            # checkpoint this run — a stale same-name directory from an
+            # earlier run would otherwise pass the isdir test and export
+            # weights that never produced the winning rank_ic.
+            def _ckpt(seed):
+                c = dataclasses.replace(
+                    cfg, train=dataclasses.replace(cfg.train, seed=int(seed)))
+                return os.path.join(c.train.save_dir, c.checkpoint_name())
 
-        from factorvae_tpu.eval.sweep import seed_sweep
-        from factorvae_tpu.models.factorvae import load_model
+            import numpy as np
 
-        if args.mesh:
-            # FleetTrainer does not compose the seed axis with a
-            # ('data','stock') mesh; training would silently run
-            # unsharded (and every pod process would race the same
-            # checkpoint paths). Fail loudly instead.
-            print(
-                "error: --mesh is not supported with --fleet_seeds "
-                "(the fleet is the single-chip seed-parallel mode); "
-                "drop one of the two flags", file=sys.stderr)
-            return 2
-        seeds = list(range(cfg.train.seed, cfg.train.seed + args.fleet_seeds))
-        spp = auto_plan.seeds_per_program if auto_plan is not None else None
-        import contextlib
+            ranked = df["rank_ic"].dropna()
+            ranked = ranked[np.isfinite(df.loc[ranked.index, "best_val"])]
+            ranked = ranked[[os.path.isdir(_ckpt(s)) for s in ranked.index]]
+            if ranked.empty:
+                # Every seed's scores were NaN (e.g. a divergent lr) or no
+                # checkpoint survived: there is no winner to pick — fail
+                # like every other CLI path, with a message instead of an
+                # int(NaN) traceback.
+                print("error: no fleet seed with finite rank_ic and a "
+                      "best-val checkpoint; nothing to score/export "
+                      "(check lr / data ranges)", file=sys.stderr)
+                return 2
+            best_seed = int(ranked.idxmax())
+            logger.log("fleet_sweep", best_seed=best_seed,
+                       seeds=seeds, **df.attrs["summary"])
+            cfg = dataclasses.replace(
+                cfg, train=dataclasses.replace(cfg.train, seed=best_seed))
+            _, params = load_model(cfg, checkpoint_path=_ckpt(best_seed),
+                                   n_max=dataset.n_max)
+        else:
+            from factorvae_tpu.utils.profiling import trace
 
-        from factorvae_tpu.utils.profiling import debug_nans, trace
+            try:
+                trainer = Trainer(cfg, dataset, logger=logger, use_mesh=args.mesh)
+            except ValueError as e:
+                if "empty training split" in str(e):
+                    print(
+                        f"error: no trading days in [{cfg.data.start_time}, "
+                        f"{cfg.data.fit_end_time}] — the dataset covers "
+                        f"[{dataset.dates[0].date()}, {dataset.dates[-1].date()}]; "
+                        f"adjust --start_time/--fit_end_time",
+                        file=sys.stderr,
+                    )
+                    return 2
+                raise
+            import contextlib
 
-        nan_ctx = debug_nans() if args.debug_nans else contextlib.nullcontext()
-        try:
+            from factorvae_tpu.utils.profiling import debug_nans
+
+            nan_ctx = debug_nans() if args.debug_nans else contextlib.nullcontext()
             with trace(args.profile), nan_ctx:
-                df = seed_sweep(
-                    cfg, dataset, seeds=seeds,
-                    score_start=args.score_start, score_end=args.score_end,
-                    logger=logger, fleet=True, seeds_per_program=spp,
-                    # --resume: each group restores from its lockstep
-                    # per-seed full-state checkpoints when present.
-                    fleet_resume=args.resume)
-        except ValueError as e:
-            if "empty training split" in str(e):
-                print(
-                    f"error: no trading days in [{cfg.data.start_time}, "
-                    f"{cfg.data.fit_end_time}]; adjust --start_time/"
-                    f"--fit_end_time", file=sys.stderr)
-                return 2
-            raise
-        # Winner = best rank_ic among the seeds with a finite best_val
-        # AND a best-val checkpoint on disk. The finite-best_val filter
-        # matters beyond NaN hygiene: a seed whose validation never
-        # improved was scored on FINAL-epoch params and wrote no fresh
-        # checkpoint this run — a stale same-name directory from an
-        # earlier run would otherwise pass the isdir test and export
-        # weights that never produced the winning rank_ic.
-        def _ckpt(seed):
-            c = dataclasses.replace(
-                cfg, train=dataclasses.replace(cfg.train, seed=int(seed)))
-            return os.path.join(c.train.save_dir, c.checkpoint_name())
+                state, _ = trainer.fit(resume=args.resume)
+            # Score with the best-validation weights (what the reference's
+            # backtest loads, backtest.ipynb cell 2), not the final step.
+            best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
+            params = load_params(best, state.params) if os.path.isdir(best) else state.params
 
-        import numpy as np
+        from factorvae_tpu.eval import RankIC, export_scores, generate_prediction_scores
 
-        ranked = df["rank_ic"].dropna()
-        ranked = ranked[np.isfinite(df.loc[ranked.index, "best_val"])]
-        ranked = ranked[[os.path.isdir(_ckpt(s)) for s in ranked.index]]
-        if ranked.empty:
-            # Every seed's scores were NaN (e.g. a divergent lr) or no
-            # checkpoint survived: there is no winner to pick — fail
-            # like every other CLI path, with a message instead of an
-            # int(NaN) traceback.
-            print("error: no fleet seed with finite rank_ic and a "
-                  "best-val checkpoint; nothing to score/export "
-                  "(check lr / data ranges)", file=sys.stderr)
-            return 2
-        best_seed = int(ranked.idxmax())
-        logger.log("fleet_sweep", best_seed=best_seed,
-                   seeds=seeds, **df.attrs["summary"])
-        cfg = dataclasses.replace(
-            cfg, train=dataclasses.replace(cfg.train, seed=best_seed))
-        _, params = load_model(cfg, checkpoint_path=_ckpt(best_seed),
-                               n_max=dataset.n_max)
-    else:
-        from factorvae_tpu.utils.profiling import trace
+        score_cfg = cfg
+        if auto_plan is not None:
+            # Scoring gets the plan's SCORING knobs — the measured winner
+            # flips between workloads (r05: the scoring dtype/layout winner
+            # differs from the training one). Safe on the same params:
+            # compute_dtype only casts activations and flatten_days keeps an
+            # identical parameter tree. A user-forced dtype still wins.
+            import dataclasses
 
-        try:
-            trainer = Trainer(cfg, dataset, logger=logger, use_mesh=args.mesh)
-        except ValueError as e:
-            if "empty training split" in str(e):
-                print(
-                    f"error: no trading days in [{cfg.data.start_time}, "
-                    f"{cfg.data.fit_end_time}] — the dataset covers "
-                    f"[{dataset.dates[0].date()}, {dataset.dates[-1].date()}]; "
-                    f"adjust --start_time/--fit_end_time",
-                    file=sys.stderr,
-                )
-                return 2
-            raise
-        import contextlib
+            from factorvae_tpu import plan as planlib
 
-        from factorvae_tpu.utils.profiling import debug_nans
+            m = planlib.score_model_config(cfg.model, auto_plan)
+            if args.bf16 is not None:
+                m = dataclasses.replace(m, compute_dtype=cfg.model.compute_dtype)
+            score_cfg = dataclasses.replace(cfg, model=m)
 
-        nan_ctx = debug_nans() if args.debug_nans else contextlib.nullcontext()
-        with trace(args.profile), nan_ctx:
-            state, _ = trainer.fit(resume=args.resume)
-        # Score with the best-validation weights (what the reference's
-        # backtest loads, backtest.ipynb cell 2), not the final step.
-        best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
-        params = load_params(best, state.params) if os.path.isdir(best) else state.params
-
-    from factorvae_tpu.eval import RankIC, export_scores, generate_prediction_scores
-
-    score_cfg = cfg
-    if auto_plan is not None:
-        # Scoring gets the plan's SCORING knobs — the measured winner
-        # flips between workloads (r05: the scoring dtype/layout winner
-        # differs from the training one). Safe on the same params:
-        # compute_dtype only casts activations and flatten_days keeps an
-        # identical parameter tree. A user-forced dtype still wins.
-        import dataclasses
-
-        from factorvae_tpu import plan as planlib
-
-        m = planlib.score_model_config(cfg.model, auto_plan)
-        if args.bf16 is not None:
-            m = dataclasses.replace(m, compute_dtype=cfg.model.compute_dtype)
-        score_cfg = dataclasses.replace(cfg, model=m)
-
-    scores = generate_prediction_scores(
-        params, score_cfg, dataset,
-        start=args.score_start, end=args.score_end,
-        stochastic=None,  # defer to cfg.model.stochastic_inference
-        with_labels=True,
-        int8=args.int8_scores,
-    )
-    path = export_scores(scores, cfg, args.score_dir)
-    ic = RankIC(scores.dropna(), "LABEL0", "score")
-    logger.log(
-        "scores",
-        path=path,
-        rank_ic=float(ic["RankIC"].iloc[0]),
-        rank_ic_ir=float(ic["RankIC_IR"].iloc[0]),
-    )
-    if args.backtest:
-        from factorvae_tpu.eval.backtest import (
-            simulate_topk_account,
-            topk_dropout_backtest,
-        )
-
-        bt = topk_dropout_backtest(
-            scores.dropna(), topk=args.backtest_topk,
-            n_drop=args.backtest_n_drop,
-        )
-        logger.log("backtest", **{
-            k: v for k, v in bt.summary().items() if v is not None
-        })
-        # Full-fidelity account simulation (cell 6 exchange config) and
-        # the cell-8 annualized excess-return risk table. Pass the
-        # UN-dropped frame: the simulator owns the NaN semantics (all-NaN
-        # day = no-trade day that marks to market; in-frame NaN-label
-        # name = undealable on the execution day).
-        acct = simulate_topk_account(
-            scores, topk=args.backtest_topk,
-            n_drop=args.backtest_n_drop,
-        )
-        logger.log("backtest_account", **{
-            k: (v if v is None or isinstance(v, (int, float)) else float(v))
-            for k, v in acct.summary().items()
-        })
-        if args.backtest_plot:
-            from factorvae_tpu.eval.plots import report_graph
-
-            out_png = report_graph(
-                acct.report, args.backtest_plot,
-                title=cfg.train.run_name)
-            logger.log("backtest_plot", path=out_png)
-    if args.export:
-        from factorvae_tpu.eval.export_aot import export_prediction
-
-        platforms = (args.export_platform,) if args.export_platform else None
-        blob = export_prediction(
-            params, cfg, n_max=dataset.n_max,
-            stochastic=cfg.model.stochastic_inference, platforms=platforms,
+        scores = generate_prediction_scores(
+            params, score_cfg, dataset,
+            start=args.score_start, end=args.score_end,
+            stochastic=None,  # defer to cfg.model.stochastic_inference
+            with_labels=True,
             int8=args.int8_scores,
         )
-        with open(args.export, "wb") as fh:
-            fh.write(blob)
-        logger.log("export", path=args.export, bytes=len(blob))
-    logger.finish()
-    return 0
+        path = export_scores(scores, cfg, args.score_dir)
+        ic = RankIC(scores.dropna(), "LABEL0", "score")
+        logger.log(
+            "scores",
+            path=path,
+            rank_ic=float(ic["RankIC"].iloc[0]),
+            rank_ic_ir=float(ic["RankIC_IR"].iloc[0]),
+        )
+        if args.backtest:
+            from factorvae_tpu.eval.backtest import (
+                simulate_topk_account,
+                topk_dropout_backtest,
+            )
+
+            bt = topk_dropout_backtest(
+                scores.dropna(), topk=args.backtest_topk,
+                n_drop=args.backtest_n_drop,
+            )
+            logger.log("backtest", **{
+                k: v for k, v in bt.summary().items() if v is not None
+            })
+            # Full-fidelity account simulation (cell 6 exchange config) and
+            # the cell-8 annualized excess-return risk table. Pass the
+            # UN-dropped frame: the simulator owns the NaN semantics (all-NaN
+            # day = no-trade day that marks to market; in-frame NaN-label
+            # name = undealable on the execution day).
+            acct = simulate_topk_account(
+                scores, topk=args.backtest_topk,
+                n_drop=args.backtest_n_drop,
+            )
+            logger.log("backtest_account", **{
+                k: (v if v is None or isinstance(v, (int, float)) else float(v))
+                for k, v in acct.summary().items()
+            })
+            if args.backtest_plot:
+                from factorvae_tpu.eval.plots import report_graph
+
+                out_png = report_graph(
+                    acct.report, args.backtest_plot,
+                    title=cfg.train.run_name)
+                logger.log("backtest_plot", path=out_png)
+        if args.export:
+            from factorvae_tpu.eval.export_aot import export_prediction
+
+            platforms = (args.export_platform,) if args.export_platform else None
+            blob = export_prediction(
+                params, cfg, n_max=dataset.n_max,
+                stochastic=cfg.model.stochastic_inference, platforms=platforms,
+                int8=args.int8_scores,
+            )
+            with open(args.export, "wb") as fh:
+                fh.write(blob)
+            logger.log("export", path=args.export, bytes=len(blob))
+        return 0
+    finally:
+        if metrics_path:
+            # Detach the run's timeline before closing the stream
+            # (stray spans from daemon watchers become no-ops) and
+            # RESTORE whatever the in-process caller had installed.
+            install_timeline(prev_tl)
+        logger.finish()
 
 
 if __name__ == "__main__":

@@ -140,6 +140,13 @@ class TrainConfig:
     # False restores the old blocking save (bitwise-identical artifacts
     # either way — tested).
     async_checkpointing: bool = True
+    # On-device training-health probes (obs/probes.py): grad/update/param
+    # global norms, non-finite counters and factor-posterior spread
+    # compiled into the epoch-scan aux — zero extra dispatches, measured
+    # overhead tracked by `bench.py --obs`. Off by default: the off path
+    # is BITWISE the pre-observatory trace (tests/test_obs.py). CLI
+    # `--obs`; a measured plan row can switch it via its "obs" block.
+    obs_probes: bool = False
 
 
 @dataclass(frozen=True)

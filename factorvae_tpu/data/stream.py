@@ -32,6 +32,8 @@ from typing import Any, Callable, Iterator
 
 import jax
 
+from factorvae_tpu.utils.logging import timeline_span_at
+
 
 def _tree_nbytes(tree: Any) -> int:
     return sum(
@@ -70,11 +72,19 @@ class ChunkStream:
     def _produce(self, i: int):
         t0 = time.perf_counter()
         host = self._make_chunk(i)
-        self.bytes_put += _tree_nbytes(host)
+        nbytes = _tree_nbytes(host)
+        self.bytes_put += nbytes
         # ONE chunk-granularity transfer; async on accelerators, so the
         # copy itself also overlaps the worker's next gather.
         dev = jax.device_put(host)
-        self.produce_seconds += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self.produce_seconds += t1 - t0
+        # The ledger as timeline spans (no-op without an installed
+        # timeline): each worker-side gather+put window on the "stream"
+        # lane, so `obs.timeline` can show how much of it hid behind
+        # the "device" lane — the run-level overlap_frac.
+        timeline_span_at("chunk_produce", t0, t1, cat="stream",
+                         resource="stream", chunk=i, bytes=nbytes)
         return dev
 
     def __iter__(self) -> Iterator[Any]:
@@ -87,7 +97,10 @@ class ChunkStream:
                        if i + 1 < self.n_chunks else None)
                 t0 = time.perf_counter()
                 batch = fut.result()
-                self.wait_seconds += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self.wait_seconds += t1 - t0
+                timeline_span_at("chunk_wait", t0, t1, cat="stream",
+                                 resource="stream_wait", chunk=i)
                 yield batch
                 fut = nxt
 

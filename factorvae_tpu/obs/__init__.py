@@ -1,0 +1,47 @@
+"""Run observatory: training-health telemetry for the whole pipeline.
+
+Three pillars (ISSUE 5; docs/observability.md has the long-form story):
+
+- **On-device health probes** (`obs.probes`, wired through
+  `train/loop.py make_step_fns(obs=True)`): scalar probes — grad/param/
+  update global norms, per-term losses, non-finite counts, factor-
+  posterior spread — compiled into the existing epoch-scan aux, so they
+  cost zero extra dispatches, vmap cleanly across the fleet seed axis,
+  and are BITWISE-NEUTRAL when off (the default; the off path is the
+  pre-observatory trace, pinned in tests/test_obs.py — the same
+  discipline as `panel_residency`).
+- **Unified host timeline** (`utils/logging.Timeline` +
+  `python -m factorvae_tpu.obs.timeline`): Trainer/FleetTrainer epochs,
+  the ChunkStream transfer ledger, async checkpoint saves and the jit
+  compile watchdog all emit monotonic-clock spans into one RUN.jsonl;
+  the CLI renders a text Gantt and computes per-resource overlap
+  fractions, cross-linkable with `--profile` device traces via shared
+  span names.
+- **Run reports** (`python -m factorvae_tpu.obs.report RUN.jsonl`):
+  per-epoch tables plus health flags — NaN/inf hits, grad-norm spikes,
+  val-metric divergence, throughput regressions vs the plan row's
+  measured envelope — in human or JSON form. `bench.py --obs` measures
+  the probes' own overhead so the cost of watching is itself a tracked
+  number.
+"""
+
+from factorvae_tpu.obs.probes import (
+    EVAL_PROBE_KEYS,
+    TRAIN_PROBE_KEYS,
+    finalize_eval_probes,
+    finalize_train_probes,
+    grad_probes,
+    loss_probes,
+)
+from factorvae_tpu.obs.watchdog import WatchedJit, watch_jit
+
+__all__ = [
+    "EVAL_PROBE_KEYS",
+    "TRAIN_PROBE_KEYS",
+    "WatchedJit",
+    "finalize_eval_probes",
+    "finalize_train_probes",
+    "grad_probes",
+    "loss_probes",
+    "watch_jit",
+]

@@ -1,0 +1,118 @@
+# graftlint: hot-path (these run inside the jitted epoch scans)
+"""On-device training-health probes.
+
+Every probe is a SCALAR accumulated per step inside the existing epoch
+scan (train/loop.py), so the whole catalog rides the aux pytree that the
+scan already carries: zero extra dispatches, one host fetch per epoch
+(the same fetch the loss metrics already pay), and — because every
+finalized value is a scalar — the fleet's vmapped entry points return
+(S,)-shaped probe dicts with no code changes (the `train/loop.py` fleet
+contract).
+
+Per-step aux (raw, un-reduced; produced by `loss_probes`/`grad_probes`):
+
+    nf_loss        non-finite per-day losses among REAL days this step
+    mu_spread_sum  day-weighted sum of std_K(posterior factor mu)
+    sigma_mean_sum day-weighted sum of mean_K(posterior factor sigma)
+    grad_norm      optax.global_norm of the step's gradients
+    update_norm    optax.global_norm of the optimizer update
+    param_norm     optax.global_norm of the post-update params
+    nonfinite_grads  count of non-finite gradient ELEMENTS this step
+
+Finalized per-epoch metrics (`finalize_*_probes`; `TRAIN_PROBE_KEYS` /
+`EVAL_PROBE_KEYS` name them for the trainers and obs.report):
+
+    grad_norm_max / grad_norm_mean / update_norm_mean / param_norm_last
+    nonfinite_grads / nonfinite_loss (epoch totals)
+    factor_mu_spread / factor_sigma_mean (day-weighted epoch means)
+
+The probes observe values the update path already computes; they feed
+nothing back into it, so enabling them must not change training — the
+bitwise-off AND params-equal-on pins live in tests/test_obs.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# Epoch-level probe metric names, in reporting order. The trainers use
+# these to lift probe values into the epoch record; obs.report uses them
+# to know which health checks have data.
+TRAIN_PROBE_KEYS = (
+    "grad_norm_max",
+    "grad_norm_mean",
+    "update_norm_mean",
+    "param_norm_last",
+    "nonfinite_grads",
+    "nonfinite_loss",
+    "factor_mu_spread",
+    "factor_sigma_mean",
+)
+EVAL_PROBE_KEYS = (
+    "nonfinite_loss",
+    "factor_mu_spread",
+    "factor_sigma_mean",
+)
+
+
+def _count_nonfinite(tree) -> jnp.ndarray:
+    """Total non-finite elements across a pytree, as a float32 scalar."""
+    counts = jax.tree.map(
+        lambda g: jnp.sum(~jnp.isfinite(g)).astype(jnp.float32), tree)
+    return jax.tree.reduce(jnp.add, counts, jnp.zeros((), jnp.float32))
+
+
+def loss_probes(out, day_w: jnp.ndarray) -> dict:
+    """Forward-pass probes from one step's day-batched model output.
+
+    `out` is a FactorVAEOutput with (B,)-shaped per-day losses and
+    (B, K) posterior moments; `day_w` is the (B,) real-day weight (0 on
+    epoch padding). Padded days gather day 0's data, so their values are
+    finite garbage — every probe is day-weighted to exclude them.
+    """
+    f32 = jnp.float32
+    return {
+        "nf_loss": jnp.sum((~jnp.isfinite(out.loss)).astype(f32) * day_w),
+        "mu_spread_sum": jnp.sum(
+            jnp.std(out.factor_mu.astype(f32), axis=-1) * day_w),
+        "sigma_mean_sum": jnp.sum(
+            jnp.mean(out.factor_sigma.astype(f32), axis=-1) * day_w),
+    }
+
+
+def grad_probes(grads, updates, new_params) -> dict:
+    """Backward-pass probes from one optimizer step."""
+    return {
+        "grad_norm": optax.global_norm(grads),
+        "update_norm": optax.global_norm(updates),
+        "param_norm": optax.global_norm(new_params),
+        "nonfinite_grads": _count_nonfinite(grads),
+    }
+
+
+def finalize_train_probes(auxes, days: jnp.ndarray) -> dict:
+    """(steps,) probe aux -> scalar epoch metrics. `days` is the epoch's
+    real-day count (already clamped >= 1 by the caller's loss
+    finalizer)."""
+    return {
+        "grad_norm_max": jnp.max(auxes["grad_norm"]),
+        "grad_norm_mean": jnp.mean(auxes["grad_norm"]),
+        "update_norm_mean": jnp.mean(auxes["update_norm"]),
+        # the post-update norm after the LAST step — the epoch's
+        # parameter-scale snapshot
+        "param_norm_last": auxes["param_norm"][-1],
+        "nonfinite_grads": jnp.sum(auxes["nonfinite_grads"]),
+        "nonfinite_loss": jnp.sum(auxes["nf_loss"]),
+        "factor_mu_spread": jnp.sum(auxes["mu_spread_sum"]) / days,
+        "factor_sigma_mean": jnp.sum(auxes["sigma_mean_sum"]) / days,
+    }
+
+
+def finalize_eval_probes(auxes, days: jnp.ndarray) -> dict:
+    return {
+        "nonfinite_loss": jnp.sum(auxes["nf_loss"]),
+        "factor_mu_spread": jnp.sum(auxes["mu_spread_sum"]) / days,
+        "factor_sigma_mean": jnp.sum(auxes["sigma_mean_sum"]) / days,
+    }

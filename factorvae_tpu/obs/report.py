@@ -1,0 +1,361 @@
+"""Run report: per-epoch health tables + flags from a RUN.jsonl.
+
+    python -m factorvae_tpu.obs.report RUN.jsonl [--json]
+        [--spike-mult 10] [--slow-frac 0.5] [--diverge-frac 0.2]
+        [--diverge-epochs 3]
+
+Aggregates the metric stream (epoch / fleet_epoch records, the health
+probes when `obs` was on, the `plan` decision block, scores/best
+events) into one table and raises health flags:
+
+- `nonfinite`     — NaN/inf train or val loss, non-finite gradient
+                    elements, or non-finite per-day losses (the probe
+                    counters). This is the flag that would have caught
+                    the PR-4 donation bug (NaN epoch-3 losses after
+                    resume) in the first epoch record instead of a
+                    root-cause hunt.
+- `grad_spike`    — grad_norm_max > spike-mult x the run's median
+                    grad_norm_mean (needs `obs` probes).
+- `val_divergence`— val loss sitting >= diverge-frac above its best for
+                    diverge-epochs consecutive epochs while training
+                    continues (classic overfit/collapse signature).
+- `slow_epoch`    — days_per_sec below slow-frac x the run median, and
+                    (when the planner's measured envelope is in the
+                    stream) below slow-frac x the plan row's measured
+                    rate — a throughput regression against the envelope
+                    the planner promised.
+
+Human output by default; `--json` for the machine-readable form.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from statistics import median
+from typing import List, Optional
+
+from factorvae_tpu.obs.probes import TRAIN_PROBE_KEYS
+from factorvae_tpu.obs.timeline import load_run
+
+# autotune_plan rows carry "train 0.1234 s/day" in their source string;
+# a matched value is the measured envelope the planner promised.
+_PLAN_RATE_RE = re.compile(r"train ([0-9.eE+-]+) s/day")
+
+
+def _nums(v) -> List[float]:
+    """Numeric leaves of an epoch-record value (fleet records hold
+    per-seed lists; serial records hold scalars)."""
+    if isinstance(v, (int, float)):
+        return [float(v)]
+    if isinstance(v, list):
+        return [float(x) for x in v if isinstance(x, (int, float))]
+    return []
+
+
+def _any_nonfinite(v) -> bool:
+    return any(not math.isfinite(x) for x in _nums(v))
+
+
+def _mean(v) -> Optional[float]:
+    xs = [x for x in _nums(v) if math.isfinite(x)]
+    return sum(xs) / len(xs) if xs else None
+
+
+def _parse_plan_rate(rec: dict) -> Optional[float]:
+    """Measured train rate promised by ONE `plan` record, or None —
+    default-provenance plans promise no envelope."""
+    if rec.get("provenance") != "measured":
+        return None
+    m = _PLAN_RATE_RE.search(str(rec.get("source", "")))
+    if not m:
+        return None
+    try:
+        s_per_day = float(m.group(1))
+        return 1.0 / s_per_day if s_per_day > 0 else None
+    except ValueError:
+        return None
+
+
+def plan_measured_days_per_sec(events: List[dict]) -> Optional[float]:
+    """Envelope of the stream's FIRST plan record (single-run streams)."""
+    for rec in events:
+        if rec.get("event") == "plan":
+            return _parse_plan_rate(rec)
+    return None
+
+
+def _plan_rate_for(seg: List[dict], events: List[dict]) -> Optional[float]:
+    """The plan envelope governing THIS segment: the last `plan` record
+    the stream logged before the segment's first epoch (record order via
+    the `_line` annotation obs.timeline.load_run attaches). A plan from
+    a different run in a concatenated session must not set the envelope
+    here — and a run whose own plan was default-provenance gets none.
+    Hand-built record lists without `_line` fall back to the stream's
+    first plan record."""
+    plans = [r for r in events if r.get("event") == "plan"]
+    if not plans:
+        return None
+    first = seg[0].get("_line") if seg else None
+    if first is not None and all(p.get("_line") is not None for p in plans):
+        prior = [p for p in plans if p["_line"] < first]
+        if not prior:
+            return None
+        return _parse_plan_rate(prior[-1])
+    return _parse_plan_rate(plans[0])
+
+
+def _segments(epochs: List[dict]) -> List[List[dict]]:
+    """Split a (possibly concatenated) stream's epoch records into
+    per-run segments. One RUN.jsonl deliberately carries many runs —
+    autotune + train + sweep sessions, parity grid points, fleet groups
+    — and the stateful health checks (divergence baselines, throughput
+    medians, the compile-epoch exemption) must not leak across run
+    boundaries. A new segment starts wherever the epoch number fails to
+    increase: a fresh run restarts at 0 (or any earlier epoch), while a
+    resume continues its predecessor's numbering and correctly extends
+    the segment."""
+    segs: List[List[dict]] = []
+    cur: List[dict] = []
+    last: Optional[float] = None
+    for rec in epochs:
+        e = rec.get("epoch")
+        if cur and isinstance(e, (int, float)) \
+                and isinstance(last, (int, float)) and e <= last:
+            segs.append(cur)
+            cur = []
+        cur.append(rec)
+        if isinstance(e, (int, float)):
+            last = e
+    if cur:
+        segs.append(cur)
+    return segs
+
+
+def _lane_count(seg: List[dict], key: str) -> int:
+    """Seed-lane width of a metric over a segment: fleets log per-seed
+    LISTS, serial runs scalars (width 1). Health checks run per lane so
+    one bad seed is never diluted by the healthy majority ("flags fire
+    if ANY seed trips")."""
+    return max((len(_nums(r.get(key))) for r in seg), default=0)
+
+
+def _lane(rec: dict, key: str, s: int) -> Optional[float]:
+    lanes = _nums(rec.get(key))
+    return lanes[s] if s < len(lanes) else None
+
+
+def health_flags(epochs: List[dict], events: List[dict],
+                 spike_mult: float = 10.0, slow_frac: float = 0.5,
+                 diverge_frac: float = 0.2,
+                 diverge_epochs: int = 3) -> List[dict]:
+    flags: List[dict] = []
+
+    def flag(rec, kind, detail):
+        # `line` (the load_run stream position) identifies the exact
+        # record: in a concatenated multi-run stream, epoch NUMBERS
+        # repeat across runs and must not be the join key.
+        flags.append({"epoch": rec.get("epoch"), "line": rec.get("_line"),
+                      "flag": kind, "detail": detail})
+
+    def seed_tag(s: int, width: int) -> str:
+        return f" (seed lane {s})" if width > 1 else ""
+
+    # Every stateful check runs PER SEGMENT (per run): baselines,
+    # medians, exemptions and the plan envelope from one grid point or
+    # fleet group must not flag — or excuse — the next one.
+    for seg in _segments(epochs):
+        # nonfinite: losses + probe counters. A run with NO validation
+        # split records NaN val_loss every epoch BY DESIGN — the
+        # exemption is judged over THIS run only, so a sibling run's
+        # finite val split can't un-excuse it.
+        no_val = all(_any_nonfinite(r.get("val_loss", 0.0)) for r in seg)
+        for rec in seg:
+            for key in ("train_loss", "val_loss"):
+                if key in rec and _any_nonfinite(rec[key]):
+                    if key == "val_loss" and no_val:
+                        continue
+                    flag(rec, "nonfinite",
+                         f"{key} is not finite: {rec[key]}")
+            for key in ("nonfinite_grads", "nonfinite_loss",
+                        "val_nonfinite_loss"):
+                n = _mean(rec.get(key, 0.0))
+                if n and n > 0:
+                    flag(rec, "nonfinite", f"{key}={n:g} (probe counter)")
+
+        # grad spikes (probe data required), per seed lane: each seed
+        # is judged against ITS OWN epoch-median grad_norm_mean
+        s_grad = _lane_count(seg, "grad_norm_mean")
+        for s in range(s_grad):
+            means = [m for r in seg
+                     for m in [_lane(r, "grad_norm_mean", s)]
+                     if m is not None and math.isfinite(m)]
+            if not means:
+                continue
+            base = median(means)
+            for rec in seg:
+                gmax = _lane(rec, "grad_norm_max", s)
+                if gmax is not None and base > 0 \
+                        and gmax > spike_mult * base:
+                    flag(rec, "grad_spike",
+                         f"grad_norm_max={gmax:.4g} > {spike_mult:g}x "
+                         f"median grad_norm_mean ({base:.4g})"
+                         + seed_tag(s, s_grad))
+
+        # val divergence, per seed lane: >= diverge_epochs consecutive
+        # epochs sitting diverge_frac above that seed's best in this run
+        s_val = _lane_count(seg, "val_loss")
+        for s in range(s_val):
+            best = math.inf
+            streak: List[dict] = []
+            for rec in seg:
+                v = _lane(rec, "val_loss", s)
+                if v is None or not math.isfinite(v):
+                    continue
+                if math.isfinite(best) and v > best * (1.0 + diverge_frac):
+                    streak.append(rec)
+                    if len(streak) == diverge_epochs:
+                        flag(streak[0], "val_divergence",
+                             f"val loss >= {1 + diverge_frac:g}x its "
+                             f"best ({best:.6g}) for {diverge_epochs} "
+                             "consecutive epochs (through epoch "
+                             f"{rec.get('epoch')})" + seed_tag(s, s_val))
+                else:
+                    streak = []
+                best = min(best, v)
+
+        # throughput: vs this run's median, and vs THIS run's plan
+        # envelope (the last plan record logged before this segment).
+        # Each run's FIRST epoch record pays jit compilation and is
+        # exempt — flagging every cold start would train readers to
+        # ignore the flag.
+        plan_rate = _plan_rate_for(seg, events)
+        timed = seg[1:] if len(seg) > 1 else seg
+        rates = [r for rec in timed
+                 for r in [_mean(rec.get("days_per_sec",
+                                         rec.get("seed_days_per_sec")))]
+                 if r is not None and r > 0]
+        if rates:
+            run_median = median(rates)
+            for rec in timed:
+                r = _mean(rec.get("days_per_sec",
+                                  rec.get("seed_days_per_sec")))
+                if r is None or r <= 0:
+                    continue
+                if r < slow_frac * run_median:
+                    flag(rec, "slow_epoch",
+                         f"{r:.3g} days/s < {slow_frac:g}x run median "
+                         f"({run_median:.3g})")
+                elif plan_rate is not None and r < slow_frac * plan_rate:
+                    flag(rec, "slow_epoch",
+                         f"{r:.3g} days/s < {slow_frac:g}x the plan "
+                         f"row's measured {plan_rate:.3g} days/s")
+    return flags
+
+
+def build_report(run: dict, **kw) -> dict:
+    epochs = run["epochs"]
+    flags = health_flags(epochs, run["events"], **kw)
+    by_kind: dict = {}
+    for f in flags:
+        by_kind[f["flag"]] = by_kind.get(f["flag"], 0) + 1
+    finals = [r for r in run["events"] if r.get("event") in ("best",
+                                                            "fleet_best")]
+    scores = [r for r in run["events"] if r.get("event") == "scores"]
+    probes_on = any(k in rec for rec in epochs for k in TRAIN_PROBE_KEYS)
+    return {
+        "meta": run["meta"][-1] if run["meta"] else None,
+        "num_epochs": len(epochs),
+        "probes": probes_on,
+        "epochs": epochs,
+        "flags": flags,
+        "summary": {
+            "flag_counts": by_kind,
+            "healthy": not flags,
+            "best": finals[-1] if finals else None,
+            "scores": scores[-1] if scores else None,
+        },
+    }
+
+
+def _flag_matches(f: dict, rec: dict) -> bool:
+    """Row join for the table: by stream position when both sides have
+    it (epoch numbers repeat across concatenated runs), else by epoch
+    number (hand-built record lists)."""
+    if f.get("line") is not None and rec.get("_line") is not None:
+        return f["line"] == rec["_line"]
+    return f["epoch"] == rec.get("epoch")
+
+
+def format_report(rep: dict) -> str:
+    lines = []
+    meta = rep["meta"] or {}
+    lines.append(
+        f"run: {meta.get('run_name') or '?'}  platform="
+        f"{meta.get('platform')}  devices={meta.get('device_count')}  "
+        f"git={meta.get('git_sha')}  config={meta.get('config_hash')}")
+    lines.append(f"epochs: {rep['num_epochs']}   health probes: "
+                 f"{'on' if rep['probes'] else 'off'}")
+    if rep["epochs"]:
+        cols = ["epoch", "train_loss", "val_loss", "lr", "days_per_sec"]
+        if rep["probes"]:
+            cols += ["grad_norm_max", "nonfinite_grads"]
+        lines.append("  ".join(f"{c:>13}" for c in cols) + "  flags")
+        for rec in rep["epochs"]:
+            row = []
+            for c in cols:
+                v = _mean(rec.get(c)) if c != "epoch" else rec.get(c)
+                row.append(f"{v:>13.6g}" if isinstance(v, (int, float))
+                           else f"{'-':>13}")
+            marks = sorted({f["flag"] for f in rep["flags"]
+                            if _flag_matches(f, rec)})
+            lines.append("  ".join(row) + ("  !! " + ",".join(marks)
+                                           if marks else ""))
+        if any(isinstance(r.get("train_loss"), list) for r in rep["epochs"]):
+            lines.append("(fleet run: per-seed lists reported as means; "
+                         "flags fire if ANY seed trips)")
+    if rep["flags"]:
+        lines.append("")
+        lines.append(f"HEALTH FLAGS ({len(rep['flags'])}):")
+        for f in rep["flags"]:
+            lines.append(f"  epoch {f['epoch']}: [{f['flag']}] {f['detail']}")
+    else:
+        lines.append("no health flags — run looks clean")
+    best = rep["summary"]["best"]
+    if best:
+        vals = best.get("best_val")
+        lines.append(f"best val: {vals}")
+    sc = rep["summary"]["scores"]
+    if sc:
+        lines.append(f"scores: rank_ic={sc.get('rank_ic')} "
+                     f"rank_ic_ir={sc.get('rank_ic_ir')} -> {sc.get('path')}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m factorvae_tpu.obs.report",
+        description="Per-epoch health table + flags for a RUN.jsonl")
+    ap.add_argument("run_jsonl")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--spike-mult", type=float, default=10.0)
+    ap.add_argument("--slow-frac", type=float, default=0.5)
+    ap.add_argument("--diverge-frac", type=float, default=0.2)
+    ap.add_argument("--diverge-epochs", type=int, default=3)
+    args = ap.parse_args(argv)
+    rep = build_report(
+        load_run(args.run_jsonl), spike_mult=args.spike_mult,
+        slow_frac=args.slow_frac, diverge_frac=args.diverge_frac,
+        diverge_epochs=args.diverge_epochs)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(format_report(rep))
+    return 0 if rep["num_epochs"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

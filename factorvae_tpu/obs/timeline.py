@@ -1,0 +1,256 @@
+"""Render a RUN.jsonl host timeline as a text Gantt + overlap report.
+
+    python -m factorvae_tpu.obs.timeline RUN.jsonl [--width 72]
+        [--top 10] [--json]
+
+Reads the `span` / `mark` records that `utils.logging.Timeline` emits
+(Trainer/FleetTrainer epochs on the "device" resource, ChunkStream
+prefetch on "stream", checkpoint saves/serializes on "checkpoint",
+compile-watchdog spans on "compile") and prints:
+
+- one Gantt lane per resource (merged busy intervals over the run
+  window), so the overlap structure of the pipeline — is the prefetch
+  really hiding behind the epoch scan? is the async checkpoint really
+  off the critical path? — is visible at a glance;
+- per-resource totals: busy seconds, span count, and `overlap_frac` —
+  the fraction of that resource's busy time that overlapped "device"
+  busy time. This is the run-level generalization of the ChunkStream
+  ledger's overlap number: ~1.0 means the work hid behind compute,
+  ~0.0 means it ran in the gaps (or the gaps ran in it).
+
+Span names deliberately match `utils.profiling.step_annotation` names
+(`train_epoch_{e}`, ...), so a host span here can be located on the
+device lanes of a `--profile` trace (utils/trace_summary.py) by name.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+Interval = Tuple[float, float]
+
+DEVICE_RESOURCE = "device"
+
+
+def load_run(path: str) -> dict:
+    """Split a RUN.jsonl into {"spans", "marks", "epochs", "meta",
+    "events"} record lists (unparseable lines are skipped, not fatal —
+    a live-tailed file may end mid-line)."""
+    out: dict = {"spans": [], "marks": [], "epochs": [], "meta": [],
+                 "events": []}
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            # Stream position: the report needs record ORDER across the
+            # split lists (e.g. which plan record precedes which run's
+            # epochs in a concatenated session stream).
+            rec.setdefault("_line", i)
+            ev = rec.get("event")
+            if ev == "span":
+                out["spans"].append(rec)
+            elif ev == "mark":
+                out["marks"].append(rec)
+            elif ev in ("epoch", "fleet_epoch"):
+                out["epochs"].append(rec)
+            elif ev == "run_meta":
+                out["meta"].append(rec)
+            else:
+                out["events"].append(rec)
+    return out
+
+
+def merge_intervals(iv: List[Interval]) -> List[Interval]:
+    """Sorted union of possibly-overlapping intervals."""
+    out: List[Interval] = []
+    for lo, hi in sorted(iv):
+        if hi <= lo:
+            continue
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def total(iv: List[Interval]) -> float:
+    return sum(hi - lo for lo, hi in iv)
+
+
+def intersect(a: List[Interval], b: List[Interval]) -> List[Interval]:
+    """Intersection of two MERGED interval lists (linear sweep)."""
+    out: List[Interval] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo < hi:
+            out.append((lo, hi))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def resource_intervals(spans: List[dict]) -> dict:
+    """resource -> merged busy intervals."""
+    by_res: dict = {}
+    for s in spans:
+        try:
+            by_res.setdefault(s.get("resource", "host"), []).append(
+                (float(s["t0"]), float(s["t1"])))
+        except (KeyError, TypeError, ValueError):
+            continue
+    return {r: merge_intervals(iv) for r, iv in by_res.items()}
+
+
+def overlap_report(spans: List[dict]) -> List[dict]:
+    """Per-resource busy totals + overlap_frac vs the device lane.
+    overlap_frac is None for the device lane itself and when no device
+    spans exist (nothing to overlap with — report honestly, don't
+    default to 0 or 1)."""
+    res = resource_intervals(spans)
+    device = res.get(DEVICE_RESOURCE, [])
+    counts: dict = {}
+    for s in spans:
+        counts[s.get("resource", "host")] = counts.get(
+            s.get("resource", "host"), 0) + 1
+    rows = []
+    for r in sorted(res):
+        busy = total(res[r])
+        if r == DEVICE_RESOURCE or not device or busy <= 0.0:
+            frac: Optional[float] = None
+        else:
+            frac = total(intersect(res[r], device)) / busy
+        rows.append({
+            "resource": r,
+            "busy_seconds": round(busy, 6),
+            "spans": counts.get(r, 0),
+            "overlap_frac": None if frac is None else round(frac, 4),
+        })
+    return rows
+
+
+def gantt(spans: List[dict], width: int = 72) -> str:
+    """One text lane per resource over the run window."""
+    res = resource_intervals(spans)
+    if not res:
+        return "(no spans)"
+    lo = min(iv[0][0] for iv in res.values() if iv)
+    hi = max(iv[-1][1] for iv in res.values() if iv)
+    window = max(hi - lo, 1e-9)
+    name_w = max(len(r) for r in res)
+    lines = [f"{'':<{name_w}}  |{'run window':-^{width}}| "
+             f"{lo:.3f}s .. {hi:.3f}s"]
+    for r in sorted(res):
+        cells = [" "] * width
+        for a, b in res[r]:
+            c0 = int((a - lo) / window * width)
+            c1 = max(c0 + 1, int((b - lo) / window * width + 0.5))
+            for c in range(c0, min(c1, width)):
+                cells[c] = "#"
+        lines.append(f"{r:<{name_w}}  |{''.join(cells)}|")
+    return "\n".join(lines)
+
+
+def span_sections(run: dict) -> List[List[dict]]:
+    """Partition a stream's spans into per-process sections at
+    `run_meta` boundaries (every file-backed MetricsLogger attach
+    writes one). Each process's Timeline origin restarts near zero, so
+    spans from different sections of a concatenated session stream
+    share NO time base: merging them would overlay separate runs into
+    one window and fabricate overlap between work that never ran
+    concurrently. Streams without positional info (hand-built lists)
+    or with a single header stay one section."""
+    bounds = sorted(m["_line"] for m in run.get("meta", [])
+                    if m.get("_line") is not None)
+    spans = run["spans"]
+    if len(bounds) <= 1 or any(s.get("_line") is None for s in spans):
+        return [spans] if spans else []
+    sections: List[List[dict]] = [[] for _ in bounds]
+    for s in spans:
+        # the section whose header precedes this span
+        i = sum(1 for b in bounds if b < s["_line"]) - 1
+        sections[max(i, 0)].append(s)
+    return [sec for sec in sections if sec]
+
+
+def format_report(run: dict, width: int = 72, top: int = 10) -> str:
+    sections = span_sections(run)
+    lines: List[str] = []
+    for i, spans in enumerate(sections):
+        if len(sections) > 1:
+            lines.append(f"=== run section {i + 1}/{len(sections)} "
+                         "(separate process: own time base) ===")
+        lines.append(gantt(spans, width=width))
+        lines.append("")
+        rows = overlap_report(spans)
+        if rows:
+            w = max(len("resource"), max(len(r["resource"]) for r in rows))
+            lines.append(f"{'resource':<{w}} {'busy':>10} {'spans':>6}  "
+                         "overlap_frac")
+            for r in rows:
+                frac = ("-" if r["overlap_frac"] is None
+                        else f"{r['overlap_frac']:.1%}")
+                lines.append(
+                    f"{r['resource']:<{w}} {r['busy_seconds']:>9.3f}s "
+                    f"{r['spans']:>6}  {frac}")
+        if top > 0 and spans:
+            longest = sorted(spans,
+                             key=lambda s: -float(s.get("dur", 0.0)))[:top]
+            lines.append("")
+            lines.append(f"longest spans (top {len(longest)}):")
+            for s in longest:
+                lines.append(
+                    f"  {s.get('dur', 0.0):>9.3f}s  [{s.get('resource')}] "
+                    f"{s.get('name')}")
+        if len(sections) > 1:
+            lines.append("")
+    storms = [m for m in run["marks"] if m.get("name") == "retrace_storm"]
+    if storms:
+        worst = max(storms, key=lambda m: m.get("compiles", 0))
+        lines.append(
+            f"RETRACE STORM: '{worst.get('fn')}' compiled "
+            f"{worst.get('compiles')} times over {worst.get('calls')} calls")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m factorvae_tpu.obs.timeline",
+        description="Text Gantt + per-resource overlap for a RUN.jsonl "
+                    "span stream")
+    ap.add_argument("run_jsonl")
+    ap.add_argument("--width", type=int, default=72)
+    ap.add_argument("--top", type=int, default=10,
+                    help="longest spans listed (0 disables)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable overlap report instead of text")
+    args = ap.parse_args(argv)
+    run = load_run(args.run_jsonl)
+    if args.json:
+        print(json.dumps({
+            # per-section: spans across run_meta boundaries carry
+            # separate per-process time bases (see span_sections)
+            "sections": [overlap_report(sec)
+                         for sec in span_sections(run)],
+            "num_spans": len(run["spans"]),
+            "retrace_storms": [m for m in run["marks"]
+                               if m.get("name") == "retrace_storm"],
+        }, indent=2))
+    else:
+        print(format_report(run, width=args.width, top=args.top))
+    return 0 if run["spans"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,90 @@
+"""jit compile/retrace watchdog.
+
+A retrace storm — a jitted function recompiling every call because a
+static argument or a weak-typed shape keeps changing — is invisible at
+the Python level: the run just gets mysteriously slower. `WatchedJit`
+wraps a compiled function and watches its executable cache
+(`_cache_size()`, present on jax's PjitFunction; absent-API fallback:
+only the first call counts as a compile): a call that GROWS the cache
+was a cache miss, its wall time (compile + first execution — jax does
+not expose the split) is emitted as a `jit_compile:<name>` span on the
+installed timeline, and once the per-function miss count passes
+`storm_threshold` every further miss emits a `retrace_storm` mark so
+the report/timeline flag it.
+
+The wrapper is a transparent passthrough — same positional/keyword
+calling convention, same outputs, donation semantics untouched (they
+live on the wrapped jit) — and does NOTHING unless a timeline is
+installed, so the default path stays exactly the pre-observatory one.
+graftlint's engine resolves `self._f = watch_jit(jax.jit(...), ...)`
+assignments through the wrapper, so JGL004 donation tracking keeps
+working on watched jits.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from factorvae_tpu.utils.logging import current_timeline
+
+# Misses beyond this per function flag a retrace storm. The legitimate
+# compile count for an epoch function is tiny (one per distinct scan
+# length: whole epochs plus possibly one shorter tail chunk).
+STORM_THRESHOLD = 3
+
+
+class WatchedJit:
+    def __init__(self, fn: Callable, name: str,
+                 storm_threshold: int = STORM_THRESHOLD):
+        self._fn = fn
+        self.name = name
+        self.storm_threshold = storm_threshold
+        self.calls = 0
+        self.compiles = 0
+
+    def __getattr__(self, attr: str) -> Any:
+        # Transparent delegation: jit-surface APIs (.lower(),
+        # .clear_cache(), ...) keep working on a watched function
+        # (tests/test_parallel.py lowers the trainer's epoch jit to
+        # assert sharded HLO).
+        return getattr(self._fn, attr)
+
+    def _cache_size(self) -> Optional[int]:
+        f = getattr(self._fn, "_cache_size", None)
+        if not callable(f):
+            return None
+        try:
+            return int(f())
+        except Exception:
+            return None
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        tl = current_timeline()
+        if tl is None:
+            return self._fn(*args, **kwargs)
+        before = self._cache_size()
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        t1 = time.perf_counter()
+        self.calls += 1
+        missed = (self.calls == 1 if before is None
+                  else (self._cache_size() or 0) > before)
+        if missed:
+            self.compiles += 1
+            tl.span_at(
+                f"jit_compile:{self.name}", t0, t1, cat="compile",
+                resource="compile", compiles=self.compiles)
+            if self.compiles > self.storm_threshold:
+                tl.event(
+                    "retrace_storm", cat="compile", resource="compile",
+                    fn=self.name, compiles=self.compiles, calls=self.calls,
+                    note="cache misses keep accruing — a static arg or "
+                         "shape is changing per call")
+        return out
+
+
+def watch_jit(fn: Callable, name: str,
+              storm_threshold: int = STORM_THRESHOLD) -> WatchedJit:
+    """Wrap a jitted callable with the compile watchdog."""
+    return WatchedJit(fn, name, storm_threshold=storm_threshold)

@@ -151,6 +151,14 @@ class Plan:
     `scripts/autotune_plan.py --stream` rows (a `"stream"` block;
     absent on pre-stream rows, which resolve to "hbm" — no schema
     break).
+
+    `obs_probes` is the observability knob (obs/probes.py via
+    TrainConfig.obs_probes): whether the on-device health probes
+    compile into the epoch scan. Off by default (the bitwise-neutral
+    path); a row's `"obs"` block (`{"probes": true}`) can switch a
+    deployment on once `bench.py --obs` has shown the overhead
+    acceptable for that shape. Rows without the block keep resolving
+    probes-off — same backward-compatibility rule as `fleet`/`stream`.
     """
 
     flatten_days: bool
@@ -166,6 +174,7 @@ class Plan:
     seeds_per_program: int = 1
     panel_residency: str = "hbm"
     stream_chunk_days: int = 32
+    obs_probes: bool = False
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -398,6 +407,10 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                     or "hbm"),
                 stream_chunk_days=int(
                     (row.get("stream") or {}).get("chunk_days") or 32),
+                # Pre-observatory rows have no "obs" block: probes off
+                # (the bitwise-neutral default).
+                obs_probes=bool(
+                    (row.get("obs") or {}).get("probes", False)),
             )
     default = _TPU_DEFAULT if plat == "tpu" else _CPU_DEFAULT
     src = ("per-backend default: round-2 measured TPU winners (PERF.md)"
@@ -436,7 +449,7 @@ def plan_for_config(config, n_stocks: int, platform: Optional[str] = None,
 def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
                keep_dtype: bool = False, keep_layout: bool = False,
                keep_pad: bool = False, keep_kernels: bool = False,
-               keep_residency: bool = False):
+               keep_residency: bool = False, keep_obs: bool = False):
     """Return a Config with the plan's TRAINING knobs applied. `keep_*`
     leaves an explicitly user-set knob alone (CLI flag precedence)."""
     model_kw: dict = {}
@@ -452,8 +465,13 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
         model_kw["use_pallas_gru"] = plan.use_pallas_gru
     model = dataclasses.replace(config.model, **model_kw) \
         if model_kw else config.model
-    train = config.train if keep_days_per_step else dataclasses.replace(
-        config.train, days_per_step=plan.days_per_step)
+    train_kw: dict = {}
+    if not keep_days_per_step:
+        train_kw["days_per_step"] = plan.days_per_step
+    if not keep_obs:
+        train_kw["obs_probes"] = plan.obs_probes
+    train = dataclasses.replace(config.train, **train_kw) \
+        if train_kw else config.train
     data_kw: dict = {}
     if not keep_pad:
         data_kw["max_stocks"] = plan.pad_target

@@ -18,12 +18,19 @@ corrupt one.
 from __future__ import annotations
 
 import os
+import threading
+import time
 from typing import Any, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 from factorvae_tpu.train.state import TrainState
+from factorvae_tpu.utils.logging import (
+    current_timeline,
+    timeline_span,
+    timeline_span_at,
+)
 
 
 def _own_buffers(tree):
@@ -71,29 +78,66 @@ class Checkpointer:
         self._async = async_save
 
     def save(self, step: int, state: TrainState, meta: dict) -> None:
-        if self._async:
-            # Snapshot to OWNED host buffers before handing orbax the
-            # tree: its background writer would otherwise hold zero-copy
-            # views of CPU jax arrays that the next epoch's jit donates
-            # (the same alias class the restore-side _own_buffers
-            # severs). One host memcpy up front; serialization and disk
-            # I/O then overlap the next epoch freely.
-            import numpy as np
+        # `ckpt_save` on the timeline is the part the TRAINING LOOP
+        # pays: snapshot + enqueue under async, the whole serialization
+        # under sync — the number that shows whether async checkpointing
+        # actually moved the cost off the critical path.
+        with timeline_span(f"ckpt_save_{step}", cat="checkpoint",
+                           resource="checkpoint", step=step,
+                           mode="async" if self._async else "sync"):
+            if self._async:
+                # Snapshot to OWNED host buffers before handing orbax the
+                # tree: its background writer would otherwise hold
+                # zero-copy views of CPU jax arrays that the next epoch's
+                # jit donates (the same alias class the restore-side
+                # _own_buffers severs). One host memcpy up front;
+                # serialization and disk I/O then overlap the next epoch
+                # freely.
+                import numpy as np
 
-            state = jax.tree.map(lambda x: np.array(x), state)
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(meta),
-            ),
-        )
+                state = jax.tree.map(lambda x: np.array(x), state)
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+            )
         if not self._async:
             self._mgr.wait_until_finished()
+        elif current_timeline() is not None:
+            self._watch_commit(step)
+
+    def _watch_commit(self, step: int) -> None:
+        """Emit the BACKGROUND serialize span for an async save: a
+        daemon thread polls for orbax's atomic step-directory commit
+        (tmp-dir rename) and reports enqueue->commit as
+        `ckpt_serialize_{step}` — the filesystem is the only safe
+        observation point (orbax's manager is not re-entrant from a
+        second thread). Telemetry only: spawned when a timeline is
+        installed, never on the default path."""
+        t0 = time.perf_counter()
+        path = os.path.join(self.directory, str(step))
+
+        def poll() -> None:
+            deadline = t0 + 600.0
+            while time.perf_counter() < deadline:
+                if os.path.isdir(path):
+                    timeline_span_at(
+                        f"ckpt_serialize_{step}", t0, time.perf_counter(),
+                        cat="checkpoint", resource="ckpt_serialize",
+                        step=step)
+                    return
+                time.sleep(0.02)
+
+        threading.Thread(target=poll, daemon=True,
+                         name=f"ckpt-commit-watch-{step}").start()
 
     def wait_until_finished(self) -> None:
         """Drain any in-flight async save (the moved barrier)."""
-        self._mgr.wait_until_finished()
+        with timeline_span("ckpt_barrier", cat="checkpoint",
+                           resource="checkpoint"):
+            self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         self._mgr.wait_until_finished()

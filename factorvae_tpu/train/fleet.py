@@ -56,7 +56,7 @@ from factorvae_tpu.train.state import (
     learning_rate_at,
     make_optimizer,
 )
-from factorvae_tpu.utils.logging import MetricsLogger
+from factorvae_tpu.utils.logging import MetricsLogger, timeline_span
 
 
 def stack_states(states: Sequence[TrainState]) -> TrainState:
@@ -148,6 +148,7 @@ class FleetTrainer:
             compute_dtype=config.model.compute_dtype,
             n_real=getattr(dataset, "n_real", dataset.n_max),
             n_padded=dataset.n_max,
+            obs_probes=config.train.obs_probes,
         )
 
     # ------------------------------------------------------------------
@@ -159,47 +160,59 @@ class FleetTrainer:
         cfg = self.cfg
         self.tx = make_optimizer(cfg.train, self.total_steps)
         self.fns = make_step_fns(
-            self.model, self.model_eval, self.tx, cfg.data.seq_len
+            self.model, self.model_eval, self.tx, cfg.data.seq_len,
+            obs=cfg.train.obs_probes,
         )
+        from factorvae_tpu.obs.watchdog import watch_jit
+
         if self.num_seeds == 1:
             # Bitwise-oracle path: identical jits to the serial Trainer.
-            self._train_epoch_jit = jax.jit(
-                self.fns.train_epoch, donate_argnums=(0,))
-            self._eval_epoch_jit = jax.jit(self.fns.eval_epoch)
+            self._train_epoch_jit = watch_jit(jax.jit(
+                self.fns.train_epoch, donate_argnums=(0,)),
+                "fleet_train_epoch")
+            self._eval_epoch_jit = watch_jit(
+                jax.jit(self.fns.eval_epoch), "fleet_eval_epoch")
             if self.stream:
-                self._train_chunk_jit = jax.jit(
-                    self.fns.train_chunk, donate_argnums=(0,))
-                self._eval_chunk_jit = jax.jit(self.fns.eval_chunk)
-                self._finalize_train_jit = jax.jit(self.fns.finalize_train)
-                self._finalize_eval_jit = jax.jit(self.fns.finalize_eval)
+                self._train_chunk_jit = watch_jit(jax.jit(
+                    self.fns.train_chunk, donate_argnums=(0,)),
+                    "fleet_train_chunk")
+                self._eval_chunk_jit = watch_jit(
+                    jax.jit(self.fns.eval_chunk), "fleet_eval_chunk")
+                self._finalize_train_jit = watch_jit(
+                    jax.jit(self.fns.finalize_train),
+                    "fleet_finalize_train")
+                self._finalize_eval_jit = watch_jit(
+                    jax.jit(self.fns.finalize_eval), "fleet_finalize_eval")
         else:
             # Panel broadcast (in_axes=None): ONE HBM copy serves every
             # seed; state and day orders carry the seed axis.
-            self._train_epoch_jit = jax.jit(
+            self._train_epoch_jit = watch_jit(jax.jit(
                 jax.vmap(self.fns.train_epoch, in_axes=(0, 0, None)),
                 donate_argnums=(0,),
-            )
+            ), "fleet_train_epoch")
             # params/key are per-seed; the validation order is shared
             # (shuffle=False, seed 0 — identical across seeds).
-            self._eval_epoch_jit = jax.jit(
+            self._eval_epoch_jit = watch_jit(jax.jit(
                 jax.vmap(self.fns.eval_epoch, in_axes=(0, None, 0, None))
-            )
+            ), "fleet_eval_epoch")
             if self.stream:
                 # Train mini-panels are PER-SEED (each seed shuffles its
                 # own day order, so its chunk gathers different slabs);
                 # the shared validation order keeps one broadcast panel.
-                self._train_chunk_jit = jax.jit(
+                self._train_chunk_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.train_chunk, in_axes=(0, 0, 0)),
                     donate_argnums=(0,),
-                )
-                self._eval_chunk_jit = jax.jit(
+                ), "fleet_train_chunk")
+                self._eval_chunk_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.eval_chunk,
                              in_axes=(0, None, 0, None))
-                )
-                self._finalize_train_jit = jax.jit(
-                    jax.vmap(self.fns.finalize_train))
-                self._finalize_eval_jit = jax.jit(
-                    jax.vmap(self.fns.finalize_eval))
+                ), "fleet_eval_chunk")
+                self._finalize_train_jit = watch_jit(jax.jit(
+                    jax.vmap(self.fns.finalize_train)),
+                    "fleet_finalize_train")
+                self._finalize_eval_jit = watch_jit(jax.jit(
+                    jax.vmap(self.fns.finalize_eval)),
+                    "fleet_finalize_eval")
 
     def panel_args(self):
         return (self.ds.values, self.ds.last_valid, self.ds.next_valid)
@@ -430,12 +443,27 @@ class FleetTrainer:
         val_order = self._val_order()
         ckpt_every = max(1, cfg.train.checkpoint_every or 0)
         history = []
+        from factorvae_tpu.utils.logging import current_timeline
+
         for epoch in range(start_epoch, epochs):
             t0 = time.time()
-            run_state, train_m = self._run_train_epoch(run_state, epoch)
+            # Timed spans drain the dispatch (block_until_ready) so the
+            # span covers the device work; without a timeline the loop
+            # keeps its original async dispatch exactly.
+            with timeline_span(f"train_epoch_{epoch}", cat="train",
+                               resource="device", epoch=epoch,
+                               seeds=self.num_seeds):
+                run_state, train_m = self._run_train_epoch(run_state, epoch)
+                if current_timeline() is not None:
+                    jax.block_until_ready(train_m["loss"])
             if val_order is not None:
-                val_m = self._run_eval_epoch(run_state.params, val_order,
-                                             epoch)
+                with timeline_span(f"val_epoch_{epoch}", cat="eval",
+                                   resource="device", epoch=epoch,
+                                   seeds=self.num_seeds):
+                    val_m = self._run_eval_epoch(run_state.params,
+                                                 val_order, epoch)
+                    if current_timeline() is not None:
+                        jax.block_until_ready(val_m["loss"])
                 selection = val_m["loss"]
             else:
                 val_m = None
@@ -474,6 +502,22 @@ class FleetTrainer:
                     self.num_seeds * float(np.asarray(train_m["days"])[0])
                     / max(dt, 1e-9)),
             )
+            if cfg.train.obs_probes:
+                # Per-seed probe lists (obs/probes.py): the vmapped
+                # epoch returns every scalar probe (S,)-shaped.
+                from factorvae_tpu.obs.probes import (
+                    EVAL_PROBE_KEYS,
+                    TRAIN_PROBE_KEYS,
+                )
+
+                for k in TRAIN_PROBE_KEYS:
+                    if k in train_m:
+                        rec[k] = [float(v) for v in np.asarray(train_m[k])]
+                if val_m is not None:
+                    for k in EVAL_PROBE_KEYS:
+                        if k in val_m:
+                            rec["val_" + k] = [
+                                float(v) for v in np.asarray(val_m[k])]
             history.append(rec)
             self.logger.log("fleet_epoch", **rec)
             # Serial save cadence, fleet-wide: improved seeds' best-val

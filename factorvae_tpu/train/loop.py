@@ -70,6 +70,7 @@ def make_step_fns(
     tx: optax.GradientTransformation,
     seq_len: int,
     shard_batch: Any = None,
+    obs: bool = False,
 ) -> StepFns:
     """`model_train` / `model_eval` are the day-batched forward variants
     (models.day_forward with train=True/False; they share one param tree).
@@ -84,7 +85,17 @@ def make_step_fns(
 
     `shard_batch`, when given (parallel.make_batch_constraint), pins the
     gathered (B, N, ...) batch to the ('data', 'stock') mesh layout inside
-    the jitted step."""
+    the jitted step.
+
+    `obs=True` (TrainConfig.obs_probes) compiles the on-device health
+    probes (obs/probes.py: grad/update/param global norms, non-finite
+    counters, factor-posterior spread) into the step aux and the epoch
+    finalizers — scalar additions to the scan carry, zero extra
+    dispatches, vmappable over the fleet seed axis like every other
+    metric. `obs=False` (the default) is gated at TRACE TIME: the traced
+    graph is the pre-observatory one, so the default path stays bitwise
+    identical (pinned in tests/test_obs.py, the `panel_residency`
+    discipline)."""
 
     def batch_for(days: jnp.ndarray, panel):
         values, last_valid, next_valid = panel
@@ -122,6 +133,10 @@ def make_step_fns(
             "wloss_sum": jnp.sum(out.loss * n_valid),
             "samples": jnp.sum(n_valid),
         }
+        if obs:
+            from factorvae_tpu.obs.probes import loss_probes
+
+            aux.update(loss_probes(out, day_w))
         return loss, aux
 
     def train_step(state: TrainState, days: jnp.ndarray, panel):
@@ -134,6 +149,10 @@ def make_step_fns(
         state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt
         )
+        if obs:
+            from factorvae_tpu.obs.probes import grad_probes
+
+            aux.update(grad_probes(grads, updates, new_params))
         return state, aux
 
     def finalize_train(auxes):
@@ -142,16 +161,21 @@ def make_step_fns(
         (jitted over the chunk-concatenated aux): the metric reduction
         over the full step axis is identical either way."""
         days = jnp.maximum(jnp.sum(auxes["days"]), 1.0)
-        return {
+        m = {
             "loss": jnp.sum(auxes["loss_sum"]) / days,
             "recon": jnp.sum(auxes["recon_sum"]) / days,
             "kl": jnp.sum(auxes["kl_sum"]) / days,
             "days": jnp.sum(auxes["days"]),
         }
+        if obs:
+            from factorvae_tpu.obs.probes import finalize_train_probes
+
+            m.update(finalize_train_probes(auxes, days))
+        return m
 
     def finalize_eval(auxes):
         days = jnp.maximum(jnp.sum(auxes["days"]), 1.0)
-        return {
+        m = {
             "loss": jnp.sum(auxes["loss_sum"]) / days,
             "recon": jnp.sum(auxes["recon_sum"]) / days,
             "kl": jnp.sum(auxes["kl_sum"]) / days,
@@ -161,6 +185,11 @@ def make_step_fns(
             "loss_sample_weighted": jnp.sum(auxes["wloss_sum"])
             / jnp.maximum(jnp.sum(auxes["samples"]), 1.0),
         }
+        if obs:
+            from factorvae_tpu.obs.probes import finalize_eval_probes
+
+            m.update(finalize_eval_probes(auxes, days))
+        return m
 
     def train_chunk(state: TrainState, order: jnp.ndarray, panel):
         """One epoch SEGMENT: the epoch scan body over a (k, B) slice of

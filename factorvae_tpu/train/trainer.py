@@ -42,7 +42,7 @@ from factorvae_tpu.train.state import (
     learning_rate_at,
     make_optimizer,
 )
-from factorvae_tpu.utils.logging import MetricsLogger
+from factorvae_tpu.utils.logging import MetricsLogger, timeline_span
 
 
 class Trainer:
@@ -118,6 +118,7 @@ class Trainer:
             n_padded=dataset.n_max,
             dead_compute_frac=round(
                 getattr(dataset, "dead_compute_frac", 0.0), 4),
+            obs_probes=config.train.obs_probes,
         )
 
     def _build_step_fns(self) -> None:
@@ -133,7 +134,14 @@ class Trainer:
             self.tx,
             cfg.data.seq_len,
             shard_batch=self._shard_batch,
+            obs=cfg.train.obs_probes,
         )
+
+        # Every jit goes through the compile watchdog (obs/watchdog.py):
+        # a pure passthrough unless a timeline is installed, in which
+        # case cache misses become jit_compile spans and retrace storms
+        # are flagged in RUN.jsonl.
+        from factorvae_tpu.obs.watchdog import watch_jit
 
         donate = (0,)
         if self.mesh is not None:
@@ -141,29 +149,33 @@ class Trainer:
             ord_s = order_sharding(self.mesh)
             pan_s = panel_shardings(self.mesh)
             # `rep` as a prefix pytree replicates the whole state/metrics
-            self._train_epoch_jit = jax.jit(
+            self._train_epoch_jit = watch_jit(jax.jit(
                 self.fns.train_epoch,
                 donate_argnums=donate,
                 in_shardings=(rep, ord_s, pan_s),
                 out_shardings=(rep, rep),
-            )
-            self._eval_epoch_jit = jax.jit(
+            ), "train_epoch")
+            self._eval_epoch_jit = watch_jit(jax.jit(
                 self.fns.eval_epoch, in_shardings=(rep, ord_s, rep, pan_s),
                 out_shardings=rep,
-            )
+            ), "eval_epoch")
         else:
-            self._train_epoch_jit = jax.jit(
-                self.fns.train_epoch, donate_argnums=donate)
-            self._eval_epoch_jit = jax.jit(self.fns.eval_epoch)
+            self._train_epoch_jit = watch_jit(jax.jit(
+                self.fns.train_epoch, donate_argnums=donate), "train_epoch")
+            self._eval_epoch_jit = watch_jit(
+                jax.jit(self.fns.eval_epoch), "eval_epoch")
         if self.stream:
             # Chunked stream-epoch programs: the same step bodies scanned
             # over prefetched batches + the shared metric finalizers
             # (train/loop.py docstrings spell out the bitwise contract).
-            self._train_chunk_jit = jax.jit(
-                self.fns.train_chunk, donate_argnums=donate)
-            self._eval_chunk_jit = jax.jit(self.fns.eval_chunk)
-            self._finalize_train_jit = jax.jit(self.fns.finalize_train)
-            self._finalize_eval_jit = jax.jit(self.fns.finalize_eval)
+            self._train_chunk_jit = watch_jit(jax.jit(
+                self.fns.train_chunk, donate_argnums=donate), "train_chunk")
+            self._eval_chunk_jit = watch_jit(
+                jax.jit(self.fns.eval_chunk), "eval_chunk")
+            self._finalize_train_jit = watch_jit(
+                jax.jit(self.fns.finalize_train), "finalize_train")
+            self._finalize_eval_jit = watch_jit(
+                jax.jit(self.fns.finalize_eval), "finalize_eval")
 
     def panel_args(self):
         """The HBM panel as explicit jit arguments (loop.py: passing these
@@ -343,15 +355,24 @@ class Trainer:
         for epoch in range(start_epoch, epochs):
             t0 = time.time()
             order = self._epoch_orders(epoch)
-            with step_annotation(f"train_epoch_{epoch}"):
+            # The timeline span shares its name with the profiler
+            # step_annotation so host spans cross-link with --profile
+            # device lanes; the float() sync inside the span makes the
+            # span cover the device work, not just the dispatch.
+            with step_annotation(f"train_epoch_{epoch}"), \
+                    timeline_span(f"train_epoch_{epoch}", cat="train",
+                                  resource="device", epoch=epoch):
                 state, train_m = self._train_epoch(state, order)
-            train_loss = float(train_m["loss"])
+                train_loss = float(train_m["loss"])
             if val_order is not None:
                 eval_key = jax.random.fold_in(
                     jax.random.PRNGKey(cfg.train.seed + 1), epoch
                 )
-                val_m = self._eval_epoch(state.params, val_order, eval_key)
-                val_loss = float(val_m["loss"])
+                with timeline_span(f"val_epoch_{epoch}", cat="eval",
+                                   resource="device", epoch=epoch):
+                    val_m = self._eval_epoch(state.params, val_order,
+                                             eval_key)
+                    val_loss = float(val_m["loss"])
                 selection_loss = val_loss
             else:
                 # No validation split: select the best epoch on train loss
@@ -380,6 +401,22 @@ class Trainer:
                 seconds=dt,
                 days_per_sec=float(train_m["days"]) / max(dt, 1e-9),
             )
+            if cfg.train.obs_probes:
+                # On-device health probes (obs/probes.py), already in
+                # the fetched metric dicts — same per-epoch host sync
+                # the loss metrics pay, no extra dispatches.
+                from factorvae_tpu.obs.probes import (
+                    EVAL_PROBE_KEYS,
+                    TRAIN_PROBE_KEYS,
+                )
+
+                for k in TRAIN_PROBE_KEYS:
+                    if k in train_m:
+                        rec[k] = float(train_m[k])
+                if val_order is not None:
+                    for k in EVAL_PROBE_KEYS:
+                        if k in val_m:
+                            rec["val_" + k] = float(val_m[k])
             history.append(rec)
             self.logger.log("epoch", **rec)
 

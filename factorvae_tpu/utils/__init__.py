@@ -1,4 +1,9 @@
-from factorvae_tpu.utils.logging import MetricsLogger
+from factorvae_tpu.utils.logging import (
+    MetricsLogger,
+    Timeline,
+    current_timeline,
+    install_timeline,
+)
 from factorvae_tpu.utils.profiling import debug_nans, step_annotation, trace
 from factorvae_tpu.utils.rng import set_seed
 from factorvae_tpu.utils.testing import (
@@ -9,7 +14,10 @@ from factorvae_tpu.utils.testing import (
 
 __all__ = [
     "MetricsLogger",
+    "Timeline",
+    "current_timeline",
     "debug_nans",
+    "install_timeline",
     "enable_persistent_compile_cache",
     "force_host_devices",
     "host_device_count",

@@ -1,21 +1,82 @@
-"""Structured metrics stream.
+"""Structured metrics stream + the unified host timeline.
 
-Replaces the reference's print + tqdm + optional wandb combo
-(main.py:63-87) with a JSONL metric stream (one line per epoch/event)
-plus the same optional wandb hookup, gated so the framework runs without
-wandb installed or configured.
+`MetricsLogger` replaces the reference's print + tqdm + optional wandb
+combo (main.py:63-87) with a JSONL metric stream (one line per
+epoch/event) plus the same optional wandb hookup, gated so the framework
+runs without wandb installed or configured. Every file-backed stream
+opens with a `run_meta` header record (jax/platform/device_count, git
+sha, config hash) so a RUN.jsonl is self-describing, and the logger is a
+context manager that closes its file handle on error paths.
+
+`Timeline` is the span/event half of the run observatory
+(factorvae_tpu/obs): monotonic-clock spans (`time.perf_counter`, immune
+to wall-clock jumps), thread-safe by construction (the underlying
+logger serializes writes), emitted as `span` / `mark` records into the
+SAME JSONL stream as the metrics — one RUN.jsonl carries epochs, health
+probes, stream-prefetch spans, checkpoint spans and compile-watchdog
+events, which `python -m factorvae_tpu.obs.timeline` renders as a text
+Gantt with per-resource overlap fractions. Span names are chosen to
+match `utils.profiling.step_annotation` names so a host span can be
+cross-linked with the device lanes of a `--profile` trace.
+
+Producers deep in the stack (data/stream.py's prefetch worker, the
+async Checkpointer, the jit watchdog) reach the run's timeline through
+the module-level `install_timeline` / `current_timeline` registry and
+the no-op-when-absent `timeline_span` / `timeline_event` /
+`timeline_span_at` helpers — zero overhead and zero behavior change
+when no timeline is installed (the default).
 """
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
-from typing import Any, Optional
+from typing import Any, Iterator, Optional
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+        )
+        return r.stdout.strip() or None if r.returncode == 0 else None
+    except Exception:
+        return None
+
+
+def run_meta(config: Optional[dict] = None,
+             run_name: Optional[str] = None) -> dict:
+    """Header fields for the first record of a metrics stream. jax is
+    queried only if already imported (probing it here must not
+    initialize a backend behind the caller's platform setup)."""
+    meta: dict = {"run_name": run_name, "git_sha": _git_sha()}
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        meta["jax"] = getattr(jax, "__version__", None)
+        try:
+            meta["platform"] = jax.default_backend()
+            meta["device_count"] = jax.device_count()
+        except Exception:  # backend not initializable here — header only
+            meta["platform"] = None
+            meta["device_count"] = None
+    if config is not None:
+        blob = json.dumps(config, sort_keys=True, default=str)
+        meta["config_hash"] = hashlib.sha256(blob.encode()).hexdigest()[:12]
+    return meta
 
 
 class MetricsLogger:
+    """JSONL metric stream; context manager; thread-safe writes."""
+
     def __init__(
         self,
         jsonl_path: Optional[str] = None,
@@ -24,14 +85,24 @@ class MetricsLogger:
         run_name: Optional[str] = None,
         config: Optional[dict] = None,
         echo: bool = True,
+        echo_to: Any = None,
     ):
         self.jsonl_path = jsonl_path
         self.echo = echo
+        # Scripts whose stdout IS the artifact (autotune's table JSON)
+        # route the echo to stderr instead.
+        self._echo_to = echo_to
+        self._lock = threading.Lock()
         self._fh = None
+        self._wandb = None
         if jsonl_path:
             os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)), exist_ok=True)
             self._fh = open(jsonl_path, "a")
-        self._wandb = None
+            # Every file-backed stream opens with a run_meta header: a
+            # RUN.jsonl must identify the software/hardware/config that
+            # produced it (obs.report reads this back).
+            self.log("run_meta", _echo=False,
+                     **run_meta(config, run_name=run_name))
         if use_wandb:
             try:
                 import wandb  # type: ignore
@@ -42,25 +113,140 @@ class MetricsLogger:
                 print(f"[metrics] wandb unavailable ({e}); JSONL only", file=sys.stderr)
                 self._wandb = None
 
-    def log(self, event: str, **fields: Any) -> None:
+    def log(self, event: str, _echo: Optional[bool] = None, **fields: Any) -> None:
         rec = {"ts": time.time(), "event": event, **fields}
-        if self._fh:
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
+        with self._lock:
+            if self._fh:
+                self._fh.write(json.dumps(rec) + "\n")
+                self._fh.flush()
         if self._wandb is not None and event == "epoch":
             self._wandb.log({k: v for k, v in fields.items() if isinstance(v, (int, float))})
-        if self.echo:
+        if self.echo if _echo is None else _echo:
             shown = ", ".join(
                 f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in fields.items()
             )
-            print(f"[{event}] {shown}")
+            print(f"[{event}] {shown}", file=self._echo_to)
 
     def finish(self, **fields: Any) -> None:
         if fields:
             self.log("final", **fields)
         if self._wandb is not None:
             self._wandb.finish()
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+            self._wandb = None
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+
+    # Context-manager form: the file handle must not leak on error paths
+    # (pre-observatory, only wandb ever got a finish()).
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.finish()
+
+
+# ---------------------------------------------------------------------------
+# Unified host timeline
+# ---------------------------------------------------------------------------
+
+
+class Timeline:
+    """Span/event emitter over a MetricsLogger stream.
+
+    Spans are measured on `time.perf_counter` (monotonic, high
+    resolution) relative to this timeline's origin, so records from
+    every thread of one run share one time base. Emission is
+    thread-safe: the logger serializes writes, and span bookkeeping is
+    local to each call. Record shapes:
+
+        {"event": "span", "name", "cat", "resource", "t0", "t1",
+         "dur", "thread", ...}
+        {"event": "mark", "name", "cat", "resource", "t", ...}
+
+    `resource` is the lane the Gantt renderer groups by ("device",
+    "stream", "checkpoint", "compile", ...); `cat` is the subsystem.
+    """
+
+    _clock = staticmethod(time.perf_counter)
+
+    def __init__(self, logger: MetricsLogger, origin: Optional[float] = None):
+        self.logger = logger
+        self.origin = self._clock() if origin is None else origin
+
+    def rel(self, mono: float) -> float:
+        return mono - self.origin
+
+    def event(self, name: str, cat: str = "host", resource: str = "host",
+              **fields: Any) -> None:
+        self.logger.log(
+            "mark", _echo=False, name=name, cat=cat, resource=resource,
+            t=round(self.rel(self._clock()), 6), **fields)
+
+    def span_at(self, name: str, t0: float, t1: float, cat: str = "host",
+                resource: str = "host", **fields: Any) -> None:
+        """Emit a span from already-measured perf_counter endpoints (the
+        ChunkStream ledger path: the worker measured its own window)."""
+        self.logger.log(
+            "span", _echo=False, name=name, cat=cat, resource=resource,
+            t0=round(self.rel(t0), 6), t1=round(self.rel(t1), 6),
+            dur=round(t1 - t0, 6),
+            thread=threading.current_thread().name, **fields)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host", resource: str = "host",
+             **fields: Any) -> Iterator[None]:
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.span_at(name, t0, self._clock(), cat=cat,
+                         resource=resource, **fields)
+
+
+# Module-level registry: producers deep in the stack (stream prefetch
+# worker, async checkpoint commit watcher, jit watchdog) emit into the
+# run's timeline without threading it through every constructor. A plain
+# module global (not a contextvar): worker THREADS must see it too.
+_TIMELINE: Optional[Timeline] = None
+
+
+def install_timeline(tl: Optional[Timeline]) -> Optional[Timeline]:
+    """Install the process-wide timeline; returns the previous one so
+    callers (tests) can restore it."""
+    global _TIMELINE
+    prev = _TIMELINE
+    _TIMELINE = tl
+    return prev
+
+
+def current_timeline() -> Optional[Timeline]:
+    return _TIMELINE
+
+
+@contextlib.contextmanager
+def timeline_span(name: str, cat: str = "host", resource: str = "host",
+                  **fields: Any) -> Iterator[None]:
+    """`Timeline.span` against the installed timeline; no-op without one."""
+    tl = _TIMELINE
+    if tl is None:
+        yield
+        return
+    with tl.span(name, cat=cat, resource=resource, **fields):
+        yield
+
+
+def timeline_event(name: str, cat: str = "host", resource: str = "host",
+                   **fields: Any) -> None:
+    tl = _TIMELINE
+    if tl is not None:
+        tl.event(name, cat=cat, resource=resource, **fields)
+
+
+def timeline_span_at(name: str, t0: float, t1: float, cat: str = "host",
+                     resource: str = "host", **fields: Any) -> None:
+    tl = _TIMELINE
+    if tl is not None:
+        tl.span_at(name, t0, t1, cat=cat, resource=resource, **fields)

@@ -49,21 +49,50 @@ def _load_events(path: str) -> list:
     return data.get("traceEvents", [])
 
 
+# Transfer events in jax/XLA chrome traces: memcpy kernels, infeed/
+# outfeed, and async copy ops. Substring-matched case-insensitively on
+# the event name; direction classified when the name says so.
+_TRANSFER_MARKERS = ("memcpy", "infeed", "outfeed", "copy-start",
+                     "copy-done", "transferto", "transferfrom")
+_H2D_MARKERS = ("h2d", "htod", "infeed", "transferto")
+_D2H_MARKERS = ("d2h", "dtoh", "outfeed", "transferfrom")
+
+
+def _classify_transfer(name: str) -> Optional[str]:
+    low = name.lower()
+    if not any(m in low for m in _TRANSFER_MARKERS):
+        return None
+    if any(m in low for m in _H2D_MARKERS):
+        return "h2d_us"
+    if any(m in low for m in _D2H_MARKERS):
+        return "d2h_us"
+    return "other_us"
+
+
 def summarize_trace(
     log_dir: str, device_only: bool = True, top: int = 15
 ) -> dict:
-    """{'files', 'device_pids', 'total_us', 'by_name': [(name, us, count)]}
+    """{'files', 'device_pids', 'host_pids', 'total_us', 'host_us',
+    'transfer', 'by_name': [(name, us, count)]}
 
     Aggregates complete ("X") event durations by event name across every
     trace file, restricted (by default) to processes whose metadata
-    process_name mentions a device lane ("/device:" — TPU/GPU streams;
-    host python/runtime lanes are excluded so the total is device time,
-    not wall time)."""
+    process_name mentions a device lane ("/device:" — TPU/GPU streams).
+    Host lanes are no longer silently dropped: their total rides along
+    as `host_us` (+ `host_by_name` top rows), and memcpy/infeed/outfeed
+    transfer events from EVERY lane are classified into the `transfer`
+    breakdown {h2d_us, d2h_us, other_us, count} — the h2d column is the
+    device-side view of the ChunkStream ledger's bytes_put."""
     files = find_trace_files(log_dir)
     device_pids: dict = {}
+    host_pids: dict = {}
     durations: dict = defaultdict(float)
     counts: dict = defaultdict(int)
+    host_durations: dict = defaultdict(float)
+    host_counts: dict = defaultdict(int)
+    transfer = {"h2d_us": 0.0, "d2h_us": 0.0, "other_us": 0.0, "count": 0}
     total = 0.0
+    host_total = 0.0
     # first pass: lane metadata for every file, and the GLOBAL decision
     # of whether any device lane exists — the fallback must not be
     # per-file, or a host-only trace file alongside a device-lane file
@@ -83,6 +112,8 @@ def summarize_trace(
         if restrict:
             pids = {p for p, n in lanes.items() if "/device:" in n}
             device_pids.update({p: lanes[p] for p in pids})
+            host_pids.update({p: n for p, n in lanes.items()
+                              if "/device:" not in n})
         else:
             # CPU-only captures have no "/device:" lane (everything runs
             # under "/host:CPU"): take every lane rather than reporting
@@ -93,8 +124,6 @@ def summarize_trace(
         for ev in events:
             if ev.get("ph") != "X":
                 continue
-            if pids is not None and ev.get("pid") not in pids:
-                continue
             name = ev.get("name", "?")
             if name.startswith("$"):
                 # python source-frame events ($file.py:line fn) are a
@@ -102,6 +131,17 @@ def summarize_trace(
                 # kernel/op events carry the real time
                 continue
             dur = float(ev.get("dur", 0.0))
+            kind = _classify_transfer(name)
+            if kind is not None:
+                transfer[kind] += dur
+                transfer["count"] += 1
+            if pids is not None and ev.get("pid") not in pids:
+                # a host-lane event under device restriction: tallied
+                # in the host breakdown instead of dropped
+                host_durations[name] += dur
+                host_counts[name] += 1
+                host_total += dur
+                continue
             durations[name] += dur
             counts[name] += 1
             total += dur
@@ -109,9 +149,14 @@ def summarize_trace(
         ((n, d, counts[n]) for n, d in durations.items()),
         key=lambda t: -t[1],
     )[: max(top, 0)]
+    host_by_name = sorted(
+        ((n, d, host_counts[n]) for n, d in host_durations.items()),
+        key=lambda t: -t[1],
+    )[: max(top, 0)]
     return {
         "files": files,
         "device_pids": device_pids,
+        "host_pids": host_pids,
         # NOTE (ADVICE r2): durations are summed across ALL matched lanes
         # and threads. On a multi-device (or multi-stream) capture,
         # overlapping execution is counted once per lane, so total_us can
@@ -119,6 +164,9 @@ def summarize_trace(
         # can tell aggregate device-time from wall time.
         "num_lanes": len(device_pids),
         "total_us": total,
+        "host_us": host_total,
+        "host_by_name": host_by_name,
+        "transfer": transfer,
         "by_name": by_name,
     }
 
@@ -137,6 +185,19 @@ def format_summary(s: dict) -> str:
         if n_lanes > 1 else ""
     )
     lines.append(f"device time : {s['total_us'] / 1e3:.3f} ms{qualifier}")
+    if s.get("host_us"):
+        n_host = len(s.get("host_pids", {}))
+        lines.append(
+            f"host time   : {s['host_us'] / 1e3:.3f} ms across "
+            f"{n_host} host lane(s) (--all_lanes merges them into the "
+            "breakdown)")
+    tr = s.get("transfer") or {}
+    if tr.get("count"):
+        lines.append(
+            f"transfer    : H2D {tr['h2d_us'] / 1e3:.3f} ms, "
+            f"D2H {tr['d2h_us'] / 1e3:.3f} ms, "
+            f"other {tr['other_us'] / 1e3:.3f} ms "
+            f"({tr['count']} memcpy/infeed events)")
     if s["by_name"]:
         width = max(len(n) for n, _, _ in s["by_name"])
         lines.append(f"{'kernel/fusion':<{width}}  {'total':>10}  {'count':>6}  share")

@@ -41,7 +41,14 @@ Usage:
     python scripts/autotune_plan.py --all --days 4 --reps 1   # quickest
     python scripts/autotune_plan.py --fleet               # + fleet knob race
     python scripts/autotune_plan.py --stream              # + residency race
-        [--out PLAN_TABLE.json] [--dry_run]
+        [--out PLAN_TABLE.json] [--dry_run] [--metrics_jsonl RUN.jsonl]
+
+Race progress is emitted as structured events through MetricsLogger
+(echoed to stderr; stdout stays the table-JSON artifact). With
+`--metrics_jsonl RUN.jsonl` the events land in the same stream a
+subsequent `cli.py --metrics_jsonl RUN.jsonl` / sweep run appends to —
+one coherent RUN.jsonl for the whole autotune+train+sweep session,
+renderable by `python -m factorvae_tpu.obs.report`.
 """
 
 from __future__ import annotations
@@ -93,6 +100,19 @@ FLEET_CANDIDATES = [1, 2, 4, 8]
 # host->device transfer, data/stream.py). HBM is always in the raced
 # set, so a persisted row can never regress an in-memory workload.
 STREAM_CHUNK_CANDIDATES = [16, 32, 64]
+
+
+def _log(logger, event: str, **fields) -> None:
+    """Race progress goes through the metrics/event stream (ISSUE 5: an
+    autotune + sweep run should yield ONE coherent RUN.jsonl, not a
+    stderr transcript). The echo lands on stderr — stdout is reserved
+    for the table JSON artifact. `logger=None` (library callers) falls
+    back to a bare stderr line so the functions stay usable standalone."""
+    if logger is not None:
+        logger.log(event, **fields)
+    else:
+        shown = ", ".join(f"{k}={v}" for k, v in fields.items())
+        print(f"[{event}] {shown}", file=sys.stderr)
 
 
 def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int,
@@ -225,7 +245,7 @@ def time_stream(shape: dict, train_knobs: dict, residency: str,
 
 
 def race_stream(name: str, shape: dict, train_knobs: dict,
-                days: int, reps: int) -> dict:
+                days: int, reps: int, logger=None) -> dict:
     """Race panel residency (hbm vs stream x chunk sizes); return the
     row's `stream` block (winner + every candidate timing for audit)."""
     measured = {}
@@ -237,8 +257,8 @@ def race_stream(name: str, shape: dict, train_knobs: dict,
                           days, reps)
         key = residency if residency == "hbm" else f"stream_c{chunk}"
         measured[key] = round(sec, 5)
-        print(f"[autotune] {name} residency {key}: {sec:.4f} s/day",
-              file=sys.stderr)
+        _log(logger, "autotune_stream_candidate", shape=name,
+             candidate=key, s_per_day=round(sec, 5))
         if best_sec is None or sec < best_sec:
             best, best_sec = (residency, chunk), sec
     return {
@@ -254,7 +274,7 @@ def race_stream(name: str, shape: dict, train_knobs: dict,
 
 
 def race_fleet(name: str, shape: dict, train_knobs: dict,
-               days: int, reps: int) -> dict:
+               days: int, reps: int, logger=None) -> dict:
     """Race `seeds_per_program` over FLEET_CANDIDATES; return the row's
     `fleet` block (winner + every candidate timing for audit)."""
     measured = {}
@@ -262,8 +282,8 @@ def race_fleet(name: str, shape: dict, train_knobs: dict,
     for s in FLEET_CANDIDATES:
         wps = time_fleet(shape, train_knobs, s, days, reps)
         measured[f"S={s}"] = round(wps, 1)
-        print(f"[autotune] {name} fleet S={s}: {wps:,.0f} w/s·seed "
-              f"aggregate", file=sys.stderr)
+        _log(logger, "autotune_fleet_candidate", shape=name, seeds=s,
+             aggregate_windows_per_sec_seed=round(wps, 1))
         if best_wps is None or wps > best_wps:
             best_s, best_wps = s, wps
     return {
@@ -277,7 +297,8 @@ def race_fleet(name: str, shape: dict, train_knobs: dict,
 
 
 def race_shape(name: str, shape: dict, days: int, reps: int,
-               fleet: bool = False, stream: bool = False) -> dict:
+               fleet: bool = False, stream: bool = False,
+               logger=None) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
     plan-table row."""
@@ -294,8 +315,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
             sec = time_train(shape, dtype, cand["flatten_days"],
                              cand["days_per_step"], days, reps)
             measured["train"][key] = round(sec, 5)
-            print(f"[autotune] {name} train {key}: {sec:.4f} s/day",
-                  file=sys.stderr)
+            _log(logger, "autotune_train_candidate", shape=name,
+                 candidate=key, s_per_day=round(sec, 5))
             if best_train is None or sec < best_train:
                 best_train = sec
                 best_train_key = {**cand, "compute_dtype": dtype}
@@ -306,18 +327,20 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
             key = f"flat={int(cand['flatten_days'])}_{dtype}"
             ws = time_score(shape, dtype, cand["flatten_days"], days, reps)
             measured["score"][key] = round(ws, 1)
-            print(f"[autotune] {name} score {key}: {ws:,.0f} w/s",
-                  file=sys.stderr)
+            _log(logger, "autotune_score_candidate", shape=name,
+                 candidate=key, windows_per_sec=round(ws, 1))
             if best_score is None or ws > best_score:
                 best_score = ws
                 best_score_key = {**cand, "compute_dtype": dtype}
 
     fleet_block = None
     if fleet:
-        fleet_block = race_fleet(name, shape, best_train_key, days, reps)
+        fleet_block = race_fleet(name, shape, best_train_key, days,
+                                 reps, logger=logger)
     stream_block = None
     if stream:
-        stream_block = race_stream(name, shape, best_train_key, days, reps)
+        stream_block = race_stream(name, shape, best_train_key, days,
+                                   reps, logger=logger)
 
     shp = ShapeKey(
         num_features=shape["features"], seq_len=shape["seq_len"],
@@ -354,7 +377,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
 
 
 def race_widths(name: str, shape: dict, days: int, reps: int,
-                fleet: bool = False, stream: bool = False) -> list:
+                fleet: bool = False, stream: bool = False,
+                logger=None) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
     envelope row — both bounds measured, no extrapolation beyond them
@@ -364,7 +388,7 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
     if not isinstance(widths, (list, tuple)):
         widths = [widths]
     rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
-                       fleet=fleet, stream=stream)
+                       fleet=fleet, stream=stream, logger=logger)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
@@ -416,6 +440,11 @@ def main() -> int:
                         "without the block resolve to hbm)")
     p.add_argument("--dry_run", action="store_true",
                    help="race and print the rows without persisting")
+    p.add_argument("--metrics_jsonl", default=None,
+                   help="append race-progress events to this JSONL "
+                        "stream (one RUN.jsonl per session: point a "
+                        "subsequent cli.py/sweep run at the same file "
+                        "and obs.report renders the whole thing)")
     args = p.parse_args()
 
     from factorvae_tpu.plan import save_rows
@@ -432,16 +461,25 @@ def main() -> int:
 
         force_host_devices(1)
 
-    names = sorted(SHAPES) if args.all else [args.config]
-    rows = [r for n in names
-            for r in race_widths(n, SHAPES[n], args.days, args.reps,
-                                 fleet=args.fleet, stream=args.stream)]
-    print(json.dumps({"rows": rows}, indent=1))
-    if args.dry_run:
-        print("[autotune] --dry_run: table not written", file=sys.stderr)
-        return 0
-    path = save_rows(rows, path=args.out)
-    print(f"[autotune] wrote {len(rows)} row(s) -> {path}", file=sys.stderr)
+    # Echo to STDERR: stdout is the table-JSON artifact. Constructed
+    # after force_host_devices so the run_meta header records the
+    # platform the race actually runs on.
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    with MetricsLogger(jsonl_path=args.metrics_jsonl, echo=True,
+                       echo_to=sys.stderr, run_name="autotune_plan") as lg:
+        names = sorted(SHAPES) if args.all else [args.config]
+        rows = [r for n in names
+                for r in race_widths(n, SHAPES[n], args.days, args.reps,
+                                     fleet=args.fleet, stream=args.stream,
+                                     logger=lg)]
+        print(json.dumps({"rows": rows}, indent=1))
+        if args.dry_run:
+            lg.log("autotune_dry_run", rows=len(rows),
+                   note="table not written")
+            return 0
+        path = save_rows(rows, path=args.out)
+        lg.log("autotune_table_written", rows=len(rows), path=path)
     return 0
 
 

@@ -88,7 +88,8 @@ def _cfg_for(cfg0, prefix_dates, window_dates, epochs,
     )
 
 
-def _run_one(cfg, ds, ref_scores, labels, score_start, score_end):
+def _run_one(cfg, ds, ref_scores, labels, score_start, score_end,
+             logger=None):
     from factorvae_tpu.eval.compare import compare_scores
     from factorvae_tpu.eval.predict import generate_prediction_scores
     from factorvae_tpu.train.checkpoint import load_params
@@ -97,7 +98,7 @@ def _run_one(cfg, ds, ref_scores, labels, score_start, score_end):
 
     shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
     t0 = time.time()
-    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    trainer = Trainer(cfg, ds, logger=logger or MetricsLogger(echo=False))
     state, out = trainer.fit()
     best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
     params = load_params(best, state.params) if os.path.isdir(best) \
@@ -154,6 +155,10 @@ def main(argv=None) -> int:
                          "grid winner + reference-faithful, after the "
                          "grid.")
     ap.add_argument("--out", default="PARITY_RUN_r04.json")
+    ap.add_argument("--metrics_jsonl", default=None,
+                    help="append progress + per-seed sweep events to this "
+                         "JSONL stream (ISSUE 5: one RUN.jsonl per "
+                         "session; obs.report renders it)")
     ap.add_argument("--quick", action="store_true",
                     help="2 epochs, 2 seeds, 2 grid points (smoke)")
     args = ap.parse_args(argv)
@@ -163,235 +168,253 @@ def main(argv=None) -> int:
     from factorvae_tpu.utils.testing import enable_persistent_compile_cache
 
     enable_persistent_compile_cache()
-    ref = load_ref_scores(args.scores_dir)
-    panel, prefix_dates, window_dates = build_proxy_panel(ref)
-    labels = panel_labels(panel)
-    score_start = str(window_dates[0].date())
-    score_end = str(window_dates[-1].date())
+    # ONE logger/event stream for the whole protocol: every Trainer
+    # epoch, seed_sweep per-seed record and [k60] progress line goes
+    # through it (raw prints made a full autotune+sweep session
+    # unreconstructable; echo keeps the console experience).
+    from factorvae_tpu.utils.logging import MetricsLogger
 
-    cfg0 = get_preset(PRESET)
-    # _cfg_for forces compute_dtype=float32 on every run (presets are
-    # bf16 for bench; parity should not fold a dtype change in).
-    ds = PanelDataset(panel, seq_len=cfg0.model.seq_len, pad_multiple=8)
+    logger = MetricsLogger(jsonl_path=args.metrics_jsonl, echo=True,
+                           run_name="parity_k60_sweep")
+    # close-on-error: a multi-hour sweep killed mid-grid must still
+    # finalize the JSONL handle (and any wandb run), not just the
+    # happy path — the same contract autotune_plan.py's `with` carries.
+    try:
+        ref = load_ref_scores(args.scores_dir)
+        panel, prefix_dates, window_dates = build_proxy_panel(ref)
+        labels = panel_labels(panel)
+        score_start = str(window_dates[0].date())
+        score_end = str(window_dates[-1].date())
 
-    # Fleet execution (train/fleet.py): --fleet auto follows the
-    # planner's raced seeds_per_program for this shape; partial-result
-    # files stay format-compatible (on_seed fires per seed either way).
-    from factorvae_tpu.plan import plan_for_config
+        cfg0 = get_preset(PRESET)
+        # _cfg_for forces compute_dtype=float32 on every run (presets are
+        # bf16 for bench; parity should not fold a dtype change in).
+        ds = PanelDataset(panel, seq_len=cfg0.model.seq_len, pad_multiple=8)
 
-    plan = plan_for_config(cfg0, getattr(ds, "n_real", ds.n_max))
-    if args.fleet == "on":
-        use_fleet, spp = True, None      # one program for all seeds
-    elif args.fleet == "off":
-        use_fleet, spp = False, None
-    else:
-        spp = plan.seeds_per_program
-        use_fleet = spp > 1
-    print(f"[k60] sweep execution: "
-          f"{'fleet (seeds_per_program=%s)' % (spp or 'all') if use_fleet else 'serial'}"
-          f" [plan {plan.provenance}: seeds_per_program={plan.seeds_per_program}]")
+        # Fleet execution (train/fleet.py): --fleet auto follows the
+        # planner's raced seeds_per_program for this shape; partial-result
+        # files stay format-compatible (on_seed fires per seed either way).
+        from factorvae_tpu.plan import plan_for_config
 
-    epochs = 2 if args.quick else args.epochs
-    n_seeds = 2 if args.quick else args.seeds
-    grid = _parse_points(args.grid) if args.grid else []
-    if args.quick:
-        grid = grid[:2]
+        plan = plan_for_config(cfg0, getattr(ds, "n_real", ds.n_max))
+        if args.fleet == "on":
+            use_fleet, spp = True, None      # one program for all seeds
+        elif args.fleet == "off":
+            use_fleet, spp = False, None
+        else:
+            spp = plan.seeds_per_program
+            use_fleet = spp > 1
+        logger.log(
+            "k60_execution",
+            mode=("fleet (seeds_per_program=%s)" % (spp or "all")
+                  if use_fleet else "serial"),
+            plan_provenance=plan.provenance,
+            plan_seeds_per_program=plan.seeds_per_program)
 
-    import jax
+        epochs = 2 if args.quick else args.epochs
+        n_seeds = 2 if args.quick else args.seeds
+        grid = _parse_points(args.grid) if args.grid else []
+        if args.quick:
+            grid = grid[:2]
 
-    from factorvae_tpu.eval.metrics import daily_rank_ic
+        import jax
 
-    ref_joined = ref[PRESET].join(labels.rename("LABEL0"),
-                                  how="inner").dropna()
-    ref_ic0 = float(daily_rank_ic(ref_joined, "LABEL0", "score").mean())
+        from factorvae_tpu.eval.metrics import daily_rank_ic
 
-    results = {"preset": PRESET, "epochs": epochs,
-               "platform": jax.devices()[0].platform,
-               "protocol": "proxy panel (parity_protocol.build_proxy_panel)",
-               "reference_rank_ic": ref_ic0,
-               "complete": False, "grid": [], "sweeps": {}}
+        ref_joined = ref[PRESET].join(labels.rename("LABEL0"),
+                                      how="inner").dropna()
+        ref_ic0 = float(daily_rank_ic(ref_joined, "LABEL0", "score").mean())
 
-    # Restart resume (ADVICE r4): adopt finished records from a prior
-    # partial run of the SAME protocol so a killed multi-hour run
-    # continues instead of silently redoing every seed. partial_seeds
-    # values are full per-seed records (older files stored bare
-    # rank_ic floats; seed_sweep accepts both via prior_records).
-    if os.path.exists(args.out):
-        try:
-            with open(args.out) as f:
-                prev = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            prev = None
-        if prev and prev.get("preset") == PRESET \
-                and prev.get("epochs") == epochs \
-                and prev.get("platform") == results["platform"]:
-            results["grid"] = prev.get("grid", [])
-            results["sweeps"] = prev.get("sweeps", {})
-            n_prior = sum(len(s.get("partial_seeds", {}))
-                          + len(s.get("per_seed_rank_ic", {}))
-                          for s in results["sweeps"].values())
-            print(f"[k60] resuming from {args.out}: "
-                  f"{len(results['grid'])} grid points, "
-                  f"{n_prior} finished sweep seeds adopted")
-        elif prev:
-            # Do NOT overwrite a finished multi-hour artifact in place:
-            # a protocol-mismatched rerun (e.g. --quick smoke against a
-            # completed 50-epoch file) moves the old file aside first.
-            bak = args.out + ".mismatch.bak"
-            n = 1
-            while os.path.exists(bak):
-                n += 1
-                bak = f"{args.out}.mismatch.bak{n}"
-            shutil.move(args.out, bak)
-            # name only the fields that actually mismatch (ADVICE r5) —
-            # the CHIP_DAY.log reader should not have to guess which of
-            # three candidate causes blocked the resume
-            mismatches = [
-                f"{field} {prev.get(field)!r} != {want!r}"
-                for field, want in (("preset", PRESET), ("epochs", epochs),
-                                    ("platform", results["platform"]))
-                if prev.get(field) != want
-            ]
-            print(f"[k60] NOT resuming from {args.out}: protocol "
-                  f"mismatch ({'; '.join(mismatches)}); "
-                  f"moved the old artifact to {bak} and starting fresh "
-                  "— CPU seeds must not silently mix into a TPU "
-                  "statistics artifact or vice versa")
+        results = {"preset": PRESET, "epochs": epochs,
+                   "platform": jax.devices()[0].platform,
+                   "protocol": "proxy panel (parity_protocol.build_proxy_panel)",
+                   "reference_rank_ic": ref_ic0,
+                   "complete": False, "grid": [], "sweeps": {}}
 
-    def _json_safe(o):
-        # Non-finite floats (e.g. NaN rank_ic_ir on seeds resumed from
-        # a legacy bare-float partial) would serialize as the
-        # non-standard `NaN` token and break strict JSON consumers.
-        if isinstance(o, float) and not np.isfinite(o):
-            return None
-        if isinstance(o, dict):
-            return {k: _json_safe(v) for k, v in o.items()}
-        if isinstance(o, list):
-            return [_json_safe(v) for v in o]
-        return o
+        # Restart resume (ADVICE r4): adopt finished records from a prior
+        # partial run of the SAME protocol so a killed multi-hour run
+        # continues instead of silently redoing every seed. partial_seeds
+        # values are full per-seed records (older files stored bare
+        # rank_ic floats; seed_sweep accepts both via prior_records).
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    prev = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                prev = None
+            if prev and prev.get("preset") == PRESET \
+                    and prev.get("epochs") == epochs \
+                    and prev.get("platform") == results["platform"]:
+                results["grid"] = prev.get("grid", [])
+                results["sweeps"] = prev.get("sweeps", {})
+                n_prior = sum(len(s.get("partial_seeds", {}))
+                              + len(s.get("per_seed_rank_ic", {}))
+                              for s in results["sweeps"].values())
+                logger.log("k60_resume", out=args.out,
+                           grid_points=len(results["grid"]),
+                           adopted_seeds=n_prior)
+            elif prev:
+                # Do NOT overwrite a finished multi-hour artifact in place:
+                # a protocol-mismatched rerun (e.g. --quick smoke against a
+                # completed 50-epoch file) moves the old file aside first.
+                bak = args.out + ".mismatch.bak"
+                n = 1
+                while os.path.exists(bak):
+                    n += 1
+                    bak = f"{args.out}.mismatch.bak{n}"
+                shutil.move(args.out, bak)
+                # name only the fields that actually mismatch (ADVICE r5) —
+                # the CHIP_DAY.log reader should not have to guess which of
+                # three candidate causes blocked the resume
+                mismatches = [
+                    f"{field} {prev.get(field)!r} != {want!r}"
+                    for field, want in (("preset", PRESET), ("epochs", epochs),
+                                        ("platform", results["platform"]))
+                    if prev.get(field) != want
+                ]
+                logger.log(
+                    "k60_resume_mismatch", out=args.out, moved_to=bak,
+                    mismatches="; ".join(mismatches),
+                    note="starting fresh — CPU seeds must not silently mix "
+                         "into a TPU statistics artifact or vice versa")
 
-    def flush():
-        # Incremental persistence: a multi-hour CPU-fallback run killed
-        # at round end must leave every finished record on disk.
-        with open(args.out, "w") as f:
-            json.dump(_json_safe(results), f, indent=1)
+        def _json_safe(o):
+            # Non-finite floats (e.g. NaN rank_ic_ir on seeds resumed from
+            # a legacy bare-float partial) would serialize as the
+            # non-standard `NaN` token and break strict JSON consumers.
+            if isinstance(o, float) and not np.isfinite(o):
+                return None
+            if isinstance(o, dict):
+                return {k: _json_safe(v) for k, v in o.items()}
+            if isinstance(o, list):
+                return [_json_safe(v) for v in o]
+            return o
 
-    def run_point(lr, klw, tag):
-        cfg = _cfg_for(cfg0, prefix_dates, window_dates,
-                       epochs, lr, klw, tag)
-        rec = _run_one(cfg, ds, ref[PRESET], labels,
-                       score_start, score_end)
-        rec.update(lr=lr, kl_weight=klw)
-        return rec
+        def flush():
+            # Incremental persistence: a multi-hour CPU-fallback run killed
+            # at round end must leave every finished record on disk.
+            with open(args.out, "w") as f:
+                json.dump(_json_safe(results), f, indent=1)
 
-    def sweep(lr, klw, label):
-        from factorvae_tpu.eval.sweep import seed_sweep
+        def run_point(lr, klw, tag):
+            cfg = _cfg_for(cfg0, prefix_dates, window_dates,
+                           epochs, lr, klw, tag)
+            rec = _run_one(cfg, ds, ref[PRESET], labels,
+                           score_start, score_end, logger=logger)
+            rec.update(lr=lr, kl_weight=klw)
+            return rec
 
-        # Resume matches by (lr, kl_weight), not display label:
-        # explicit --sweeps mode and the grid-winner path name the same
-        # point 'lr1e-4_kl1' vs 'winner'/'reference_faithful', and a
-        # label miss would retrain a finished multi-hour sweep.
-        for lbl, e in results["sweeps"].items():
-            if (e.get("lr"), e.get("kl_weight")) == (lr, klw):
-                label = lbl
-                break
-        entry = results["sweeps"].get(label, {})
-        done = entry.get("per_seed_rank_ic", {})
-        if len(done) >= n_seeds:
-            print(f"[k60] sweep {label} already complete "
-                  f"({len(done)} seeds >= {n_seeds}); skipping")
-            return
-        cfg = _cfg_for(cfg0, prefix_dates, window_dates,
-                       epochs, lr, klw, f"sweep_{label}")
-        shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
-        partial = results["sweeps"].setdefault(
-            label, {"lr": lr, "kl_weight": klw})
-        partial.setdefault("partial_seeds", {})
-        # A finished-but-smaller sweep (e.g. 5 seeds, now asked for 8)
-        # contributes its seeds as priors rather than being redone.
-        for s, v in done.items():
-            partial["partial_seeds"].setdefault(s, {
-                "rank_ic": v,
-                "rank_ic_ir": entry.get(
-                    "per_seed_rank_ic_ir", {}).get(s, float("nan")),
-                "best_val": entry.get(
-                    "per_seed_best_val", {}).get(s, float("nan")),
-            })
-        prior = dict(partial["partial_seeds"])
-        if prior:
-            print(f"[k60] sweep {label}: resuming, "
-                  f"{len(prior)} seeds already on disk")
+        def sweep(lr, klw, label):
+            from factorvae_tpu.eval.sweep import seed_sweep
 
-        def on_seed(rec):
-            partial["partial_seeds"][rec["seed"]] = rec
+            # Resume matches by (lr, kl_weight), not display label:
+            # explicit --sweeps mode and the grid-winner path name the same
+            # point 'lr1e-4_kl1' vs 'winner'/'reference_faithful', and a
+            # label miss would retrain a finished multi-hour sweep.
+            for lbl, e in results["sweeps"].items():
+                if (e.get("lr"), e.get("kl_weight")) == (lr, klw):
+                    label = lbl
+                    break
+            entry = results["sweeps"].get(label, {})
+            done = entry.get("per_seed_rank_ic", {})
+            if len(done) >= n_seeds:
+                logger.log("k60_sweep_skipped", label=label,
+                           seeds_done=len(done), seeds_wanted=n_seeds)
+                return
+            cfg = _cfg_for(cfg0, prefix_dates, window_dates,
+                           epochs, lr, klw, f"sweep_{label}")
+            shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
+            partial = results["sweeps"].setdefault(
+                label, {"lr": lr, "kl_weight": klw})
+            partial.setdefault("partial_seeds", {})
+            # A finished-but-smaller sweep (e.g. 5 seeds, now asked for 8)
+            # contributes its seeds as priors rather than being redone.
+            for s, v in done.items():
+                partial["partial_seeds"].setdefault(s, {
+                    "rank_ic": v,
+                    "rank_ic_ir": entry.get(
+                        "per_seed_rank_ic_ir", {}).get(s, float("nan")),
+                    "best_val": entry.get(
+                        "per_seed_best_val", {}).get(s, float("nan")),
+                })
+            prior = dict(partial["partial_seeds"])
+            if prior:
+                logger.log("k60_sweep_resuming", label=label,
+                           seeds_on_disk=len(prior))
+
+            def on_seed(rec):
+                partial["partial_seeds"][rec["seed"]] = rec
+                flush()
+
+            df = seed_sweep(cfg, ds, seeds=list(range(n_seeds)),
+                            score_start=score_start, score_end=score_end,
+                            logger=logger, on_seed=on_seed,
+                            prior_records=prior,
+                            fleet=use_fleet, seeds_per_program=spp)
+            s = df.attrs["summary"]
+            mean, std, n = s["rank_ic_mean"], s["rank_ic_std"], s["num_seeds"]
+            ref_ic = results["reference_rank_ic"]
+            ci = 1.96 * std / np.sqrt(max(n, 1))
+            rec = {
+                "lr": lr, "kl_weight": klw,
+                "per_seed_rank_ic": df["rank_ic"].to_dict(),
+                "per_seed_rank_ic_ir": df["rank_ic_ir"].to_dict(),
+                "per_seed_best_val": df["best_val"].to_dict(),
+                **s,
+                "ci95_half_width": float(ci),
+                "reference_rank_ic": ref_ic,
+            }
+            if ref_ic:
+                rec["recovery_fraction"] = float(mean / ref_ic)
+                rec["recovery_ci"] = [float((mean - ci) / ref_ic),
+                                      float((mean + ci) / ref_ic)]
+            results["sweeps"][label] = rec
             flush()
+            logger.log(
+                "k60_sweep_done", label=label, mean=round(mean, 4),
+                std=round(std, 4), n=n,
+                recovery=rec.get("recovery_fraction", float("nan")))
 
-        df = seed_sweep(cfg, ds, seeds=list(range(n_seeds)),
-                        score_start=score_start, score_end=score_end,
-                        on_seed=on_seed, prior_records=prior,
-                        fleet=use_fleet, seeds_per_program=spp)
-        s = df.attrs["summary"]
-        mean, std, n = s["rank_ic_mean"], s["rank_ic_std"], s["num_seeds"]
-        ref_ic = results["reference_rank_ic"]
-        ci = 1.96 * std / np.sqrt(max(n, 1))
-        rec = {
-            "lr": lr, "kl_weight": klw,
-            "per_seed_rank_ic": df["rank_ic"].to_dict(),
-            "per_seed_rank_ic_ir": df["rank_ic_ir"].to_dict(),
-            "per_seed_best_val": df["best_val"].to_dict(),
-            **s,
-            "ci95_half_width": float(ci),
-            "reference_rank_ic": ref_ic,
-        }
-        if ref_ic:
-            rec["recovery_fraction"] = float(mean / ref_ic)
-            rec["recovery_ci"] = [float((mean - ci) / ref_ic),
-                                  float((mean + ci) / ref_ic)]
-        results["sweeps"][label] = rec
+        explicit_sweeps = _parse_points(args.sweeps) if args.sweeps else None
+        if explicit_sweeps:
+            # CPU-fallback ordering: headline seed-sweep CIs first, grid
+            # afterwards as time allows.
+            for lr, klw in explicit_sweeps:
+                logger.log("k60_explicit_sweep", lr=lr, kl_weight=klw,
+                           seeds=n_seeds)
+                sweep(lr, klw, f"lr{lr:g}_kl{klw:g}")
+
+        logger.log("k60_grid_start", points=len(grid), epochs=epochs)
+        done_points = {(r["lr"], r["kl_weight"]) for r in results["grid"]}
+        for lr, klw in grid:
+            if (lr, klw) in done_points:
+                logger.log("k60_grid_skipped", lr=lr, kl_weight=klw)
+                continue
+            rec = run_point(lr, klw, f"lr{lr:g}_kl{klw:g}")
+            results["grid"].append(rec)
+            flush()
+            logger.log("k60_grid_point", lr=lr, kl_weight=klw,
+                       rank_ic=rec["rank_ic"],
+                       train_seconds=rec["train_seconds"])
+
+        if not explicit_sweeps and results["grid"]:
+            best = max(results["grid"], key=lambda r: r["rank_ic"])
+            results["grid_winner"] = {"lr": best["lr"],
+                                      "kl_weight": best["kl_weight"]}
+            logger.log("k60_winner_sweep", lr=best["lr"],
+                       kl_weight=best["kl_weight"], seeds=n_seeds)
+            sweep(best["lr"], best["kl_weight"], "winner")
+            if (best["lr"], best["kl_weight"]) != (1e-4, 1.0):
+                logger.log("k60_reference_faithful_sweep", lr=1e-4,
+                           kl_weight=1.0, seeds=n_seeds)
+                sweep(1e-4, 1.0, "reference_faithful")
+
+        results["complete"] = True
         flush()
-        print(f"[k60] sweep {label}: mean={mean:.4f}±{std:.4f} "
-              f"(n={n}) recovery="
-              f"{rec.get('recovery_fraction', float('nan')):.1%}")
-
-    explicit_sweeps = _parse_points(args.sweeps) if args.sweeps else None
-    if explicit_sweeps:
-        # CPU-fallback ordering: headline seed-sweep CIs first, grid
-        # afterwards as time allows.
-        for lr, klw in explicit_sweeps:
-            print(f"[k60] explicit sweep lr={lr:g} kl={klw:g}, "
-                  f"{n_seeds} seeds")
-            sweep(lr, klw, f"lr{lr:g}_kl{klw:g}")
-
-    print(f"[k60] grid search: {len(grid)} points x 1 seed, "
-          f"{epochs} epochs each")
-    done_points = {(r["lr"], r["kl_weight"]) for r in results["grid"]}
-    for lr, klw in grid:
-        if (lr, klw) in done_points:
-            print(f"[k60] grid lr={lr:g} kl={klw:g} already done; skipping")
-            continue
-        rec = run_point(lr, klw, f"lr{lr:g}_kl{klw:g}")
-        results["grid"].append(rec)
-        flush()
-        print(f"[k60] lr={lr:g} kl_weight={klw:g}: "
-              f"ic={rec['rank_ic']:.4f} ({rec['train_seconds']:.0f}s)")
-
-    if not explicit_sweeps and results["grid"]:
-        best = max(results["grid"], key=lambda r: r["rank_ic"])
-        results["grid_winner"] = {"lr": best["lr"],
-                                  "kl_weight": best["kl_weight"]}
-        print(f"[k60] seed sweep at grid winner "
-              f"(lr={best['lr']:g}, kl={best['kl_weight']:g}), "
-              f"{n_seeds} seeds")
-        sweep(best["lr"], best["kl_weight"], "winner")
-        if (best["lr"], best["kl_weight"]) != (1e-4, 1.0):
-            print(f"[k60] reference-faithful sweep (lr=1e-4, kl=1.0), "
-                  f"{n_seeds} seeds")
-            sweep(1e-4, 1.0, "reference_faithful")
-
-    results["complete"] = True
-    flush()
-    print(f"[k60] wrote {args.out}")
-    return 0
+        logger.log("k60_done", out=args.out)
+        return 0
+    finally:
+        logger.finish()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,27 @@
+"""JGL006 seeded violation: bare print() in a library module.
+
+Analyzed (tests/test_analysis.py) under a synthetic
+`factorvae_tpu/...` path — the rule keys on the module's location, so
+the fixture file itself (under tests/) stays out of the self-lint gate.
+Expected: 2 findings (the function print and the module-level print);
+the `main()` print is exempt.
+"""
+
+
+def train_and_report(trainer, epochs):
+    for epoch in range(epochs):
+        loss = trainer.step(epoch)
+        # BAD: progress interleaved into whatever stdout the caller
+        # owns, invisible to RUN.jsonl
+        print(f"epoch {epoch}: loss={loss:.4f}")
+    return loss
+
+
+# BAD: module-level print outside any __main__ guard
+print("library module imported")
+
+
+def main(argv=None):
+    # exempt: a CLI entry's job is stdout
+    print("usage: ...")
+    return 0

@@ -261,6 +261,125 @@ def frames(chunks, idxs):
         assert [f.rule for f in hot] == ["JGL005"]
         assert cold == []
 
+    def test_watched_jit_keeps_donation_tracking(self):
+        # obs/watchdog.py wraps every trainer jit:
+        # `self._f = watch_jit(jax.jit(g, donate_argnums=(0,)), "g")`.
+        # The engine must resolve donators THROUGH the wrapper, or
+        # instrumenting a jit would silently blind JGL004.
+        src = """
+import jax
+from factorvae_tpu.obs.watchdog import watch_jit
+
+class T:
+    def build(self):
+        self._step = watch_jit(
+            jax.jit(self.fn, donate_argnums=(0,)), "step")
+
+    def run(self, state, order):
+        state2 = self._step(state, order)
+        return state2, state.params
+"""
+        findings = _active(analyze_source(src, "t.py"))
+        assert [f.rule for f in findings] == ["JGL004"]
+
+    def test_watched_jit_instance_cache_exempt_from_jgl003(self):
+        # ...and the instance-cached JGL003 exemption must also look
+        # through the wrapper: built once per object, not per call.
+        src = """
+import jax
+from factorvae_tpu.obs.watchdog import watch_jit
+
+class T:
+    def build(self):
+        self._step = watch_jit(jax.jit(self.fn), "step")
+"""
+        assert _active(analyze_source(src, "t.py")) == []
+
+    def test_only_known_wrappers_unwrap(self):
+        # The look-through is for TRANSPARENT instrumentation wrappers
+        # only. (a) `self.out = jax.jit(f)(batch)` is a fresh jit
+        # invoked per call — the self-attr assignment of the RESULT
+        # must not grant the instance-cache exemption.
+        src_invoked = """
+import jax
+
+class T:
+    def run(self, batch):
+        self.out = jax.jit(self.fn)(batch)
+"""
+        findings = _active(analyze_source(src_invoked, "t.py"))
+        assert [f.rule for f in findings] == ["JGL003"]
+        # (b) functools.partial re-maps argument positions: the jit's
+        # donate_argnums=(0,) binds to cfg, NOT to state — inheriting
+        # it through the partial would emit a FALSE JGL004 on
+        # `state.params`. (The per-call-scope JGL003 on this shape is
+        # pre-existing, correct, and not the point here.)
+        src_partial = """
+import functools
+import jax
+
+class T:
+    def build(self):
+        self.step = functools.partial(
+            jax.jit(self._fn, donate_argnums=(0,)), self.cfg)
+
+    def run(self, state):
+        out = self.step(state)
+        return out, state.params
+"""
+        findings = _active(analyze_source(src_partial, "t.py"))
+        assert "JGL004" not in {f.rule for f in findings}
+
+
+class TestJGL006:
+    """Bare print() in library modules (path-keyed: the rule fires only
+    under factorvae_tpu/, so the fixture files are analyzed under a
+    synthetic library path)."""
+
+    def _analyze(self, fixture, path):
+        with open(_fixture(fixture)) as fh:
+            return analyze_source(fh.read(), path)
+
+    def test_fires_on_seeded_violation(self):
+        findings = _active(self._analyze(
+            "jgl006_bad.py", "factorvae_tpu/train/newmod.py"))
+        hits = [f for f in findings if f.rule == "JGL006"]
+        assert len(hits) == 2, [(f.line, f.message) for f in findings]
+        assert _rules(findings) == ["JGL006"]  # no cross-rule noise
+
+    def test_silent_on_corrected_twin(self):
+        assert _active(self._analyze(
+            "jgl006_good.py", "factorvae_tpu/train/newmod.py")) == []
+
+    def test_outside_library_paths_is_exempt(self):
+        # scripts/, tests/, bench.py own their stdout
+        assert _active(self._analyze(
+            "jgl006_bad.py", "scripts/some_driver.py")) == []
+        assert _active(analyze_paths([_fixture("jgl006_bad.py")])) == []
+
+    def test_cli_and_dunder_main_files_exempt(self):
+        src = "print('usage')\n"
+        assert _active(analyze_source(
+            src, "factorvae_tpu/cli.py")) == []
+        assert _active(analyze_source(
+            src, "factorvae_tpu/obs/__main__.py")) == []
+        # ...but an ordinary library module flags module-level prints
+        assert [f.rule for f in _active(analyze_source(
+            src, "factorvae_tpu/obs/newmod.py"))] == ["JGL006"]
+
+    def test_logger_sink_exempt(self):
+        src = "def log(self):\n    print('[epoch] loss=1')\n"
+        assert _active(analyze_source(
+            src, "factorvae_tpu/utils/logging.py")) == []
+
+    def test_suppressible_with_justification(self):
+        src = ("def f():\n"
+               "    print('x')  # graftlint: disable=JGL006 fixture: "
+               "demo suppression\n")
+        findings = analyze_source(src, "factorvae_tpu/train/newmod.py")
+        assert _active(findings) == []
+        assert [f.rule for f in findings if f.suppressed] == ["JGL006"]
+
 
 # ---------------------------------------------------------------------------
 # tier-1 gates

@@ -1,0 +1,306 @@
+"""utils/logging.py + utils/profiling.py + utils/trace_summary.py
+coverage (ISSUE 5 satellites): JSONL schema round-trip incl. the
+run_meta header, echo formatting, wandb-absent degradation, the
+context-manager close-on-error contract, the Timeline span/event API
+(thread-safety included), debug_nans raising inside jit, and the
+trace-summary host/transfer lane accounting against a synthetic
+Chrome-trace fixture."""
+
+import gzip
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factorvae_tpu.utils.logging import (
+    MetricsLogger,
+    Timeline,
+    current_timeline,
+    install_timeline,
+    timeline_event,
+    timeline_span,
+    timeline_span_at,
+)
+from factorvae_tpu.utils.profiling import debug_nans, trace
+
+
+def read_jsonl(path):
+    return [json.loads(l) for l in open(path).read().strip().splitlines()]
+
+
+class TestMetricsLogger:
+    def test_run_meta_header_is_first_line(self, tmp_path):
+        p = tmp_path / "m.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False,
+                           config={"a": 1}, run_name="hdr")
+        lg.log("epoch", loss=1.0)
+        lg.finish()
+        lines = read_jsonl(p)
+        assert lines[0]["event"] == "run_meta"
+        assert lines[0]["run_name"] == "hdr"
+        # jax is imported in this process -> version/platform recorded
+        assert lines[0]["jax"] == jax.__version__
+        assert lines[0]["platform"] == "cpu"
+        assert lines[0]["device_count"] == jax.device_count()
+        assert len(lines[0]["config_hash"]) == 12
+        # same config -> same hash; different -> different
+        lg2 = MetricsLogger(jsonl_path=str(tmp_path / "m2.jsonl"),
+                            echo=False, config={"a": 1})
+        lg3 = MetricsLogger(jsonl_path=str(tmp_path / "m3.jsonl"),
+                            echo=False, config={"a": 2})
+        lg2.finish(), lg3.finish()
+        h2 = read_jsonl(tmp_path / "m2.jsonl")[0]["config_hash"]
+        h3 = read_jsonl(tmp_path / "m3.jsonl")[0]["config_hash"]
+        assert h2 == lines[0]["config_hash"] and h3 != h2
+
+    def test_jsonl_roundtrip_preserves_fields(self, tmp_path):
+        p = tmp_path / "m.jsonl"
+        with MetricsLogger(jsonl_path=str(p), echo=False) as lg:
+            lg.log("epoch", epoch=0, loss=0.5, tag="x", ok=True,
+                   seeds=[1, 2])
+        ev = [l for l in read_jsonl(p) if l["event"] == "epoch"][0]
+        assert ev["epoch"] == 0 and ev["loss"] == 0.5
+        assert ev["tag"] == "x" and ev["ok"] is True and ev["seeds"] == [1, 2]
+        assert isinstance(ev["ts"], float)
+
+    def test_echo_formatting(self, capsys):
+        lg = MetricsLogger(echo=True)
+        lg.log("epoch", loss=0.5, step=3)
+        out = capsys.readouterr().out
+        assert "[epoch]" in out and "loss=0.5" in out and "step=3" in out
+
+    def test_echo_to_stderr(self, capsys):
+        lg = MetricsLogger(echo=True, echo_to=sys.stderr)
+        lg.log("autotune_candidate", key="flat=1_f32")
+        cap = capsys.readouterr()
+        assert cap.out == "" and "[autotune_candidate]" in cap.err
+
+    def test_per_call_echo_override(self, capsys):
+        lg = MetricsLogger(echo=True)
+        lg.log("span", _echo=False, name="x")
+        assert capsys.readouterr().out == ""
+        lg = MetricsLogger(echo=False)
+        lg.log("loud", _echo=True, note="forced")
+        assert "[loud]" in capsys.readouterr().out
+
+    def test_context_manager_closes_on_error(self, tmp_path):
+        p = tmp_path / "m.jsonl"
+        with pytest.raises(RuntimeError):
+            with MetricsLogger(jsonl_path=str(p), echo=False) as lg:
+                lg.log("partial", n=1)
+                raise RuntimeError("boom")
+        assert lg._fh is None  # handle closed on the error path
+        events = [l["event"] for l in read_jsonl(p)]
+        assert events == ["run_meta", "partial"]
+
+    def test_finish_idempotent(self, tmp_path):
+        lg = MetricsLogger(jsonl_path=str(tmp_path / "m.jsonl"), echo=False)
+        lg.finish()
+        lg.finish()  # second close is a no-op, not an error
+        lg.log("after_close", n=1)  # write after close: silently dropped
+
+    def test_wandb_absent_degrades_to_jsonl(self, tmp_path, monkeypatch,
+                                            capsys):
+        # sys.modules[name] = None makes `import wandb` raise ImportError
+        monkeypatch.setitem(sys.modules, "wandb", None)
+        p = tmp_path / "m.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), use_wandb=True, echo=False)
+        assert lg._wandb is None
+        assert "wandb unavailable" in capsys.readouterr().err
+        lg.log("epoch", loss=1.0)
+        lg.finish()
+        assert [l["event"] for l in read_jsonl(p)] == ["run_meta", "epoch"]
+
+
+class TestTimeline:
+    def test_span_and_mark_records(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False)
+        tl = Timeline(lg)
+        with tl.span("train_epoch_0", cat="train", resource="device",
+                     epoch=0):
+            pass
+        tl.event("retrace_storm", cat="compile", resource="compile", fn="f")
+        lg.finish()
+        recs = read_jsonl(p)
+        span = [r for r in recs if r["event"] == "span"][0]
+        assert span["name"] == "train_epoch_0"
+        assert span["resource"] == "device" and span["epoch"] == 0
+        assert 0 <= span["t0"] <= span["t1"]
+        assert span["dur"] == pytest.approx(span["t1"] - span["t0"], abs=1e-5)
+        assert span["thread"]
+        mark = [r for r in recs if r["event"] == "mark"][0]
+        assert mark["name"] == "retrace_storm" and mark["t"] >= 0
+
+    def test_span_at_ledger_endpoints(self, tmp_path):
+        lg = MetricsLogger(jsonl_path=str(tmp_path / "t.jsonl"), echo=False)
+        tl = Timeline(lg, origin=100.0)
+        tl.span_at("chunk_produce", 101.0, 103.5, resource="stream",
+                   bytes=42)
+        lg.finish()
+        span = [r for r in read_jsonl(tmp_path / "t.jsonl")
+                if r["event"] == "span"][0]
+        assert span["t0"] == 1.0 and span["t1"] == 3.5
+        assert span["dur"] == 2.5 and span["bytes"] == 42
+
+    def test_thread_safety(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False)
+        tl = Timeline(lg)
+
+        def emit(tid):
+            for i in range(50):
+                with tl.span(f"w{tid}_{i}", resource=f"worker{tid}"):
+                    pass
+
+        threads = [threading.Thread(target=emit, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        lg.finish()
+        spans = [r for r in read_jsonl(p) if r["event"] == "span"]
+        assert len(spans) == 200  # every line parses: no torn writes
+
+    def test_helpers_noop_without_installed_timeline(self):
+        assert current_timeline() is None
+        with timeline_span("x", resource="device"):
+            pass
+        timeline_event("y")
+        timeline_span_at("z", 0.0, 1.0)  # all silently no-ops
+
+    def test_install_returns_previous(self, tmp_path):
+        lg = MetricsLogger(jsonl_path=str(tmp_path / "t.jsonl"), echo=False)
+        tl = Timeline(lg)
+        prev = install_timeline(tl)
+        try:
+            assert prev is None and current_timeline() is tl
+            with timeline_span("train_epoch_0", resource="device"):
+                timeline_event("inside")
+        finally:
+            assert install_timeline(prev) is tl
+        lg.finish()
+        recs = read_jsonl(tmp_path / "t.jsonl")
+        assert {"span", "mark"} <= {r["event"] for r in recs}
+
+
+class TestProfiling:
+    def test_debug_nans_raises_inside_jit(self):
+        with debug_nans(True):
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: x / 0.0 * 0.0)(jnp.zeros(()))
+
+    def test_debug_nans_restores_config(self):
+        before = jax.config.jax_debug_nans
+        with debug_nans(True):
+            assert jax.config.jax_debug_nans is True
+        assert jax.config.jax_debug_nans == before
+        # nested disable inside the sweep scorer path (eval/sweep.py)
+        with debug_nans(True):
+            with debug_nans(False):
+                # NaN-by-design scoring must not trip a caller's guard
+                out = jax.jit(lambda x: x * jnp.nan)(jnp.ones(()))
+                assert np.isnan(np.asarray(out))
+            assert jax.config.jax_debug_nans is True
+
+    def test_trace_none_is_noop(self):
+        with trace(None):
+            pass  # no directory created, no profiler started
+
+    def test_step_annotation_context(self):
+        from factorvae_tpu.utils.profiling import step_annotation
+
+        with step_annotation("train_epoch_0"):
+            jnp.ones(()).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# trace_summary: synthetic Chrome-trace fixture (host + transfer lanes)
+
+
+def write_trace(tmp_path, events, name="host.trace.json.gz"):
+    d = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(d, exist_ok=True)
+    with gzip.open(d / name, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return str(tmp_path)
+
+
+DEVICE_LANE = {"ph": "M", "name": "process_name", "pid": 1,
+               "args": {"name": "/device:TPU:0 (compute)"}}
+HOST_LANE = {"ph": "M", "name": "process_name", "pid": 2,
+             "args": {"name": "/host:CPU python"}}
+
+
+def X(pid, name, dur):
+    return {"ph": "X", "pid": pid, "name": name, "dur": dur, "ts": 0}
+
+
+class TestTraceSummary:
+    def test_device_host_and_transfer_split(self, tmp_path):
+        from factorvae_tpu.utils.trace_summary import summarize_trace
+
+        log_dir = write_trace(tmp_path, [
+            DEVICE_LANE, HOST_LANE,
+            X(1, "fusion.1", 100.0),
+            X(1, "MemcpyH2D", 30.0),
+            X(1, "MemcpyD2H", 10.0),
+            X(2, "TransferToDeviceLocked", 25.0),
+            X(2, "python_host_work", 40.0),
+            X(2, "$file.py:1 frame", 999.0),  # nested stack: skipped
+        ])
+        s = summarize_trace(log_dir)
+        # device total: the three device-lane events only
+        assert s["total_us"] == pytest.approx(140.0)
+        # host lanes surfaced, not dropped ($ frames still excluded)
+        assert s["host_us"] == pytest.approx(65.0)
+        assert 2 in s["host_pids"]
+        # transfer classified across ALL lanes
+        assert s["transfer"]["h2d_us"] == pytest.approx(55.0)  # 30 + 25
+        assert s["transfer"]["d2h_us"] == pytest.approx(10.0)
+        assert s["transfer"]["count"] == 3
+        names = [n for n, _, _ in s["by_name"]]
+        assert "fusion.1" in names and "python_host_work" not in names
+        host_names = [n for n, _, _ in s["host_by_name"]]
+        assert "python_host_work" in host_names
+
+    def test_cpu_only_capture_counts_all_lanes(self, tmp_path):
+        from factorvae_tpu.utils.trace_summary import summarize_trace
+
+        log_dir = write_trace(tmp_path, [
+            HOST_LANE, X(2, "host_op", 50.0)])
+        s = summarize_trace(log_dir)
+        # no device lane anywhere -> everything is the total (the
+        # pre-existing CPU fallback), host_us stays 0
+        assert s["total_us"] == pytest.approx(50.0)
+        assert s["host_us"] == 0.0
+
+    def test_format_summary_mentions_host_and_transfer(self, tmp_path):
+        from factorvae_tpu.utils.trace_summary import (
+            format_summary,
+            summarize_trace,
+        )
+
+        log_dir = write_trace(tmp_path, [
+            DEVICE_LANE, HOST_LANE,
+            X(1, "fusion.1", 100.0), X(1, "MemcpyH2D", 30.0),
+            X(2, "host_op", 10.0)])
+        out = format_summary(summarize_trace(log_dir))
+        assert "host time" in out and "transfer" in out and "H2D" in out
+
+    def test_empty_dir_reports_no_files(self, tmp_path):
+        from factorvae_tpu.utils.trace_summary import (
+            format_summary,
+            summarize_trace,
+        )
+
+        s = summarize_trace(str(tmp_path))
+        assert s["files"] == []
+        assert "no .trace.json" in format_summary(s)

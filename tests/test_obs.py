@@ -1,0 +1,604 @@
+"""Run-observatory contracts (ISSUE 5):
+
+- obs OFF (the default) is bitwise-neutral: Trainer/FleetTrainer params
+  and metric histories are identical with the probes compiled out vs in
+  (the probes only OBSERVE values the update path already computes) —
+  and the off path adds nothing to the pre-observatory trace.
+- Probes ride the fleet seed axis as (S,) lists and the stream
+  residency path unchanged.
+- Plan/TrainConfig `obs` knob plumbing (row "obs" block, apply_plan,
+  CLI precedence).
+- Timeline interval math, Gantt/overlap rendering, report health flags,
+  compile watchdog, and the end-to-end RUN.jsonl -> obs.timeline /
+  obs.report round trip on a real (tiny) training run.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.obs.probes import EVAL_PROBE_KEYS, TRAIN_PROBE_KEYS
+from factorvae_tpu.obs.watchdog import watch_jit
+from factorvae_tpu.train import FleetTrainer, Trainer
+from factorvae_tpu.utils.logging import (
+    MetricsLogger,
+    Timeline,
+    install_timeline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(
+        num_days=20, num_instruments=6, num_features=8, missing_prob=0.2,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def ds(panel):
+    return PanelDataset(panel, seq_len=5)
+
+
+def obs_config(save_dir, ds, obs=False, residency="hbm", **train_kw):
+    defaults = dict(num_epochs=2, lr=1e-3, seed=0, save_dir=str(save_dir),
+                    checkpoint_every=0, days_per_step=2, obs_probes=obs)
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5),
+        data=DataConfig(seq_len=5, start_time=None,
+                        fit_end_time=str(ds.dates[12].date()),
+                        val_start_time=str(ds.dates[13].date()),
+                        val_end_time=str(ds.dates[-1].date()),
+                        panel_residency=residency, stream_chunk_days=4),
+        train=TrainConfig(**defaults),
+    )
+
+
+def assert_trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# probes: neutral when off, observational when on
+
+
+class TestProbesNeutrality:
+    def test_serial_params_and_metrics_identical_off_vs_on(self, ds,
+                                                           tmp_path):
+        s_off, out_off = Trainer(
+            obs_config(tmp_path / "off", ds, obs=False), ds,
+            logger=MetricsLogger(echo=False)).fit()
+        s_on, out_on = Trainer(
+            obs_config(tmp_path / "on", ds, obs=True), ds,
+            logger=MetricsLogger(echo=False)).fit()
+        # The probes observe the update path; they must not change it.
+        assert_trees_equal(s_off.params, s_on.params)
+        for r_off, r_on in zip(out_off["history"], out_on["history"]):
+            for k in ("train_loss", "val_loss", "train_recon", "train_kl"):
+                assert r_off[k] == r_on[k]
+            # probe keys present ONLY with obs on (the off stream is the
+            # pre-observatory schema)
+            assert not any(k in r_off for k in TRAIN_PROBE_KEYS)
+            for k in TRAIN_PROBE_KEYS:
+                assert np.isfinite(r_on[k]), k
+            assert r_on["nonfinite_grads"] == 0.0
+            assert r_on["nonfinite_loss"] == 0.0
+            assert r_on["grad_norm_max"] >= r_on["grad_norm_mean"] > 0
+            assert r_on["factor_sigma_mean"] > 0
+            for k in EVAL_PROBE_KEYS:
+                assert np.isfinite(r_on["val_" + k])
+
+    def test_stream_residency_with_probes_bitwise_hbm(self, panel,
+                                                      tmp_path):
+        ds_h = PanelDataset(panel, seq_len=5)
+        ds_s = PanelDataset(panel, seq_len=5, residency="stream")
+        s_h, out_h = Trainer(
+            obs_config(tmp_path / "h", ds_h, obs=True), ds_h,
+            logger=MetricsLogger(echo=False)).fit()
+        s_s, out_s = Trainer(
+            obs_config(tmp_path / "s", ds_s, obs=True, residency="stream"),
+            ds_s, logger=MetricsLogger(echo=False)).fit()
+        assert_trees_equal(s_h.params, s_s.params)
+        for r_h, r_s in zip(out_h["history"], out_s["history"]):
+            for k in TRAIN_PROBE_KEYS:
+                np.testing.assert_allclose(r_h[k], r_s[k], rtol=0, atol=0)
+
+    def test_fleet_probes_are_per_seed_lists(self, ds, tmp_path):
+        cfg = obs_config(tmp_path / "fleet", ds, obs=True)
+        tr = FleetTrainer(cfg, ds, seeds=[0, 1],
+                          logger=MetricsLogger(echo=False))
+        _, out = tr.fit()
+        rec = out["history"][0]
+        for k in TRAIN_PROBE_KEYS:
+            assert isinstance(rec[k], list) and len(rec[k]) == 2
+            assert all(np.isfinite(v) for v in rec[k])
+        # independent seeds -> independent gradient trajectories
+        assert rec["grad_norm_mean"][0] != rec["grad_norm_mean"][1]
+
+    def test_evaluate_carries_probes_when_on(self, ds, tmp_path):
+        tr = Trainer(obs_config(tmp_path / "ev", ds, obs=True), ds,
+                     logger=MetricsLogger(echo=False))
+        state, _ = tr.fit(num_epochs=1)
+        m = tr.evaluate(state.params)
+        for k in EVAL_PROBE_KEYS:
+            assert k in m and np.isfinite(m[k])
+
+    def test_make_step_fns_defaults_obs_off(self):
+        import inspect
+
+        from factorvae_tpu.train.loop import make_step_fns
+
+        assert inspect.signature(
+            make_step_fns).parameters["obs"].default is False
+
+
+class TestPlanObsKnob:
+    ROW = {
+        "platform": "cpu",
+        "shape": {"c": 8, "t": 5, "h": 8, "k": 4, "m": 6},
+        "n_min": 6, "n_max": 6,
+        "train": {"flatten_days": False, "days_per_step": 1,
+                  "compute_dtype": "float32"},
+        "obs": {"probes": True},
+        "source": "test row",
+    }
+
+    def shape(self):
+        from factorvae_tpu.plan import ShapeKey
+
+        return ShapeKey(num_features=8, seq_len=5, hidden_size=8,
+                        num_factors=4, num_portfolios=6, n_stocks=6)
+
+    def test_row_obs_block_resolves(self):
+        from factorvae_tpu.plan import plan_for
+
+        p = plan_for(self.shape(), platform="cpu", table=[self.ROW])
+        assert p.obs_probes is True
+        assert p.describe()["obs_probes"] is True
+
+    def test_pre_observatory_rows_resolve_probes_off(self):
+        from factorvae_tpu.plan import plan_for
+
+        row = {k: v for k, v in self.ROW.items() if k != "obs"}
+        assert plan_for(self.shape(), platform="cpu",
+                        table=[row]).obs_probes is False
+        assert plan_for(self.shape(), platform="cpu",
+                        table=[]).obs_probes is False  # default plan
+
+    def test_apply_plan_sets_and_keeps_obs(self):
+        import dataclasses
+
+        from factorvae_tpu.plan import apply_plan, plan_for
+
+        p = plan_for(self.shape(), platform="cpu", table=[self.ROW])
+        cfg = Config()
+        assert apply_plan(cfg, p).train.obs_probes is True
+        # keep_obs: an explicit --obs/--no-obs wins over the row
+        cfg_off = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, obs_probes=False))
+        assert apply_plan(cfg_off, p,
+                          keep_obs=True).train.obs_probes is False
+
+
+# ---------------------------------------------------------------------------
+# timeline math + rendering
+
+
+class TestTimelineMath:
+    def test_merge_and_intersect(self):
+        from factorvae_tpu.obs.timeline import (
+            intersect,
+            merge_intervals,
+            total,
+        )
+
+        merged = merge_intervals([(3, 4), (0, 1), (0.5, 2), (4, 4)])
+        assert merged == [(0, 2), (3, 4)]
+        assert total(merged) == pytest.approx(3.0)
+        both = intersect([(0, 2), (3, 4)], [(1, 3.5)])
+        assert both == [(1, 2), (3, 3.5)]
+
+    def spans(self):
+        def span(name, res, t0, t1):
+            return {"event": "span", "name": name, "resource": res,
+                    "t0": t0, "t1": t1, "dur": t1 - t0}
+
+        return [
+            span("train_epoch_0", "device", 1.0, 3.0),
+            span("train_epoch_1", "device", 4.0, 6.0),
+            # stream busy [0.5, 2.5]: 1.5 of 2.0 overlaps device
+            span("chunk_produce", "stream", 0.5, 2.5),
+            # checkpoint fully inside the device gap: overlap 0
+            span("ckpt_save_0", "checkpoint", 3.2, 3.8),
+        ]
+
+    def test_overlap_report(self):
+        from factorvae_tpu.obs.timeline import overlap_report
+
+        rows = {r["resource"]: r for r in overlap_report(self.spans())}
+        assert rows["device"]["overlap_frac"] is None  # the reference lane
+        assert rows["device"]["busy_seconds"] == pytest.approx(4.0)
+        assert rows["stream"]["overlap_frac"] == pytest.approx(0.75)
+        assert rows["checkpoint"]["overlap_frac"] == pytest.approx(0.0)
+
+    def test_overlap_without_device_lane_is_none(self):
+        from factorvae_tpu.obs.timeline import overlap_report
+
+        rows = overlap_report([{"event": "span", "name": "x",
+                                "resource": "stream", "t0": 0, "t1": 1}])
+        assert rows[0]["overlap_frac"] is None
+
+    def test_gantt_renders_lanes(self):
+        from factorvae_tpu.obs.timeline import gantt
+
+        g = gantt(self.spans(), width=40)
+        lines = g.splitlines()
+        assert any(l.startswith("device") and "#" in l for l in lines)
+        assert any(l.startswith("stream") for l in lines)
+        assert any(l.startswith("checkpoint") for l in lines)
+
+    def test_sections_split_at_run_meta_boundaries(self, tmp_path):
+        """Spans from different processes of a concatenated session
+        stream carry separate perf_counter origins — merging them would
+        fabricate overlap between work that never ran concurrently."""
+        from factorvae_tpu.obs.timeline import (
+            load_run,
+            overlap_report,
+            span_sections,
+        )
+
+        def span(res, t0, t1):
+            return {"event": "span", "name": res, "resource": res,
+                    "t0": t0, "t1": t1, "dur": t1 - t0}
+
+        recs = [{"event": "run_meta"}, span("device", 0.0, 10.0),
+                {"event": "run_meta"}, span("stream", 1.0, 9.0)]
+        p = tmp_path / "two.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        run = load_run(str(p))
+        sections = span_sections(run)
+        assert [len(s) for s in sections] == [1, 1]
+        # run 2 has no device lane of its own: overlap is honestly
+        # unknown, NOT the false 100% a merged window would report
+        rows2 = overlap_report(sections[1])
+        assert rows2[0]["resource"] == "stream"
+        assert rows2[0]["overlap_frac"] is None
+        # without positional info (hand-built lists): one section
+        assert span_sections({"meta": [], "spans": run["spans"]}) \
+            == [run["spans"]]
+
+    def test_load_run_skips_torn_lines(self, tmp_path):
+        from factorvae_tpu.obs.timeline import load_run
+
+        p = tmp_path / "r.jsonl"
+        p.write_text(json.dumps({"event": "span", "t0": 0, "t1": 1,
+                                 "resource": "device", "name": "x"})
+                     + "\n{torn")
+        run = load_run(str(p))
+        assert len(run["spans"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# report health flags
+
+
+def epoch(e, train=1.0, val=1.0, dps=10.0, **kw):
+    return {"ts": 0.0, "event": "epoch", "epoch": e, "train_loss": train,
+            "val_loss": val, "lr": 1e-4, "days_per_sec": dps, **kw}
+
+
+def write_run(tmp_path, records, name="RUN.jsonl"):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    return str(p)
+
+
+class TestReport:
+    def report(self, records, **kw):
+        from factorvae_tpu.obs.report import build_report
+        from factorvae_tpu.obs.timeline import load_run as _parse
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "r.jsonl")
+            with open(path, "w") as fh:
+                fh.write("\n".join(json.dumps(r) for r in records))
+            return build_report(_parse(path), **kw)
+
+    def test_clean_run_has_no_flags(self):
+        rep = self.report([epoch(e, train=1.0 - 0.1 * e,
+                                 val=1.0 - 0.05 * e) for e in range(4)])
+        assert rep["flags"] == [] and rep["summary"]["healthy"]
+
+    def test_nonfinite_flags(self):
+        rep = self.report([epoch(0), epoch(1, train=float("nan")),
+                           epoch(2, nonfinite_grads=3.0)])
+        kinds = {(f["epoch"], f["flag"]) for f in rep["flags"]}
+        assert (1, "nonfinite") in kinds and (2, "nonfinite") in kinds
+
+    def test_fleet_any_seed_nonfinite_flags(self):
+        rep = self.report([
+            {"event": "fleet_epoch", "epoch": 0,
+             "train_loss": [1.0, float("inf")], "val_loss": [1.0, 1.0],
+             "seed_days_per_sec": 10.0}])
+        assert any(f["flag"] == "nonfinite" for f in rep["flags"])
+
+    def test_grad_spike_flag(self):
+        recs = [epoch(e, grad_norm_mean=1.0, grad_norm_max=1.5)
+                for e in range(4)]
+        recs.append(epoch(4, grad_norm_mean=1.0, grad_norm_max=50.0))
+        rep = self.report(recs)
+        assert any(f["flag"] == "grad_spike" and f["epoch"] == 4
+                   for f in rep["flags"])
+
+    def test_val_divergence_flag(self):
+        recs = [epoch(0, val=1.0), epoch(1, val=0.9)]
+        recs += [epoch(2 + i, val=1.5) for i in range(3)]
+        rep = self.report(recs)
+        div = [f for f in rep["flags"] if f["flag"] == "val_divergence"]
+        assert div and div[0]["epoch"] == 2
+
+    def test_slow_epoch_vs_run_median(self):
+        recs = [epoch(e, dps=10.0) for e in range(4)] + [epoch(4, dps=2.0)]
+        rep = self.report(recs)
+        assert any(f["flag"] == "slow_epoch" and f["epoch"] == 4
+                   for f in rep["flags"])
+
+    def test_throughput_vs_plan_envelope(self):
+        from factorvae_tpu.obs.report import plan_measured_days_per_sec
+
+        plan_rec = {"event": "plan", "provenance": "measured",
+                    "source": "autotune_plan flagship n=300 on cpu "
+                              "(days=8, reps=2): train 0.2000 s/day, "
+                              "score 1,234 w/s"}
+        assert plan_measured_days_per_sec([plan_rec]) == pytest.approx(5.0)
+        # at 1.0 d/s the plan envelope flags even a CONSISTENT run
+        # (the run median alone would see nothing wrong). Epoch 0 is
+        # compile-exempt, so 2 of the 3 epochs flag.
+        recs = [plan_rec] + [epoch(e, dps=1.0) for e in range(3)]
+        rep = self.report(recs)
+        slow = [f for f in rep["flags"] if f["flag"] == "slow_epoch"]
+        assert len(slow) == 2 and "plan row" in slow[0]["detail"]
+
+    def test_concatenated_runs_are_segmented(self):
+        """One RUN.jsonl deliberately carries many runs (autotune +
+        train + sweep, parity grid points). Stateful checks must not
+        leak across run boundaries: run A's best-val baseline must not
+        flag a healthy run B as divergent, and each run's compile
+        epoch is exempt from the slow check."""
+        run_a = [epoch(e, val=0.5, dps=100.0) for e in range(3)]
+        # run B restarts at epoch 0: higher (but stable) val loss and a
+        # slower-but-consistent rate, plus its own compile epoch 0
+        run_b = [epoch(0, val=0.9, dps=1.0)] + [
+            epoch(e, val=0.9, dps=10.0) for e in range(1, 5)]
+        rep = self.report(run_a + run_b)
+        assert rep["flags"] == [], rep["flags"]
+
+    def test_no_val_split_exemption_is_per_run(self):
+        """A run with no validation split logs NaN val_loss by design;
+        a sibling run's finite val split in the same concatenated
+        stream must not un-excuse it."""
+        no_val = [epoch(e, val=float("nan")) for e in range(3)]
+        with_val = [epoch(e, val=0.9) for e in range(3)]
+        rep = self.report(no_val + with_val)
+        assert rep["flags"] == [], rep["flags"]
+
+    def test_fleet_single_seed_spike_and_divergence_flag(self):
+        """Per-seed lanes: ONE bad seed among healthy ones must trip
+        the flag (the report's 'ANY seed' promise) — a cross-seed mean
+        would dilute it below threshold."""
+        def fleet(e, val1, gmax1):
+            return {"event": "fleet_epoch", "epoch": e,
+                    "train_loss": [1.0, 1.0], "val_loss": [0.9, val1],
+                    "grad_norm_mean": [1.0, 1.0],
+                    "grad_norm_max": [1.5, gmax1],
+                    "seed_days_per_sec": 10.0}
+
+        recs = [fleet(0, 0.9, 1.5), fleet(1, 0.8, 1.5)]
+        recs += [fleet(2 + i, 1.5, 1.5) for i in range(3)]  # seed 1 diverges
+        recs.append(fleet(5, 1.5, 50.0))                    # seed 1 spikes
+        rep = self.report(recs)
+        kinds = {f["flag"] for f in rep["flags"]}
+        assert "val_divergence" in kinds and "grad_spike" in kinds
+        assert all("seed lane 1" in f["detail"] for f in rep["flags"])
+
+    def test_plan_envelope_does_not_leak_across_runs(self):
+        """Each segment is judged against ITS OWN preceding plan record:
+        run A (default plan, honestly slow) stays unflagged; run B
+        (measured plan, same rate) flags against its envelope."""
+        default_plan = {"event": "plan", "provenance": "default",
+                        "source": "per-backend default"}
+        measured_plan = {"event": "plan", "provenance": "measured",
+                         "source": "autotune: train 0.0200 s/day"}
+        run_a = [epoch(e, dps=1.0) for e in range(3)]
+        run_b = [epoch(e, dps=1.0) for e in range(3)]
+        rep = self.report([default_plan] + run_a + [measured_plan] + run_b)
+        slow = [f for f in rep["flags"] if f["flag"] == "slow_epoch"]
+        # only run B's non-compile epochs (1, 2) flag — run A has no
+        # envelope and a consistent rate
+        assert [f["epoch"] for f in slow] == [1, 2]
+        assert all("plan row" in f["detail"] for f in slow)
+
+    def test_default_provenance_promises_no_envelope(self):
+        from factorvae_tpu.obs.report import plan_measured_days_per_sec
+
+        assert plan_measured_days_per_sec(
+            [{"event": "plan", "provenance": "default",
+              "source": "per-backend default"}]) is None
+
+    def test_cli_json_contract(self, tmp_path, capsys):
+        from factorvae_tpu.obs.report import main
+
+        path = write_run(tmp_path, [
+            {"event": "run_meta", "platform": "cpu"},
+            epoch(0), epoch(1, train=float("nan"))])
+        assert main([path, "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["num_epochs"] == 2
+        assert rep["summary"]["flag_counts"].get("nonfinite") == 1
+
+    def test_cli_human_renders_flags(self, tmp_path, capsys):
+        from factorvae_tpu.obs.report import main
+
+        path = write_run(tmp_path, [epoch(0), epoch(1, val=float("inf"))])
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert "HEALTH FLAGS" in out and "nonfinite" in out
+
+    def test_table_marks_attach_to_the_flagged_run_only(self, tmp_path,
+                                                        capsys):
+        """Concatenated runs repeat epoch NUMBERS; the table must mark
+        the flagged run's row, not every same-numbered row."""
+        from factorvae_tpu.obs.report import main
+
+        run_a = [epoch(0), epoch(1)]                       # healthy
+        run_b = [epoch(0, train=float("nan")), epoch(1)]   # epoch 0 bad
+        path = write_run(tmp_path, run_a + run_b)
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        marked = [l for l in out.splitlines() if "!!" in l]
+        # exactly ONE row marked — run B's epoch 0 (its NaN train_loss
+        # renders as "-"), not run A's healthy same-numbered row
+        assert len(marked) == 1 and "nonfinite" in marked[0]
+        cells = marked[0].split()
+        assert cells[0] == "0" and cells[1] == "-"
+
+
+# ---------------------------------------------------------------------------
+# compile watchdog
+
+
+class TestWatchdog:
+    def test_passthrough_without_timeline(self):
+        f = watch_jit(jax.jit(lambda x: x + 1), "f")
+        assert float(f(jnp.ones(()))) == 2.0
+        assert f.compiles == 0 and f.calls == 0  # dormant: no counting
+
+    def test_counts_compiles_and_flags_storm(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False)
+        prev = install_timeline(Timeline(lg))
+        try:
+            f = watch_jit(jax.jit(lambda x: x * 2), "storm",
+                          storm_threshold=2)
+            for n in range(1, 5):
+                f(jnp.ones((n,)))   # every distinct shape recompiles
+            f(jnp.ones((4,)))       # cache hit: no new compile
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        assert f.compiles == 4 and f.calls == 5
+        recs = [json.loads(l) for l in open(p).read().strip().splitlines()]
+        spans = [r for r in recs if r["event"] == "span"
+                 and r["name"] == "jit_compile:storm"]
+        assert len(spans) == 4
+        assert all(r["resource"] == "compile" for r in spans)
+        storms = [r for r in recs if r["event"] == "mark"
+                  and r["name"] == "retrace_storm"]
+        assert len(storms) == 2  # compiles 3 and 4 are past threshold 2
+        assert storms[-1]["fn"] == "storm" and storms[-1]["compiles"] == 4
+
+
+# ---------------------------------------------------------------------------
+# end to end: train -> RUN.jsonl -> timeline + report
+
+
+class TestEndToEnd:
+    def run_training(self, ds, tmp_path, residency="hbm"):
+        run_jsonl = str(tmp_path / "RUN.jsonl")
+        lg = MetricsLogger(jsonl_path=run_jsonl, echo=False,
+                           run_name="e2e", config={"e2e": True})
+        prev = install_timeline(Timeline(lg))
+        try:
+            dset = ds if residency == "hbm" else PanelDataset(
+                ds.panel, seq_len=5, residency="stream")
+            cfg = obs_config(tmp_path / "m", dset, obs=True,
+                             residency=residency, checkpoint_every=1)
+            tr = Trainer(cfg, dset, logger=lg)
+            tr.fit()
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        return run_jsonl
+
+    def test_run_jsonl_renders_in_both_tools(self, ds, tmp_path, capsys):
+        from factorvae_tpu.obs.report import main as report_main
+        from factorvae_tpu.obs.timeline import load_run
+        from factorvae_tpu.obs.timeline import main as timeline_main
+
+        run_jsonl = self.run_training(ds, tmp_path)
+        run = load_run(run_jsonl)
+        resources = {s["resource"] for s in run["spans"]}
+        # epochs + checkpoint save/serialize + compile watchdog spans
+        assert {"device", "checkpoint", "compile"} <= resources
+        assert "ckpt_serialize" in resources  # async commit watcher
+        assert run["meta"] and run["meta"][0]["run_name"] == "e2e"
+        names = {s["name"] for s in run["spans"]}
+        assert {"train_epoch_0", "val_epoch_0", "ckpt_save_0"} <= names
+
+        assert timeline_main([run_jsonl]) == 0
+        out = capsys.readouterr().out
+        assert "overlap_frac" in out and "device" in out
+
+        assert report_main([run_jsonl]) == 0
+        out = capsys.readouterr().out
+        assert "health probes: on" in out
+        assert "no health flags" in out  # a tiny clean run
+
+    def test_stream_residency_emits_prefetch_spans(self, ds, tmp_path):
+        from factorvae_tpu.obs.timeline import load_run, overlap_report
+
+        run_jsonl = self.run_training(ds, tmp_path, residency="stream")
+        run = load_run(run_jsonl)
+        produce = [s for s in run["spans"]
+                   if s["name"] == "chunk_produce"]
+        assert produce and all(s["bytes"] > 0 for s in produce)
+        rows = {r["resource"]: r for r in overlap_report(run["spans"])}
+        assert "stream" in rows and rows["stream"]["overlap_frac"] is not None
+
+    def test_cli_obs_flag_writes_run_jsonl(self, tmp_path, monkeypatch):
+        """`--obs` end to end through the CLI: RUN.jsonl lands in cwd
+        (the documented default), probes on, spans present."""
+        from factorvae_tpu.cli import main
+        from factorvae_tpu.data.synthetic import synthetic_frame
+        from factorvae_tpu.obs.timeline import load_run
+
+        df = synthetic_frame(num_days=16, num_instruments=6,
+                             num_features=8, seed=3)
+        pkl = tmp_path / "panel.pkl"
+        df.to_pickle(pkl)
+        monkeypatch.chdir(tmp_path)
+        rc = main([
+            "--dataset", str(pkl), "--num_epochs", "1",
+            "--num_latent", "8", "--hidden_size", "8", "--num_factor", "4",
+            "--num_portfolio", "6", "--seq_len", "5",
+            "--start_time", "2020-01-01", "--fit_end_time", "2020-01-14",
+            "--val_start_time", "2020-01-15",
+            "--val_end_time", "2020-01-18",
+            "--score_start", "2020-01-10", "--score_end", "2020-01-22",
+            "--save_dir", str(tmp_path / "models"),
+            "--score_dir", str(tmp_path / "scores"),
+            "--obs",
+        ])
+        assert rc == 0
+        run = load_run(str(tmp_path / "RUN.jsonl"))
+        assert run["meta"], "run_meta header missing"
+        assert run["epochs"] and "grad_norm_max" in run["epochs"][0]
+        assert any(s["resource"] == "device" for s in run["spans"])
+        obs_recs = [r for r in run["events"] if r["event"] == "obs"]
+        assert obs_recs and obs_recs[0]["probes"] is True

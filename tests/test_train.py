@@ -196,8 +196,9 @@ class TestMetricsLogger:
         lg.finish(best_val=2.0)
         lines = [json.loads(l) for l in path.read_text().strip().splitlines()]
         events = [l["event"] for l in lines]
-        assert events == ["epoch", "custom", "final"]
-        assert lines[0]["train_loss"] == 1.0
+        # ISSUE 5: every file-backed stream opens with a run_meta header
+        assert events == ["run_meta", "epoch", "custom", "final"]
+        assert lines[1]["train_loss"] == 1.0
 
 
 class TestTrainerConvenienceAPI:
