@@ -140,6 +140,23 @@ STREAM_CHUNK_DAYS = int(os.environ.get("BENCH_STREAM_CHUNK", 0))
 # itself a tracked number (the acceptance envelope is <= 5% windows/sec
 # on the flagship shape). Same robustness contract.
 USE_OBS = os.environ.get("BENCH_OBS", "0") == "1"
+# Mesh mode (`python bench.py --mesh` or BENCH_MESH=1): the composed
+# scaling grid (PR 6, partition-rule sharding). For each mesh shape
+# (data x stock factorization of the visible devices) x S in
+# BENCH_MESH_SEEDS, train a FleetTrainer ON the mesh — seed lanes over
+# 'data', cross-section over 'stock' — and report windows/sec*seed per
+# cell: the SCALE_MESH-style composed curve. BENCH_MESH_DEVICES=n
+# forces n virtual host-CPU devices (the test-rig pattern) so the grid
+# is a real 2x2 on a sandbox; wall-clock there is a correctness/ceiling
+# probe, not a speedup claim (the cores are oversubscribed — same
+# caveat as scripts/scale_demo.py). BENCH_MESH_RESIDENCY=stream runs
+# the full triple (mesh x fleet x stream). Same robustness contract.
+USE_MESH = os.environ.get("BENCH_MESH", "0") == "1"
+MESH_SEED_COUNTS = tuple(
+    int(s) for s in os.environ.get("BENCH_MESH_SEEDS", "1,2").split(",")
+    if s.strip())
+MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", 0))
+MESH_RESIDENCY = os.environ.get("BENCH_MESH_RESIDENCY", "hbm")
 
 
 def resolve_plan(platform: str):
@@ -236,13 +253,16 @@ def fail_metric() -> str:
         return "stream_train_throughput_failed"
     if USE_OBS or os.environ.get("BENCH_OBS", "0") == "1":
         return "obs_train_throughput_failed"
+    if USE_MESH or os.environ.get("BENCH_MESH", "0") == "1":
+        return "mesh_train_throughput_failed"
     return "train_throughput_flagship_K96_H64_Alpha158_failed"
 
 
 def fail_unit() -> str:
     """Unit for failure payloads, matching the mode's success unit so
     the longitudinal series never mixes units across records."""
-    fleet = USE_FLEET or os.environ.get("BENCH_FLEET", "0") == "1"
+    fleet = (USE_FLEET or os.environ.get("BENCH_FLEET", "0") == "1"
+             or USE_MESH or os.environ.get("BENCH_MESH", "0") == "1")
     return "windows/sec*seed" if fleet else "windows/sec/chip"
 
 
@@ -738,16 +758,142 @@ def run_obs_bench() -> dict:
     }
 
 
+def run_mesh_bench() -> dict:
+    """Composed scaling grid (BENCH_MESH): for each (data x stock) mesh
+    factorization x S seeds, train a seed-fleet ON the mesh at the
+    planner-resolved knobs and report windows/sec*seed per cell — the
+    SCALE_MESH-style artifact for the one-sharding-story composition
+    (partition-rule-driven: seed lanes over 'data', cross-section over
+    'stock', optional stream residency for the full triple). Serial
+    cells (S=1) compile the serial sharded program; cells whose
+    divisibility constraints fail (compose.validate) are reported as
+    skipped, not silently dropped. One JSON line, same terminal
+    contract; `value` is the best composed aggregate."""
+    import jax
+    import numpy as np
+
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    from jax.sharding import Mesh
+
+    from factorvae_tpu.data import synthetic_panel_dense
+    from factorvae_tpu.parallel.compose import (
+        CompositionError,
+        compatible_days_per_step,
+        mesh_shape_candidates,
+        validate,
+    )
+    from factorvae_tpu.train import FleetTrainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    platform, peak = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    devices = jax.devices()
+    panel = synthetic_panel_dense(
+        num_days=NUM_DAYS, num_instruments=N_STOCKS,
+        num_features=NUM_FEATURES)
+
+    grid = []
+    for dp, sp in mesh_shape_candidates(len(devices)):
+        mesh = Mesh(
+            np.asarray(devices[:dp * sp]).reshape(dp, sp),
+            ("data", "stock"))
+        for s in MESH_SEED_COUNTS:
+            cell = {"data": dp, "stock": sp, "seeds": s,
+                    "residency": MESH_RESIDENCY}
+            # Serial cells need days_per_step divisible by dp; the ONE
+            # scaling rule (compose.compatible_days_per_step) applies
+            # and the scaled value is recorded on the cell.
+            dps = knobs["days_per_step"]
+            if s == 1:
+                dps = compatible_days_per_step(dps, dp)
+            cell["days_per_step"] = dps
+            try:
+                validate(mesh=mesh, num_seeds=s, residency=MESH_RESIDENCY,
+                         days_per_step=dps)
+            except CompositionError as e:
+                cell["skipped"] = str(e)
+                grid.append(cell)
+                continue
+            cfg, ds = bench_setup(dict(knobs, days_per_step=dps),
+                                  residency=MESH_RESIDENCY, panel=panel)
+            trainer = FleetTrainer(cfg, ds, seeds=list(range(s)),
+                                   mesh=mesh,
+                                   logger=MetricsLogger(echo=False))
+            state = trainer.init_run_state()
+            state, m = trainer._run_train_epoch(state, 0)  # warmup/compile
+            jax.block_until_ready(m["loss"])
+            days_per_epoch = float(jax.numpy.asarray(m["days"]).reshape(-1)[0])
+            t0 = time.time()
+            for epoch in range(1, EPOCHS_TIMED + 1):
+                state, m = trainer._run_train_epoch(state, epoch)
+            jax.block_until_ready(m["loss"])
+            dt = time.time() - t0
+            per_seed = EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt
+            cell["windows_per_sec_seed"] = round(per_seed, 1)
+            cell["aggregate_windows_per_sec"] = round(per_seed * s, 1)
+            grid.append(cell)
+
+    ran = [c for c in grid if "aggregate_windows_per_sec" in c]
+    serial = next(
+        (c["aggregate_windows_per_sec"] for c in ran
+         if (c["data"], c["stock"], c["seeds"]) == (1, 1, 1)), None)
+    if serial:
+        for c in ran:
+            c["speedup_vs_1x1_serial"] = round(
+                c["aggregate_windows_per_sec"] / serial, 3)
+    best = max(ran, key=lambda c: c["aggregate_windows_per_sec"])
+    payload = {
+        "metric": (
+            f"mesh_train_throughput_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+            f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}"
+            f"_d{NUM_DAYS}e{EPOCHS_TIMED}_dev{len(devices)}"
+            + ("" if MESH_RESIDENCY == "hbm" else f"_{MESH_RESIDENCY}")
+            + ("" if "BENCH_MESH_SEEDS" not in os.environ else
+               "_S" + "-".join(str(s) for s in MESH_SEED_COUNTS))
+            + ("_cpu_fallback" if FORCED_CPU else "")),
+        "value": best["aggregate_windows_per_sec"],
+        "unit": "windows/sec*seed",
+        "vs_baseline": round(
+            best["aggregate_windows_per_sec"] / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        "devices": len(devices),
+        "best_cell": {k: best[k] for k in ("data", "stock", "seeds")},
+        "grid": grid,
+        "residency": MESH_RESIDENCY,
+        "n_real": N_STOCKS,
+        # Oversubscribed virtual CPU devices share the same cores: the
+        # grid is a correctness/ceiling probe there, not a speedup claim
+        # (scale_demo.py's long-standing caveat).
+        "virtual_devices": platform == "cpu" and len(devices) > 1,
+        "plan": plan_block,
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "SCALE_MESH_COMPOSED.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return payload
+
+
 def bench_payload() -> dict:
     """Fleet mode (--fleet / BENCH_FLEET=1), stream-residency A/B
     (--stream / BENCH_STREAM=1), probe-overhead A/B (--obs /
-    BENCH_OBS=1), or the single-model headline."""
+    BENCH_OBS=1), composed mesh grid (--mesh / BENCH_MESH=1), or the
+    single-model headline."""
     if USE_FLEET:
         return run_fleet_bench()
     if USE_STREAM:
         return run_stream_bench()
     if USE_OBS:
         return run_obs_bench()
+    if USE_MESH:
+        return run_mesh_bench()
     return run_bench()
 
 
@@ -890,7 +1036,7 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
-    global USE_FLEET, USE_STREAM, USE_OBS
+    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH
     if "--fleet" in sys.argv:
         # Propagate into the probe/accel/fallback subprocesses too.
         USE_FLEET = True
@@ -901,6 +1047,9 @@ def main() -> None:
     if "--obs" in sys.argv:
         USE_OBS = True
         os.environ["BENCH_OBS"] = "1"
+    if "--mesh" in sys.argv:
+        USE_MESH = True
+        os.environ["BENCH_MESH"] = "1"
 
     if ACCEL_CHILD:
         # Child: backend already validated by the parent's probe; any crash
@@ -911,10 +1060,20 @@ def main() -> None:
     if FORCED_CPU:
         # Pin host CPU BEFORE any jax import: the sandbox TPU plugin pins
         # jax_platforms at the config level, so the env var alone is not
-        # enough (utils/testing.force_host_devices handles both).
+        # enough (utils/testing.force_host_devices handles both). Mesh
+        # mode gets a virtual multi-device rig (BENCH_MESH_DEVICES,
+        # default 4 -> a real 2x2 grid) — the forced-CPU composition
+        # probe; other modes keep the single-device host.
         from factorvae_tpu.utils.testing import force_host_devices
 
-        force_host_devices(1)
+        if USE_MESH and MESH_DEVICES:
+            # An EXPLICIT BENCH_MESH_DEVICES must win over an inherited
+            # --xla_force_host_platform_device_count (e.g. the test
+            # rig's 8) — force_host_devices only appends when absent.
+            os.environ["XLA_FLAGS"] = " ".join(
+                f for f in os.environ.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f)
+        force_host_devices((MESH_DEVICES or 4) if USE_MESH else 1)
         try:
             emit(bench_payload())
         except Exception as e:

@@ -48,9 +48,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--days_per_step", type=int, default=None,
                    help="days whose grads are averaged per update (1 = reference-faithful)")
     p.add_argument("--mesh", action="store_true",
-                   help="shard over all visible devices (data x stock mesh)")
-    p.add_argument("--mesh_stock", type=int, default=1,
-                   help="size of the 'stock' (cross-section) mesh axis")
+                   help="shard over all visible devices (data x stock "
+                        "mesh). Composes with --fleet_seeds (seed lanes "
+                        "ride the 'data' axis) and --panel_residency "
+                        "stream (per-shard chunk prefetch) — one "
+                        "program, all three axes (docs/sharding.md)")
+    p.add_argument("--mesh_stock", type=int, default=None,
+                   help="size of the 'stock' (cross-section) mesh axis "
+                        "(default: 1, or a measured plan row's 'mesh' "
+                        "block under --auto_plan)")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest full-state checkpoint")
     p.add_argument("--fleet_seeds", type=int, default=None,
@@ -309,7 +315,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             wandb=args.wandb,
             obs_probes=bool(args.obs),
         ),
-        mesh=MeshConfig(stock_axis=args.mesh_stock),
+        mesh=MeshConfig(stock_axis=args.mesh_stock or 1),
     )
 
 
@@ -377,7 +383,7 @@ def main(argv=None) -> int:
 
             auto_plan = planlib.plan_for_config(
                 cfg, panel.num_instruments,
-                shard=args.mesh_stock if args.mesh else 1)
+                shard=(args.mesh_stock or 1) if args.mesh else 1)
             cfg = planlib.apply_plan(
                 cfg, auto_plan,
                 keep_days_per_step=args.days_per_step is not None,
@@ -387,24 +393,10 @@ def main(argv=None) -> int:
                 keep_residency=(args.panel_residency is not None
                                 or args.stream_chunk_days is not None),
                 keep_obs=args.obs is not None,
+                # A measured mesh-shape row only matters under --mesh,
+                # and an explicit --mesh_stock still wins.
+                keep_mesh=not args.mesh or args.mesh_stock is not None,
             )
-            if args.mesh and args.panel_residency is None \
-                    and cfg.data.panel_residency == "stream":
-                # Stream residency does not compose with a device mesh (the
-                # sharded path needs the panel in HBM to shard it); a
-                # measured stream row must not break --mesh runs — fall
-                # back to HBM and say so. Only the PLAN's choice is
-                # overridden: an EXPLICIT --panel_residency stream with
-                # --mesh still fails loudly in Trainer, same as without
-                # --auto_plan.
-                import dataclasses
-
-                cfg = dataclasses.replace(cfg, data=dataclasses.replace(
-                    cfg.data, panel_residency="hbm"))
-                logger.log(
-                    "plan_residency_override", residency="hbm",
-                    note="plan chose panel_residency=stream but --mesh needs "
-                         "the HBM panel; keeping hbm")
             logger.log("plan", **auto_plan.describe(
                 planlib.shape_of(cfg, panel.num_instruments)))
 
@@ -424,9 +416,35 @@ def main(argv=None) -> int:
             )
             return 2
 
+        # The mesh (if any) the run trains/scores on — threaded into the
+        # scoring pass so stream-resident chunks land pre-sharded. Built
+        # HERE so a shape that doesn't fit the visible devices (a stale
+        # plan row's factorization, a lone-device host) is the CLI's
+        # documented exit-2 error, not a traceback.
+        run_mesh = None
+        if args.mesh:
+            from factorvae_tpu.parallel.mesh import make_mesh
+
+            try:
+                run_mesh = make_mesh(cfg.mesh)
+            except ValueError as e:
+                print(
+                    f"error: cannot build the requested "
+                    f"(data x stock) mesh over the visible devices: {e} "
+                    f"(--mesh_stock overrides a plan row's shape)",
+                    file=sys.stderr)
+                return 2
         if args.score_only:
             # Scoring needs no training split — restore the best-val weights
             # through the model factory (reference utils.load_model analogue).
+            # --mesh applies here too: the HBM panel re-places onto the
+            # mesh (stream chunks land pre-sharded via mesh=run_mesh
+            # below), so a score-only pass on a wide universe shards
+            # exactly like a train+score run's scoring leg.
+            if run_mesh is not None:
+                from factorvae_tpu.parallel.sharding import shard_dataset
+
+                shard_dataset(run_mesh, dataset)
             from factorvae_tpu.models.factorvae import load_model
 
             path = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
@@ -445,20 +463,15 @@ def main(argv=None) -> int:
             from factorvae_tpu.eval.sweep import seed_sweep
             from factorvae_tpu.models.factorvae import load_model
 
-            if args.mesh:
-                # FleetTrainer does not compose the seed axis with a
-                # ('data','stock') mesh; training would silently run
-                # unsharded (and every pod process would race the same
-                # checkpoint paths). Fail loudly instead.
-                print(
-                    "error: --mesh is not supported with --fleet_seeds "
-                    "(the fleet is the single-chip seed-parallel mode); "
-                    "drop one of the two flags", file=sys.stderr)
-                return 2
+            # The seed axis composes with the mesh since PR 6: seed
+            # lanes shard over 'data', the cross-section over 'stock'
+            # (parallel/partition.py; compose.validate checks the
+            # divisibility constraints below). run_mesh was built above.
             seeds = list(range(cfg.train.seed, cfg.train.seed + args.fleet_seeds))
             spp = auto_plan.seeds_per_program if auto_plan is not None else None
             import contextlib
 
+            from factorvae_tpu.parallel.compose import CompositionError
             from factorvae_tpu.utils.profiling import debug_nans, trace
 
             nan_ctx = debug_nans() if args.debug_nans else contextlib.nullcontext()
@@ -470,7 +483,10 @@ def main(argv=None) -> int:
                         logger=logger, fleet=True, seeds_per_program=spp,
                         # --resume: each group restores from its lockstep
                         # per-seed full-state checkpoints when present.
-                        fleet_resume=args.resume)
+                        fleet_resume=args.resume, mesh=run_mesh)
+            except CompositionError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
             except ValueError as e:
                 if "empty training split" in str(e):
                     print(
@@ -513,10 +529,15 @@ def main(argv=None) -> int:
             _, params = load_model(cfg, checkpoint_path=_ckpt(best_seed),
                                    n_max=dataset.n_max)
         else:
+            from factorvae_tpu.parallel.compose import CompositionError
             from factorvae_tpu.utils.profiling import trace
 
             try:
-                trainer = Trainer(cfg, dataset, logger=logger, use_mesh=args.mesh)
+                trainer = Trainer(cfg, dataset, logger=logger,
+                                  mesh=run_mesh)
+            except CompositionError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
             except ValueError as e:
                 if "empty training split" in str(e):
                     print(
@@ -564,6 +585,7 @@ def main(argv=None) -> int:
             stochastic=None,  # defer to cfg.model.stochastic_inference
             with_labels=True,
             int8=args.int8_scores,
+            mesh=run_mesh,
         )
         path = export_scores(scores, cfg, args.score_dir)
         ic = RankIC(scores.dropna(), "LABEL0", "score")

@@ -60,10 +60,18 @@ class ChunkStream:
     consumer holds chunk i.
     Iteration is strictly in order — chunk order is the SGD step order,
     part of the bitwise contract with the HBM path.
+
+    ``placement`` (optional) replaces the bare whole-tree
+    ``jax.device_put`` with a target-sharding put — the mesh path
+    (parallel.sharding.chunk_placement): each chunk lands pre-sharded
+    per the panel partition rules, and on a multi-process mesh each
+    host ships only its addressable slice of the slab.
     """
 
-    def __init__(self, make_chunk: Callable[[int], Any], n_chunks: int):
+    def __init__(self, make_chunk: Callable[[int], Any], n_chunks: int,
+                 placement: Callable[[Any], Any] | None = None):
         self._make_chunk = make_chunk
+        self._placement = placement or jax.device_put
         self.n_chunks = int(n_chunks)
         self.bytes_put = 0
         self.produce_seconds = 0.0
@@ -76,7 +84,7 @@ class ChunkStream:
         self.bytes_put += nbytes
         # ONE chunk-granularity transfer; async on accelerators, so the
         # copy itself also overlaps the worker's next gather.
-        dev = jax.device_put(host)
+        dev = self._placement(host)
         t1 = time.perf_counter()
         self.produce_seconds += t1 - t0
         # The ledger as timeline spans (no-op without an installed
@@ -120,14 +128,16 @@ def chunk_slices(n_steps: int, steps_per_chunk: int) -> list:
             for s in range(0, n_steps, steps_per_chunk)]
 
 
-def stream_epoch_batches(dataset, order, steps_per_chunk: int) -> ChunkStream:
+def stream_epoch_batches(dataset, order, steps_per_chunk: int,
+                         placement=None) -> ChunkStream:
     """ChunkStream over an epoch's (n_steps, B) day order for a
     stream-resident dataset. Each chunk is
     ``(order_local (k, B), (cvalues, clv, cnv))`` — the chunk's slice of
     the step order remapped onto a relocatable mini-panel
     (windows.chunk_mini_panel), which the chunked epoch fns
     (train/loop.py train_chunk / eval_chunk) consume through the SAME
-    device gather the HBM path runs."""
+    device gather the HBM path runs. ``placement`` puts each chunk onto
+    a mesh per the panel partition rules (see ChunkStream)."""
     import numpy as np
 
     from factorvae_tpu.data.windows import chunk_mini_panel
@@ -144,4 +154,4 @@ def stream_epoch_batches(dataset, order, steps_per_chunk: int) -> ChunkStream:
             days, dataset.seq_len)
         return local_days.reshape(hi - lo, b), (cvalues, clv, cnv)
 
-    return ChunkStream(make_chunk, len(slices))
+    return ChunkStream(make_chunk, len(slices), placement=placement)

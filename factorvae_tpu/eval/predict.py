@@ -123,7 +123,7 @@ def _score_chunk_fleet_fn(
     return score_chunk_fleet
 
 
-def _stream_chunks(dataset, days: np.ndarray, chunk: int):
+def _stream_chunks(dataset, days: np.ndarray, chunk: int, placement=None):
     """ChunkStream of (local day_idx (chunk,), mini-panel) for a scoring
     pass over a stream-resident dataset — the same chunk partitioning
     and -1 padding as `_scan_inputs`/the chunk loop, remapped onto
@@ -144,16 +144,20 @@ def _stream_chunks(dataset, days: np.ndarray, chunk: int):
             padded, dataset.seq_len)
         return local_days, (cvalues, clv, cnv)
 
-    return starts, ChunkStream(make_chunk, len(starts))
+    return starts, ChunkStream(make_chunk, len(starts), placement=placement)
 
 
 def _predict_stream(params, config, dataset, days, stochastic, seed,
-                    chunk, int8=False, stacked=False):
+                    chunk, int8=False, stacked=False, mesh=None):
     """Scoring pass over a STREAM-resident dataset: per-chunk mini-panels
     double-buffered to the device, scored by the chunk scorer with the
     chunk loop's exact per-chunk RNG stream (`fold_in(base, c0)`), so
     scores are bitwise the HBM paths' (pinned in tests/test_stream.py).
-    `stacked=True` scores S stacked param trees per chunk (fleet)."""
+    `stacked=True` scores S stacked param trees per chunk (fleet).
+    ``mesh`` places each mini-panel per the panel partition rules
+    (cross-section over 'stock', day indices replicated) so the sharded
+    scorer consumes pre-sharded slabs — mesh x stream scoring stays
+    bitwise mesh x hbm scoring."""
     n_days = len(days)
     lead = ()
     if stacked:
@@ -163,9 +167,31 @@ def _predict_stream(params, config, dataset, days, stochastic, seed,
     else:
         score_chunk = _score_chunk_fn(
             config.model, config.data.seq_len, stochastic, int8)
+    placement = None
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from factorvae_tpu.parallel.sharding import chunk_placement
+
+        # Scoring day chunks are 1-D (chunk,) and replicated; only the
+        # mini-panel shards (the stacked fleet params already carry
+        # their seed-axis sharding from training).
+        placement = chunk_placement(mesh, order_spec=P())
+        from factorvae_tpu.parallel import partition
+        from factorvae_tpu.parallel.multihost import global_put
+
+        # Params must live on the mesh's device set too (host-loaded
+        # params scoring a sharded chunk would mix device sets): serial
+        # trees replicate, stacked trees keep their seed-axis rule.
+        # Re-placing an already-correctly-placed tree is a no-op.
+        specs = partition.params_partition_specs(params, stacked=stacked)
+        params = jax.tree.map(
+            lambda x, s: global_put(x, jax.sharding.NamedSharding(mesh, s)),
+            params, specs)
     base = jax.random.PRNGKey(seed)
     out = np.full(lead + (n_days, dataset.n_max), np.nan, np.float32)
-    starts, chunks = _stream_chunks(dataset, days, chunk)
+    starts, chunks = _stream_chunks(dataset, days, chunk,
+                                    placement=placement)
     for c0, (day_idx, (cvalues, clv, cnv)) in zip(starts, chunks):
         n_sel = min(chunk, n_days - c0)
         scores = score_chunk(params, cvalues, clv, cnv, day_idx,
@@ -275,8 +301,15 @@ def predict_panel(
     chunk: int = 32,
     int8: bool = False,
     impl: str = "scan",
+    mesh=None,
 ) -> np.ndarray:
     """(len(days), N_max) float scores; padded/absent entries are NaN.
+
+    ``mesh`` only matters for STREAM-resident datasets: each prefetched
+    mini-panel chunk is placed per the panel partition rules
+    (parallel/partition.py) so the sharded scorer runs on pre-sharded
+    slabs. HBM datasets were already re-placed by shard_dataset and
+    need nothing here.
 
     `impl="scan"` (default) runs the whole pass as one jitted scan over
     day-chunks; `impl="chunk_loop"` is the pre-overhaul per-chunk
@@ -304,7 +337,7 @@ def predict_panel(
         if n_days == 0:
             return np.full((0, dataset.n_max), np.nan, np.float32)
         return _predict_stream(params, config, dataset, days, stochastic,
-                               seed, chunk, int8=int8)
+                               seed, chunk, int8=int8, mesh=mesh)
     base = jax.random.PRNGKey(seed)
 
     if impl == "chunk_loop":
@@ -344,6 +377,7 @@ def predict_panel_fleet(
     seed: int = 0,
     chunk: int = 32,
     num_seeds: Optional[int] = None,
+    mesh=None,
 ) -> np.ndarray:
     """(S, len(days), N_max) scores for S stacked param trees (leading
     seed axis on every leaf, as train/fleet.py produces) in ONE
@@ -360,14 +394,15 @@ def predict_panel_fleet(
     if s == 1:
         one = jax.tree.map(lambda x: x[0], stacked_params)
         return predict_panel(one, config, dataset, days, stochastic, seed,
-                             chunk=chunk)[None]
+                             chunk=chunk, mesh=mesh)[None]
 
     n_days = len(days)
     if n_days == 0:
         return np.full((s, 0, dataset.n_max), np.nan, np.float32)
     if getattr(dataset, "residency", "hbm") == "stream":
         return _predict_stream(stacked_params, config, dataset, days,
-                               stochastic, seed, chunk, stacked=True)
+                               stochastic, seed, chunk, stacked=True,
+                               mesh=mesh)
     base = jax.random.PRNGKey(seed)
     day_idx, keys = _scan_inputs(
         days, chunk, base, _deterministic(config.model, stochastic))
@@ -408,6 +443,7 @@ def fleet_prediction_scores(
     stochastic: Optional[bool] = None,
     seed: int = 0,
     with_labels: bool = False,
+    mesh=None,
 ) -> list:
     """Per-seed score DataFrames (same schema as
     `generate_prediction_scores` — shared frame builder) from one
@@ -415,7 +451,7 @@ def fleet_prediction_scores(
     dispatch."""
     days = dataset.split_days(start, end)
     scores = predict_panel_fleet(stacked_params, config, dataset, days,
-                                 stochastic, seed)
+                                 stochastic, seed, mesh=mesh)
     idx, valid, labels = _frame_pieces(dataset, days, with_labels)
     return [_score_frame(scores[i], idx, valid, labels)
             for i in range(scores.shape[0])]
@@ -431,13 +467,14 @@ def generate_prediction_scores(
     seed: int = 0,
     with_labels: bool = False,
     int8: bool = False,
+    mesh=None,
 ) -> pd.DataFrame:
     """Scores DataFrame with MultiIndex (datetime, instrument) and a
     'score' column (plus 'LABEL0' when with_labels=True, matching the
     merge the backtest notebook performs in cell 5)."""
     days = dataset.split_days(start, end)
     scores = predict_panel(params, config, dataset, days, stochastic, seed,
-                           int8=int8)
+                           int8=int8, mesh=mesh)
     idx, valid, labels = _frame_pieces(dataset, days, with_labels)
     return _score_frame(scores, idx, valid, labels)
 

@@ -76,9 +76,12 @@ def _fleet_records(
     logger: MetricsLogger,
     on_seed,
     fleet_resume: bool = False,
+    mesh=None,
 ) -> list:
     """Train `pending` seeds in seed-parallel programs and score each
-    group in one seed-batched scan. Returns records in `pending` order."""
+    group in one seed-batched scan. Returns records in `pending` order.
+    ``mesh`` composes the seed axis with a device mesh (seed lanes over
+    'data', cross-section over 'stock' — parallel/partition.py)."""
     import jax
     import numpy as np
 
@@ -90,7 +93,8 @@ def _fleet_records(
     records = []
     for g0 in range(0, len(pending), spp):
         group = list(pending[g0:g0 + spp])
-        trainer = FleetTrainer(config, dataset, group, logger=logger)
+        trainer = FleetTrainer(config, dataset, group, logger=logger,
+                               mesh=mesh)
         state, out = trainer.fit(resume=fleet_resume)
         best_val = np.asarray(out["best_val"])
         # Score with the per-seed BEST-VALIDATION snapshot (the serial
@@ -115,7 +119,8 @@ def _fleet_records(
         with debug_nans(False):
             frames = fleet_prediction_scores(
                 scoring, config, dataset, start=score_start,
-                end=score_end, stochastic=False, with_labels=True)
+                end=score_end, stochastic=False, with_labels=True,
+                mesh=mesh)
         for i, seed in enumerate(group):
             ic = rank_ic_frame(frames[i].dropna(), "LABEL0", "score")
             rec = {
@@ -143,6 +148,7 @@ def seed_sweep(
     fleet: bool = False,
     seeds_per_program: Optional[int] = None,
     fleet_resume: bool = False,
+    mesh=None,
 ) -> pd.DataFrame:
     """Returns a frame indexed by seed with columns
     [rank_ic, rank_ic_ir, best_val]; .attrs['summary'] holds mean/std.
@@ -167,6 +173,11 @@ def seed_sweep(
     full-state checkpoints (FleetTrainer.fit(resume=True)) — a killed
     fleet sweep continues mid-group instead of retraining the group,
     provided ``checkpoint_every`` was on and the save_dir survived.
+
+    ``mesh`` (optional) composes the run with a device mesh: fleet
+    groups train/score with seed lanes sharded over 'data' and the
+    cross-section over 'stock'; serial trainings run the sharded serial
+    program (parallel/partition.py owns the placement either way).
     """
     logger = logger or MetricsLogger(echo=False)
     prior_records = prior_records or {}
@@ -184,7 +195,7 @@ def seed_sweep(
         cfg = dataclasses.replace(
             config, train=dataclasses.replace(config.train, seed=int(seed))
         )
-        trainer = Trainer(cfg, dataset, logger=logger)
+        trainer = Trainer(cfg, dataset, mesh=mesh, logger=logger)
         state, out = trainer.fit()
         # Score with the per-seed BEST-VALIDATION weights (the reference
         # backtest's selection rule, backtest.ipynb cell 2; the
@@ -217,7 +228,7 @@ def seed_sweep(
         records.extend(_fleet_records(
             config, dataset, pending, seeds_per_program,
             score_start, score_end, logger, on_seed,
-            fleet_resume=fleet_resume))
+            fleet_resume=fleet_resume, mesh=mesh))
         # The frame keeps the caller's seed order regardless of how the
         # fleet grouped the training (equality with the serial sweep).
         order = {int(s): i for i, s in enumerate(seeds)}

@@ -1,0 +1,287 @@
+"""Named-regex partition rules -> PartitionSpecs: ONE sharding story.
+
+Before this module, the three scaling axes were wired ad hoc per path
+and mutually exclusive: the serial `Trainer` had its own mesh
+in_shardings, the seed-parallel fleet rejected meshes outright, and the
+out-of-core stream fell back to HBM whenever a mesh appeared. This
+module replaces that with the `match_partition_rules` /
+`make_shard_and_gather_fns` pattern (SNIPPETS.md [1]-[3]): a single
+table of (regex, PartitionSpec) rules matched against '/'-joined pytree
+path names resolves the placement of EVERY array the training program
+touches — the (stacked or serial) TrainState, the epoch day orders, the
+HBM panel and the stream path's relocatable mini-panel chunks — so
+Trainer, FleetTrainer, ChunkStream and scoring all compose on the same
+mesh instead of pairwise-rejecting each other.
+
+Axis semantics (docs/sharding.md has the full matrix):
+
+- 'data'  — serial runs: day-level data parallelism (each device takes
+  a slice of every update's day batch; GSPMD all-reduces gradients).
+  Fleet runs: SEED lanes. S independent models have zero cross-model
+  communication, so the seed axis is the cheapest thing to lay over the
+  mesh — each 'data' slice trains S/dp seeds and no collective ever
+  crosses it.
+- 'stock' — the cross-section N, serial and fleet alike: panel rows,
+  per-stock activations; the masked softmaxes / portfolio matvec become
+  GSPMD collectives within a 'stock' group.
+- 'host'  — (hierarchical meshes) day-batch data parallelism across
+  hosts: the once-per-step gradient all-reduce may ride DCN while the
+  latency-sensitive 'stock' reductions stay on ICI (mesh.py).
+
+The oracle discipline the rules must preserve (tests/test_parallel.py):
+S=1 on a 1x1 mesh is bitwise the serial Trainer; each axis enabled
+alone is bitwise its single-axis path; mesh x stream is bitwise
+mesh x hbm (the in-graph gather makes the chunked scan trace the same
+partitioned program).
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from factorvae_tpu.parallel.mesh import DATA_AXIS, HOST_AXIS, STOCK_AXIS
+
+# Seed lanes of a stacked (S, ...) fleet state ride the 'data' mesh axis
+# (zero cross-seed communication makes it the free axis to occupy);
+# day-batch data parallelism then moves to the 'host' axis when the
+# mesh has one, and is simply off for fleet runs on a 2-axis mesh.
+SEED_AXIS = DATA_AXIS
+
+_is_spec = lambda x: isinstance(x, P)  # noqa: E731  (tree_map guard)
+
+
+# ---------------------------------------------------------------------------
+# Path naming + rule matching
+# ---------------------------------------------------------------------------
+
+
+def _key_str(k) -> str:
+    """One path entry -> its bare name ('params', '0', 'kernel', ...)."""
+    for attr in ("name", "key", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def tree_path_name(path) -> str:
+    """'/'-joined pytree path, e.g. 'opt_state/0/mu/params/gru/kernel'."""
+    return "/".join(_key_str(k) for k in path)
+
+
+def named_tree_map(fn: Callable[[str, Any], Any], tree):
+    """tree_map with the '/'-joined path name as the first argument."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [fn(tree_path_name(p), leaf) for p, leaf in flat]
+    )
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], tree):
+    """Pytree of PartitionSpecs resolved from (regex, spec) rules.
+
+    First matching rule wins (`re.search` against the '/'-joined path
+    name), so put specific rules before general ones. Scalar and
+    single-element leaves are never partitioned (P()). A leaf no rule
+    matches is a hard error: silently replicating a new TrainState
+    field would un-shard it on every path at once — the failure must
+    name the path so the rule table gets extended deliberately.
+    """
+
+    def get_spec(name, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(
+            f"no partition rule matches leaf '{name}' "
+            f"(shape {shape}); extend the rule table"
+        )
+
+    return named_tree_map(get_spec, tree)
+
+
+@functools.lru_cache(maxsize=8)
+def _replicate_fn(sharding: NamedSharding):
+    """Cached jitted identity with replicated out_shardings — the
+    cross-process gather collective (one compile per mesh, not one per
+    gathered leaf; NamedSharding hashes by (mesh, spec))."""
+    return jax.jit(lambda t: t, out_shardings=sharding)
+
+
+def make_shard_and_gather_fns(mesh: Mesh, specs):
+    """(shard_fns, gather_fns) pytrees of per-leaf callables.
+
+    shard_fn(x) places host (or single-device) data onto the mesh per
+    its spec — through `multihost.global_put`, so on a pod slice every
+    process materializes only its addressable shards. gather_fn(x)
+    brings a (possibly sharded) array back to host numpy — the
+    checkpoint path: per-seed unstacked checkpoints are written from
+    gathered host buffers, never from sharded device arrays (orbax
+    would otherwise couple the on-disk layout to the mesh shape).
+    """
+    from factorvae_tpu.parallel.multihost import global_put
+
+    def make_shard(spec):
+        sharding = NamedSharding(mesh, spec)
+
+        def shard_fn(x):
+            return global_put(x, sharding)
+
+        return shard_fn
+
+    replicate = _replicate_fn(NamedSharding(mesh, P()))
+
+    def make_gather(spec):
+        del spec  # the gather target is always host-replicated
+
+        def gather_fn(x):
+            if not getattr(x, "is_fully_addressable", True):
+                # Multi-process array: an out_shardings=P() identity is
+                # the collective that makes every process hold the whole
+                # value; fully-addressable arrays skip the dispatch.
+                x = replicate(x)
+            return np.asarray(x)
+
+        return gather_fn
+
+    return (
+        jax.tree_util.tree_map(make_shard, specs, is_leaf=_is_spec),
+        jax.tree_util.tree_map(make_gather, specs, is_leaf=_is_spec),
+    )
+
+
+def shard_tree(mesh: Mesh, specs, tree):
+    """Apply `make_shard_and_gather_fns`' shard side to a whole tree."""
+    shard_fns, _ = make_shard_and_gather_fns(mesh, specs)
+    return jax.tree_util.tree_map(lambda fn, x: fn(x), shard_fns, tree)
+
+
+def gather_tree(mesh: Mesh, specs, tree):
+    """Apply the gather side: sharded tree -> host-numpy tree."""
+    _, gather_fns = make_shard_and_gather_fns(mesh, specs)
+    return jax.tree_util.tree_map(lambda fn, x: fn(x), gather_fns, tree)
+
+
+# ---------------------------------------------------------------------------
+# The rule tables. ONE story: the serial table and the stacked (fleet)
+# table name the SAME paths; the stacked one lays the leading seed axis
+# over SEED_AXIS and keeps everything else identical — their spec trees
+# differ exactly by that prefix (pinned in tests/test_parallel.py).
+# ---------------------------------------------------------------------------
+
+# Serial TrainState: replicated. The parameter tree is tiny (~3.5 MB at
+# flagship shapes) — model parallelism buys nothing; the win axes are
+# days ('data'/'host') and the cross-section ('stock').
+TRAIN_STATE_RULES: list = [
+    (r"^step$", P()),
+    (r"^rng$", P()),
+    (r"^params/", P()),
+    (r"^opt_state/", P()),
+]
+
+# Stacked (S, ...) fleet TrainState: the leading seed axis shards over
+# SEED_AXIS; within a seed lane everything stays replicated.
+FLEET_STATE_RULES: list = [
+    (r"^step$", P(SEED_AXIS)),
+    (r"^rng$", P(SEED_AXIS)),
+    (r"^params/", P(SEED_AXIS)),
+    (r"^opt_state/", P(SEED_AXIS)),
+]
+
+# Panel arrays (PanelDataset / the stream path's relocatable
+# mini-panels — same axis layout, so one table serves both):
+#   values     (N, D, C+1) -> rows shard over 'stock'
+#   last_valid (D, N)      -> columns shard over 'stock'
+#   next_valid (D, N)      -> columns shard over 'stock'
+PANEL_RULES: list = [
+    (r"(^|/)values$", P(STOCK_AXIS, None, None)),
+    (r"(^|/)(last_valid|next_valid)$", P(None, STOCK_AXIS)),
+]
+
+
+def state_partition_specs(state, stacked: bool = False):
+    """Spec tree for a TrainState (or a bare params tree), serial or
+    stacked. `jax.eval_shape` structs work as leaves — only shapes are
+    read."""
+    return match_partition_rules(
+        FLEET_STATE_RULES if stacked else TRAIN_STATE_RULES, state
+    )
+
+
+def params_partition_specs(params, stacked: bool = False):
+    """Spec tree for a bare params tree (scoring / best-params buffers).
+    Param paths lack the 'params/' TrainState prefix, so the catch-all
+    seed rule is applied directly."""
+    spec = P(SEED_AXIS) if stacked else P()
+    return match_partition_rules([(r".*", spec)], params)
+
+
+def panel_partition_specs(stacked: bool = False):
+    """(values, last_valid, next_valid) specs, matching the panel rule
+    table. `stacked=True` prepends the seed axis (the fleet-stream
+    path's per-seed mini-panel stacks, (S, N, cT, C+1))."""
+    d = {"values": np.zeros((2, 2, 2)),
+         "last_valid": np.zeros((2, 2)), "next_valid": np.zeros((2, 2))}
+    specs = match_partition_rules(PANEL_RULES, d)
+    out = (specs["values"], specs["last_valid"], specs["next_valid"])
+    if stacked:
+        out = tuple(P(SEED_AXIS, *s) for s in out)
+    return out
+
+
+def day_batch_axes(mesh: Mesh, stacked: bool = False) -> tuple:
+    """Mesh axes that shard the day-batch (B) dimension. Serial runs
+    keep the historical ('host','data') / ('data',) assignment
+    (mesh.batch_axes); fleet runs cede 'data' to the seed axis, so
+    day-batches shard over 'host' when the mesh has one and are
+    replicated otherwise."""
+    if not stacked:
+        from factorvae_tpu.parallel.mesh import batch_axes
+
+        return batch_axes(mesh)
+    return (HOST_AXIS,) if HOST_AXIS in mesh.axis_names else ()
+
+
+def order_partition_spec(mesh: Mesh, stacked: bool = False) -> P:
+    """Epoch day-order spec: serial (steps, B) -> P(None, day_axes);
+    stacked (S, steps, B) -> P(seed, None, day_axes)."""
+    day = day_batch_axes(mesh, stacked)
+    day_spec = day if day else None
+    if stacked:
+        return P(SEED_AXIS, None, day_spec)
+    return P(None, day_spec)
+
+
+def eval_order_partition_spec(mesh: Mesh, stacked: bool = False) -> P:
+    """The SHARED validation order (steps, B) — no seed axis even on
+    fleet runs (every seed evaluates the same days)."""
+    day = day_batch_axes(mesh, stacked)
+    return P(None, day if day else None)
+
+
+def eval_keys_partition_spec() -> P:
+    """Stacked per-seed eval keys (S, key) -> seed axis."""
+    return P(SEED_AXIS)
+
+
+def named(mesh: Mesh, specs):
+    """Spec pytree -> NamedSharding pytree (what jit in_shardings and
+    device_put consume)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=_is_spec
+    )
+
+
+def seed_parallel_size(mesh: Optional[Mesh]) -> int:
+    """How many ways the seed axis splits on this mesh (1 = no mesh)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(SEED_AXIS, 1))

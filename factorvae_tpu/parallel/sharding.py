@@ -1,6 +1,9 @@
 """Partition specs and sharding helpers for the FactorVAE training step.
 
-Layout summary (see mesh.py for the axes):
+Since PR 6 every placement here is DERIVED from the named-regex
+partition-rule tables in `parallel/partition.py` (the one sharding
+story); these helpers survive as the thin mesh-bound conveniences the
+Trainer/bench paths call. Layout summary (see mesh.py for the axes):
 
     panel values (N, D, C+1)   -> P('stock', None, None)   HBM-resident shards
     fill maps    (D, N)        -> P(None, 'stock')
@@ -12,7 +15,8 @@ Layout summary (see mesh.py for the axes):
 GSPMD then inserts the collectives: gradient all-reduce over 'data'
 (day-level data parallelism) and max/sum reductions over 'stock' for the
 masked softmaxes (module.py:38,57,146 semantics) and the portfolio matmul
-(module.py:64).
+(module.py:64). Stacked fleet states lay their seed axis over 'data'
+instead (partition.FLEET_STATE_RULES); see docs/sharding.md.
 """
 
 from __future__ import annotations
@@ -23,6 +27,10 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from factorvae_tpu.parallel.mesh import STOCK_AXIS, batch_axes
+from factorvae_tpu.parallel.partition import (
+    order_partition_spec,
+    panel_partition_specs,
+)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -30,16 +38,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def panel_shardings(mesh: Mesh) -> Tuple[NamedSharding, NamedSharding, NamedSharding]:
-    """(values, last_valid, next_valid) placements."""
-    return (
-        NamedSharding(mesh, P(STOCK_AXIS, None, None)),
-        NamedSharding(mesh, P(None, STOCK_AXIS)),
-        NamedSharding(mesh, P(None, STOCK_AXIS)),
+    """(values, last_valid, next_valid) placements — the PANEL_RULES
+    table bound to this mesh."""
+    return tuple(
+        NamedSharding(mesh, s) for s in panel_partition_specs()
     )
 
 
 def order_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(None, batch_axes(mesh)))
+    return NamedSharding(mesh, order_partition_spec(mesh))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -71,10 +78,52 @@ def shard_dataset(mesh: Mesh, dataset) -> None:
 
     Goes through multihost.global_put so a mesh spanning several
     processes (a pod slice) works identically: every process holds the
-    same host panel and materializes its addressable shards."""
+    same host panel and materializes its addressable shards.
+
+    Stream-resident datasets (panel_residency='stream') round-trip
+    CLEANLY: the panel is host-pinned numpy by design and never holds a
+    device array to re-place — the stream path ships each prefetched
+    mini-panel chunk pre-sharded instead (data/stream.py placement,
+    built from the SAME panel rule table), so this is a documented
+    no-op, not a mid-run AttributeError.
+    """
+    if getattr(dataset, "residency", "hbm") == "stream":
+        return
     from factorvae_tpu.parallel.multihost import global_put
 
     v_s, lv_s, nv_s = panel_shardings(mesh)
     dataset.values = global_put(dataset.values, v_s)
     dataset.last_valid = global_put(dataset.last_valid, lv_s)
     dataset.next_valid = global_put(dataset.next_valid, nv_s)
+
+
+def chunk_placement(mesh: Mesh, stacked: bool = False,
+                    order_spec=None) -> Callable:
+    """Placement function for ChunkStream under a mesh: device_put each
+    prefetched chunk `(order_local, (values, last_valid, next_valid))`
+    with its rule-table sharding, so each host ships only its
+    addressable slice of the mini-panel (multihost.global_put) instead
+    of a full replicated copy per chunk.
+
+    `stacked=True` is the fleet-stream layout: per-seed mini-panel
+    stacks (S, ...) whose leading axis rides the seed ('data') axis and
+    per-seed local orders (S, k, B). `order_spec` overrides the order
+    placement — the fleet's SHARED validation chunks pair a broadcast
+    mini-panel with the stacked eval-order spec
+    (partition.eval_order_partition_spec)."""
+    from factorvae_tpu.parallel.multihost import global_put
+
+    pan = tuple(NamedSharding(mesh, s)
+                for s in panel_partition_specs(stacked=stacked))
+    ord_s = NamedSharding(
+        mesh, order_spec if order_spec is not None
+        else order_partition_spec(mesh, stacked=stacked))
+
+    def place(chunk):
+        order_local, panel = chunk
+        return (
+            global_put(order_local, ord_s),
+            tuple(global_put(a, s) for a, s in zip(panel, pan)),
+        )
+
+    return place

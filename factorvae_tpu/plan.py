@@ -159,6 +159,21 @@ class Plan:
     deployment on once `bench.py --obs` has shown the overhead
     acceptable for that shape. Rows without the block keep resolving
     probes-off — same backward-compatibility rule as `fleet`/`stream`.
+
+    `mesh_data_axis` / `mesh_stock_axis` are the mesh-shape knob
+    (parallel/mesh.py MeshConfig): how a `--mesh` run should factor the
+    visible devices into (data x stock). 0/0 means "no measured mesh
+    row" — the run keeps whatever MeshConfig it was given (the
+    conservative default everywhere; rows without a `"mesh"` block —
+    every pre-PR-6 table — keep resolving exactly as before). Raced
+    values come from `scripts/autotune_plan.py --mesh` rows (a `"mesh"`
+    block: `{"data_axis": D, "stock_axis": S, "days_per_step": B}`).
+    `mesh_days_per_step` is the day batch the mesh winner was RACED at
+    (serial day-dp needs days_per_step % data_axis == 0, so the race
+    scales it; compose.compatible_days_per_step) — apply_plan applies
+    it together with the mesh shape, keeping the persisted row
+    self-consistent: a mesh block whose shape needs dps=2 must not ship
+    next to the train race's dps=1.
     """
 
     flatten_days: bool
@@ -175,6 +190,9 @@ class Plan:
     panel_residency: str = "hbm"
     stream_chunk_days: int = 32
     obs_probes: bool = False
+    mesh_data_axis: int = 0
+    mesh_stock_axis: int = 0
+    mesh_days_per_step: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -411,6 +429,14 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 # (the bitwise-neutral default).
                 obs_probes=bool(
                     (row.get("obs") or {}).get("probes", False)),
+                # Pre-PR-6 rows have no "mesh" block: 0/0 = keep the
+                # run's own MeshConfig (no schema break).
+                mesh_data_axis=int(
+                    (row.get("mesh") or {}).get("data_axis") or 0),
+                mesh_stock_axis=int(
+                    (row.get("mesh") or {}).get("stock_axis") or 0),
+                mesh_days_per_step=int(
+                    (row.get("mesh") or {}).get("days_per_step") or 0),
             )
     default = _TPU_DEFAULT if plat == "tpu" else _CPU_DEFAULT
     src = ("per-backend default: round-2 measured TPU winners (PERF.md)"
@@ -449,7 +475,8 @@ def plan_for_config(config, n_stocks: int, platform: Optional[str] = None,
 def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
                keep_dtype: bool = False, keep_layout: bool = False,
                keep_pad: bool = False, keep_kernels: bool = False,
-               keep_residency: bool = False, keep_obs: bool = False):
+               keep_residency: bool = False, keep_obs: bool = False,
+               keep_mesh: bool = False):
     """Return a Config with the plan's TRAINING knobs applied. `keep_*`
     leaves an explicitly user-set knob alone (CLI flag precedence)."""
     model_kw: dict = {}
@@ -465,9 +492,19 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
         model_kw["use_pallas_gru"] = plan.use_pallas_gru
     model = dataclasses.replace(config.model, **model_kw) \
         if model_kw else config.model
+    apply_mesh = (not keep_mesh and plan.mesh_data_axis > 0
+                  and plan.mesh_stock_axis > 0)
     train_kw: dict = {}
     if not keep_days_per_step:
-        train_kw["days_per_step"] = plan.days_per_step
+        # A mesh row's winner was raced at its OWN (scaled) day batch —
+        # serial day-dp requires days_per_step % data_axis == 0 — so
+        # applying the mesh shape without its days_per_step would ship
+        # a self-incompatible config (compose.validate would reject it
+        # at Trainer construction).
+        train_kw["days_per_step"] = (
+            plan.mesh_days_per_step
+            if apply_mesh and plan.mesh_days_per_step > 0
+            else plan.days_per_step)
     if not keep_obs:
         train_kw["obs_probes"] = plan.obs_probes
     train = dataclasses.replace(config.train, **train_kw) \
@@ -480,7 +517,15 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
         data_kw["stream_chunk_days"] = plan.stream_chunk_days
     data = dataclasses.replace(config.data, **data_kw) \
         if data_kw else config.data
-    return dataclasses.replace(config, model=model, train=train, data=data)
+    mesh_cfg = config.mesh
+    if apply_mesh:
+        # A measured mesh row reshapes the (data x stock) factorization;
+        # 0/0 rows (every pre-PR-6 table) leave MeshConfig alone.
+        mesh_cfg = dataclasses.replace(
+            config.mesh, data_axis=plan.mesh_data_axis,
+            stock_axis=plan.mesh_stock_axis)
+    return dataclasses.replace(config, model=model, train=train, data=data,
+                               mesh=mesh_cfg)
 
 
 def score_model_config(model_cfg, plan: Plan):

@@ -48,6 +48,15 @@ import numpy as np
 from factorvae_tpu.config import Config
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.models.factorvae import day_forward
+from factorvae_tpu.parallel import compose, partition
+from factorvae_tpu.parallel.sharding import (
+    chunk_placement,
+    make_batch_constraint,
+    order_sharding,
+    panel_shardings,
+    replicated,
+    shard_dataset,
+)
 from factorvae_tpu.train.checkpoint import Checkpointer, save_params
 from factorvae_tpu.train.loop import concat_auxes, make_step_fns
 from factorvae_tpu.train.state import (
@@ -92,10 +101,14 @@ def select_best(best_params, best_val, params, selection_loss):
 class FleetTrainer:
     """Train S seeds of one Config simultaneously in one jitted program.
 
-    `config.train.seed` is ignored; `seeds` names the fleet. Meshes are
-    not composed with the seed axis (fleet is the single-chip
-    seed-parallel mode; a ('data','stock') mesh run stays on the serial
-    `Trainer`).
+    `config.train.seed` is ignored; `seeds` names the fleet. Since PR 6
+    the seed axis COMPOSES with a device mesh (`mesh=...`): the stacked
+    (S, ...) TrainState shards its seed lanes over the 'data' mesh axis
+    and the cross-section over 'stock', per the partition-rule tables
+    (parallel/partition.py, docs/sharding.md) — S/dp independent seeds
+    per data slice, zero cross-seed collectives. S=1 on a mesh compiles
+    the serial Trainer's sharded program (the bitwise oracle chain:
+    S=1 x 1x1 mesh == serial Trainer exactly).
     """
 
     def __init__(
@@ -104,6 +117,7 @@ class FleetTrainer:
         dataset: PanelDataset,
         seeds: Sequence[int],
         logger: Optional[MetricsLogger] = None,
+        mesh: Optional[object] = None,
     ):
         if len(seeds) == 0:
             raise ValueError("empty fleet: need at least one seed")
@@ -114,6 +128,18 @@ class FleetTrainer:
         self.seeds = [int(s) for s in seeds]
         self.num_seeds = len(self.seeds)
         self.logger = logger or MetricsLogger(echo=False)
+        self.mesh = mesh
+        compose.validate(
+            mesh=mesh,
+            num_seeds=self.num_seeds,
+            residency=getattr(dataset, "residency", "hbm"),
+            days_per_step=max(1, config.train.days_per_step),
+            stream_chunk_days=config.data.stream_chunk_days,
+        )
+        if mesh is not None:
+            # HBM panels re-place onto the mesh once; stream datasets
+            # round-trip as a no-op (per-chunk placement instead).
+            shard_dataset(mesh, dataset)
 
         self.train_days = dataset.split_days(
             config.data.start_time, config.data.fit_end_time
@@ -156,28 +182,68 @@ class FleetTrainer:
     def _build_step_fns(self) -> None:
         """(Re)build optimizer + jitted fleet-epoch fns for the current
         `self.total_steps` (same cosine-horizon contract as
-        Trainer._build_step_fns)."""
+        Trainer._build_step_fns). Under a mesh, every in_sharding is
+        resolved from the partition-rule tables (parallel/partition.py):
+        stacked states/orders/keys ride the seed ('data') axis, the
+        panel — whole or per-chunk mini — rides 'stock'."""
         cfg = self.cfg
+        mesh = self.mesh
         self.tx = make_optimizer(cfg.train, self.total_steps)
+        # S=1 keeps the serial Trainer's exact step graph — including,
+        # on a mesh, its in-step batch constraint — so the single-seed
+        # fleet stays bitwise the serial Trainer mesh path. The vmapped
+        # S>1 path carries no in-step constraint: input shardings plus
+        # GSPMD propagation place the batched graph.
+        shard_batch = (make_batch_constraint(mesh)
+                       if mesh is not None and self.num_seeds == 1
+                       else None)
         self.fns = make_step_fns(
             self.model, self.model_eval, self.tx, cfg.data.seq_len,
-            obs=cfg.train.obs_probes,
+            shard_batch=shard_batch, obs=cfg.train.obs_probes,
         )
         from factorvae_tpu.obs.watchdog import watch_jit
 
+        self._chunk_placement = None
+        self._eval_chunk_placement = None
+        if mesh is not None:
+            rep = replicated(mesh)
+            pan_s = panel_shardings(mesh)
         if self.num_seeds == 1:
-            # Bitwise-oracle path: identical jits to the serial Trainer.
-            self._train_epoch_jit = watch_jit(jax.jit(
-                self.fns.train_epoch, donate_argnums=(0,)),
-                "fleet_train_epoch")
-            self._eval_epoch_jit = watch_jit(
-                jax.jit(self.fns.eval_epoch), "fleet_eval_epoch")
+            # Bitwise-oracle path: identical jits to the serial Trainer
+            # (mesh or not).
+            if mesh is not None:
+                ord_s = order_sharding(mesh)
+                self._train_epoch_jit = watch_jit(jax.jit(
+                    self.fns.train_epoch, donate_argnums=(0,),
+                    in_shardings=(rep, ord_s, pan_s),
+                    out_shardings=(rep, rep)), "fleet_train_epoch")
+                self._eval_epoch_jit = watch_jit(jax.jit(
+                    self.fns.eval_epoch,
+                    in_shardings=(rep, ord_s, rep, pan_s),
+                    out_shardings=rep), "fleet_eval_epoch")
+            else:
+                self._train_epoch_jit = watch_jit(jax.jit(
+                    self.fns.train_epoch, donate_argnums=(0,)),
+                    "fleet_train_epoch")
+                self._eval_epoch_jit = watch_jit(
+                    jax.jit(self.fns.eval_epoch), "fleet_eval_epoch")
             if self.stream:
+                chunk_kw = {}
+                eval_chunk_kw = {}
+                if mesh is not None:
+                    ord_s = order_sharding(mesh)
+                    chunk_kw = dict(in_shardings=(rep, ord_s, pan_s),
+                                    out_shardings=(rep, rep))
+                    eval_chunk_kw = dict(
+                        in_shardings=(rep, ord_s, rep, pan_s),
+                        out_shardings=rep)
+                    self._chunk_placement = chunk_placement(mesh)
                 self._train_chunk_jit = watch_jit(jax.jit(
-                    self.fns.train_chunk, donate_argnums=(0,)),
+                    self.fns.train_chunk, donate_argnums=(0,), **chunk_kw),
                     "fleet_train_chunk")
                 self._eval_chunk_jit = watch_jit(
-                    jax.jit(self.fns.eval_chunk), "fleet_eval_chunk")
+                    jax.jit(self.fns.eval_chunk, **eval_chunk_kw),
+                    "fleet_eval_chunk")
                 self._finalize_train_jit = watch_jit(
                     jax.jit(self.fns.finalize_train),
                     "fleet_finalize_train")
@@ -186,26 +252,83 @@ class FleetTrainer:
         else:
             # Panel broadcast (in_axes=None): ONE HBM copy serves every
             # seed; state and day orders carry the seed axis.
+            jit_kw = {}
+            eval_kw = {}
+            chunk_kw = {}
+            eval_chunk_kw = {}
+            if mesh is not None:
+                # Partition-rule-resolved shardings for the STACKED
+                # program: seed lanes over 'data', cross-section over
+                # 'stock', day-batches over 'host' when the mesh has
+                # one (partition.day_batch_axes).
+                abstract = jax.eval_shape(self.init_fleet_state)
+                state_sh = partition.named(mesh, partition.
+                                           state_partition_specs(
+                                               abstract, stacked=True))
+                self._state_shardings = state_sh
+                ord_sh = partition.named(
+                    mesh, partition.order_partition_spec(mesh,
+                                                         stacked=True))
+                keys_sh = partition.named(
+                    mesh, partition.eval_keys_partition_spec())
+                val_ord_sh = partition.named(
+                    mesh, partition.eval_order_partition_spec(
+                        mesh, stacked=True))
+                # out_shardings are pinned to the SAME rule-table specs
+                # (a seed-axis prefix for the (S,)-leading metric/aux
+                # trees): without the pin GSPMD may re-shard an output
+                # leaf (e.g. a stacked bias onto ('data','stock')),
+                # which then mismatches the next call's explicit
+                # in_shardings — the state is a carried value, so its
+                # placement must be a fixed point of the epoch jit.
+                seed_pref = partition.named(
+                    mesh, jax.sharding.PartitionSpec(partition.SEED_AXIS))
+                jit_kw = dict(in_shardings=(state_sh, ord_sh, pan_s),
+                              out_shardings=(state_sh, seed_pref))
+                eval_kw = dict(in_shardings=(state_sh.params, val_ord_sh,
+                                             keys_sh, pan_s),
+                               out_shardings=seed_pref)
+                pan_stacked = tuple(
+                    partition.named(mesh, s)
+                    for s in partition.panel_partition_specs(stacked=True))
+                chunk_kw = dict(
+                    in_shardings=(state_sh, ord_sh, pan_stacked),
+                    out_shardings=(state_sh, seed_pref))
+                eval_chunk_kw = dict(
+                    in_shardings=(state_sh.params, val_ord_sh, keys_sh,
+                                  pan_s),
+                    out_shardings=seed_pref)
             self._train_epoch_jit = watch_jit(jax.jit(
                 jax.vmap(self.fns.train_epoch, in_axes=(0, 0, None)),
-                donate_argnums=(0,),
+                donate_argnums=(0,), **jit_kw,
             ), "fleet_train_epoch")
             # params/key are per-seed; the validation order is shared
             # (shuffle=False, seed 0 — identical across seeds).
             self._eval_epoch_jit = watch_jit(jax.jit(
-                jax.vmap(self.fns.eval_epoch, in_axes=(0, None, 0, None))
+                jax.vmap(self.fns.eval_epoch, in_axes=(0, None, 0, None)),
+                **eval_kw,
             ), "fleet_eval_epoch")
             if self.stream:
                 # Train mini-panels are PER-SEED (each seed shuffles its
                 # own day order, so its chunk gathers different slabs);
                 # the shared validation order keeps one broadcast panel.
+                # Under a mesh the stacked mini-panels shard
+                # (seed, stock, ...) and ship per-shard slabs
+                # (chunk_placement(stacked=True)).
+                if mesh is not None:
+                    self._chunk_placement = chunk_placement(mesh,
+                                                            stacked=True)
+                    self._eval_chunk_placement = chunk_placement(
+                        mesh, order_spec=partition.
+                        eval_order_partition_spec(mesh, stacked=True))
                 self._train_chunk_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.train_chunk, in_axes=(0, 0, 0)),
-                    donate_argnums=(0,),
+                    donate_argnums=(0,), **chunk_kw,
                 ), "fleet_train_chunk")
                 self._eval_chunk_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.eval_chunk,
-                             in_axes=(0, None, 0, None))
+                             in_axes=(0, None, 0, None)),
+                    **eval_chunk_kw,
                 ), "fleet_eval_chunk")
                 self._finalize_train_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.finalize_train)),
@@ -286,7 +409,8 @@ class FleetTrainer:
 
     def init_run_state(self) -> TrainState:
         state = self.init_fleet_state()
-        return state if self.num_seeds > 1 else unstack_state(state, 0)
+        state = state if self.num_seeds > 1 else unstack_state(state, 0)
+        return self._place_run_state(state)
 
     def _stacked(self, run_state):
         """Stacked (S, ...) view of a run state, for the per-seed
@@ -333,7 +457,8 @@ class FleetTrainer:
         parts = []
         if self.num_seeds == 1:
             chunks = stream_epoch_batches(
-                self.ds, np.asarray(orders[0]), self.steps_per_chunk)
+                self.ds, np.asarray(orders[0]), self.steps_per_chunk,
+                placement=self._chunk_placement)
             for order_local, panel_chunk in chunks:
                 run_state, aux = self._train_chunk_jit(
                     run_state, order_local, panel_chunk)
@@ -358,7 +483,8 @@ class FleetTrainer:
                           for k in (1, 2, 3))
             return order_local, panel
 
-        chunks = ChunkStream(make_chunk, len(slices))
+        chunks = ChunkStream(make_chunk, len(slices),
+                             placement=self._chunk_placement)
         for order_local, panel_chunk in chunks:
             run_state, aux = self._train_chunk_jit(
                 run_state, order_local, panel_chunk)
@@ -375,7 +501,9 @@ class FleetTrainer:
 
         serial = self.num_seeds == 1
         chunks = stream_epoch_batches(
-            self.ds, np.asarray(val_order), self.steps_per_chunk)
+            self.ds, np.asarray(val_order), self.steps_per_chunk,
+            placement=(self._chunk_placement if serial
+                       else self._eval_chunk_placement))
         key = keys[0] if serial else keys
         parts = []
         for order_local, panel_chunk in chunks:
@@ -440,6 +568,16 @@ class FleetTrainer:
                                 best_val=[float(v) for v in bv])
         run_state = (state if self.num_seeds > 1
                      else unstack_state(state, 0))
+        run_state = self._place_run_state(run_state)
+        if self.mesh is not None and self.num_seeds > 1:
+            # The best-params buffer rides the same seed-axis sharding
+            # as the live params (select_best is a pure elementwise
+            # select — mixed placements would force a gather per epoch).
+            from factorvae_tpu.parallel.multihost import global_put
+
+            best_params = jax.tree.map(
+                lambda x, s: global_put(x, s), best_params,
+                self._state_shardings.params)
         val_order = self._val_order()
         ckpt_every = max(1, cfg.train.checkpoint_every or 0)
         history = []
@@ -560,6 +698,38 @@ class FleetTrainer:
             train=dataclasses.replace(self.cfg.train, seed=int(seed)),
         )
 
+    # ---- mesh placement / gather boundaries --------------------------
+
+    def _place_run_state(self, run_state):
+        """Place the initial (or restored) run state onto the mesh: the
+        serial state replicated, the stacked state per the fleet rule
+        table (seed lanes over 'data'). Without a mesh, a no-op — the
+        jits place uncommitted arrays themselves, exactly as before."""
+        if self.mesh is None:
+            return run_state
+        from factorvae_tpu.parallel.multihost import global_put
+
+        if self.num_seeds == 1:
+            rep = replicated(self.mesh)
+            return jax.tree.map(lambda x: global_put(x, rep), run_state)
+        return jax.tree.map(
+            lambda x, s: global_put(x, s), run_state,
+            self._state_shardings)
+
+    def _gather_host(self, tree, stacked_params: bool = False):
+        """Sharded stacked tree -> host numpy, through the rule table's
+        gather fns (partition.make_shard_and_gather_fns): per-seed
+        checkpoint rows are unstacked from gathered HOST buffers, so the
+        on-disk layout never depends on the mesh shape (a mesh-saved
+        checkpoint restores into a serial Trainer unchanged — pinned in
+        tests/test_train.py)."""
+        if self.mesh is None:
+            return tree
+        specs = (partition.params_partition_specs(tree, stacked=True)
+                 if stacked_params
+                 else partition.state_partition_specs(tree, stacked=True))
+        return partition.gather_tree(self.mesh, specs, tree)
+
     def _save_best(self, best_params, best_val: np.ndarray,
                    only=None) -> None:
         """Per-seed best-val weights under the serial naming scheme —
@@ -571,10 +741,14 @@ class FleetTrainer:
         checkpoint, exactly like the serial Trainer, whose save runs
         only inside the `improved` branch; consumers then fall back to
         final-epoch params."""
-        rows = range(self.num_seeds) if only is None else only
+        rows = [i for i in (range(self.num_seeds) if only is None else only)
+                if np.isfinite(best_val[i])]
+        if not rows:
+            return
+        # Mesh runs: ONE gather of the stacked buffer to host, then
+        # unstack rows — per-seed artifacts never carry mesh layout.
+        best_params = self._gather_host(best_params, stacked_params=True)
         for i in rows:
-            if not np.isfinite(best_val[i]):
-                continue
             cfg_s = self.seed_config(self.seeds[i])
             save_params(
                 cfg_s.train.save_dir, cfg_s.checkpoint_name(),
@@ -679,12 +853,20 @@ class FleetTrainer:
         restore the whole group. Saves are async: a kill mid-way leaves
         members at MOST one complete epoch apart (uncommitted steps are
         invisible to readers), exactly the case the group-resume
-        max-common-step rule rewinds over."""
+        max-common-step rule rewinds over. On a mesh the stacked state
+        is gathered to host ONCE through the rule table's gather fns,
+        then unstacked — serial-format checkpoints regardless of mesh
+        shape."""
+        fleet_state = self._gather_host(fleet_state)
         for i, seed in enumerate(self.seeds):
             cfg_s = self.seed_config(seed)
+            # 0-d ndarrays, not numpy scalars: indexing a gathered host
+            # (S,) leaf yields np.int32-style scalars, which orbax's
+            # sync StandardSave rejects ("Unsupported type").
+            row = jax.tree.map(np.asarray, unstack_state(fleet_state, i))
             self._seed_checkpointer(seed).save(
                 epoch,
-                unstack_state(fleet_state, i),
+                row,
                 {"epoch": epoch, "best_val": float(best_val[i]),
                  "config": cfg_s.to_dict()},
             )

@@ -26,8 +26,10 @@ import numpy as np
 from factorvae_tpu.config import Config
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.models.factorvae import day_forward
-from factorvae_tpu.parallel.mesh import data_parallel_size, make_mesh
+from factorvae_tpu.parallel import compose
+from factorvae_tpu.parallel.mesh import make_mesh
 from factorvae_tpu.parallel.sharding import (
+    chunk_placement,
     make_batch_constraint,
     order_sharding,
     panel_shardings,
@@ -78,23 +80,24 @@ class Trainer:
         self.steps_per_chunk = max(
             1, config.data.stream_chunk_days // self.batch_days)
 
-        # mesh (optional; single device works without one)
+        # mesh (optional; single device works without one). The
+        # composition matrix — mesh x stream included since PR 6 — is
+        # validated in ONE place (parallel/compose.py).
         self.mesh = mesh if mesh is not None else (
             make_mesh(config.mesh) if use_mesh else None
         )
-        if self.stream and self.mesh is not None:
-            raise ValueError(
-                "panel_residency='stream' does not compose with a device "
-                "mesh (the sharded path needs the panel in HBM to shard "
-                "it); use residency='hbm' or drop the mesh")
+        compose.validate(
+            mesh=self.mesh,
+            residency=getattr(dataset, "residency", "hbm"),
+            days_per_step=self.batch_days,
+            stream_chunk_days=config.data.stream_chunk_days,
+        )
         shard_batch = None
         if self.mesh is not None:
-            dp = data_parallel_size(self.mesh)
-            if self.batch_days % dp != 0:
-                raise ValueError(
-                    f"days_per_step={self.batch_days} not divisible by "
-                    f"data-parallel size {dp}"
-                )
+            # HBM residency: re-place the panel onto the mesh once.
+            # Stream residency: a documented no-op — each prefetched
+            # mini-panel chunk is placed per the SAME panel rules by
+            # chunk_placement instead.
             shard_dataset(self.mesh, dataset)
             shard_batch = make_batch_constraint(self.mesh)
 
@@ -168,14 +171,38 @@ class Trainer:
             # Chunked stream-epoch programs: the same step bodies scanned
             # over prefetched batches + the shared metric finalizers
             # (train/loop.py docstrings spell out the bitwise contract).
+            # Under a mesh the chunk jits take the SAME shardings the
+            # whole-epoch jits take — mini-panels share the full panel's
+            # axis layout, so one rule table covers both (and keeps
+            # mesh x stream bitwise mesh x hbm: identical partitioned
+            # step graphs).
+            chunk_kw = {}
+            eval_chunk_kw = {}
+            if self.mesh is not None:
+                rep = replicated(self.mesh)
+                ord_s = order_sharding(self.mesh)
+                pan_s = panel_shardings(self.mesh)
+                # out_shardings pin the carried state (and the returned
+                # eval key) replicated: the state is a fixed point of
+                # the chunk jit, and an unpinned output lets GSPMD
+                # re-shard a leaf that then mismatches the next call's
+                # explicit in_shardings.
+                chunk_kw = dict(in_shardings=(rep, ord_s, pan_s),
+                                out_shardings=(rep, rep))
+                eval_chunk_kw = dict(in_shardings=(rep, ord_s, rep, pan_s),
+                                     out_shardings=rep)
             self._train_chunk_jit = watch_jit(jax.jit(
-                self.fns.train_chunk, donate_argnums=donate), "train_chunk")
+                self.fns.train_chunk, donate_argnums=donate, **chunk_kw),
+                "train_chunk")
             self._eval_chunk_jit = watch_jit(
-                jax.jit(self.fns.eval_chunk), "eval_chunk")
+                jax.jit(self.fns.eval_chunk, **eval_chunk_kw), "eval_chunk")
             self._finalize_train_jit = watch_jit(
                 jax.jit(self.fns.finalize_train), "finalize_train")
             self._finalize_eval_jit = watch_jit(
                 jax.jit(self.fns.finalize_eval), "finalize_eval")
+            self._chunk_placement = (
+                chunk_placement(self.mesh) if self.mesh is not None
+                else None)
 
     def panel_args(self):
         """The HBM panel as explicit jit arguments (loop.py: passing these
@@ -198,6 +225,8 @@ class Trainer:
 
     def _train_epoch(self, state, order):
         if self.stream:
+            if self.mesh is not None:
+                state = self._globalize(state, replicated(self.mesh))
             return self._train_epoch_stream(state, order)
         if self.mesh is not None:
             state = self._globalize(state, replicated(self.mesh))
@@ -207,6 +236,9 @@ class Trainer:
 
     def _eval_epoch(self, params, order, key):
         if self.stream:
+            if self.mesh is not None:
+                params = self._globalize(params, replicated(self.mesh))
+                key = self._globalize(key, replicated(self.mesh))
             return self._eval_epoch_stream(params, order, key)
         if self.mesh is not None:
             params = self._globalize(params, replicated(self.mesh))
@@ -226,7 +258,8 @@ class Trainer:
         from factorvae_tpu.data.stream import stream_epoch_batches
 
         chunks = stream_epoch_batches(
-            self.ds, np.asarray(order), self.steps_per_chunk)
+            self.ds, np.asarray(order), self.steps_per_chunk,
+            placement=self._chunk_placement)
         parts = []
         for order_local, panel_chunk in chunks:
             state, aux = self._train_chunk_jit(state, order_local,
@@ -239,7 +272,8 @@ class Trainer:
         from factorvae_tpu.data.stream import stream_epoch_batches
 
         chunks = stream_epoch_batches(
-            self.ds, np.asarray(order), self.steps_per_chunk)
+            self.ds, np.asarray(order), self.steps_per_chunk,
+            placement=self._chunk_placement)
         parts = []
         for order_local, panel_chunk in chunks:
             key, aux = self._eval_chunk_jit(params, order_local, key,

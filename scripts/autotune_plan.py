@@ -41,6 +41,7 @@ Usage:
     python scripts/autotune_plan.py --all --days 4 --reps 1   # quickest
     python scripts/autotune_plan.py --fleet               # + fleet knob race
     python scripts/autotune_plan.py --stream              # + residency race
+    python scripts/autotune_plan.py --mesh                # + mesh-shape race
         [--out PLAN_TABLE.json] [--dry_run] [--metrics_jsonl RUN.jsonl]
 
 Race progress is emitted as structured events through MetricsLogger
@@ -100,6 +101,13 @@ FLEET_CANDIDATES = [1, 2, 4, 8]
 # host->device transfer, data/stream.py). HBM is always in the raced
 # set, so a persisted row can never regress an in-memory workload.
 STREAM_CHUNK_CANDIDATES = [16, 32, 64]
+# --mesh: mesh-shape race on the winning train knobs — every
+# (data x stock) factorization of the visible devices, with the no-mesh
+# serial path always in the raced set (a persisted "mesh" block can
+# never regress a single-device workload; no block is written when
+# no-mesh wins). Winners persist as the row's `mesh` block
+# (plan_for -> Plan.mesh_data_axis/mesh_stock_axis; rows without the
+# block keep the run's own MeshConfig).
 
 
 def _log(logger, event: str, **fields) -> None:
@@ -273,6 +281,97 @@ def race_stream(name: str, shape: dict, train_knobs: dict,
     }
 
 
+def _time_serial_mesh(shape: dict, train_knobs: dict, dps: int,
+                      days: int, reps: int, mesh=None) -> float:
+    """Seconds per trained day for one (mesh-or-none, days_per_step)
+    operating point on the winning train knobs (compile excluded)."""
+    import jax
+
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, train_knobs["compute_dtype"],
+                     train_knobs["flatten_days"], dps, days)
+    trainer = Trainer(cfg, ds, mesh=mesh, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+    state, m = trainer._train_epoch(state, trainer._epoch_orders(0))  # warmup
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    for e in range(1, 1 + reps):
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(e))
+    jax.block_until_ready(m["loss"])
+    return (time.time() - t0) / (reps * days)
+
+
+def race_mesh(name: str, shape: dict, train_knobs: dict,
+              days: int, reps: int, logger=None) -> dict:
+    """Race mesh shapes (no-mesh + every data x stock factorization of
+    the visible devices, compose.mesh_shape_candidates); return the
+    row's `mesh` block, or {'data_axis': 0, 'stock_axis': 0} when
+    no-mesh wins (no block is persisted then — the conservative
+    default).
+
+    Serial day-dp scales days_per_step per candidate
+    (compose.compatible_days_per_step) — and the NO-MESH side is raced
+    at every scaled dps too, so the winner is a mesh-vs-no-mesh
+    comparison at matched batch semantics, not a larger-batch speedup
+    in disguise. The winner's dps is part of the block
+    (`days_per_step`): a persisted mesh shape must ship with the day
+    batch it was measured at, or the row would be self-incompatible
+    (compose.validate rejects dps=1 on a 2-way 'data' axis)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from factorvae_tpu.parallel.compose import (
+        compatible_days_per_step,
+        mesh_shape_candidates,
+    )
+
+    n_dev = len(jax.devices())
+    base_dps = train_knobs["days_per_step"]
+    mesh_cells = [(dp, sp) for dp, sp in mesh_shape_candidates(n_dev)
+                  if (dp, sp) != (1, 1)]
+    # no-mesh baselines at EVERY dps a mesh cell will run at (base
+    # first): always in the raced set, so a persisted winner can never
+    # regress the single-device path at matched semantics.
+    none_dps = sorted({base_dps} | {
+        compatible_days_per_step(base_dps, dp) for dp, _ in mesh_cells})
+    measured = {}
+    best, best_sec, best_dps = (0, 0), None, base_dps
+    for dps in none_dps:
+        sec = _time_serial_mesh(shape, train_knobs, dps, days, reps)
+        key = "none" if dps == base_dps else f"none_dps{dps}"
+        measured[key] = round(sec, 5)
+        _log(logger, "autotune_mesh_candidate", shape=name, candidate=key,
+             s_per_day=round(sec, 5))
+        if best_sec is None or sec < best_sec:
+            best, best_sec, best_dps = (0, 0), sec, dps
+    for dp, sp in mesh_cells:
+        dps = compatible_days_per_step(base_dps, dp)
+        mesh = Mesh(np.asarray(jax.devices()[:dp * sp]).reshape(dp, sp),
+                    ("data", "stock"))
+        sec = _time_serial_mesh(shape, train_knobs, dps, days, reps,
+                                mesh=mesh)
+        key = f"mesh_{dp}x{sp}_dps{dps}"
+        measured[key] = round(sec, 5)
+        _log(logger, "autotune_mesh_candidate", shape=name, candidate=key,
+             s_per_day=round(sec, 5))
+        if sec < best_sec:
+            best, best_sec, best_dps = (dp, sp), sec, dps
+    label = ("none" if best == (0, 0) else f"{best[0]}x{best[1]}")
+    return {
+        "data_axis": best[0],
+        "stock_axis": best[1],
+        "days_per_step": best_dps,
+        "measured": measured,
+        "source": f"mesh race on {train_knobs['compute_dtype']} "
+                  f"flat={int(train_knobs['flatten_days'])} over {n_dev} "
+                  f"devices (dps-matched no-mesh baselines): best "
+                  f"{label} dps{best_dps} at {best_sec:.4f} s/day",
+    }
+
+
 def race_fleet(name: str, shape: dict, train_knobs: dict,
                days: int, reps: int, logger=None) -> dict:
     """Race `seeds_per_program` over FLEET_CANDIDATES; return the row's
@@ -296,15 +395,68 @@ def race_fleet(name: str, shape: dict, train_knobs: dict,
     }
 
 
+def _existing_measured_row(shape: dict, platform: str):
+    """First persisted FILE row matching this (platform, shape, width)
+    — the row whose winners a --mesh race should extend, not re-race.
+    Builtins are excluded (they live in code and carry no measured
+    dict; a shape only they cover gets a fresh full race)."""
+    from factorvae_tpu import plan as planlib
+
+    shp = planlib.ShapeKey(
+        num_features=shape["features"], seq_len=shape["seq_len"],
+        hidden_size=shape["hidden"], num_factors=shape["factors"],
+        num_portfolios=shape["portfolios"], n_stocks=int(shape["stocks"]))
+    for row in planlib._read_rows(planlib.table_path()):
+        if planlib._match(row, shp, platform):
+            return row
+    return None
+
+
 def race_shape(name: str, shape: dict, days: int, reps: int,
                fleet: bool = False, stream: bool = False,
-               logger=None) -> dict:
+               mesh: bool = False, logger=None) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
-    plan-table row."""
+    plan-table row.
+
+    With ``mesh=True`` and an ALREADY-MEASURED row covering this
+    (platform, shape, width), the train/score knobs (and any
+    fleet/stream blocks) are REUSED from that row and only the mesh
+    race runs: --mesh forces a virtual multi-device rig on CPU hosts,
+    and re-timing the single-program knob races there could silently
+    flip winners that were measured on the real device layout — and
+    would drop the row's existing fleet/stream blocks.
+    """
     from factorvae_tpu.plan import ShapeKey, pad_target_policy, platform_kind
 
     plat = platform_kind()
+    if mesh:
+        prior = _existing_measured_row(shape, plat)
+        if prior is not None:
+            train_knobs = dict(prior["train"])
+            mesh_block = race_mesh(name, shape, train_knobs, days, reps,
+                                   logger=logger)
+            row = {k: v for k, v in prior.items()}
+            row.setdefault("measured", {})
+            if isinstance(row["measured"], dict):
+                row["measured"] = dict(row["measured"],
+                                       mesh=mesh_block.pop("measured"))
+            else:
+                mesh_block.pop("measured")
+            row.pop("mesh", None)
+            # a re-race REPLACES any previous mesh sentence instead of
+            # accreting one per run
+            prior_src = str(prior.get("source", "plan table"))
+            prior_src = prior_src.split("; mesh race")[0]
+            row["source"] = (prior_src +
+                             f"; {mesh_block['source']} "
+                             f"(raced at n={shape['stocks']})")
+            if mesh_block["data_axis"] > 0 and mesh_block["stock_axis"] > 0:
+                row["mesh"] = {
+                    "data_axis": mesh_block["data_axis"],
+                    "stock_axis": mesh_block["stock_axis"],
+                    "days_per_step": mesh_block["days_per_step"]}
+            return row
     measured: dict = {"train": {}, "score": {}}
 
     best_train, best_train_key = None, None
@@ -341,6 +493,10 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
     if stream:
         stream_block = race_stream(name, shape, best_train_key, days,
                                    reps, logger=logger)
+    mesh_block = None
+    if mesh:
+        mesh_block = race_mesh(name, shape, best_train_key, days,
+                               reps, logger=logger)
 
     shp = ShapeKey(
         num_features=shape["features"], seq_len=shape["seq_len"],
@@ -350,6 +506,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         measured["fleet"] = fleet_block.pop("measured")
     if stream_block is not None:
         measured["stream"] = stream_block.pop("measured")
+    if mesh_block is not None:
+        measured["mesh"] = mesh_block.pop("measured")
     row = {
         "platform": plat,
         "shape": {"c": shp.num_features, "t": shp.seq_len,
@@ -373,12 +531,23 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         row["stream"] = {"panel_residency": stream_block["panel_residency"],
                          "chunk_days": stream_block["chunk_days"]}
         row["source"] += f"; {stream_block['source']}"
+    if mesh_block is not None:
+        row["source"] += f"; {mesh_block['source']}"
+        if mesh_block["data_axis"] > 0 and mesh_block["stock_axis"] > 0:
+            # no-mesh winners persist NO block (the conservative
+            # default; plan_for then leaves MeshConfig alone). The
+            # winner's (scaled) days_per_step ships WITH the shape —
+            # a 2-way 'data' axis next to the train race's dps=1 would
+            # be a self-incompatible row (compose.validate).
+            row["mesh"] = {"data_axis": mesh_block["data_axis"],
+                           "stock_axis": mesh_block["stock_axis"],
+                           "days_per_step": mesh_block["days_per_step"]}
     return row
 
 
 def race_widths(name: str, shape: dict, days: int, reps: int,
                 fleet: bool = False, stream: bool = False,
-                logger=None) -> list:
+                mesh: bool = False, logger=None) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
     envelope row — both bounds measured, no extrapolation beyond them
@@ -388,13 +557,16 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
     if not isinstance(widths, (list, tuple)):
         widths = [widths]
     rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
-                       fleet=fleet, stream=stream, logger=logger)
+                       fleet=fleet, stream=stream, mesh=mesh,
+                       logger=logger)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
         p = merged[-1]
-        if (r["train"], r["score"], r.get("fleet"), r.get("stream")) != (
-                p["train"], p["score"], p.get("fleet"), p.get("stream")):
+        if (r["train"], r["score"], r.get("fleet"), r.get("stream"),
+                r.get("mesh")) != (
+                p["train"], p["score"], p.get("fleet"), p.get("stream"),
+                p.get("mesh")):
             merged.append(r)
             continue
         if not any(k.startswith("n=") for k in p["measured"]):
@@ -438,6 +610,21 @@ def main() -> int:
                         "persisted on the row's 'stream' block (plan_for "
                         "-> Plan.panel_residency/stream_chunk_days; rows "
                         "without the block resolve to hbm)")
+    p.add_argument("--mesh", action="store_true",
+                   help="also race the mesh shape (no-mesh + every "
+                        "data x stock factorization of the visible "
+                        "devices, parallel/partition.py) on each "
+                        "shape's winning train knobs; a mesh winner is "
+                        "persisted on the row's 'mesh' block (plan_for "
+                        "-> Plan.mesh_data_axis/mesh_stock_axis; "
+                        "no-mesh winners persist NO block, and rows "
+                        "without one keep the run's own MeshConfig)")
+    p.add_argument("--mesh_devices", type=int, default=0,
+                   help="with --mesh under JAX_PLATFORMS=cpu: force "
+                        "this many virtual host-CPU devices (the test-"
+                        "rig pattern) so the race covers a real grid; "
+                        "default 4. Ignored on accelerators (real "
+                        "devices are raced)")
     p.add_argument("--dry_run", action="store_true",
                    help="race and print the rows without persisting")
     p.add_argument("--metrics_jsonl", default=None,
@@ -459,7 +646,7 @@ def main() -> int:
         # TPU plan_for can never match).
         from factorvae_tpu.utils.testing import force_host_devices
 
-        force_host_devices(1)
+        force_host_devices((args.mesh_devices or 4) if args.mesh else 1)
 
     # Echo to STDERR: stdout is the table-JSON artifact. Constructed
     # after force_host_devices so the run_meta header records the
@@ -472,7 +659,7 @@ def main() -> int:
         rows = [r for n in names
                 for r in race_widths(n, SHAPES[n], args.days, args.reps,
                                      fleet=args.fleet, stream=args.stream,
-                                     logger=lg)]
+                                     mesh=args.mesh, logger=lg)]
         print(json.dumps({"rows": rows}, indent=1))
         if args.dry_run:
             lg.log("autotune_dry_run", rows=len(rows),

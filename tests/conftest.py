@@ -51,7 +51,12 @@ _SLOW_CLASSES = {
 }
 _SLOW_TESTS = {"test_flax_default_init_path"}
 # Fast parser/config tests inside slow files stay quick for iteration.
-_QUICK_CLASSES = {"TestCLIDefaults"}
+# The PR-6 composition classes are quick BY DESIGN: tier-1 must exercise
+# the mesh x fleet x stream oracles on a real multi-device CPU mesh
+# (this rig's 8 virtual devices -> a genuine 2x2), not a 1x1 degenerate;
+# the widest grids stay slow (TestComposedWideGrid).
+_QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
+                  "TestComposeValidate", "TestComposedOracles"}
 
 
 def pytest_collection_modifyitems(config, items):

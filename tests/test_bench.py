@@ -93,6 +93,37 @@ def test_bench_obs_overhead_contract():
     assert rec["plan"]["provenance"] in ("measured", "default")
 
 
+def test_bench_mesh_grid_contract():
+    """BENCH_MESH mode (PR 6): the composed (data, stock, S) grid keeps
+    the one-JSON-line contract, runs every factorization cell on the
+    forced virtual-device rig, reports skipped cells with the
+    compose.validate message (never silently dropped), and `value` is
+    the best composed aggregate in windows/sec*seed."""
+    rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_MESH": "1",
+                "BENCH_MESH_DEVICES": "2", "BENCH_MESH_SEEDS": "1,2"})
+    assert REQUIRED_KEYS <= set(rec)
+    assert rec["metric"].startswith("mesh_train_throughput_")
+    assert rec["unit"] == "windows/sec*seed"
+    assert rec["devices"] == 2
+    assert rec["value"] > 0
+    cells = rec["grid"]
+    ran = [c for c in cells if "aggregate_windows_per_sec" in c]
+    assert ran, cells
+    # every ran cell carries the full coordinate + the serial anchor
+    for c in ran:
+        assert {"data", "stock", "seeds",
+                "windows_per_sec_seed"} <= set(c)
+        assert c["speedup_vs_1x1_serial"] > 0
+    assert rec["best_cell"] in [
+        {k: c[k] for k in ("data", "stock", "seeds")} for c in ran]
+    # skipped cells say WHY in the one compose format
+    for c in cells:
+        if "skipped" in c:
+            assert "invalid parallel composition" in c["skipped"]
+    assert rec["virtual_devices"] is True
+    assert rec["plan"]["provenance"] in ("measured", "default")
+
+
 def test_bench_survives_backend_init_failure():
     # A bogus platform makes every probe attempt fail fast (the round-1
     # failure mode); the bench must fall back to pinned host CPU and emit

@@ -285,3 +285,406 @@ class TestMeshIntegration:
         res_losses = {h["epoch"]: h["train_loss"] for h in resumed["history"]}
         assert set(res_losses) == {1}
         np.testing.assert_allclose(full_losses[1], res_losses[1], rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# PR 6: partition rules + composition (quick-tier: these run in tier-1 on
+# the forced multi-device CPU rig so the 2x2 mesh is real, not degenerate)
+
+
+def _tiny_state():
+    """A real TrainState (dummy params, real optimizer tree) — cheap
+    enough for rule-matching tests, structurally the real thing."""
+    import optax
+
+    from factorvae_tpu.train.state import create_train_state
+
+    params = {"params": {"enc": {"kernel": jnp.zeros((3, 4)),
+                                 "bias": jnp.zeros((4,))}}}
+    return create_train_state(params, optax.adam(1e-3), 0)
+
+
+class TestPartitionRules:
+    def test_first_matching_rule_wins(self):
+        from jax.sharding import PartitionSpec as P
+
+        from factorvae_tpu.parallel.partition import match_partition_rules
+
+        tree = {"a": {"kernel": np.zeros((4, 4))}}
+        specs = match_partition_rules(
+            [(r"a/kernel", P("stock")), (r".*", P("data"))], tree)
+        assert specs["a"]["kernel"] == P("stock")
+        specs2 = match_partition_rules(
+            [(r".*", P("data")), (r"a/kernel", P("stock"))], tree)
+        assert specs2["a"]["kernel"] == P("data")
+
+    def test_unmatched_leaf_is_an_error_naming_the_path(self):
+        from jax.sharding import PartitionSpec as P
+
+        from factorvae_tpu.parallel.partition import match_partition_rules
+
+        tree = {"a": {"kernel": np.zeros((4, 4))},
+                "mystery": np.zeros((2, 2))}
+        with pytest.raises(ValueError, match="mystery"):
+            match_partition_rules([(r"a/", P("data"))], tree)
+
+    def test_scalars_never_partition(self):
+        from jax.sharding import PartitionSpec as P
+
+        from factorvae_tpu.parallel.partition import match_partition_rules
+
+        tree = {"scalar": np.zeros(()), "one": np.zeros((1,)),
+                "wide": np.zeros((4,))}
+        specs = match_partition_rules([(r".*", P("data"))], tree)
+        assert specs["scalar"] == P()
+        assert specs["one"] == P()
+        assert specs["wide"] == P("data")
+
+    def test_state_rules_cover_the_real_state_tree(self):
+        """Every leaf of a real TrainState resolves (no unmatched-leaf
+        error), serial and stacked."""
+        from factorvae_tpu.parallel.partition import state_partition_specs
+
+        st = _tiny_state()
+        serial = state_partition_specs(st, stacked=False)
+        stacked_state = jax.tree.map(lambda x: jnp.stack([x, x]), st)
+        stacked = state_partition_specs(stacked_state, stacked=True)
+        assert len(jax.tree.leaves(serial, is_leaf=lambda x: True)) > 0
+        assert len(jax.tree.leaves(stacked, is_leaf=lambda x: True)) > 0
+
+    def test_stacked_specs_are_serial_specs_plus_seed_axis(self):
+        """ONE rule table: the stacked spec tree differs from the serial
+        one exactly by the leading seed axis (scalar leaves excepted —
+        stacking makes them (S,) vectors that ride the seed axis)."""
+        from jax.sharding import PartitionSpec as P
+
+        from factorvae_tpu.parallel.partition import (
+            SEED_AXIS,
+            state_partition_specs,
+        )
+
+        st = _tiny_state()
+        serial = state_partition_specs(st, stacked=False)
+        stacked = state_partition_specs(
+            jax.tree.map(lambda x: jnp.stack([x, x]), st), stacked=True)
+        flat_serial = jax.tree_util.tree_flatten_with_path(
+            serial, is_leaf=lambda x: isinstance(x, P))[0]
+        flat_stacked = jax.tree_util.tree_flatten_with_path(
+            stacked, is_leaf=lambda x: isinstance(x, P))[0]
+        assert [p for p, _ in flat_serial] == [p for p, _ in flat_stacked]
+        for (_, s_spec), (_, f_spec) in zip(flat_serial, flat_stacked):
+            assert f_spec == P(SEED_AXIS, *s_spec)
+
+    def test_panel_specs_match_rule_table(self):
+        from jax.sharding import PartitionSpec as P
+
+        from factorvae_tpu.parallel.partition import panel_partition_specs
+
+        v, lv, nv = panel_partition_specs()
+        assert v == P("stock", None, None)
+        assert lv == nv == P(None, "stock")
+        sv, slv, snv = panel_partition_specs(stacked=True)
+        assert sv == P("data", "stock", None, None)
+        assert slv == snv == P("data", None, "stock")
+
+    def test_shard_and_gather_roundtrip(self, devices):
+        from factorvae_tpu.parallel.partition import (
+            make_shard_and_gather_fns,
+            match_partition_rules,
+        )
+        from jax.sharding import PartitionSpec as P
+
+        mesh = Mesh(np.asarray(devices[:4]).reshape(2, 2),
+                    ("data", "stock"))
+        tree = {"w": np.arange(16, dtype=np.float32).reshape(4, 4),
+                "b": np.arange(4, dtype=np.float32)}
+        specs = match_partition_rules(
+            [(r"w", P("data", "stock")), (r"b", P("stock"))], tree)
+        shard_fns, gather_fns = make_shard_and_gather_fns(mesh, specs)
+        sharded = jax.tree.map(lambda f, x: f(x), shard_fns, tree)
+        assert len(sharded["w"].sharding.device_set) == 4
+        back = jax.tree.map(lambda f, x: f(x), gather_fns, sharded)
+        np.testing.assert_array_equal(back["w"], tree["w"])
+        np.testing.assert_array_equal(back["b"], tree["b"])
+
+
+class TestComposeValidate:
+    """The ONE composition matrix (parallel/compose.py): every invalid
+    combination fails with the single message format; every valid one
+    passes silently."""
+
+    def _mesh(self, dp, sp, devices):
+        return Mesh(np.asarray(devices[:dp * sp]).reshape(dp, sp),
+                    ("data", "stock"))
+
+    def test_valid_combinations_pass(self, devices):
+        from factorvae_tpu.parallel.compose import validate
+
+        m = self._mesh(2, 2, devices)
+        validate()                                          # bare serial
+        validate(mesh=m, days_per_step=2)                   # mesh serial
+        validate(mesh=m, num_seeds=4)                       # mesh x fleet
+        validate(mesh=m, num_seeds=2, residency="stream")   # full triple
+        validate(residency="stream", stream_chunk_days=8)   # stream alone
+        validate(num_seeds=8)                               # fleet alone
+
+    def test_bad_residency(self):
+        from factorvae_tpu.parallel.compose import (
+            CompositionError,
+            validate,
+        )
+
+        with pytest.raises(CompositionError,
+                           match=r"invalid parallel composition \[stream\]"):
+            validate(residency="disk")
+
+    def test_bad_chunk_days(self):
+        from factorvae_tpu.parallel.compose import (
+            CompositionError,
+            validate,
+        )
+
+        with pytest.raises(CompositionError, match="stream_chunk_days"):
+            validate(residency="stream", stream_chunk_days=0)
+
+    def test_serial_mesh_needs_divisible_days(self, devices):
+        from factorvae_tpu.parallel.compose import (
+            CompositionError,
+            validate,
+        )
+
+        with pytest.raises(CompositionError,
+                           match=r"\[mesh\].*days_per_step=3"):
+            validate(mesh=self._mesh(2, 2, devices), days_per_step=3)
+
+    def test_fleet_mesh_needs_divisible_seeds(self, devices):
+        from factorvae_tpu.parallel.compose import (
+            CompositionError,
+            validate,
+        )
+
+        with pytest.raises(CompositionError,
+                           match=r"\[mesh x fleet\].*3 seeds"):
+            validate(mesh=self._mesh(2, 2, devices), num_seeds=3)
+
+    def test_empty_fleet(self):
+        from factorvae_tpu.parallel.compose import (
+            CompositionError,
+            validate,
+        )
+
+        with pytest.raises(CompositionError, match=r"\[fleet\]"):
+            validate(num_seeds=0)
+
+    def test_composition_error_is_a_value_error(self):
+        from factorvae_tpu.parallel.compose import CompositionError
+
+        assert issubclass(CompositionError, ValueError)
+
+    def test_mesh_shape_candidates(self):
+        """The ONE factorization enumeration bench and autotune share."""
+        from factorvae_tpu.parallel.compose import mesh_shape_candidates
+
+        assert mesh_shape_candidates(1) == [(1, 1)]
+        got = mesh_shape_candidates(4)
+        assert got[0] == (1, 1)
+        assert set(got) == {(1, 1), (4, 1), (2, 2), (1, 4)}
+        assert all(dp * sp in (1, 8) for dp, sp in mesh_shape_candidates(8))
+
+    def test_compatible_days_per_step(self):
+        """The ONE serial day-dp scaling rule."""
+        from factorvae_tpu.parallel.compose import (
+            compatible_days_per_step,
+            validate,
+        )
+
+        assert compatible_days_per_step(1, 1) == 1
+        assert compatible_days_per_step(1, 2) == 2
+        assert compatible_days_per_step(8, 4) == 8
+        assert compatible_days_per_step(3, 2) == 6
+        # and its output always satisfies the validator it exists for
+        m = self._mesh(2, 2, jax.devices())
+        validate(mesh=m, days_per_step=compatible_days_per_step(1, 2))
+
+
+@pytest.fixture(scope="module")
+def compose_panel():
+    from factorvae_tpu.data import synthetic_panel
+
+    return synthetic_panel(num_days=20, num_instruments=6, num_features=8,
+                           missing_prob=0.2, seed=0)
+
+
+def compose_config(save_dir, panel_dates, residency="hbm", **train_kw):
+    defaults = dict(num_epochs=2, lr=1e-3, seed=3, save_dir=str(save_dir),
+                    checkpoint_every=0, days_per_step=2)
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5),
+        data=DataConfig(seq_len=5, start_time=None,
+                        fit_end_time=str(panel_dates[12].date()),
+                        val_start_time=str(panel_dates[13].date()),
+                        val_end_time=str(panel_dates[-1].date()),
+                        panel_residency=residency, stream_chunk_days=4),
+        train=TrainConfig(**defaults),
+    )
+
+
+def _assert_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestComposedOracles:
+    """The PR 6 oracle chain on a REAL forced-CPU mesh (the conftest
+    rig): S=1 on a 1x1 mesh is bitwise the serial Trainer; mesh x
+    stream is bitwise mesh x hbm; the full triple (mesh x fleet x
+    stream) is bitwise mesh x fleet x hbm."""
+
+    def test_fleet_s1_on_1x1_mesh_bitwise_serial_trainer(
+            self, compose_panel, tmp_path, devices):
+        from factorvae_tpu.data import PanelDataset
+        from factorvae_tpu.train import FleetTrainer, Trainer
+        from factorvae_tpu.utils.logging import MetricsLogger
+
+        ds = PanelDataset(compose_panel, seq_len=5)
+        tr = Trainer(compose_config(tmp_path / "t", ds.dates), ds,
+                     logger=MetricsLogger(echo=False))
+        st_t, out_t = tr.fit()
+        mesh11 = Mesh(np.asarray(devices[:1]).reshape(1, 1),
+                      ("data", "stock"))
+        ft = FleetTrainer(compose_config(tmp_path / "f", ds.dates), ds,
+                          seeds=[3], mesh=mesh11,
+                          logger=MetricsLogger(echo=False))
+        st_f, out_f = ft.fit()
+        _assert_bitwise(st_t.params,
+                        jax.tree.map(lambda x: x[0], st_f.params))
+        assert out_t["best_val"] == float(np.asarray(out_f["best_val"])[0])
+
+    @pytest.fixture(scope="class")
+    def mesh_pair_runs(self, compose_panel, tmp_path_factory, devices):
+        """One S=2 fleet on a 2x2 mesh per residency — the triple's A/B."""
+        from factorvae_tpu.data import PanelDataset
+        from factorvae_tpu.train import FleetTrainer
+        from factorvae_tpu.utils.logging import MetricsLogger
+
+        runs = {}
+        for res in ("hbm", "stream"):
+            ds = PanelDataset(compose_panel, seq_len=5, residency=res)
+            mesh = Mesh(np.asarray(devices[:4]).reshape(2, 2),
+                        ("data", "stock"))
+            ft = FleetTrainer(
+                compose_config(tmp_path_factory.mktemp(res), ds.dates,
+                               residency=res, num_epochs=3,
+                               days_per_step=1),
+                ds, seeds=[3, 4], mesh=mesh,
+                logger=MetricsLogger(echo=False))
+            runs[res] = ft.fit()
+        return runs
+
+    def test_triple_bitwise_vs_mesh_fleet_hbm(self, mesh_pair_runs):
+        (st_h, out_h) = mesh_pair_runs["hbm"]
+        (st_s, out_s) = mesh_pair_runs["stream"]
+        _assert_bitwise(st_h.params, st_s.params)
+        _assert_bitwise(out_h["best_params"], out_s["best_params"])
+        np.testing.assert_array_equal(np.asarray(out_h["best_val"]),
+                                      np.asarray(out_s["best_val"]))
+
+    def test_triple_history_bitwise(self, mesh_pair_runs):
+        (_, out_h), (_, out_s) = (mesh_pair_runs["hbm"],
+                                  mesh_pair_runs["stream"])
+        for h, s in zip(out_h["history"], out_s["history"]):
+            assert h["train_loss"] == s["train_loss"]
+            assert h["val_loss"] == s["val_loss"]
+
+    def test_trainer_mesh_stream_bitwise_mesh_hbm(
+            self, compose_panel, tmp_path, devices):
+        from factorvae_tpu.data import PanelDataset
+        from factorvae_tpu.eval.predict import generate_prediction_scores
+        from factorvae_tpu.train import Trainer
+        from factorvae_tpu.utils.logging import MetricsLogger
+
+        states = {}
+        scores = {}
+        for res in ("hbm", "stream"):
+            ds = PanelDataset(compose_panel, seq_len=5, residency=res)
+            mesh = Mesh(np.asarray(devices[:4]).reshape(2, 2),
+                        ("data", "stock"))
+            cfg = compose_config(tmp_path / res, ds.dates, residency=res)
+            tr = Trainer(cfg, ds, mesh=mesh,
+                         logger=MetricsLogger(echo=False))
+            st, _ = tr.fit()
+            states[res] = st
+            # scoring rides the same rule table: stream chunks land
+            # pre-sharded via predict(..., mesh=)
+            scores[res] = generate_prediction_scores(
+                st.params, cfg, ds, stochastic=True, with_labels=True,
+                mesh=mesh)
+        _assert_bitwise(states["hbm"].params, states["stream"].params)
+        assert scores["hbm"].equals(scores["stream"])
+
+
+@pytest.mark.slow
+class TestComposedWideGrid:
+    """The widest composition grid — slow tier (the quick tier keeps the
+    2x2 oracles above): S=4 seed lanes over a 4-way 'data' axis, the
+    hierarchical ('host','data','stock') mesh under a fleet, and the
+    mesh x fleet ~ plain-fleet independence check."""
+
+    def test_fleet_s4_on_4x2_mesh_close_to_plain_fleet(
+            self, compose_panel, tmp_path, devices):
+        from factorvae_tpu.data import PanelDataset
+        from factorvae_tpu.train import FleetTrainer
+        from factorvae_tpu.utils.logging import MetricsLogger
+
+        seeds = [3, 4, 5, 6]
+        ds = PanelDataset(compose_panel, seq_len=5)
+        ft_p = FleetTrainer(compose_config(tmp_path / "p", ds.dates),
+                            ds, seeds=seeds,
+                            logger=MetricsLogger(echo=False))
+        st_p, out_p = ft_p.fit()
+        ds2 = PanelDataset(compose_panel, seq_len=5)
+        mesh = Mesh(np.asarray(devices[:8]).reshape(4, 2),
+                    ("data", "stock"))
+        ft_m = FleetTrainer(compose_config(tmp_path / "m", ds2.dates),
+                            ds2, seeds=seeds, mesh=mesh,
+                            logger=MetricsLogger(echo=False))
+        st_m, out_m = ft_m.fit()
+        for x, y in zip(jax.tree.leaves(st_p.params),
+                        jax.tree.leaves(st_m.params)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=5e-3, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(out_p["best_val"]),
+                                   np.asarray(out_m["best_val"]),
+                                   rtol=5e-3, atol=5e-3)
+
+    def test_fleet_on_hierarchical_mesh(self, compose_panel, tmp_path):
+        """Seed lanes over 'data', day-batches over 'host', stocks over
+        'stock' — the three-axis composition runs and tracks the plain
+        fleet."""
+        from factorvae_tpu.data import PanelDataset
+        from factorvae_tpu.parallel import make_hierarchical_mesh
+        from factorvae_tpu.train import FleetTrainer
+        from factorvae_tpu.utils.logging import MetricsLogger
+
+        ds = PanelDataset(compose_panel, seq_len=5)
+        mesh = make_hierarchical_mesh(MeshConfig(stock_axis=2),
+                                      num_hosts=2)
+        ft = FleetTrainer(compose_config(tmp_path / "h", ds.dates,
+                                         days_per_step=2),
+                          ds, seeds=[3, 4], mesh=mesh,
+                          logger=MetricsLogger(echo=False))
+        st, out = ft.fit()
+        ds2 = PanelDataset(compose_panel, seq_len=5)
+        ft_p = FleetTrainer(compose_config(tmp_path / "p", ds2.dates,
+                                           days_per_step=2),
+                            ds2, seeds=[3, 4],
+                            logger=MetricsLogger(echo=False))
+        st_p, out_p = ft_p.fit()
+        for x, y in zip(jax.tree.leaves(st.params),
+                        jax.tree.leaves(st_p.params)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=5e-3, atol=5e-3)

@@ -372,3 +372,81 @@ class TestStreamKnob:
         p = plan_for(K60, "cpu", table=load_table(str(path)))
         assert p.panel_residency == "stream"
         assert p.stream_chunk_days == 16
+
+
+class TestMeshKnob:
+    """mesh_data_axis / mesh_stock_axis (PR 6's planner knob): raced
+    rows carry a 'mesh' block; pre-PR-6 rows (every existing table)
+    must keep resolving exactly as before — 0/0 = keep the run's own
+    MeshConfig."""
+
+    def test_mesh_row_resolves_axes(self):
+        table = [row(mesh={"data_axis": 4, "stock_axis": 2})]
+        p = plan_for(K60, "cpu", table=table)
+        assert p.provenance == "measured"
+        assert (p.mesh_data_axis, p.mesh_stock_axis) == (4, 2)
+        d = p.describe(K60, platform="cpu")
+        assert (d["mesh_data_axis"], d["mesh_stock_axis"]) == (4, 2)
+
+    def test_pre_pr6_row_keeps_meshconfig_alone(self):
+        p = plan_for(K60, "cpu", table=[row()])
+        assert p.provenance == "measured"
+        assert (p.mesh_data_axis, p.mesh_stock_axis) == (0, 0)
+        cfg = Config()
+        applied = apply_plan(cfg, p)
+        assert applied.mesh == cfg.mesh
+
+    def test_null_mesh_block_tolerated(self):
+        assert plan_for(K60, "cpu",
+                        table=[row(mesh=None)]).mesh_data_axis == 0
+        assert plan_for(K60, "cpu",
+                        table=[row(mesh={})]).mesh_stock_axis == 0
+
+    def test_apply_plan_reshapes_meshconfig(self):
+        p = plan_for(K60, "cpu",
+                     table=[row(mesh={"data_axis": 2, "stock_axis": 2})])
+        cfg = apply_plan(Config(), p)
+        assert (cfg.mesh.data_axis, cfg.mesh.stock_axis) == (2, 2)
+        kept = apply_plan(Config(), p, keep_mesh=True)
+        assert kept.mesh == Config().mesh
+
+    def test_mesh_block_ships_with_its_days_per_step(self):
+        """A mesh winner was raced at a SCALED day batch (serial day-dp
+        needs dps % data_axis == 0): applying the mesh shape must apply
+        that dps too, or the persisted row would be self-incompatible
+        (compose.validate would reject it at Trainer construction)."""
+        p = plan_for(K60, "cpu", table=[row(
+            mesh={"data_axis": 2, "stock_axis": 2, "days_per_step": 2})])
+        assert p.mesh_days_per_step == 2
+        cfg = apply_plan(Config(), p)
+        assert cfg.train.days_per_step == 2
+        assert (cfg.mesh.data_axis, cfg.mesh.stock_axis) == (2, 2)
+        # an explicitly forced dps still wins (the user owns the clash)
+        kept = apply_plan(Config(), p, keep_days_per_step=True)
+        assert kept.train.days_per_step == Config().train.days_per_step
+        # keep_mesh drops the block AND its dps: the train winner's dps
+        # applies as before
+        no_mesh = apply_plan(Config(), p, keep_mesh=True)
+        assert no_mesh.train.days_per_step == p.days_per_step
+
+    def test_mesh_block_without_dps_keeps_train_winner_dps(self):
+        """Back-compat: a hand-written block without days_per_step
+        applies the mesh shape and leaves dps at the train winner."""
+        p = plan_for(K60, "cpu",
+                     table=[row(mesh={"data_axis": 2, "stock_axis": 2})])
+        assert p.mesh_days_per_step == 0
+        cfg = apply_plan(Config(), p)
+        assert cfg.train.days_per_step == p.days_per_step
+
+    def test_mesh_table_file_round_trip(self, tmp_path):
+        """save_rows/load_table round-trips the mesh block, and a
+        pre-PR-6 file (no block) still parses — no migration needed."""
+        path = tmp_path / "table.json"
+        save_rows([row(mesh={"data_axis": 2, "stock_axis": 2})],
+                  path=str(path))
+        p = plan_for(K60, "cpu", table=load_table(str(path)))
+        assert (p.mesh_data_axis, p.mesh_stock_axis) == (2, 2)
+        path2 = tmp_path / "pre.json"
+        save_rows([row()], path=str(path2))
+        p2 = plan_for(K60, "cpu", table=load_table(str(path2)))
+        assert (p2.mesh_data_axis, p2.mesh_stock_axis) == (0, 0)

@@ -226,14 +226,37 @@ class TestSerialStreamOracle:
         assert int(st_s.step) == int(st_h.step) == tr_h.steps_per_epoch
         assert_trees_bitwise(st_h.params, st_s.params)
 
-    def test_stream_rejects_mesh(self, ds_pair, tmp_path):
+    def test_stream_composes_with_mesh(self, ds_pair, tmp_path):
+        """PR 6: stream + mesh is a supported composition, not a
+        rejection — the Trainer builds the sharded chunk jits and a
+        rule-table chunk placement (the bitwise A/B lives in
+        tests/test_parallel.py TestComposedOracles)."""
         _, ds_s = ds_pair
-        from factorvae_tpu.parallel.mesh import make_mesh
+        from jax.sharding import Mesh
 
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stock"))
         cfg = stream_config(tmp_path, "stream", ds_s)
-        with pytest.raises(ValueError, match="stream"):
-            Trainer(cfg, ds_s, mesh=make_mesh(cfg.mesh),
-                    logger=MetricsLogger(echo=False))
+        tr = Trainer(cfg, ds_s, mesh=mesh,
+                     logger=MetricsLogger(echo=False))
+        assert tr.stream and tr.mesh is not None
+        assert tr._chunk_placement is not None
+
+    def test_shard_dataset_roundtrips_stream_residency(self, ds_pair):
+        """shard_dataset on a stream-resident dataset is a documented
+        no-op (the panel is host-pinned numpy by design; per-chunk
+        placement shards instead) — it must NOT raise mid-run, and the
+        host panel must come through untouched."""
+        from factorvae_tpu.parallel.mesh import make_mesh
+        from factorvae_tpu.parallel.sharding import shard_dataset
+
+        _, ds_s = ds_pair
+        before = ds_s.values_np
+        shard_dataset(make_mesh(), ds_s)
+        assert ds_s.values_np is before
+        assert ds_s.residency == "stream"
+        # and the host-side accessors still answer
+        assert ds_s.panel_nbytes == before.nbytes
 
 
 # ---------------------------------------------------------------------------
