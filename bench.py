@@ -43,6 +43,13 @@ speedup over the serial S=1 baseline measured in the same run — plus
 the planner's decision block. The same probe/timeout/CPU-fallback
 robustness contract applies.
 
+Track mode (`python bench.py --track`, composable with every other
+mode): append the emitted headline row to BENCH_HISTORY.jsonl so the
+run extends the longitudinal perf trajectory; `python -m
+factorvae_tpu.obs.ledger` then checks the latest row per metric against
+its trailing same-rig median (obs/ledger.py — regression gate, rig
+refusal, backfill from the checked-in artifacts).
+
 Stream mode (`python bench.py --stream`, or BENCH_STREAM=1 with
 BENCH_STREAM_CHUNK=n): A/B the panel residency — HBM-resident
 whole-epoch scan vs the out-of-core stream path (data/stream.py,
@@ -157,6 +164,14 @@ MESH_SEED_COUNTS = tuple(
     if s.strip())
 MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", 0))
 MESH_RESIDENCY = os.environ.get("BENCH_MESH_RESIDENCY", "hbm")
+# Track mode (`--track` or BENCH_TRACK=1): append the emitted headline
+# row to BENCH_HISTORY.jsonl (obs/ledger.py) so every bench run extends
+# the longitudinal perf trajectory instead of producing a one-off
+# artifact. Only the TOP-LEVEL process appends (the probe/accel/
+# fallback subprocesses have the env stripped): exactly one history row
+# per bench invocation, and failure payloads are never appended (the
+# ledger skips them — a crash has no throughput).
+USE_TRACK = os.environ.get("BENCH_TRACK", "0") == "1"
 
 
 def resolve_plan(platform: str):
@@ -237,9 +252,18 @@ CPU_FALLBACK_SHAPES = {
 
 
 def emit(payload: dict) -> None:
-    """The ONE JSON line the driver parses."""
+    """The ONE JSON line the driver parses. Under --track, the emitted
+    payload also lands in BENCH_HISTORY.jsonl (never from the accel
+    child — its parent re-emits the same payload)."""
     print(json.dumps(payload))
     sys.stdout.flush()
+    if USE_TRACK and not ACCEL_CHILD:
+        try:
+            from factorvae_tpu.obs.ledger import append_row
+
+            append_row(payload)
+        except Exception as e:  # tracking must never kill the one shot
+            print(f"[bench] --track append failed: {e}", file=sys.stderr)
 
 
 def fail_metric() -> str:
@@ -758,6 +782,58 @@ def run_obs_bench() -> dict:
     }
 
 
+def _annotate_cell_program(cell: dict, trainer, mesh, state, s: int,
+                           comm_budget: int = 0) -> None:
+    """Attach the compiled-program bill to one executed mesh cell
+    (ISSUE 7): the `comms` block — collective payload bytes/epoch per
+    mesh axis from a static scan of the compiled epoch program's HLO
+    (obs/comms.py) — plus the program's cost/memory capture and the
+    rule-table shard-balance bytes per device (obs/memory.py). A plan
+    row's `budgets.comm_bytes_per_epoch` envelope is judged here
+    (`comm_over_budget` on the cell) — this is where the comms bill
+    exists. All observation-only (abstract shapes + HLO text; the
+    timed numbers are already recorded) and guarded: a version-skewed
+    jax yields null blocks WITH a note, never a dead cell.
+    Stream-residency cells run the CHUNKED program, which is not
+    captured here — their comms is honestly null, not a guess from the
+    un-run whole-epoch program."""
+    try:
+        from factorvae_tpu.obs import comms as commslib
+        from factorvae_tpu.obs import compile as compilelib
+        from factorvae_tpu.obs.memory import shard_balance_block
+
+        cell["shard_balance"] = shard_balance_block(
+            mesh, state=state, dataset=trainer.ds, stacked=s > 1)
+        if trainer.stream:
+            cell["comms"] = None
+            cell["comms_note"] = ("stream residency runs the chunked "
+                                  "program; per-epoch comms not captured")
+            return
+        orders = trainer._epoch_orders(0)
+        args = (state, orders[0] if s == 1 else orders,
+                trainer.panel_args())
+        cap = compilelib.capture_compile(
+            trainer._train_epoch_jit, compilelib.abstractify(args),
+            want_text=True)
+        text = cap.pop("hlo_text", None)
+        cell["comms"] = commslib.comms_block(
+            text, mesh=mesh, steps_per_epoch=trainer.steps_per_epoch)
+        if text is None:
+            cell["comms_note"] = ("compiled HLO text unavailable on "
+                                  "this jax/backend")
+        elif comm_budget > 0:
+            cell["comm_over_budget"] = (
+                cell["comms"]["bytes_per_epoch"] > comm_budget)
+        cell["compile"] = {k: cap.get(k) for k in
+                           ("compile_s", "flops", "bytes_accessed",
+                            "peak_bytes")}
+    except Exception as e:  # pragma: no cover - defensive
+        cell.setdefault("shard_balance", None)
+        cell.setdefault("comms", None)
+        cell.setdefault("compile", None)
+        cell["comms_note"] = f"program capture failed: {e}"
+
+
 def run_mesh_bench() -> dict:
     """Composed scaling grid (BENCH_MESH): for each (data x stock) mesh
     factorization x S seeds, train a seed-fleet ON the mesh at the
@@ -834,6 +910,10 @@ def run_mesh_bench() -> dict:
             per_seed = EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt
             cell["windows_per_sec_seed"] = round(per_seed, 1)
             cell["aggregate_windows_per_sec"] = round(per_seed * s, 1)
+            _annotate_cell_program(
+                cell, trainer, mesh, state, s,
+                comm_budget=int(plan_block.get(
+                    "budget_comm_bytes_per_epoch") or 0))
             grid.append(cell)
 
     ran = [c for c in grid if "aggregate_windows_per_sec" in c]
@@ -885,16 +965,29 @@ def bench_payload() -> dict:
     """Fleet mode (--fleet / BENCH_FLEET=1), stream-residency A/B
     (--stream / BENCH_STREAM=1), probe-overhead A/B (--obs /
     BENCH_OBS=1), composed mesh grid (--mesh / BENCH_MESH=1), or the
-    single-model headline."""
+    single-model headline. The payload carries the MEASURING process's
+    `run_meta` (git sha + backend env): the forced-CPU fallback and the
+    accel child run under a different platform pin than the driver
+    parent that ultimately emits/tracks the row, and the perf ledger's
+    rig key must describe the environment that produced the number,
+    not the one that relayed it."""
     if USE_FLEET:
-        return run_fleet_bench()
-    if USE_STREAM:
-        return run_stream_bench()
-    if USE_OBS:
-        return run_obs_bench()
-    if USE_MESH:
-        return run_mesh_bench()
-    return run_bench()
+        payload = run_fleet_bench()
+    elif USE_STREAM:
+        payload = run_stream_bench()
+    elif USE_OBS:
+        payload = run_obs_bench()
+    elif USE_MESH:
+        payload = run_mesh_bench()
+    else:
+        payload = run_bench()
+    try:
+        from factorvae_tpu.utils.logging import run_meta
+
+        payload["run_meta"] = run_meta()
+    except Exception:  # provenance is optional, the number is not
+        pass
+    return payload
 
 
 # The most recent REAL-TPU measurement, carried as clearly-labeled
@@ -977,6 +1070,9 @@ def cpu_fallback_payload(error: str) -> dict:
     env = dict(os.environ)
     env["BENCH_FORCE_CPU"] = "1"
     env["JAX_PLATFORMS"] = "cpu"  # the driver env pins an accelerator here
+    # Only the top-level process appends to the ledger: the parent
+    # emits this child's payload itself.
+    env.pop("BENCH_TRACK", None)
     for k, v in CPU_FALLBACK_SHAPES.items():
         env.setdefault(k, v)
     try:
@@ -1013,6 +1109,7 @@ def run_accel_child() -> tuple[bool, str]:
     driver's one shot. Returns (ok, error_detail)."""
     env = dict(os.environ)
     env["BENCH_ACCEL_CHILD"] = "1"
+    env.pop("BENCH_TRACK", None)  # the parent appends the emitted row
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -1036,7 +1133,11 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
-    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH
+    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_TRACK
+    if "--track" in sys.argv:
+        # NOT propagated via env: only this top-level process appends
+        # (emit() guards the accel child; the helpers strip the env).
+        USE_TRACK = True
     if "--fleet" in sys.argv:
         # Propagate into the probe/accel/fallback subprocesses too.
         USE_FLEET = True

@@ -150,11 +150,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "factor-posterior spread — zero extra dispatches; "
                         "overhead measured by bench.py --obs) and emit "
                         "the host timeline (epoch/stream/checkpoint/"
-                        "compile spans) into the metrics stream; "
-                        "--metrics_jsonl defaults to RUN.jsonl when set. "
-                        "Render with python -m factorvae_tpu.obs.report / "
-                        ".timeline. --no-obs pins probes off even when a "
-                        "measured plan row enables them")
+                        "compile spans) plus one `compile` record per jit "
+                        "build — wall time and the guarded cost_analysis/"
+                        "memory_analysis program bill (obs/compile.py) — "
+                        "into the metrics stream; --metrics_jsonl "
+                        "defaults to RUN.jsonl when set. Render with "
+                        "python -m factorvae_tpu.obs.report / .timeline. "
+                        "--no-obs pins probes off even when a measured "
+                        "plan row enables them")
     p.add_argument("--preset", type=str, default=None,
                    help="named config preset (see factorvae_tpu.presets). The "
                         "preset fixes the model architecture; explicitly "

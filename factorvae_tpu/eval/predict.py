@@ -42,6 +42,11 @@ import pandas as pd
 from factorvae_tpu.config import Config, ModelConfig
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.models.factorvae import day_prediction
+# Scoring jits go through the compile watchdog like the trainer jits
+# (obs/watchdog.py): pure passthrough without an installed timeline;
+# with one, every cache miss lands a `compile` record in RUN.jsonl so a
+# scoring pass's program bill is part of the same trajectory.
+from factorvae_tpu.obs.watchdog import watch_jit
 
 
 def _deterministic(model_cfg: ModelConfig, stochastic: Optional[bool]) -> bool:
@@ -97,7 +102,7 @@ def _score_chunk_fn(
             p = dequantize_params(p, compute_dtype)
         return chunk_scores(p, values, last_valid, next_valid, day_idx, key)
 
-    return score_chunk
+    return watch_jit(score_chunk, "score_chunk")
 
 
 @functools.lru_cache(maxsize=32)
@@ -120,7 +125,7 @@ def _score_chunk_fleet_fn(
 
         return jax.vmap(one_seed)(stacked_p)
 
-    return score_chunk_fleet
+    return watch_jit(score_chunk_fleet, "score_chunk_fleet")
 
 
 def _stream_chunks(dataset, days: np.ndarray, chunk: int, placement=None):
@@ -229,7 +234,7 @@ def _score_scan_fleet_fn(
 
         return jax.vmap(one_seed)(stacked_p)
 
-    return score_scan_fleet
+    return watch_jit(score_scan_fleet, "score_scan_fleet")
 
 
 @functools.lru_cache(maxsize=32)
@@ -264,7 +269,7 @@ def _score_scan_fn(
         _, scores = jax.lax.scan(body, 0, (day_idx, keys))
         return scores
 
-    return score_scan
+    return watch_jit(score_scan, "score_scan")
 
 
 def _scan_inputs(days: np.ndarray, chunk: int, base: jax.Array,

@@ -23,8 +23,25 @@ Three pillars (ISSUE 5; docs/observability.md has the long-form story):
   measured envelope — in human or JSON form. `bench.py --obs` measures
   the probes' own overhead so the cost of watching is itself a tracked
   number.
+- **Compiled-program observatory** (ISSUE 7; `obs/compile.py`,
+  `obs/comms.py`, `obs/memory.py`, `obs/ledger.py`): what did XLA
+  actually build? Every watched jit's cache miss emits a `compile`
+  record (wall time + guarded `cost_analysis`/`memory_analysis` bill);
+  the compiled HLO text is statically scanned for collective ops with
+  per-mesh-axis byte attribution (`bench.py --mesh` comms blocks); the
+  rule tables yield a per-device shard-balance bill; and `bench.py
+  --track` appends every headline bench row to `BENCH_HISTORY.jsonl`,
+  which `python -m factorvae_tpu.obs.ledger` checks for regressions
+  against the trailing median — the perf trajectory, not one-off
+  artifacts.
 """
 
+from factorvae_tpu.obs.compile import (
+    capture_compile,
+    guarded_compiled_text,
+    guarded_cost_analysis,
+    guarded_memory_analysis,
+)
 from factorvae_tpu.obs.probes import (
     EVAL_PROBE_KEYS,
     TRAIN_PROBE_KEYS,
@@ -39,9 +56,13 @@ __all__ = [
     "EVAL_PROBE_KEYS",
     "TRAIN_PROBE_KEYS",
     "WatchedJit",
+    "capture_compile",
     "finalize_eval_probes",
     "finalize_train_probes",
     "grad_probes",
+    "guarded_compiled_text",
+    "guarded_cost_analysis",
+    "guarded_memory_analysis",
     "loss_probes",
     "watch_jit",
 ]

@@ -1,0 +1,286 @@
+"""Static collective-communication scan of compiled HLO text.
+
+"One sharding story" (PR 6) is only steerable if each composed
+(data, stock, S) cell reports its communication bill, not just
+windows/sec. This module reads the POST-OPTIMIZATION (post-SPMD-
+partitioning) HLO text of a compiled program — `obs/compile.
+guarded_compiled_text` — and statically accounts its collective ops:
+
+- **Which collectives** XLA inserted (all-reduce, all-gather,
+  reduce-scatter, collective-permute, all-to-all; async `-start` forms
+  counted once, `-done` halves skipped).
+- **Payload bytes per op** from the result shape (dtype size x element
+  count; tuple shapes summed). These are PAYLOAD bytes — what the
+  program hands the collective — not wire bytes (an all-reduce moves
+  ~2(k-1)/k of its payload per device on a ring); payload is the number
+  a budget can be written against without modeling the interconnect.
+- **Mesh-axis attribution**: an op's replica groups (explicit
+  `{{0,1},{2,3}}` and iota `[2,2]<=[4]` / `<=[2,2]T(1,0)` forms both
+  parsed) are matched against the groups each mesh axis would form —
+  a gradient all-reduce rides 'data', the masked-softmax reductions
+  ride 'stock', anything else is 'mixed'.
+- **Loop placement**: collectives reachable from a `while` body (the
+  epoch scan) run once per step; the rest once per program. The
+  summary multiplies accordingly, so `bytes_per_epoch` is
+  steps x per-step payload + once-per-program payload.
+
+Degenerate groups (size <= 1 — the serial anchor, the 1x1 mesh) are
+dropped: no communication happens, so the serial cell's comms block is
+honestly zero. Pure text analysis — nothing here touches the program
+or its numbers.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+__all__ = ["comms_block", "parse_replica_groups", "scan_collectives"]
+
+COLLECTIVE_KINDS = (
+    "all-reduce-scatter",  # not a real HLO op; kept before the prefixes
+    "reduce-scatter",
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+# `%name = <result shape> <kind>[-start](operands...)`. The shape
+# segment is matched lazily up to a WHITESPACE-preceded kind token so
+# TPU tiled-layout annotations — `f32[128,256]{1,0:T(8,128)}`, memory
+# spaces `S(1)` — parse too (a restricted character class silently
+# missed every real-chip collective). Operand REFERENCES to ops named
+# `%all-reduce.N` never match: the kind must be followed directly by
+# `(` (or `-start(`), which only the defining position has.
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*(?P<shape>\S.*?)\s"
+    r"(?P<kind>" + "|".join(re.escape(k) for k in COLLECTIVE_KINDS)
+    + r")(?P<start>-start)?\(")
+
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]\w*)\[(?P<dims>[\d,]*)\]")
+
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(?P<body>[\d{},\s]*)\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(?P<gshape>\d+,\d+)\]<=\[(?P<dims>[\d,]+)\]"
+    r"(?:T\((?P<perm>[\d,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(?P<body>[\d{},\s]*)\}")
+
+# Computation definitions start at column 0 and end with a bare '}'.
+_COMP_DEF_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*(?:\(|\s)")
+_COMP_REF_RE = re.compile(
+    r"(?:to_apply|body|condition|calls)=\{?%?(?P<name>[\w.\-]+)")
+_BODY_REF_RE = re.compile(r"body=%?(?P<name>[\w.\-]+)")
+
+
+def _ints(s: str) -> List[int]:
+    return [int(x) for x in re.findall(r"\d+", s)]
+
+
+def parse_replica_groups(line: str) -> Optional[List[List[int]]]:
+    """Replica groups of one HLO op line, explicit or iota form;
+    collective-permute's source_target_pairs parse as 2-element groups.
+    None when the line carries no group annotation (e.g.
+    `replica_groups={}` = one group of all devices — the caller decides
+    what "all" means)."""
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        import numpy as np
+
+        g, s = _ints(m.group("gshape"))
+        dims = _ints(m.group("dims"))
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group("perm"):
+            ids = np.transpose(ids, _ints(m.group("perm")))
+        return [list(map(int, row)) for row in ids.reshape(g, s)]
+    m = _EXPLICIT_GROUPS_RE.search(line)
+    if m:
+        body = m.group("body").strip()
+        if not body:
+            return None  # replica_groups={}: one group of everything
+        return [_ints(grp) for grp in re.findall(r"\{([\d,\s]*)\}", body)]
+    m = _PAIRS_RE.search(line)
+    if m:
+        return [_ints(grp) for grp in
+                re.findall(r"\{([\d,\s]*)\}", m.group("body"))]
+    return None
+
+
+def _shape_bytes(shape: str, async_start: bool = False) -> int:
+    """Payload bytes of an HLO result-shape string (unknown dtypes
+    counted at 4 bytes — wrong by a small factor beats silently
+    dropped). Plain tuples sum their members; an async `-start` op's
+    tuple ALIASES its input next to its output (`(f32[8,..], f32[32,..])
+    all-gather-start`), so summing would double-count — the LARGEST
+    top-level component (the output) is the payload there."""
+
+    def arrays_bytes(s: str) -> int:
+        total = 0
+        for m in _SHAPE_RE.finditer(s):
+            elems = 1
+            for d in _ints(m.group("dims")):
+                elems *= d
+            total += _DTYPE_BYTES.get(m.group("dtype"), 4) * elems
+        return total
+
+    shape = shape.strip()
+    if not (async_start and shape.startswith("(")):
+        return arrays_bytes(shape)
+    # split the tuple at depth-1 commas into top-level components
+    parts, depth, cur = [], 0, []
+    for ch in shape[1:-1] if shape.endswith(")") else shape[1:]:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return max((arrays_bytes(p) for p in parts), default=0)
+
+
+def _computation_blocks(text: str) -> dict:
+    """name -> list of that computation's lines (HLO text layout:
+    definitions start at column 0, close with a bare '}')."""
+    blocks: dict = {}
+    cur = None
+    for line in text.splitlines():
+        if line and not line[0].isspace() and line.rstrip().endswith("{"):
+            # _COMP_DEF_RE strips the optional ENTRY prefix itself; a
+            # character-set lstrip would mangle un-sigiled names that
+            # happen to start with E/N/T/R/Y.
+            m = _COMP_DEF_RE.match(line.strip())
+            cur = m.group("name") if m else None
+            if cur is not None:
+                blocks[cur] = []
+            continue
+        if line.strip() == "}":
+            cur = None
+            continue
+        if cur is not None:
+            blocks[cur].append(line)
+    return blocks
+
+
+def _loop_computations(blocks: dict) -> set:
+    """Names of computations reachable from any `while` body — their
+    ops execute once per loop step (the epoch scan)."""
+    bodies = set()
+    refs: dict = {}
+    for name, lines in blocks.items():
+        refs[name] = set()
+        for line in lines:
+            for m in _COMP_REF_RE.finditer(line):
+                refs[name].add(m.group("name"))
+            for m in _BODY_REF_RE.finditer(line):
+                bodies.add(m.group("name"))
+    reach = set()
+    frontier = list(bodies)
+    while frontier:
+        n = frontier.pop()
+        if n in reach:
+            continue
+        reach.add(n)
+        frontier.extend(refs.get(n, ()))
+    return reach
+
+
+def _axis_groups(mesh) -> dict:
+    """Mesh axis name -> the set of participant-index groups a
+    collective over ONLY that axis would form. Post-SPMD replica groups
+    index the DEVICE ASSIGNMENT (the mesh's flattened device order),
+    not `Device.id` — on a real TPU slice `mesh_utils` reorders devices
+    for topology, so position != id and an id-based match would
+    misattribute every op to 'mixed' exactly on the rig this scan
+    exists for. Indices are therefore positions in the flattened
+    `mesh.devices` array."""
+    import numpy as np
+
+    ids = np.arange(int(np.prod(mesh.devices.shape))).reshape(
+        mesh.devices.shape)
+    out = {}
+    for i, name in enumerate(mesh.axis_names):
+        moved = np.moveaxis(ids, i, -1).reshape(-1, ids.shape[i])
+        out[str(name)] = frozenset(
+            frozenset(int(x) for x in row) for row in moved)
+    return out
+
+
+def scan_collectives(hlo_text: str, mesh=None) -> List[dict]:
+    """Per-op records for every communicating collective in the text:
+    {kind, bytes, group_size, groups, axis, in_loop}. Degenerate ops
+    (every group a single device) are dropped."""
+    blocks = _computation_blocks(hlo_text)
+    loops = _loop_computations(blocks)
+    axes = _axis_groups(mesh) if mesh is not None else {}
+    n_devices = (int(mesh.devices.size) if mesh is not None else None)
+    ops = []
+    for comp, lines in blocks.items():
+        in_loop = comp in loops
+        for line in lines:
+            m = _OP_RE.match(line)
+            if m is None:
+                continue
+            groups = parse_replica_groups(line)
+            if groups is None:
+                # replica_groups={} / no annotation: one group of all
+                # participating devices.
+                groups = ([list(range(n_devices))]
+                          if n_devices else [[0, 1]])
+            size = max((len(g) for g in groups), default=0)
+            if size <= 1:
+                continue  # no communication (the serial anchor)
+            gset = frozenset(frozenset(g) for g in groups)
+            axis = "mixed"
+            for name, expect in axes.items():
+                if gset == expect:
+                    axis = name
+                    break
+            ops.append({
+                "kind": m.group("kind"),
+                "bytes": _shape_bytes(m.group("shape"),
+                                      async_start=bool(m.group("start"))),
+                "group_size": size,
+                "groups": [sorted(g) for g in groups],
+                "axis": axis,
+                "in_loop": in_loop,
+            })
+    return ops
+
+
+def comms_block(hlo_text: Optional[str], mesh=None,
+                steps_per_epoch: int = 1) -> Optional[dict]:
+    """The per-program comms bill as one JSON-ready block (what every
+    `bench.py --mesh` cell carries). None in on a version-skewed jax
+    (no compiled text) -> None out, never a crash."""
+    if not hlo_text:
+        return None
+    ops = scan_collectives(hlo_text, mesh=mesh)
+    by_kind: dict = {}
+    by_axis: dict = {}
+    per_program = 0
+    per_epoch = 0
+    for op in ops:
+        by_kind[op["kind"]] = by_kind.get(op["kind"], 0) + 1
+        mult = steps_per_epoch if op["in_loop"] else 1
+        per_program += op["bytes"]
+        per_epoch += op["bytes"] * mult
+        by_axis[op["axis"]] = by_axis.get(op["axis"], 0) + op["bytes"] * mult
+    return {
+        "collective_ops": len(ops),
+        "ops_by_kind": by_kind,
+        "payload_bytes_per_program": per_program,
+        "bytes_per_epoch": per_epoch,
+        "bytes_by_axis": by_axis,
+        "steps_per_epoch": steps_per_epoch,
+    }

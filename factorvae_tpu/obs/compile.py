@@ -1,0 +1,213 @@
+"""Compiled-program capture: what did XLA actually build, and at what
+cost?
+
+The run observatory (PR 5) watches *runtime* — probes, spans, flags —
+but nothing about the programs behind them: how long each jit took to
+build, how many FLOPs/bytes it schedules, how much HBM it reserves.
+This module owns that capture (ISSUE 7):
+
+- **Guarded accessors** over the jax AOT surface. `cost_analysis()` /
+  `memory_analysis()` / `as_text()` availability and return shape vary
+  across jax versions and backends (list-of-dict vs dict,
+  `CompiledMemoryStats` vs dict, missing entirely, or returning None).
+  Every accessor here degrades to None — it NEVER raises — so a version
+  skew turns a telemetry field null instead of killing a run. The
+  accessors are the one shared implementation (tests/test_bench.py's
+  FLOPs-model oracle reads through them too).
+
+- **`capture_compile`**: given a jitted callable and the ABSTRACT
+  shapes of one call (`abstractify` snapshots them as
+  `jax.ShapeDtypeStruct`s, never touching buffers — donation only
+  deletes the buffer, the metadata survives), replay `lower()` +
+  `compile()` separately timed and extract the cost/memory analyses.
+  The replay is a SECOND full XLA compile — there is no in-process
+  executable cache across `lower()` calls (only the optional on-disk
+  persistent cache) — which is why the watchdog invokes this once per
+  jit, on the first detected miss. The authoritative wall time of the
+  real build is the watchdog's measured `wall_s` (compile + first
+  execution — jax does not expose that split in the call path); the
+  replay's `lower_s`/`compile_s` give the trace-vs-XLA split of an
+  equivalent build.
+
+`obs/watchdog.WatchedJit` emits one `compile` record per detected
+cache miss into the same RUN.jsonl stream as the metrics, carrying
+these fields; `obs.report` / `obs.timeline` render and budget-check
+them. Everything here is observation-only: abstract shapes in, JSON
+fields out, params and numerics untouched (the bitwise discipline of
+tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "abstractify",
+    "capture_compile",
+    "guarded_compiled_text",
+    "guarded_cost_analysis",
+    "guarded_memory_analysis",
+]
+
+
+def _as_float(v) -> Optional[float]:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f
+
+
+def guarded_cost_analysis(compiled: Any) -> Optional[dict]:
+    """`compiled.cost_analysis()` normalized to ONE flat {str: float}
+    dict, or None where the jax version / backend doesn't support it.
+
+    Handles every observed return shape: a dict, a list of per-module
+    dicts (older jaxlibs — the first entry is the program), None, a
+    missing attribute, or an accessor that raises. Never raises.
+    """
+    fn = getattr(compiled, "cost_analysis", None)
+    if not callable(fn):
+        return None
+    try:
+        ca = fn()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for k, v in ca.items():
+        f = _as_float(v)
+        if f is not None:
+            out[str(k)] = f
+    return out or None
+
+
+# CompiledMemoryStats attribute -> record key. `peak_bytes` is derived:
+# argument + output + temp - alias (donated buffers alias in place) — an
+# estimate of the executable's device-memory high water, not a measured
+# allocator peak (that is obs/memory.watermark territory).
+_MEMORY_FIELDS = {
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "temp_size_in_bytes": "temp_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+}
+
+
+def guarded_memory_analysis(compiled: Any) -> Optional[dict]:
+    """`compiled.memory_analysis()` normalized to
+    {argument_bytes, output_bytes, temp_bytes, alias_bytes,
+    generated_code_bytes, peak_bytes}, or None where unsupported.
+    Accepts the `CompiledMemoryStats` object (attributes) or a dict
+    (some backends); never raises."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if not callable(fn):
+        return None
+    try:
+        ma = fn()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out: dict = {}
+    for attr, key in _MEMORY_FIELDS.items():
+        v = (ma.get(attr) if isinstance(ma, dict)
+             else getattr(ma, attr, None))
+        out[key] = _as_float(v)
+    if all(v is None for v in out.values()):
+        return None
+    known = [out[k] for k in ("argument_bytes", "output_bytes",
+                              "temp_bytes") if out.get(k) is not None]
+    if known:
+        peak = sum(known)
+        if out.get("alias_bytes"):
+            peak -= out["alias_bytes"]
+        out["peak_bytes"] = max(peak, 0.0)
+    else:
+        out["peak_bytes"] = None
+    return out
+
+
+def guarded_compiled_text(compiled: Any) -> Optional[str]:
+    """Post-optimization (post-SPMD-partitioning) HLO text of a compiled
+    executable, or None where unsupported — the input of the
+    obs/comms.py collective scan. Never raises."""
+    fn = getattr(compiled, "as_text", None)
+    if not callable(fn):
+        return None
+    try:
+        text = fn()
+    except Exception:
+        return None
+    return text if isinstance(text, str) else None
+
+
+def abstractify(tree):
+    """Pytree of `jax.ShapeDtypeStruct`s mirroring `tree`'s arrays —
+    shape/dtype metadata only, safe to take BEFORE a donating call and
+    to lower() from AFTER it (a donated buffer must never be re-read;
+    lowering from abstract values reads nothing)."""
+    import jax
+    import numpy as np
+
+    def one(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def capture_compile(fn: Callable, abstract_args: tuple,
+                    abstract_kwargs: Optional[dict] = None,
+                    want_text: bool = False) -> dict:
+    """Replay lower+compile on abstract shapes and extract the program
+    bill. Returns a flat dict of JSON-ready fields — every one None
+    where the API is missing — plus, with `want_text=True`, the
+    compiled HLO text under `"hlo_text"` (for the comms scan; never
+    emitted into metric streams — it is megabytes).
+
+        lower_s / compile_s   cache-warm lowering/compile wall split
+        flops                 cost_analysis "flops"
+        bytes_accessed        cost_analysis "bytes accessed"
+        argument_bytes / output_bytes / temp_bytes / peak_bytes
+                              memory_analysis (peak derived; see above)
+
+    Guarded end to end: any failure (no .lower, tracing error on a
+    wrapper, backend refusal) yields the all-null record, never an
+    exception into the training loop."""
+    import time
+
+    rec: dict = {"lower_s": None, "compile_s": None, "flops": None,
+                 "bytes_accessed": None, "argument_bytes": None,
+                 "output_bytes": None, "temp_bytes": None,
+                 "peak_bytes": None}
+    lower = getattr(fn, "lower", None)
+    if not callable(lower):
+        return rec
+    try:
+        t0 = time.perf_counter()
+        lowered = lower(*abstract_args, **(abstract_kwargs or {}))
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+    except Exception:
+        return rec
+    rec["lower_s"] = round(t1 - t0, 6)
+    rec["compile_s"] = round(t2 - t1, 6)
+    ca = guarded_cost_analysis(compiled)
+    if ca:
+        rec["flops"] = ca.get("flops")
+        rec["bytes_accessed"] = ca.get("bytes accessed")
+    ma = guarded_memory_analysis(compiled)
+    if ma:
+        for k in ("argument_bytes", "output_bytes", "temp_bytes",
+                  "peak_bytes"):
+            rec[k] = ma.get(k)
+    if want_text:
+        rec["hlo_text"] = guarded_compiled_text(compiled)
+    return rec

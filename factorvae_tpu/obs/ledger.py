@@ -1,0 +1,359 @@
+"""Perf-regression ledger: bench artifacts as a tracked trajectory.
+
+Seven rounds of bench runs produced one-off `BENCH_*.json` artifacts; a
+throughput regression today is invisible unless someone rereads
+PERF.md. This module turns every headline bench row into a LINE of
+`BENCH_HISTORY.jsonl` (`bench.py --track` appends after each emit) and
+checks the latest row per metric key against the trailing median of its
+own history:
+
+    python -m factorvae_tpu.obs.ledger                  # check, exit 1 on regression
+    python -m factorvae_tpu.obs.ledger --backfill       # seed history from BENCH_*.json
+    python -m factorvae_tpu.obs.ledger --json           # machine-readable report
+
+Row schema (one JSON object per line):
+
+    {"ts", "metric", "value", "unit", "platform", "vs_baseline",
+     "plan": <the bench plan block>, "run_meta": {git_sha, env, ...}}
+
+**Rig discipline**: every fresh row carries `run_meta.env` — the
+backend environment (`JAX_PLATFORMS`, the virtual-device count, sorted
+`XLA_FLAGS`; utils/logging.backend_env) plus platform/device_count —
+and two rows are comparable ONLY when their rig keys match exactly.
+A laptop run can never flag a chip series (or vice versa) as a
+regression; rows from other rigs are reported as skipped, not
+compared. Backfilled rows (pre-ledger artifacts recorded no
+environment) get a platform-only rig of their own.
+
+Metrics are higher-is-better (every bench series is windows/sec
+flavored; the `fail_unit` discipline keeps units stable per metric) —
+a regression is `latest < (1 - threshold) x trailing median`. The
+default threshold (0.4) sits above this sandbox's documented ±30%
+run-to-run CPU variance; tune per rig with `--threshold`.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from statistics import median
+from typing import List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+HISTORY_ENV = "FACTORVAE_BENCH_HISTORY"
+DEFAULT_HISTORY_PATH = os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl")
+
+DEFAULT_THRESHOLD = 0.4
+DEFAULT_WINDOW = 5
+
+
+def history_path(path: Optional[str] = None) -> str:
+    return path or os.environ.get(HISTORY_ENV) or DEFAULT_HISTORY_PATH
+
+
+def rig_key(row: dict) -> str:
+    """Canonical comparability key of one ledger row: platform +
+    device_count + the backend env (sorted-JSON so dict order never
+    splits a rig). Backfilled rows (no recorded env) key on platform
+    alone — their own rig, never compared against instrumented rows."""
+    meta = row.get("run_meta") or {}
+    key = {
+        "platform": row.get("platform"),
+        "device_count": meta.get("device_count"),
+        "env": meta.get("env"),
+        # Pre-ledger artifacts recorded no environment AND spanned
+        # different sandboxes round to round (PERF.md documents ±30%
+        # and a 2x CPU difference across rounds): each backfilled
+        # artifact is its own rig — historical context on the
+        # trajectory, never a regression baseline.
+        "backfill_source": meta.get("backfill_source"),
+    }
+    return json.dumps(key, sort_keys=True)
+
+
+def make_row(payload: dict, run_meta: Optional[dict] = None) -> dict:
+    from factorvae_tpu.utils import logging as loglib
+
+    if run_meta is None:
+        # A payload-embedded run_meta is the MEASURING process's rig
+        # (bench.py's subprocess-measured payloads carry one: the
+        # forced-CPU fallback and the accel child run under different
+        # platform pins than the driver parent appending this row).
+        # Only a payload without one falls back to this process's env.
+        run_meta = payload.get("run_meta") or loglib.run_meta()
+    return {
+        "ts": round(time.time(), 3),
+        "metric": payload.get("metric"),
+        "value": payload.get("value"),
+        "unit": payload.get("unit"),
+        "platform": payload.get("platform"),
+        "vs_baseline": payload.get("vs_baseline"),
+        "plan": payload.get("plan"),
+        "run_meta": run_meta,
+    }
+
+
+def _trackable(payload: dict) -> Optional[Tuple[str, float]]:
+    """(metric, value) when a payload belongs in the history, else
+    None. ONE definition of the rule for --track and --backfill alike:
+    failure payloads (`*_failed` metrics, non-positive or non-numeric
+    values) carry no throughput and would poison the median the next
+    real run is judged against."""
+    metric = str(payload.get("metric") or "")
+    try:
+        value = float(payload.get("value"))
+    except (TypeError, ValueError):
+        return None
+    if not metric or metric.endswith("_failed") or value <= 0:
+        return None
+    return metric, value
+
+
+def append_row(payload: dict, path: Optional[str] = None,
+               run_meta: Optional[dict] = None) -> Optional[str]:
+    """Append one bench payload as a history row; untrackable payloads
+    (see `_trackable`) are skipped. Returns the path written, or None
+    when the row was skipped."""
+    if _trackable(payload) is None:
+        return None
+    p = history_path(path)
+    with open(p, "a") as fh:
+        fh.write(json.dumps(make_row(payload, run_meta=run_meta)) + "\n")
+    return p
+
+
+def load_history(path: Optional[str] = None) -> List[dict]:
+    """Rows in file order; unparseable lines are skipped (the ledger is
+    append-only and a kill mid-append may tear the last line)."""
+    rows = []
+    try:
+        fh = open(history_path(path))
+    except OSError:
+        return rows
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("metric") is not None:
+                rows.append(rec)
+    return rows
+
+
+def check(path: Optional[str] = None, threshold: float = DEFAULT_THRESHOLD,
+          window: int = DEFAULT_WINDOW) -> Tuple[bool, dict]:
+    """(ok, report). For each metric key, the LATEST row is compared
+    against the trailing median of up to `window` PRIOR same-rig rows;
+    `ok` is False when any metric regressed past the threshold. Rows
+    from other rigs are counted as skipped per metric — refused, not
+    compared."""
+    rows = load_history(path)
+    by_metric: dict = {}
+    for r in rows:
+        by_metric.setdefault(r["metric"], []).append(r)
+    # Backfilled rows are HISTORY by definition, wherever they sit in
+    # the file: a `--backfill` run after fresh --track rows exist must
+    # not demote the latest tracked row to mid-series (which would
+    # silently turn the gate into no_comparable_history for that
+    # metric). Stable-sort backfill rows ahead of instrumented ones.
+    for metric, series in by_metric.items():
+        by_metric[metric] = sorted(
+            series, key=lambda r: 0 if (r.get("run_meta") or {}).get(
+                "backfill_source") else 1)
+    report: dict = {"path": history_path(path), "rows": len(rows),
+                    "threshold": threshold, "window": window, "metrics": []}
+    ok = True
+    for metric in sorted(by_metric):
+        series = by_metric[metric]
+        latest = series[-1]
+        prior = series[:-1]
+        rig = rig_key(latest)
+        same = [r for r in prior if rig_key(r) == rig]
+        entry: dict = {
+            "metric": metric,
+            "unit": latest.get("unit"),
+            "latest": latest.get("value"),
+            "history": len(prior),
+            "other_rig_skipped": len(prior) - len(same),
+        }
+        vals = []
+        for r in same[-window:]:
+            try:
+                v = float(r.get("value"))
+            except (TypeError, ValueError):
+                continue
+            if v > 0:
+                vals.append(v)
+        if not vals:
+            entry["status"] = "no_comparable_history"
+        else:
+            med = median(vals)
+            try:
+                ratio = float(latest.get("value")) / med
+            except (TypeError, ValueError, ZeroDivisionError):
+                ratio = None
+            entry["trailing_median"] = round(med, 3)
+            entry["ratio_vs_median"] = (round(ratio, 4)
+                                        if ratio is not None else None)
+            if ratio is None or ratio < 1.0 - threshold:
+                entry["status"] = "REGRESSION"
+                ok = False
+            elif ratio > 1.0 + threshold:
+                entry["status"] = "improvement"
+            else:
+                entry["status"] = "ok"
+        report["metrics"].append(entry)
+    report["ok"] = ok
+    return ok, report
+
+
+def _payloads_from_artifact(fname: str) -> List[dict]:
+    """Bench payloads in one checked-in artifact: a direct payload dict
+    ({metric, value, unit}), or a driver wrapper whose `tail` holds the
+    bench's emitted JSON line(s). Anything else yields nothing."""
+    try:
+        with open(fname) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(data, dict):
+        return []
+    if {"metric", "value", "unit"} <= set(data):
+        return [data]
+    out = []
+    for line in str(data.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and {"metric", "value", "unit"} <= set(rec):
+            out.append(rec)
+    return out
+
+
+def backfill(artifacts: Optional[List[str]] = None,
+             path: Optional[str] = None,
+             repo_root: str = _REPO_ROOT) -> dict:
+    """Seed (or extend) the history from checked-in bench artifacts, so
+    the trajectory starts at PR 1 instead of empty. Default set: every
+    `BENCH_*.json` at the repo root plus `SCALE_MESH_COMPOSED.json`
+    (the composed-grid series), in name order — the round numbering
+    (`_r01`..) makes that chronological. Rows already present for a
+    (metric, value, source) are not duplicated, so backfill is
+    idempotent. Backfilled rows carry `run_meta.backfill_source` and no
+    env block (pre-ledger artifacts recorded none): each artifact forms
+    its OWN rig, so the pre-ledger rounds — measured on different
+    sandboxes — chart the trajectory without ever serving as a
+    regression baseline (rig_key)."""
+    if artifacts is None:
+        artifacts = sorted(
+            f for f in glob.glob(os.path.join(repo_root, "BENCH_*.json"))
+            if not f.endswith("BENCH_HISTORY.jsonl"))
+        composed = os.path.join(repo_root, "SCALE_MESH_COMPOSED.json")
+        if os.path.exists(composed):
+            artifacts.append(composed)
+    existing = {
+        (r.get("metric"), r.get("value"),
+         (r.get("run_meta") or {}).get("backfill_source"))
+        for r in load_history(path)}
+    p = history_path(path)
+    added, skipped = [], []
+    with open(p, "a") as fh:
+        for fname in artifacts:
+            payloads = _payloads_from_artifact(fname)
+            src = os.path.basename(fname)
+            if not payloads:
+                skipped.append(src)
+                continue
+            for payload in payloads:
+                tv = _trackable(payload)
+                if tv is None:
+                    continue
+                metric, value = tv
+                if (payload.get("metric"), payload.get("value"),
+                        src) in existing:
+                    continue
+                row = make_row(payload,
+                               run_meta={"backfill_source": src})
+                row["ts"] = None  # measurement time unknown; order known
+                fh.write(json.dumps(row) + "\n")
+                added.append({"metric": metric, "value": value,
+                              "source": src})
+    return {"path": p, "added": added, "skipped_artifacts": skipped}
+
+
+def format_report(report: dict) -> str:
+    lines = [f"perf ledger: {report['path']} ({report['rows']} rows, "
+             f"threshold {report['threshold']:.0%}, "
+             f"window {report['window']})"]
+    if not report["metrics"]:
+        lines.append("  (empty history — run `bench.py --track` or "
+                     "`python -m factorvae_tpu.obs.ledger --backfill`)")
+    for e in report["metrics"]:
+        med = e.get("trailing_median")
+        ratio = e.get("ratio_vs_median")
+        detail = (f"latest {e['latest']:g} vs median {med:g} "
+                  f"(x{ratio:g})" if med is not None
+                  else f"latest {e['latest']:g} — {e['status']}")
+        mark = {"REGRESSION": "!!", "improvement": "++"}.get(
+            e["status"], "  ")
+        skip = (f"  [{e['other_rig_skipped']} other-rig rows skipped]"
+                if e.get("other_rig_skipped") else "")
+        lines.append(f"{mark} {e['metric']}: {detail}{skip}")
+    lines.append("OK" if report["ok"] else
+                 "REGRESSION detected (exit 1)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m factorvae_tpu.obs.ledger",
+        description="perf-regression check over BENCH_HISTORY.jsonl "
+                    "(latest row vs trailing same-rig median per metric)")
+    ap.add_argument("history", nargs="?", default=None,
+                    help=f"history path (default: ${HISTORY_ENV} or "
+                         f"{os.path.basename(DEFAULT_HISTORY_PATH)} at "
+                         "the repo root)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="regression when latest < (1-threshold) x median")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing same-rig rows in the median")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--backfill", action="store_true",
+                    help="seed the history from the checked-in "
+                         "BENCH_*.json artifacts (idempotent), then check")
+    args = ap.parse_args(argv)
+    if args.backfill:
+        res = backfill(path=args.history)
+        if not args.json:
+            print(f"backfilled {len(res['added'])} rows -> {res['path']}"
+                  + (f" (no payload in: "
+                     f"{', '.join(res['skipped_artifacts'])})"
+                     if res["skipped_artifacts"] else ""))
+    elif not os.path.exists(history_path(args.history)):
+        print(f"error: no bench history at {history_path(args.history)} "
+              "(seed it with --backfill or `python bench.py --track`)")
+        return 2
+    ok, report = check(path=args.history, threshold=args.threshold,
+                       window=args.window)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_report(report))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

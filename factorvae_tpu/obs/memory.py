@@ -1,0 +1,140 @@
+"""Device-memory accounting: shard balance from the rule tables +
+live-buffer watermarks where the backend exposes them.
+
+Two complementary views (ISSUE 7):
+
+- **Static shard balance** (`shard_balance_block`): given the PR-6
+  partition rules, how many REAL bytes of the TrainState and the panel
+  does each device hold, and how uneven is the split? GSPMD pads an
+  uneven dimension (N=800 over a 3-way 'stock' axis -> shards of
+  267/267/266 real rows plus dead padding), so `imbalance_frac` =
+  (max - min) / max over per-device bytes is the number that catches a
+  lopsided axis before it becomes a straggler. Computed from abstract
+  shapes (`jax.eval_shape` structs work) — no device traffic.
+
+- **Live watermarks** (`device_memory_stats` / `watermark_event`):
+  `Device.memory_stats()` where the backend implements it (TPU/GPU;
+  host CPU returns nothing). `watermark_event` emits one `memory` mark
+  per call onto the installed timeline with per-device
+  `bytes_in_use` / `peak_bytes_in_use` — the measured complement of
+  the per-program `memory_analysis` estimate in the `compile` records.
+  No timeline, or no stats: a no-op. Observation-only throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from factorvae_tpu.utils.logging import current_timeline
+
+__all__ = [
+    "device_memory_stats",
+    "shard_balance",
+    "shard_balance_block",
+    "watermark_event",
+]
+
+
+def shard_balance(mesh, specs, tree) -> dict:
+    """Per-device byte bill of `tree` placed per `specs`:
+    {total_bytes, min/max/mean bytes_per_device, imbalance_frac}."""
+    import numpy as np
+
+    from factorvae_tpu.parallel.partition import device_bytes
+
+    per = device_bytes(mesh, specs, tree).reshape(-1)
+    hi = int(per.max()) if per.size else 0
+    lo = int(per.min()) if per.size else 0
+    return {
+        "total_bytes": int(per.sum()),
+        "bytes_per_device_max": hi,
+        "bytes_per_device_min": lo,
+        "bytes_per_device_mean": float(np.mean(per)) if per.size else 0.0,
+        "imbalance_frac": round((hi - lo) / hi, 4) if hi else 0.0,
+    }
+
+
+def _panel_tree(dataset) -> Optional[dict]:
+    """Abstract {values, last_valid, next_valid} of a PanelDataset,
+    residency-agnostic (stream datasets hold host numpy; HBM datasets
+    device arrays — only shapes/dtypes are read either way)."""
+    names = (("values", "last_valid", "next_valid")
+             if getattr(dataset, "residency", "hbm") == "hbm"
+             else ("values_np", "last_valid_np", "next_valid_np"))
+    try:
+        arrs = [getattr(dataset, n) for n in names]
+    except AttributeError:
+        return None
+    return dict(zip(("values", "last_valid", "next_valid"), arrs))
+
+
+def shard_balance_block(mesh, state=None, dataset=None,
+                        stacked: bool = False) -> dict:
+    """The one JSON-ready block Trainer/FleetTrainer log (and bench
+    --mesh cells carry): a `state` bill from TRAIN_STATE_RULES /
+    FLEET_STATE_RULES and a `panel` bill from PANEL_RULES, per device.
+    A stream-resident dataset's panel never lives on device, so its
+    panel bill reports the PER-CHUNK mini-panel footprint semantics via
+    `residency` instead of pretending the whole panel is resident."""
+    from factorvae_tpu.parallel import partition
+
+    block: dict = {
+        "mesh": {str(n): int(s) for n, s in
+                 zip(mesh.axis_names, mesh.devices.shape)},
+        "devices": int(mesh.devices.size),
+    }
+    if state is not None:
+        specs = partition.state_partition_specs(state, stacked=stacked)
+        block["state"] = shard_balance(mesh, specs, state)
+    if dataset is not None:
+        tree = _panel_tree(dataset)
+        if tree is not None:
+            # the ONE panel rule resolution (parallel/partition.py) —
+            # the bill must account exactly what the placement places
+            specs = dict(zip(("values", "last_valid", "next_valid"),
+                             partition.panel_partition_specs()))
+            block["panel"] = shard_balance(mesh, specs, tree)
+            block["panel"]["residency"] = getattr(dataset, "residency",
+                                                  "hbm")
+    return block
+
+
+def device_memory_stats() -> Optional[list]:
+    """Per-device allocator stats where the backend exposes them
+    ([{device, bytes_in_use, peak_bytes_in_use, bytes_limit}, ...]), or
+    None (host CPU, older jaxlibs). Never raises."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            fn = getattr(d, "memory_stats", None)
+            stats = fn() if callable(fn) else None
+            if not stats:
+                continue
+            out.append({
+                "device": f"{d.platform}:{d.id}",
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            })
+        return out or None
+    except Exception:
+        return None
+
+
+def watermark_event(**fields) -> bool:
+    """Emit a `memory` mark with the live per-device watermarks onto the
+    installed timeline. No timeline or no backend stats: no-op (False).
+    The epoch loops call this once per epoch — host-side observation
+    only, zero effect on the compiled programs."""
+    tl = current_timeline()
+    if tl is None:
+        return False
+    stats = device_memory_stats()
+    if stats is None:
+        return False
+    peak = max((s.get("peak_bytes_in_use") or 0) for s in stats)
+    tl.event("memory", cat="memory", resource="memory", devices=stats,
+             peak_bytes_in_use=peak, **fields)
+    return True

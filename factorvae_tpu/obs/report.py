@@ -5,8 +5,9 @@
         [--diverge-epochs 3]
 
 Aggregates the metric stream (epoch / fleet_epoch records, the health
-probes when `obs` was on, the `plan` decision block, scores/best
-events) into one table and raises health flags:
+probes when `obs` was on, the `plan` decision block, the compiled-
+program `compile` records, scores/best events) into one table and
+raises health flags:
 
 - `nonfinite`     — NaN/inf train or val loss, non-finite gradient
                     elements, or non-finite per-day losses (the probe
@@ -24,8 +25,19 @@ events) into one table and raises health flags:
                     stream) below slow-frac x the plan row's measured
                     rate — a throughput regression against the envelope
                     the planner promised.
+- `compile_storm` — a retrace storm, now with its COST dimension: the
+                    per-miss `compile` records say what the storm burned
+                    in compile wall seconds (ISSUE 7).
+- `hbm_over_budget` / `compile_over_budget`
+                  — a `compile` record whose program peak-HBM estimate
+                    or compile wall exceeds the governing plan row's
+                    optional `budgets` envelope (plan.py
+                    budget_peak_hbm_bytes / budget_compile_s; rows
+                    without the block promise nothing and flag nothing).
 
-Human output by default; `--json` for the machine-readable form.
+Human output by default; `--json` for the machine-readable form. An
+empty, missing, or non-JSONL stream exits with a one-line error; a
+trailing torn line (async-kill artifact) is a warning, never fatal.
 """
 
 from __future__ import annotations
@@ -37,7 +49,18 @@ from statistics import median
 from typing import List, Optional
 
 from factorvae_tpu.obs.probes import TRAIN_PROBE_KEYS
-from factorvae_tpu.obs.timeline import load_run
+from factorvae_tpu.obs.timeline import (
+    RunStreamError,
+    compile_summary,
+    load_run,
+    open_run,
+)
+
+# load_run/open_run are re-exported CLI plumbing here; keeping the names
+# referenced preserves the public import path tests rely on.
+__all__ = ["build_report", "format_report", "health_flags", "load_run",
+           "main", "open_run", "plan_measured_days_per_sec",
+           "program_flags"]
 
 # autotune_plan rows carry "train 0.1234 s/day" in their source string;
 # a matched value is the measured envelope the planner promised.
@@ -254,9 +277,83 @@ def health_flags(epochs: List[dict], events: List[dict],
     return flags
 
 
+def _budgets_for(rec: dict, events: List[dict]) -> dict:
+    """The observability budgets governing one `compile` record: the
+    last `plan` record the stream logged before it (same record-order
+    rule as `_plan_rate_for`). {} when no plan with budgets precedes it
+    — budgets are opt-in, and a plan from a LATER run must not judge an
+    earlier program."""
+    plans = [r for r in events if r.get("event") == "plan"]
+    line = rec.get("_line")
+    if line is not None and all(p.get("_line") is not None for p in plans):
+        plans = [p for p in plans if p["_line"] < line]
+    if not plans:
+        return {}
+    p = plans[-1]
+    return {
+        "compile_s": float(p.get("budget_compile_s") or 0.0),
+        "peak_hbm_bytes": int(p.get("budget_peak_hbm_bytes") or 0),
+    }
+
+
+def program_flags(run: dict) -> List[dict]:
+    """Compiled-program flags (ISSUE 7), judged per RECORD rather than
+    per epoch: retrace storms with their measured compile-wall cost,
+    and compile records past the governing plan row's budgets."""
+    flags: List[dict] = []
+    events = run.get("events", [])
+    compiles = [r for r in events if r.get("event") == "compile"]
+
+    # compile_storm: one flag per stormed jit, worst mark wins; the
+    # cost dimension comes from that jit's compile records.
+    storms: dict = {}
+    for m in run.get("marks", []):
+        if m.get("name") != "retrace_storm":
+            continue
+        fn = m.get("fn")
+        prev = storms.get(fn)
+        if prev is None or (m.get("compiles") or 0) > (prev.get("compiles")
+                                                       or 0):
+            storms[fn] = m
+    for fn, m in storms.items():
+        cost = sum(float(c.get("wall_s") or 0.0)
+                   for c in compiles if c.get("fn") == fn)
+        flags.append({
+            "epoch": None, "line": m.get("_line"), "flag": "compile_storm",
+            "detail": f"'{fn}' compiled {m.get('compiles')}x over "
+                      f"{m.get('calls')} calls"
+                      + (f" — {cost:.2f}s of compile wall burned"
+                         if cost else ""),
+        })
+
+    for c in compiles:
+        budgets = _budgets_for(c, events)
+        peak_budget = budgets.get("peak_hbm_bytes") or 0
+        peak = c.get("peak_bytes")
+        if peak_budget > 0 and peak is not None and peak > peak_budget:
+            flags.append({
+                "epoch": None, "line": c.get("_line"),
+                "flag": "hbm_over_budget",
+                "detail": f"'{c.get('fn')}' program peak HBM estimate "
+                          f"{peak / 1e6:.1f} MB > budget "
+                          f"{peak_budget / 1e6:.1f} MB (plan row)",
+            })
+        s_budget = budgets.get("compile_s") or 0.0
+        wall = c.get("wall_s")
+        if s_budget > 0 and wall is not None and wall > s_budget:
+            flags.append({
+                "epoch": None, "line": c.get("_line"),
+                "flag": "compile_over_budget",
+                "detail": f"'{c.get('fn')}' compile wall {wall:.2f}s > "
+                          f"budget {s_budget:g}s (plan row)",
+            })
+    return flags
+
+
 def build_report(run: dict, **kw) -> dict:
     epochs = run["epochs"]
     flags = health_flags(epochs, run["events"], **kw)
+    flags += program_flags(run)
     by_kind: dict = {}
     for f in flags:
         by_kind[f["flag"]] = by_kind.get(f["flag"], 0) + 1
@@ -269,6 +366,7 @@ def build_report(run: dict, **kw) -> dict:
         "num_epochs": len(epochs),
         "probes": probes_on,
         "epochs": epochs,
+        "compiles": compile_summary(run),
         "flags": flags,
         "summary": {
             "flag_counts": by_kind,
@@ -297,6 +395,15 @@ def format_report(rep: dict) -> str:
         f"git={meta.get('git_sha')}  config={meta.get('config_hash')}")
     lines.append(f"epochs: {rep['num_epochs']}   health probes: "
                  f"{'on' if rep['probes'] else 'off'}")
+    comp = rep.get("compiles") or {}
+    if comp.get("records"):
+        peak = comp.get("max_peak_bytes")
+        lines.append(
+            f"compiled programs: {len(comp['by_fn'])} jits / "
+            f"{comp['records']} compiles, "
+            f"{comp['total_wall_s']:.2f}s compile wall"
+            + (f", peak program HBM estimate {peak / 1e6:.1f} MB"
+               if peak else ""))
     if rep["epochs"]:
         cols = ["epoch", "train_loss", "val_loss", "lr", "days_per_sec"]
         if rep["probes"]:
@@ -319,7 +426,9 @@ def format_report(rep: dict) -> str:
         lines.append("")
         lines.append(f"HEALTH FLAGS ({len(rep['flags'])}):")
         for f in rep["flags"]:
-            lines.append(f"  epoch {f['epoch']}: [{f['flag']}] {f['detail']}")
+            where = (f"epoch {f['epoch']}" if f.get("epoch") is not None
+                     else "program")  # compile/budget flags are per jit
+            lines.append(f"  {where}: [{f['flag']}] {f['detail']}")
     else:
         lines.append("no health flags — run looks clean")
     best = rep["summary"]["best"]
@@ -346,8 +455,17 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--diverge-frac", type=float, default=0.2)
     ap.add_argument("--diverge-epochs", type=int, default=3)
     args = ap.parse_args(argv)
+    import sys
+
+    try:
+        run, warnings = open_run(args.run_jsonl)
+    except RunStreamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
     rep = build_report(
-        load_run(args.run_jsonl), spike_mult=args.spike_mult,
+        run, spike_mult=args.spike_mult,
         slow_frac=args.slow_frac, diverge_frac=args.diverge_frac,
         diverge_epochs=args.diverge_epochs)
     if args.json:

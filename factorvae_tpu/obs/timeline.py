@@ -36,17 +36,31 @@ DEVICE_RESOURCE = "device"
 def load_run(path: str) -> dict:
     """Split a RUN.jsonl into {"spans", "marks", "epochs", "meta",
     "events"} record lists (unparseable lines are skipped, not fatal —
-    a live-tailed file may end mid-line)."""
+    a live-tailed file may end mid-line). Parse bookkeeping lands in
+    `_stats` so `open_run` can tell an async-kill torn tail (warning)
+    from a file that isn't JSONL at all (error)."""
     out: dict = {"spans": [], "marks": [], "epochs": [], "meta": [],
                  "events": []}
-    with open(path) as fh:
+    lines = bad = 0
+    last_bad = False
+    # errors="replace": a binary (non-UTF-8) file must surface as "no
+    # line parses" — the one-line not-a-JSONL error — not as a
+    # UnicodeDecodeError traceback out of the iterator.
+    with open(path, errors="replace") as fh:
         for i, line in enumerate(fh):
             line = line.strip()
             if not line:
                 continue
+            lines += 1
             try:
                 rec = json.loads(line)
             except ValueError:
+                bad += 1
+                last_bad = True
+                continue
+            last_bad = False
+            if not isinstance(rec, dict):
+                bad += 1
                 continue
             # Stream position: the report needs record ORDER across the
             # split lists (e.g. which plan record precedes which run's
@@ -63,7 +77,50 @@ def load_run(path: str) -> dict:
                 out["meta"].append(rec)
             else:
                 out["events"].append(rec)
+    out["_stats"] = {"lines": lines, "bad": bad, "last_bad": last_bad}
     return out
+
+
+class RunStreamError(Exception):
+    """A RUN.jsonl that cannot be rendered at all — missing, empty, or
+    not JSONL. Carries the ONE-line message the CLIs print (ISSUE 7: a
+    truncated stream is an error message, never a traceback)."""
+
+
+def open_run(path: str) -> Tuple[dict, List[str]]:
+    """`load_run` + stream sanity for the CLI entry points: returns
+    (run, warnings). Raises RunStreamError on a missing/unreadable
+    file, an empty stream, or a file none of whose lines parse as
+    JSONL. A trailing partially-written line — the artifact of killing
+    an async writer — is SKIPPED with a warning, and so are isolated
+    corrupt lines in the middle; only a stream with nothing readable is
+    fatal."""
+    try:
+        run = load_run(path)
+    except OSError as e:
+        raise RunStreamError(
+            f"cannot read {path}: {e.strerror or e}") from e
+    stats = run["_stats"]
+    if stats["lines"] == 0:
+        raise RunStreamError(
+            f"{path} is empty — no run has written to this stream yet")
+    if stats["bad"] == stats["lines"]:
+        raise RunStreamError(
+            f"{path} is not a JSONL metric stream "
+            f"(none of its {stats['lines']} lines parse)")
+    warnings = []
+    if stats["last_bad"]:
+        warnings.append(
+            f"{path}: trailing partial line skipped (stream was cut "
+            "mid-write — an async kill artifact, not corruption)")
+        if stats["bad"] > 1:
+            warnings.append(
+                f"{path}: {stats['bad'] - 1} additional unparseable "
+                "line(s) skipped")
+    elif stats["bad"]:
+        warnings.append(
+            f"{path}: {stats['bad']} unparseable line(s) skipped")
+    return run, warnings
 
 
 def merge_intervals(iv: List[Interval]) -> List[Interval]:
@@ -213,13 +270,54 @@ def format_report(run: dict, width: int = 72, top: int = 10) -> str:
                     f"{s.get('name')}")
         if len(sections) > 1:
             lines.append("")
+    compiles = compile_summary(run)
+    if compiles["records"]:
+        lines.append(
+            f"compiled programs: {len(compiles['by_fn'])} jits, "
+            f"{compiles['records']} compiles, "
+            f"{compiles['total_wall_s']:.2f}s total compile wall"
+            + (f", peak program HBM estimate "
+               f"{compiles['max_peak_bytes'] / 1e6:.1f} MB"
+               if compiles.get("max_peak_bytes") else ""))
     storms = [m for m in run["marks"] if m.get("name") == "retrace_storm"]
     if storms:
         worst = max(storms, key=lambda m: m.get("compiles", 0))
+        cost = compiles["by_fn"].get(worst.get("fn"), {}).get("wall_s")
         lines.append(
             f"RETRACE STORM: '{worst.get('fn')}' compiled "
-            f"{worst.get('compiles')} times over {worst.get('calls')} calls")
+            f"{worst.get('compiles')} times over {worst.get('calls')} calls"
+            # the cost dimension (ISSUE 7): what the storm actually
+            # burned, from the per-miss compile records
+            + (f" — {cost:.2f}s of compile wall" if cost else ""))
     return "\n".join(lines)
+
+
+def compile_summary(run: dict) -> dict:
+    """Aggregate the stream's `compile` records (obs/watchdog.py emits
+    one per detected cache miss): total/per-fn wall seconds, compile
+    counts, and the largest cost/memory figures the guarded capture
+    yielded (nulls where the jax version lacks the APIs)."""
+    recs = [r for r in run["events"] if r.get("event") == "compile"]
+    by_fn: dict = {}
+    for r in recs:
+        fn = str(r.get("fn"))
+        e = by_fn.setdefault(fn, {"compiles": 0, "wall_s": 0.0,
+                                  "flops": None, "peak_bytes": None})
+        e["compiles"] += 1
+        e["wall_s"] = round(e["wall_s"] + float(r.get("wall_s") or 0.0), 6)
+        for k in ("flops", "peak_bytes"):
+            v = r.get(k)
+            if v is not None:
+                e[k] = max(e[k] or 0, v)
+    peaks = [e["peak_bytes"] for e in by_fn.values()
+             if e["peak_bytes"] is not None]
+    return {
+        "records": len(recs),
+        "total_wall_s": round(sum(float(r.get("wall_s") or 0.0)
+                                  for r in recs), 6),
+        "max_peak_bytes": max(peaks) if peaks else None,
+        "by_fn": by_fn,
+    }
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -236,7 +334,15 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable overlap report instead of text")
     args = ap.parse_args(argv)
-    run = load_run(args.run_jsonl)
+    import sys
+
+    try:
+        run, warnings = open_run(args.run_jsonl)
+    except RunStreamError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
     if args.json:
         print(json.dumps({
             # per-section: spans across run_meta boundaries carry
@@ -244,6 +350,7 @@ def main(argv: Optional[list] = None) -> int:
             "sections": [overlap_report(sec)
                          for sec in span_sections(run)],
             "num_spans": len(run["spans"]),
+            "compiles": compile_summary(run),
             "retrace_storms": [m for m in run["marks"]
                                if m.get("name") == "retrace_storm"],
         }, indent=2))

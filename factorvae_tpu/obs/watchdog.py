@@ -1,4 +1,4 @@
-"""jit compile/retrace watchdog.
+"""jit compile/retrace watchdog + per-compile cost capture.
 
 A retrace storm — a jitted function recompiling every call because a
 static argument or a weak-typed shape keeps changing — is invisible at
@@ -12,6 +12,20 @@ installed timeline, and once the per-function miss count passes
 `storm_threshold` every further miss emits a `retrace_storm` mark so
 the report/timeline flag it.
 
+Since ISSUE 7 every detected miss ALSO emits one `compile` record into
+the same stream: the measured `wall_s` plus the compiled program's
+bill from `obs/compile.capture_compile` — cache-warm lower/compile
+split, `cost_analysis()` FLOPs/bytes, `memory_analysis()`
+argument/output/temp/peak bytes — all null-degrading where the jax
+version lacks the API. The capture replays lower+compile from
+ABSTRACT shapes (metadata survives donation; no buffer is re-read), so
+it is observation-only — and because the replay is a second full XLA
+compile, it runs on the FIRST miss per jit only: later misses (scan-
+length variants, retrace storms) record their measured wall_s without
+it, bounding the cost of watching to one extra compile per watched jit
+per process and never doubling the per-call cost of the very pathology
+the storm flag exists to catch.
+
 The wrapper is a transparent passthrough — same positional/keyword
 calling convention, same outputs, donation semantics untouched (they
 live on the wrapped jit) — and does NOTHING unless a timeline is
@@ -23,6 +37,7 @@ working on watched jits.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Optional
 
@@ -33,6 +48,28 @@ from factorvae_tpu.utils.logging import current_timeline
 # length: whole epochs plus possibly one shorter tail chunk).
 STORM_THRESHOLD = 3
 
+# Cost/memory capture master switch. The "one replay per jit" bound
+# assumes long-lived jits; a process that builds DOZENS of short-lived
+# trainers (the autotune race: fresh WatchedJits per candidate) would
+# pay the replay — a second full XLA compile — once per candidate and
+# nearly double its wall clock. Such paths wrap themselves in
+# `capture_disabled()`: records keep their measured wall_s (what the
+# race provenance consumes), only the replayed bill is skipped.
+_CAPTURE = True
+
+
+@contextlib.contextmanager
+def capture_disabled():
+    """Suspend the per-compile cost/memory replay (wall_s-only
+    records) for the duration of the block."""
+    global _CAPTURE
+    prev = _CAPTURE
+    _CAPTURE = False
+    try:
+        yield
+    finally:
+        _CAPTURE = prev
+
 
 class WatchedJit:
     def __init__(self, fn: Callable, name: str,
@@ -42,6 +79,9 @@ class WatchedJit:
         self.storm_threshold = storm_threshold
         self.calls = 0
         self.compiles = 0
+        self.total_compile_s = 0.0
+        # Most recent `compile` record's fields (tests / provenance).
+        self.last_compile: Optional[dict] = None
 
     def __getattr__(self, attr: str) -> Any:
         # Transparent delegation: jit-surface APIs (.lower(),
@@ -63,6 +103,8 @@ class WatchedJit:
         tl = current_timeline()
         if tl is None:
             return self._fn(*args, **kwargs)
+        from factorvae_tpu.obs import compile as compilelib
+
         before = self._cache_size()
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
@@ -72,13 +114,38 @@ class WatchedJit:
                   else (self._cache_size() or 0) > before)
         if missed:
             self.compiles += 1
+            wall = round(t1 - t0, 6)
+            self.total_compile_s = round(self.total_compile_s + wall, 6)
             tl.span_at(
                 f"jit_compile:{self.name}", t0, t1, cat="compile",
                 resource="compile", compiles=self.compiles)
+            # The per-compile program bill (null-degrading; ISSUE 7).
+            # `wall_s` is the authoritative in-call measurement and is
+            # ALWAYS nonnull; the capture fields ride along when the
+            # jax version exposes them. The replay is a SECOND full XLA
+            # compile (there is no in-process executable cache across
+            # lower() calls), so only the FIRST miss per jit pays it —
+            # later misses (legitimate scan-length variants, retrace
+            # storms) record wall_s only, bounding the cost of watching
+            # to one extra compile per watched jit per process. The
+            # abstract snapshot happens AFTER the call: shape/dtype
+            # metadata survives donation (only the buffer is deleted).
+            cap = {}
+            if self.compiles == 1 and _CAPTURE:
+                try:
+                    cap = compilelib.capture_compile(
+                        self._fn, compilelib.abstractify(args),
+                        compilelib.abstractify(kwargs))
+                except Exception:
+                    cap = {}
+            self.last_compile = dict(cap, fn=self.name, wall_s=wall,
+                                     compiles=self.compiles)
+            tl.logger.log("compile", _echo=False, **self.last_compile)
             if self.compiles > self.storm_threshold:
                 tl.event(
                     "retrace_storm", cat="compile", resource="compile",
                     fn=self.name, compiles=self.compiles, calls=self.calls,
+                    total_compile_s=self.total_compile_s,
                     note="cache misses keep accruing — a static arg or "
                          "shape is changing per call")
         return out

@@ -285,3 +285,63 @@ def seed_parallel_size(mesh: Optional[Mesh]) -> int:
     if mesh is None:
         return 1
     return int(mesh.shape.get(SEED_AXIS, 1))
+
+
+def _dim_shard_sizes(dim: int, k: int) -> list:
+    """GSPMD split of one dimension over k shards: every shard gets
+    ceil(dim/k) rows except the tail, which gets what is left (possibly
+    zero — an uneven split pads, and the padding is DEAD memory on the
+    devices that hold it, which is exactly what the imbalance accounting
+    must see)."""
+    per = -(-dim // k)
+    return [max(0, min(per, dim - i * per)) for i in range(k)]
+
+
+def device_bytes(mesh: Mesh, specs, tree) -> "np.ndarray":
+    """Per-device REAL bytes of `tree` placed per `specs` on `mesh` —
+    the rule-table counterpart of `make_shard_and_gather_fns`, for
+    accounting instead of placement (obs/memory.py's shard-balance
+    bill). Returns an array shaped like `mesh.devices` (device-id
+    layout) whose entries are the bytes of actual data (padding
+    excluded) each device holds for this tree. `jax.eval_shape` structs
+    work as leaves — only shape/dtype are read."""
+    shape = tuple(int(s) for s in np.asarray(mesh.devices).shape)
+    out = np.zeros(shape, dtype=np.int64)
+
+    def add_leaf(spec, leaf):
+        lshape = tuple(getattr(leaf, "shape", ()))
+        itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        # bytes per device coordinate = product over dims of that
+        # coordinate's shard length (replicated dims contribute fully on
+        # every device).
+        per_dim = []  # one (mesh-axis-index or None, sizes) per array dim
+        entries = list(spec) if spec is not None else []
+        for d, dim in enumerate(lshape):
+            axes = entries[d] if d < len(entries) else None
+            if axes is None:
+                per_dim.append((None, [dim]))
+                continue
+            names = axes if isinstance(axes, (tuple, list)) else (axes,)
+            k = 1
+            idxs = []
+            for nm in names:
+                k *= int(mesh.shape[nm])
+                idxs.append(mesh.axis_names.index(nm))
+            per_dim.append((tuple(idxs), _dim_shard_sizes(int(dim), k)))
+        it = np.ndindex(*shape)
+        for coord in it:
+            b = itemsize
+            for idxs, sizes in per_dim:
+                if idxs is None:
+                    b *= sizes[0]
+                else:
+                    # linear shard index over the (possibly multi-axis)
+                    # sharded dim, in mesh-axis order
+                    li = 0
+                    for i in idxs:
+                        li = li * shape[i] + coord[i]
+                    b *= sizes[li]
+            out[coord] += b
+
+    jax.tree_util.tree_map(add_leaf, specs, tree, is_leaf=_is_spec)
+    return out

@@ -174,6 +174,18 @@ class Plan:
     it together with the mesh shape, keeping the persisted row
     self-consistent: a mesh block whose shape needs dps=2 must not ship
     next to the train race's dps=1.
+
+    `budget_*` are the OBSERVABILITY envelopes (ISSUE 7): a row's
+    optional `"budgets"` block (`{"compile_seconds": s,
+    "peak_hbm_bytes": b, "comm_bytes_per_epoch": c}`) states what a
+    deployment of this shape is allowed to cost — obs.report flags a
+    RUN.jsonl `compile` record past the compile/HBM envelopes
+    (`compile_over_budget` / `hbm_over_budget`), `bench.py --mesh`
+    judges each cell's comms bill against the comm envelope
+    (`comm_over_budget` on the cell — the bill exists where programs
+    are compiled per mesh shape), and a serving registry can budget
+    admission on them. 0 means "no envelope" (every pre-ISSUE-7 row):
+    budgets are opt-in, never inferred.
     """
 
     flatten_days: bool
@@ -193,6 +205,9 @@ class Plan:
     mesh_data_axis: int = 0
     mesh_stock_axis: int = 0
     mesh_days_per_step: int = 0
+    budget_compile_s: float = 0.0
+    budget_peak_hbm_bytes: int = 0
+    budget_comm_bytes_per_epoch: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -437,6 +452,17 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                     (row.get("mesh") or {}).get("stock_axis") or 0),
                 mesh_days_per_step=int(
                     (row.get("mesh") or {}).get("days_per_step") or 0),
+                # Pre-ISSUE-7 rows have no "budgets" block: 0 = no
+                # envelope (budgets are opt-in, same rule as
+                # fleet/stream/obs/mesh).
+                budget_compile_s=float(
+                    (row.get("budgets") or {}).get("compile_seconds")
+                    or 0.0),
+                budget_peak_hbm_bytes=int(
+                    (row.get("budgets") or {}).get("peak_hbm_bytes") or 0),
+                budget_comm_bytes_per_epoch=int(
+                    (row.get("budgets") or {}).get("comm_bytes_per_epoch")
+                    or 0),
             )
     default = _TPU_DEFAULT if plat == "tpu" else _CPU_DEFAULT
     src = ("per-backend default: round-2 measured TPU winners (PERF.md)"

@@ -176,6 +176,30 @@ class FleetTrainer:
             n_padded=dataset.n_max,
             obs_probes=config.train.obs_probes,
         )
+        if mesh is not None:
+            # Rule-table shard-balance bill for the state the epoch
+            # loop actually CARRIES + the 'stock'-sharded panel — the
+            # per-device byte story of docs/sharding.md, measured from
+            # abstract shapes at construction (obs/memory.py). At S=1
+            # the run state is the UNSTACKED serial state on replicated
+            # shardings (init_run_state), so the bill must drop the
+            # seed axis too — billing a 1-long seed dim over a >1
+            # 'data' axis would report a maximal FALSE imbalance from
+            # the very diagnostic meant to catch real ones. Guarded:
+            # telemetry must never abort the run it observes.
+            try:
+                from factorvae_tpu.obs.memory import shard_balance_block
+
+                abstract = jax.eval_shape(self.init_fleet_state)
+                if self.num_seeds == 1:
+                    abstract = jax.tree.map(
+                        lambda s: jax.ShapeDtypeStruct(s.shape[1:],
+                                                       s.dtype), abstract)
+                self.logger.log("shard_balance", **shard_balance_block(
+                    mesh, state=abstract, dataset=dataset,
+                    stacked=self.num_seeds > 1))
+            except Exception as e:
+                self.logger.log("shard_balance", error=str(e))
 
     # ------------------------------------------------------------------
 
@@ -658,6 +682,11 @@ class FleetTrainer:
                                 float(v) for v in np.asarray(val_m[k])]
             history.append(rec)
             self.logger.log("fleet_epoch", **rec)
+            # Live allocator watermark (no-op without a timeline or on
+            # backends without memory_stats — host CPU).
+            from factorvae_tpu.obs.memory import watermark_event
+
+            watermark_event(epoch=epoch, seeds=self.num_seeds)
             # Serial save cadence, fleet-wide: improved seeds' best-val
             # snapshots hit disk THIS epoch (a killed multi-hour run
             # keeps every seed's best so far, exactly like the serial

@@ -123,6 +123,21 @@ class Trainer:
                 getattr(dataset, "dead_compute_frac", 0.0), 4),
             obs_probes=config.train.obs_probes,
         )
+        if self.mesh is not None:
+            # Rule-table shard-balance bill (obs/memory.py): per-device
+            # bytes of the replicated state + the 'stock'-sharded panel
+            # and the imbalance fraction — abstract shapes only, logged
+            # once so an uneven axis is visible before it straggles.
+            # Guarded like every other observation path: telemetry must
+            # never abort the construction it observes.
+            try:
+                from factorvae_tpu.obs.memory import shard_balance_block
+
+                self.logger.log("shard_balance", **shard_balance_block(
+                    self.mesh, state=jax.eval_shape(self.init_state),
+                    dataset=dataset))
+            except Exception as e:
+                self.logger.log("shard_balance", error=str(e))
 
     def _build_step_fns(self) -> None:
         """(Re)build optimizer + jitted epoch fns for the current
@@ -453,6 +468,13 @@ class Trainer:
                             rec["val_" + k] = float(val_m[k])
             history.append(rec)
             self.logger.log("epoch", **rec)
+            # Live-buffer watermark where the backend exposes allocator
+            # stats (TPU/GPU; no-op on host CPU or without a timeline) —
+            # the measured complement of the compile records' peak
+            # estimate (obs/memory.py).
+            from factorvae_tpu.obs.memory import watermark_event
+
+            watermark_event(epoch=epoch)
 
             improved = selection_loss < best_val
             if improved:

@@ -53,12 +53,39 @@ def _git_sha() -> Optional[str]:
         return None
 
 
+def backend_env() -> dict:
+    """The XLA/backend environment bench comparability depends on
+    (ISSUE 7): numbers measured under different platform pins, virtual
+    device counts or XLA flags are different rigs, and the perf ledger
+    (obs/ledger.py) must refuse to compare them rather than flag false
+    regressions. `xla_flags` drops the virtual-device flag (it gets its
+    own field) and is sorted, so equal rigs hash equal regardless of
+    flag order."""
+    flags = os.environ.get("XLA_FLAGS", "").split()
+    host_devices = None
+    rest = []
+    for f in flags:
+        if "xla_force_host_platform_device_count" in f:
+            try:
+                host_devices = int(f.split("=", 1)[1])
+            except (IndexError, ValueError):
+                host_devices = None
+        else:
+            rest.append(f)
+    return {
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "xla_force_host_platform_device_count": host_devices,
+        "xla_flags": sorted(rest),
+    }
+
+
 def run_meta(config: Optional[dict] = None,
              run_name: Optional[str] = None) -> dict:
     """Header fields for the first record of a metrics stream. jax is
     queried only if already imported (probing it here must not
     initialize a backend behind the caller's platform setup)."""
-    meta: dict = {"run_name": run_name, "git_sha": _git_sha()}
+    meta: dict = {"run_name": run_name, "git_sha": _git_sha(),
+                  "env": backend_env()}
     jax = sys.modules.get("jax")
     if jax is not None:
         meta["jax"] = getattr(jax, "__version__", None)

@@ -156,8 +156,13 @@ def _setup(shape: dict, dtype: str, flatten: bool, dps: int, days: int,
 
 
 def time_train(shape: dict, dtype: str, flatten: bool, dps: int,
-               days: int, reps: int) -> float:
-    """Seconds per trained day for one candidate (compile excluded)."""
+               days: int, reps: int) -> tuple:
+    """(seconds per trained day, warmup seconds) for one candidate.
+    The timed rate excludes compilation as always; the warmup wall —
+    compile + first epoch — is the candidate's compile-cost provenance
+    (ISSUE 7: a raced winner should say what it costs to BUILD, not
+    just to run; with a timeline installed the watchdog's per-miss
+    `compile` records land in the same RUN.jsonl for the full split)."""
     import jax
 
     from factorvae_tpu.train import Trainer
@@ -166,13 +171,22 @@ def time_train(shape: dict, dtype: str, flatten: bool, dps: int,
     cfg, ds = _setup(shape, dtype, flatten, dps, days)
     trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
     state = trainer.init_state()
+    t_w = time.time()
     state, m = trainer._train_epoch(state, trainer._epoch_orders(0))  # warmup
     jax.block_until_ready(m["loss"])
+    warmup = time.time() - t_w
+    # With a timeline installed the watchdog's post-miss capture replay
+    # runs INSIDE the external warmup window and would inflate the
+    # number; the watchdog's own wall_s brackets exactly the jit call
+    # (compile + first execution, capture excluded) — prefer it.
+    cap = getattr(trainer._train_epoch_jit, "last_compile", None)
+    if cap and cap.get("wall_s"):
+        warmup = float(cap["wall_s"])
     t0 = time.time()
     for e in range(1, 1 + reps):
         state, m = trainer._train_epoch(state, trainer._epoch_orders(e))
     jax.block_until_ready(m["loss"])
-    return (time.time() - t0) / (reps * days)
+    return (time.time() - t0) / (reps * days), warmup
 
 
 def time_score(shape: dict, dtype: str, flatten: bool,
@@ -459,19 +473,26 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
             return row
     measured: dict = {"train": {}, "score": {}}
 
-    best_train, best_train_key = None, None
+    best_train, best_train_key, best_warmup = None, None, None
+    measured["train_warmup_s"] = {}
     for cand in TRAIN_CANDIDATES:
         for dtype in DTYPES:
             key = (f"flat={int(cand['flatten_days'])}"
                    f"_dps{cand['days_per_step']}_{dtype}")
-            sec = time_train(shape, dtype, cand["flatten_days"],
-                             cand["days_per_step"], days, reps)
+            sec, warmup = time_train(shape, dtype, cand["flatten_days"],
+                                     cand["days_per_step"], days, reps)
             measured["train"][key] = round(sec, 5)
+            # compile-cost provenance rides NEXT TO the rates (not
+            # inside the winner block — race_widths merges rows on
+            # identical winners, and two widths' warmups always differ)
+            measured["train_warmup_s"][key] = round(warmup, 3)
             _log(logger, "autotune_train_candidate", shape=name,
-                 candidate=key, s_per_day=round(sec, 5))
+                 candidate=key, s_per_day=round(sec, 5),
+                 compile_warmup_s=round(warmup, 3))
             if best_train is None or sec < best_train:
                 best_train = sec
                 best_train_key = {**cand, "compute_dtype": dtype}
+                best_warmup = warmup
 
     best_score, best_score_key = None, None
     for cand in SCORE_CANDIDATES:
@@ -520,7 +541,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         "measured": measured,
         "source": f"autotune_plan {name} n={shp.n_stocks} on {plat} "
                   f"(days={days}, reps={reps}): "
-                  f"train {best_train:.4f} s/day, "
+                  f"train {best_train:.4f} s/day "
+                  f"(compile+first epoch {best_warmup:.1f}s), "
                   f"score {best_score:,.0f} w/s",
     }
     if fleet_block is not None:
@@ -651,22 +673,44 @@ def main() -> int:
     # Echo to STDERR: stdout is the table-JSON artifact. Constructed
     # after force_host_devices so the run_meta header records the
     # platform the race actually runs on.
-    from factorvae_tpu.utils.logging import MetricsLogger
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
 
     with MetricsLogger(jsonl_path=args.metrics_jsonl, echo=True,
                        echo_to=sys.stderr, run_name="autotune_plan") as lg:
-        names = sorted(SHAPES) if args.all else [args.config]
-        rows = [r for n in names
-                for r in race_widths(n, SHAPES[n], args.days, args.reps,
-                                     fleet=args.fleet, stream=args.stream,
-                                     mesh=args.mesh, logger=lg)]
-        print(json.dumps({"rows": rows}, indent=1))
-        if args.dry_run:
-            lg.log("autotune_dry_run", rows=len(rows),
-                   note="table not written")
-            return 0
-        path = save_rows(rows, path=args.out)
-        lg.log("autotune_table_written", rows=len(rows), path=path)
+        # Timeline installed for the races: every candidate trainer's
+        # jits go through the compile watchdog, so each compile lands a
+        # `compile` record in the same stream as the race events — the
+        # raced winners' compile provenance, renderable by
+        # obs.report/obs.timeline. Capture is DISABLED for the races:
+        # each candidate builds fresh jits, so the per-jit replay (a
+        # second full XLA compile) would fire once per candidate and
+        # nearly double the race wall clock — the provenance consumed
+        # here (time_train's warmup = the watchdog's wall_s) doesn't
+        # need it.
+        from factorvae_tpu.obs.watchdog import capture_disabled
+
+        prev_tl = install_timeline(Timeline(lg))
+        try:
+            names = sorted(SHAPES) if args.all else [args.config]
+            with capture_disabled():
+                rows = [r for n in names
+                        for r in race_widths(n, SHAPES[n], args.days,
+                                             args.reps, fleet=args.fleet,
+                                             stream=args.stream,
+                                             mesh=args.mesh, logger=lg)]
+            print(json.dumps({"rows": rows}, indent=1))
+            if args.dry_run:
+                lg.log("autotune_dry_run", rows=len(rows),
+                       note="table not written")
+                return 0
+            path = save_rows(rows, path=args.out)
+            lg.log("autotune_table_written", rows=len(rows), path=path)
+        finally:
+            install_timeline(prev_tl)
     return 0
 
 

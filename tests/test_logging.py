@@ -59,6 +59,40 @@ class TestMetricsLogger:
         h3 = read_jsonl(tmp_path / "m3.jsonl")[0]["config_hash"]
         assert h2 == lines[0]["config_hash"] and h3 != h2
 
+    def test_run_meta_carries_backend_env(self, tmp_path, monkeypatch):
+        """ISSUE 7 satellite: the header records the XLA/backend rig
+        (JAX_PLATFORMS, the virtual-device count, remaining XLA_FLAGS
+        sorted) so the perf ledger can refuse cross-rig comparisons."""
+        from factorvae_tpu.utils.logging import backend_env
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_b=2 --xla_force_host_platform_device_count=8 --xla_a=1")
+        env = backend_env()
+        assert env["jax_platforms"] == "cpu"
+        assert env["xla_force_host_platform_device_count"] == 8
+        assert env["xla_flags"] == ["--xla_a=1", "--xla_b=2"]  # sorted
+        p = tmp_path / "m.jsonl"
+        MetricsLogger(jsonl_path=str(p), echo=False).finish()
+        hdr = read_jsonl(p)[0]
+        assert hdr["env"] == env
+        # flag ORDER must not split a rig
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_a=1 --xla_force_host_platform_device_count=8 --xla_b=2")
+        assert backend_env() == env
+
+    def test_backend_env_unset_is_nulls(self, monkeypatch):
+        from factorvae_tpu.utils.logging import backend_env
+
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        env = backend_env()
+        assert env == {"jax_platforms": None,
+                       "xla_force_host_platform_device_count": None,
+                       "xla_flags": []}
+
     def test_jsonl_roundtrip_preserves_fields(self, tmp_path):
         p = tmp_path / "m.jsonl"
         with MetricsLogger(jsonl_path=str(p), echo=False) as lg:

@@ -1,4 +1,6 @@
-"""Run-observatory contracts (ISSUE 5):
+"""Run-observatory contracts (ISSUE 5 + the ISSUE 7 compiled-program
+observatory: guarded compile capture, the HLO comms scan, shard-balance
+accounting, the perf ledger, and the stream-sanity CLI behavior):
 
 - obs OFF (the default) is bitwise-neutral: Trainer/FleetTrainer params
   and metric histories are identical with the probes compiled out vs in
@@ -602,3 +604,742 @@ class TestEndToEnd:
         assert any(s["resource"] == "device" for s in run["spans"])
         obs_recs = [r for r in run["events"] if r["event"] == "obs"]
         assert obs_recs and obs_recs[0]["probes"] is True
+
+
+# ---------------------------------------------------------------------------
+# compiled-program observatory (ISSUE 7)
+
+
+class TestCompileCapture:
+    """Version-skew contract: every accessor degrades to None — missing
+    API, None return, raising accessor — and NEVER raises (the jax AOT
+    surface differs across versions/backends)."""
+
+    def test_missing_apis_yield_none(self):
+        from factorvae_tpu.obs.compile import (
+            guarded_compiled_text,
+            guarded_cost_analysis,
+            guarded_memory_analysis,
+        )
+
+        class Bare:
+            pass
+
+        assert guarded_cost_analysis(Bare()) is None
+        assert guarded_memory_analysis(Bare()) is None
+        assert guarded_compiled_text(Bare()) is None
+
+    def test_none_returns_yield_none(self):
+        from factorvae_tpu.obs.compile import (
+            guarded_compiled_text,
+            guarded_cost_analysis,
+            guarded_memory_analysis,
+        )
+
+        class Nones:
+            def cost_analysis(self):
+                return None
+
+            def memory_analysis(self):
+                return None
+
+            def as_text(self):
+                return None
+
+        assert guarded_cost_analysis(Nones()) is None
+        assert guarded_memory_analysis(Nones()) is None
+        assert guarded_compiled_text(Nones()) is None
+
+    def test_raising_accessors_yield_none(self):
+        from factorvae_tpu.obs.compile import (
+            guarded_cost_analysis,
+            guarded_memory_analysis,
+        )
+
+        class Angry:
+            def cost_analysis(self):
+                raise NotImplementedError("backend says no")
+
+            def memory_analysis(self):
+                raise RuntimeError("unsupported")
+
+        assert guarded_cost_analysis(Angry()) is None
+        assert guarded_memory_analysis(Angry()) is None
+
+    def test_list_and_dict_shapes_normalize(self):
+        from factorvae_tpu.obs.compile import (
+            guarded_cost_analysis,
+            guarded_memory_analysis,
+        )
+
+        class ListCA:
+            def cost_analysis(self):
+                return [{"flops": 12.0, "bytes accessed": 34.0}]
+
+        ca = guarded_cost_analysis(ListCA())
+        assert ca == {"flops": 12.0, "bytes accessed": 34.0}
+
+        class DictMA:
+            def memory_analysis(self):
+                return {"argument_size_in_bytes": 10,
+                        "output_size_in_bytes": 4,
+                        "temp_size_in_bytes": 6,
+                        "alias_size_in_bytes": 0}
+
+        ma = guarded_memory_analysis(DictMA())
+        assert ma["argument_bytes"] == 10.0
+        assert ma["peak_bytes"] == 20.0  # arg + out + temp - alias
+
+    def test_capture_on_real_jit(self):
+        from factorvae_tpu.obs.compile import abstractify, capture_compile
+
+        f = jax.jit(lambda x: (x @ x).sum())
+        x = jnp.ones((8, 8))
+        args = abstractify((x,))
+        rec = capture_compile(f, args)
+        assert rec["lower_s"] is not None and rec["compile_s"] is not None
+        assert rec["flops"] and rec["flops"] > 0
+        assert rec["argument_bytes"] == 256.0
+        assert rec["peak_bytes"] is not None
+
+    def test_capture_without_lower_is_all_null(self):
+        from factorvae_tpu.obs.compile import capture_compile
+
+        rec = capture_compile(lambda x: x, ((),))
+        assert all(v is None for v in rec.values())
+
+    def test_watched_jit_emits_compile_records(self, tmp_path):
+        """Every detected cache miss lands ONE `compile` record with a
+        nonnull wall_s (the acceptance contract) — donation included:
+        the capture lowers from pre-call abstract shapes, never from
+        the (deleted) donated buffers."""
+        p = tmp_path / "c.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False)
+        prev = install_timeline(Timeline(lg))
+        try:
+            f = watch_jit(jax.jit(lambda x: x * 2, donate_argnums=(0,)),
+                          "donated")
+            f(jnp.ones((8,)))
+            f(jnp.ones((8,)))   # hit: no new record
+            f(jnp.ones((4,)))   # miss
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        recs = [json.loads(l) for l in open(p).read().strip().splitlines()]
+        comp = [r for r in recs if r["event"] == "compile"]
+        assert len(comp) == 2
+        for r in comp:
+            assert r["fn"] == "donated"
+            assert r["wall_s"] is not None and r["wall_s"] > 0
+        assert f.last_compile["compiles"] == 2
+        # the guarded fields are present (nonnull on this jax/backend,
+        # but the schema contract is presence, not support)
+        assert {"lower_s", "compile_s", "flops", "peak_bytes"} \
+            <= set(comp[0])
+
+
+class TestCaptureDisabled:
+    def test_records_keep_wall_without_replay(self, tmp_path):
+        """`capture_disabled()` (the autotune-race path: dozens of
+        short-lived jits) suspends the per-jit replay — records carry
+        wall_s but no cost bill — and restores on exit."""
+        from factorvae_tpu.obs.watchdog import capture_disabled
+
+        p = tmp_path / "c.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False)
+        prev = install_timeline(Timeline(lg))
+        try:
+            with capture_disabled():
+                f = watch_jit(jax.jit(lambda x: x + 1), "quiet")
+                f(jnp.ones((4,)))
+            g = watch_jit(jax.jit(lambda x: x - 1), "loud")
+            g(jnp.ones((4,)))
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        recs = [json.loads(l) for l in open(p).read().strip().splitlines()]
+        comp = {r["fn"]: r for r in recs if r["event"] == "compile"}
+        assert comp["quiet"]["wall_s"] > 0
+        assert "flops" not in comp["quiet"]  # replay skipped
+        assert comp["loud"].get("flops") is not None  # restored
+
+
+HLO_LOOP_FIXTURE = """\
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %r = f32[] add(%a, %b)
+}
+
+%body (p: (s32[], f32[4,2])) -> (s32[], f32[4,2]) {
+  %p = (s32[], f32[4,2]) parameter(0)
+  %g = f32[4,2] get-tuple-element(%p), index=1
+  %ar = f32[4,2] all-reduce(%g), channel_id=1, replica_groups={{0,1},{2,3}}, to_apply=%add
+  ROOT %t = (s32[], f32[4,2]) tuple(%g, %ar)
+}
+
+%cond (p: (s32[], f32[4,2])) -> pred[] {
+  %p = (s32[], f32[4,2]) parameter(0)
+  ROOT %lt = pred[] constant(false)
+}
+
+ENTRY %main (x: f32[4,2]) -> f32[4,2] {
+  %x = f32[4,2] parameter(0)
+  %w = (s32[], f32[4,2]) while((s32[], f32[4,2]) %t0), condition=%cond, body=%body
+  %once = f32[8] all-gather(f32[4] %y), channel_id=2, replica_groups=[2,2]<=[2,2]T(1,0), dimensions={0}
+  %solo = f32[4,2] all-reduce(f32[4,2] %x), channel_id=3, replica_groups={{0},{1},{2},{3}}, to_apply=%add
+  ROOT %out = f32[4,2] get-tuple-element(%w), index=1
+}
+"""
+
+
+class TestComms:
+    def test_parse_replica_group_forms(self):
+        from factorvae_tpu.obs.comms import parse_replica_groups
+
+        assert parse_replica_groups(
+            "replica_groups={{0,1},{2,3}}") == [[0, 1], [2, 3]]
+        assert parse_replica_groups(
+            "replica_groups=[2,2]<=[4]") == [[0, 1], [2, 3]]
+        # transposed iota: groups stride across the leading axis
+        assert parse_replica_groups(
+            "replica_groups=[2,2]<=[2,2]T(1,0)") == [[0, 2], [1, 3]]
+        # empty groups = one group of everything: caller decides
+        assert parse_replica_groups("replica_groups={}") is None
+        assert parse_replica_groups(
+            "source_target_pairs={{0,1},{1,0}}") == [[0, 1], [1, 0]]
+
+    def test_fixture_scan_kinds_loops_and_bytes(self):
+        from factorvae_tpu.obs.comms import scan_collectives
+
+        ops = scan_collectives(HLO_LOOP_FIXTURE)
+        # the degenerate single-device groups op is dropped
+        assert sorted(o["kind"] for o in ops) == ["all-gather",
+                                                  "all-reduce"]
+        ar = next(o for o in ops if o["kind"] == "all-reduce")
+        ag = next(o for o in ops if o["kind"] == "all-gather")
+        assert ar["in_loop"] is True and ag["in_loop"] is False
+        assert ar["bytes"] == 4 * 4 * 2     # f32[4,2]
+        assert ag["bytes"] == 4 * 8         # f32[8]
+        assert ar["group_size"] == 2
+
+    def test_tpu_tiled_layouts_and_async_start_forms(self):
+        """Real-chip HLO robustness: TPU result shapes carry tiled
+        layout annotations (`{1,0:T(8,128)}`) the op regex must
+        tolerate, and async `-start` tuples alias (input, output) —
+        payload is the OUTPUT component, not the tuple sum."""
+        from factorvae_tpu.obs.comms import scan_collectives
+
+        text = """\
+ENTRY %main (x: f32[128,256]) -> f32[128,256] {
+  %x = f32[128,256]{1,0:T(8,128)} parameter(0)
+  %ar = f32[128,256]{1,0:T(8,128)} all-reduce(%x), channel_id=1, replica_groups={{0,1},{2,3}}, to_apply=%add
+  %ags = (f32[8,128]{1,0:T(8,128)}, f32[32,128]{1,0:T(8,128)}) all-gather-start(f32[8,128] %y), channel_id=2, replica_groups={{0,1,2,3}}, dimensions={0}
+  %agd = f32[32,128]{1,0} all-gather-done((f32[8,128], f32[32,128]) %ags)
+  ROOT %out = f32[128,256]{1,0:T(8,128)} copy(%ar)
+}
+"""
+        ops = scan_collectives(text)
+        assert sorted(o["kind"] for o in ops) == ["all-gather",
+                                                  "all-reduce"]
+        ar = next(o for o in ops if o["kind"] == "all-reduce")
+        ag = next(o for o in ops if o["kind"] == "all-gather")
+        assert ar["bytes"] == 128 * 256 * 4  # layout suffix tolerated
+        # -start counted once, at the OUTPUT's bytes (not in+out)
+        assert ag["bytes"] == 32 * 128 * 4
+
+    def test_comms_block_epoch_multiplication(self):
+        from factorvae_tpu.obs.comms import comms_block
+
+        blk = comms_block(HLO_LOOP_FIXTURE, steps_per_epoch=10)
+        # loop all-reduce 32B x 10 steps + once all-gather 32B
+        assert blk["bytes_per_epoch"] == 32 * 10 + 32
+        assert blk["payload_bytes_per_program"] == 64
+        assert blk["ops_by_kind"] == {"all-reduce": 1, "all-gather": 1}
+        assert comms_block(None) is None  # version-skew: no text, no block
+
+    def test_axis_attribution_on_real_mesh_program(self):
+        """A (2,2) mesh program: reductions over contiguous id groups
+        ride 'stock', strided groups ride 'data' (row-major device
+        layout) — the attribution every bench --mesh cell reports."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from factorvae_tpu.obs.comms import scan_collectives
+        from factorvae_tpu.obs.compile import guarded_compiled_text
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stock"))
+        sh = NamedSharding(mesh, P("data", "stock"))
+        f = jax.jit(lambda x: x.sum(), in_shardings=sh)
+        text = guarded_compiled_text(
+            f.lower(jax.ShapeDtypeStruct((8, 8), jnp.float32)).compile())
+        assert text is not None
+        ops = scan_collectives(text, mesh=mesh)
+        assert ops, "a full reduction over a 2x2 mesh must communicate"
+        assert {o["axis"] for o in ops} <= {"data", "stock", "mixed"}
+        assert any(o["axis"] in ("data", "stock") for o in ops)
+
+    def test_attribution_uses_mesh_position_not_device_id(self):
+        """Post-SPMD replica groups index the device ASSIGNMENT (mesh
+        position), not Device.id — a topology-reordered mesh (real TPU
+        slices; here: reversed device order, so position != id) must
+        still attribute per axis instead of degrading to 'mixed'."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from factorvae_tpu.obs.comms import scan_collectives
+        from factorvae_tpu.obs.compile import guarded_compiled_text
+
+        devs = np.array(jax.devices()[:4])[::-1]  # ids [3,2,1,0]
+        mesh = Mesh(devs.reshape(2, 2), ("data", "stock"))
+        sh = NamedSharding(mesh, P("data", "stock"))
+        f = jax.jit(lambda x: x.sum(), in_shardings=sh)
+        text = guarded_compiled_text(
+            f.lower(jax.ShapeDtypeStruct((8, 8), jnp.float32)).compile())
+        ops = scan_collectives(text, mesh=mesh)
+        assert ops
+        assert any(o["axis"] in ("data", "stock") for o in ops), ops
+
+    def test_serial_mesh_program_has_zero_comms(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from factorvae_tpu.obs.comms import comms_block
+        from factorvae_tpu.obs.compile import guarded_compiled_text
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                    ("data", "stock"))
+        sh = NamedSharding(mesh, P("data", "stock"))
+        f = jax.jit(lambda x: x.sum(), in_shardings=sh)
+        text = guarded_compiled_text(
+            f.lower(jax.ShapeDtypeStruct((8, 8), jnp.float32)).compile())
+        blk = comms_block(text, mesh=mesh, steps_per_epoch=5)
+        assert blk["collective_ops"] == 0
+        assert blk["bytes_per_epoch"] == 0
+
+
+class TestMemoryAccounting:
+    def test_uneven_stock_axis_shows_imbalance(self):
+        from jax.sharding import Mesh
+
+        from factorvae_tpu.obs.memory import shard_balance_block
+
+        mesh = Mesh(np.array(jax.devices()[:3]).reshape(1, 3),
+                    ("data", "stock"))
+
+        class DS:
+            residency = "hbm"
+            values = jax.ShapeDtypeStruct((800, 16, 9), np.float32)
+            last_valid = jax.ShapeDtypeStruct((16, 800), np.int32)
+            next_valid = jax.ShapeDtypeStruct((16, 800), np.int32)
+
+        blk = shard_balance_block(mesh, dataset=DS())
+        panel = blk["panel"]
+        # 800 over 3 'stock' shards: 267/267/266 real rows — nonzero
+        # imbalance, total preserved
+        assert panel["imbalance_frac"] > 0
+        assert panel["bytes_per_device_max"] > panel["bytes_per_device_min"]
+        assert panel["total_bytes"] == (800 * 16 * 9 + 2 * 16 * 800) * 4
+        assert blk["mesh"] == {"data": 1, "stock": 3}
+
+    def test_replicated_state_is_balanced(self):
+        from jax.sharding import Mesh
+
+        from factorvae_tpu.obs.memory import shard_balance_block
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stock"))
+        state = {"step": jax.ShapeDtypeStruct((), np.int32),
+                 "rng": jax.ShapeDtypeStruct((2,), np.uint32),
+                 "params": {"w": jax.ShapeDtypeStruct((8, 8), np.float32)},
+                 "opt_state": {"mu": jax.ShapeDtypeStruct((8, 8),
+                                                          np.float32)}}
+        blk = shard_balance_block(mesh, state=state)
+        assert blk["state"]["imbalance_frac"] == 0.0
+        # replicated: every device holds the whole state
+        assert blk["state"]["bytes_per_device_max"] \
+            == blk["state"]["total_bytes"] // 4
+
+    def test_stacked_state_shards_seed_lanes(self):
+        from jax.sharding import Mesh
+
+        from factorvae_tpu.obs.memory import shard_balance_block
+
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stock"))
+        stacked = {"step": jax.ShapeDtypeStruct((2,), np.int32),
+                   "rng": jax.ShapeDtypeStruct((2, 2), np.uint32),
+                   "params": {"w": jax.ShapeDtypeStruct((2, 8, 8),
+                                                        np.float32)},
+                   "opt_state": {"mu": jax.ShapeDtypeStruct((2, 8, 8),
+                                                            np.float32)}}
+        blk = shard_balance_block(mesh, state=stacked, stacked=True)
+        # seed axis over 'data' (2-way): each device holds half the
+        # stacked params, replicated across 'stock'
+        w_bytes = 2 * 8 * 8 * 4
+        assert blk["state"]["bytes_per_device_max"] < 2 * w_bytes
+        assert blk["state"]["imbalance_frac"] == 0.0
+
+    def test_single_seed_fleet_mesh_bill_is_not_falsely_imbalanced(
+            self, ds, tmp_path):
+        """A 1-seed fleet on a data>1 mesh CARRIES the unstacked serial
+        state (replicated); the construction-time shard_balance record
+        must bill that, not a 1-long seed dim ceil-split over 'data'
+        (which would claim one device holds 0 bytes, imbalance 1.0 — a
+        maximal false alarm)."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1),
+                    ("data", "stock"))
+        p = tmp_path / "sb.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False)
+        FleetTrainer(obs_config(tmp_path / "m", ds), ds, seeds=[0],
+                     logger=lg, mesh=mesh)
+        lg.finish()
+        recs = [json.loads(l) for l in open(p).read().strip().splitlines()]
+        sb = [r for r in recs if r["event"] == "shard_balance"][0]
+        assert "error" not in sb, sb
+        assert sb["state"]["imbalance_frac"] == 0.0
+        assert sb["state"]["bytes_per_device_min"] \
+            == sb["state"]["bytes_per_device_max"] > 0
+
+    def test_watermark_noop_without_backend_stats(self, tmp_path):
+        """Host CPU exposes no allocator stats: watermark_event is a
+        no-op (False) with or without a timeline — never a crash."""
+        from factorvae_tpu.obs.memory import (
+            device_memory_stats,
+            watermark_event,
+        )
+
+        assert watermark_event(epoch=0) is False  # no timeline at all
+        lg = MetricsLogger(jsonl_path=str(tmp_path / "w.jsonl"),
+                           echo=False)
+        prev = install_timeline(Timeline(lg))
+        try:
+            fired = watermark_event(epoch=0)
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        stats = device_memory_stats()
+        assert fired is (stats is not None)
+
+
+class TestLedger:
+    def row(self, metric, value, rig_env="a", **kw):
+        return {"ts": 0.0, "metric": metric, "value": value,
+                "unit": "windows/sec/chip", "platform": "cpu",
+                "run_meta": {"device_count": 1,
+                             "env": {"jax_platforms": rig_env}}, **kw}
+
+    def write(self, tmp_path, rows, name="H.jsonl"):
+        p = tmp_path / name
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return str(p)
+
+    def test_steady_history_passes(self, tmp_path):
+        from factorvae_tpu.obs.ledger import check
+
+        p = self.write(tmp_path, [self.row("m", 100.0 + i)
+                                  for i in range(5)])
+        ok, rep = check(path=p)
+        assert ok and rep["metrics"][0]["status"] == "ok"
+
+    def test_2x_slower_row_regresses_nonzero_exit(self, tmp_path,
+                                                  capsys):
+        from factorvae_tpu.obs.ledger import check, main
+
+        rows = [self.row("m", 100.0) for _ in range(4)] \
+            + [self.row("m", 50.0)]
+        p = self.write(tmp_path, rows)
+        ok, rep = check(path=p)
+        assert not ok
+        assert rep["metrics"][0]["status"] == "REGRESSION"
+        assert rep["metrics"][0]["trailing_median"] == 100.0
+        assert main([p]) == 1  # the CI gate: nonzero on regression
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_improvement_is_not_a_regression(self, tmp_path):
+        from factorvae_tpu.obs.ledger import check
+
+        p = self.write(tmp_path, [self.row("m", 100.0)] * 3
+                       + [self.row("m", 250.0)])
+        ok, rep = check(path=p)
+        assert ok and rep["metrics"][0]["status"] == "improvement"
+
+    def test_cross_rig_rows_are_refused_not_compared(self, tmp_path):
+        """A 2x slowdown vs rows from a DIFFERENT rig must not flag:
+        the ledger refuses the comparison and says how many rows it
+        skipped (ISSUE 7 satellite: no false regressions across
+        JAX_PLATFORMS/XLA_FLAGS/device-count changes)."""
+        from factorvae_tpu.obs.ledger import check
+
+        rows = [self.row("m", 100.0, rig_env="tpu") for _ in range(4)] \
+            + [self.row("m", 50.0, rig_env="cpu")]
+        ok, rep = check(path=self.write(tmp_path, rows))
+        assert ok
+        e = rep["metrics"][0]
+        assert e["status"] == "no_comparable_history"
+        assert e["other_rig_skipped"] == 4
+
+    def test_append_row_skips_failures_and_zero(self, tmp_path):
+        from factorvae_tpu.obs.ledger import append_row, load_history
+
+        p = str(tmp_path / "h.jsonl")
+        assert append_row({"metric": "x_failed", "value": 1.0,
+                           "unit": "u"}, path=p) is None
+        assert append_row({"metric": "x", "value": 0.0, "unit": "u"},
+                          path=p) is None
+        assert append_row({"metric": "x", "value": 5.0, "unit": "u",
+                           "platform": "cpu"}, path=p) == p
+        rows = load_history(p)
+        assert len(rows) == 1 and rows[0]["metric"] == "x"
+        # fresh rows carry the rig environment the comparisons key on
+        assert "env" in rows[0]["run_meta"]
+
+    def test_backfill_from_artifacts_is_idempotent(self, tmp_path):
+        from factorvae_tpu.obs.ledger import backfill, load_history
+
+        # driver wrapper (the BENCH_r0N.json shape), a direct payload,
+        # and a no-payload artifact
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+            "n": 1, "rc": 1, "tail": "Traceback: boom"}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+            "n": 2, "rc": 0, "tail": 'x\n{"metric": "m", "value": 10.0, '
+                                     '"unit": "w/s", "platform": "tpu"}'}))
+        (tmp_path / "BENCH_direct.json").write_text(json.dumps({
+            "metric": "m2", "value": 7.0, "unit": "w/s",
+            "platform": "cpu"}))
+        p = str(tmp_path / "h.jsonl")
+        res = backfill(path=p, repo_root=str(tmp_path))
+        assert {a["metric"] for a in res["added"]} == {"m", "m2"}
+        assert "BENCH_r01.json" in res["skipped_artifacts"]
+        assert len(load_history(p)) == 2
+        res2 = backfill(path=p, repo_root=str(tmp_path))
+        assert res2["added"] == []  # idempotent
+        assert len(load_history(p)) == 2
+
+    def test_backfill_rows_never_become_latest(self, tmp_path):
+        """Running --backfill AFTER fresh --track rows exist appends
+        the artifact row at the file tail; the gate must still judge
+        the latest INSTRUMENTED row (a stale artifact must not demote
+        it to no_comparable_history and mask a real regression)."""
+        from factorvae_tpu.obs.ledger import check
+
+        fresh = [self.row("m", 100.0) for _ in range(4)] \
+            + [self.row("m", 50.0)]                 # a real regression
+        stale = {"ts": None, "metric": "m", "value": 100.0,
+                 "unit": "windows/sec/chip", "platform": "cpu",
+                 "run_meta": {"backfill_source": "BENCH_r08.json"}}
+        p = self.write(tmp_path, fresh + [stale])   # backfill ran last
+        ok, rep = check(path=p)
+        assert not ok
+        assert rep["metrics"][0]["status"] == "REGRESSION"
+
+    def test_missing_history_is_one_line_error(self, tmp_path, capsys):
+        from factorvae_tpu.obs.ledger import main
+
+        assert main([str(tmp_path / "nope.jsonl")]) == 2
+        out = capsys.readouterr().out
+        assert "error:" in out and "\n" == out[-1]
+
+    def test_repo_backfill_plus_check_passes(self, tmp_path):
+        """The committed artifacts seed a history the ledger passes on
+        — the acceptance demo, as a fixture-free contract."""
+        from factorvae_tpu.obs.ledger import backfill, check
+
+        p = str(tmp_path / "h.jsonl")
+        res = backfill(path=p, repo_root=REPO)
+        assert res["added"], "checked-in BENCH artifacts must yield rows"
+        ok, rep = check(path=p)
+        assert ok, rep
+
+
+class TestStreamSanityCLI:
+    """ISSUE 7 satellite: obs.timeline / obs.report exit with a ONE-LINE
+    error (never a traceback) on an empty, missing, or non-JSONL
+    stream; a trailing torn line is a warning, not fatal."""
+
+    def mains(self):
+        from factorvae_tpu.obs.report import main as report_main
+        from factorvae_tpu.obs.timeline import main as timeline_main
+
+        return [timeline_main, report_main]
+
+    def test_missing_file(self, tmp_path, capsys):
+        for m in self.mains():
+            assert m([str(tmp_path / "gone.jsonl")]) == 2
+            err = capsys.readouterr().err
+            assert err.startswith("error:") and err.count("\n") == 1
+            assert "Traceback" not in err
+
+    def test_empty_file(self, tmp_path, capsys):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        for m in self.mains():
+            assert m([str(p)]) == 2
+            err = capsys.readouterr().err
+            assert "empty" in err and err.startswith("error:")
+
+    def test_non_jsonl_file(self, tmp_path, capsys):
+        p = tmp_path / "notes.txt"
+        p.write_text("this is not\na metric stream\n")
+        for m in self.mains():
+            assert m([str(p)]) == 2
+            err = capsys.readouterr().err
+            assert "not a JSONL" in err
+
+    def test_binary_file_is_one_line_error_not_decode_traceback(
+            self, tmp_path, capsys):
+        p = tmp_path / "bin.jsonl"
+        p.write_bytes(b"\x80\x81\x82 not text \xff\n\x00\x01\n")
+        for m in self.mains():
+            assert m([str(p)]) == 2
+            err = capsys.readouterr().err
+            assert "not a JSONL" in err and "Traceback" not in err
+
+    def test_trailing_torn_line_warns_not_fatal(self, tmp_path, capsys):
+        recs = [{"event": "run_meta"}, epoch(0), epoch(1),
+                {"event": "span", "name": "train_epoch_0",
+                 "resource": "device", "t0": 0.0, "t1": 1.0, "dur": 1.0}]
+        p = tmp_path / "torn.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in recs)
+                     + '\n{"event": "epo')  # killed mid-write
+        for m in self.mains():
+            assert m([str(p)]) == 0
+            cap = capsys.readouterr()
+            assert "trailing partial line skipped" in cap.err
+            assert "error:" not in cap.err
+
+
+class TestProgramFlags:
+    def run_dict(self, records):
+        import tempfile
+
+        from factorvae_tpu.obs.timeline import load_run
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "r.jsonl")
+            with open(p, "w") as fh:
+                fh.write("\n".join(json.dumps(r) for r in records))
+            return load_run(p)
+
+    def compile_rec(self, fn="train_epoch", wall=1.0, peak=None):
+        return {"event": "compile", "fn": fn, "wall_s": wall,
+                "compiles": 1, "lower_s": 0.1, "compile_s": 0.5,
+                "flops": 100.0, "peak_bytes": peak}
+
+    def test_compile_storm_flag_carries_cost(self):
+        from factorvae_tpu.obs.report import build_report
+
+        recs = [self.compile_rec(wall=2.0), self.compile_rec(wall=3.0),
+                {"event": "mark", "name": "retrace_storm",
+                 "fn": "train_epoch", "compiles": 5, "calls": 6},
+                epoch(0)]
+        rep = build_report(self.run_dict(recs))
+        storm = [f for f in rep["flags"] if f["flag"] == "compile_storm"]
+        assert len(storm) == 1
+        assert "5.00s of compile wall" in storm[0]["detail"]
+        assert rep["compiles"]["records"] == 2
+        assert rep["compiles"]["total_wall_s"] == 5.0
+
+    def test_hbm_over_budget_vs_plan_row(self):
+        from factorvae_tpu.obs.report import build_report
+
+        plan = {"event": "plan", "provenance": "measured",
+                "source": "row", "budget_peak_hbm_bytes": 1000,
+                "budget_compile_s": 10.0}
+        recs = [plan, self.compile_rec(peak=5000.0), epoch(0)]
+        rep = build_report(self.run_dict(recs))
+        flags = {f["flag"] for f in rep["flags"]}
+        assert "hbm_over_budget" in flags
+        assert "compile_over_budget" not in flags  # wall 1.0 < 10.0
+
+    def test_compile_over_budget_flag(self):
+        from factorvae_tpu.obs.report import build_report
+
+        plan = {"event": "plan", "budget_compile_s": 0.5,
+                "budget_peak_hbm_bytes": 0}
+        recs = [plan, self.compile_rec(wall=2.0, peak=5000.0), epoch(0)]
+        rep = build_report(self.run_dict(recs))
+        flags = {f["flag"] for f in rep["flags"]}
+        assert "compile_over_budget" in flags
+        # 0 budget = no envelope: never an HBM flag
+        assert "hbm_over_budget" not in flags
+
+    def test_budgets_do_not_govern_earlier_records(self):
+        """A plan logged AFTER a compile record must not judge it: the
+        governing plan is the last one BEFORE the record (record order
+        via _line) — the same rule the throughput envelope follows."""
+        from factorvae_tpu.obs.report import build_report
+
+        plan = {"event": "plan", "budget_peak_hbm_bytes": 1000}
+        recs = [self.compile_rec(peak=5000.0), plan, epoch(0)]
+        rep = build_report(self.run_dict(recs))
+        assert not any(f["flag"] == "hbm_over_budget"
+                       for f in rep["flags"])
+
+    def test_no_budgets_no_flags(self):
+        from factorvae_tpu.obs.report import build_report
+
+        plan = {"event": "plan", "provenance": "measured",
+                "source": "pre-ISSUE-7 row"}
+        recs = [plan, self.compile_rec(wall=100.0, peak=1e12), epoch(0)]
+        rep = build_report(self.run_dict(recs))
+        assert not any(f["flag"].endswith("over_budget")
+                       for f in rep["flags"])
+
+
+class TestPlanBudgets:
+    ROW = dict(TestPlanObsKnob.ROW,
+               budgets={"compile_seconds": 12.5,
+                        "peak_hbm_bytes": 2 * 10**9,
+                        "comm_bytes_per_epoch": 3 * 10**6})
+
+    def test_budgets_block_resolves(self):
+        from factorvae_tpu.plan import plan_for
+
+        p = plan_for(TestPlanObsKnob().shape(), platform="cpu",
+                     table=[self.ROW])
+        assert p.budget_compile_s == 12.5
+        assert p.budget_peak_hbm_bytes == 2 * 10**9
+        assert p.budget_comm_bytes_per_epoch == 3 * 10**6
+        # describe() carries them into the RUN.jsonl plan record the
+        # report's budget flags read
+        assert p.describe()["budget_peak_hbm_bytes"] == 2 * 10**9
+
+    def test_pre_issue7_rows_have_no_envelope(self):
+        from factorvae_tpu.plan import plan_for
+
+        row = {k: v for k, v in self.ROW.items() if k != "budgets"}
+        p = plan_for(TestPlanObsKnob().shape(), platform="cpu",
+                     table=[row])
+        assert p.budget_compile_s == 0.0
+        assert p.budget_peak_hbm_bytes == 0
+        assert p.budget_comm_bytes_per_epoch == 0
+
+
+class TestEndToEndCompileRecords:
+    def test_training_run_emits_compile_records_for_every_jit(
+            self, ds, tmp_path):
+        """The acceptance demo's contract, in-process: a --obs-style
+        run yields `compile` records with nonnull wall_s for every
+        trainer jit that compiled."""
+        run_jsonl = str(tmp_path / "RUN.jsonl")
+        lg = MetricsLogger(jsonl_path=run_jsonl, echo=False)
+        prev = install_timeline(Timeline(lg))
+        try:
+            cfg = obs_config(tmp_path / "m", ds, obs=True)
+            Trainer(cfg, ds, logger=lg).fit()
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        from factorvae_tpu.obs.timeline import load_run
+
+        run = load_run(run_jsonl)
+        comp = [r for r in run["events"] if r.get("event") == "compile"]
+        fns = {r["fn"] for r in comp}
+        assert {"train_epoch", "eval_epoch"} <= fns
+        assert all(r["wall_s"] is not None and r["wall_s"] > 0
+                   for r in comp)
+        # one compile span per record, same stream
+        spans = {s["name"] for s in run["spans"]
+                 if s["resource"] == "compile"}
+        assert {f"jit_compile:{f}" for f in fns} <= spans
