@@ -50,6 +50,15 @@ factorvae_tpu.obs.ledger` then checks the latest row per metric against
 its trailing same-rig median (obs/ledger.py — regression gate, rig
 refusal, backfill from the checked-in artifacts).
 
+Serve mode (`python bench.py --serve`, or BENCH_SERVE=1 with
+BENCH_SERVE_REQUESTS / BENCH_SERVE_MODELS): the served-latency bench
+(ISSUE 8) — stand up the scoring service (serve/: model registry +
+daemon) over the flagship-shape synthetic panel with N distinct model
+variants, and report cold-vs-warm request walls, warm p50/p99 latency,
+QPS, and the fused multi-model dispatch, with zero `compile` records
+on the warm path proven from the daemon's own RUN stream
+(BENCH_SERVE.json). Same robustness contract.
+
 Stream mode (`python bench.py --stream`, or BENCH_STREAM=1 with
 BENCH_STREAM_CHUNK=n): A/B the panel residency — HBM-resident
 whole-epoch scan vs the out-of-core stream path (data/stream.py,
@@ -164,6 +173,21 @@ MESH_SEED_COUNTS = tuple(
     if s.strip())
 MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", 0))
 MESH_RESIDENCY = os.environ.get("BENCH_MESH_RESIDENCY", "hbm")
+# Serve mode (`python bench.py --serve` or BENCH_SERVE=1): the
+# served-latency bench (ISSUE 8). Stand up the scoring service
+# in-process — a ModelRegistry holding BENCH_SERVE_MODELS distinct
+# model variants (different train seeds -> different config hashes) and
+# a ScoringDaemon over the flagship-shape synthetic panel — then
+# measure the request path: cold first-request wall per model (the
+# lazy compile), warm per-request p50/p99 latency and QPS over
+# BENCH_SERVE_REQUESTS single-day requests, and one fused multi-model
+# tick (all models, one `predict_panel_fleet` dispatch). The daemon's
+# RUN stream is scanned for `compile` records after warmup — the
+# warm path must show ZERO per-request compiles — and the payload
+# lands in BENCH_SERVE.json. Same robustness contract.
+USE_SERVE = os.environ.get("BENCH_SERVE", "0") == "1"
+SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 100))
+SERVE_MODELS = int(os.environ.get("BENCH_SERVE_MODELS", 2))
 # Track mode (`--track` or BENCH_TRACK=1): append the emitted headline
 # row to BENCH_HISTORY.jsonl (obs/ledger.py) so every bench run extends
 # the longitudinal perf trajectory instead of producing a one-off
@@ -279,6 +303,8 @@ def fail_metric() -> str:
         return "obs_train_throughput_failed"
     if USE_MESH or os.environ.get("BENCH_MESH", "0") == "1":
         return "mesh_train_throughput_failed"
+    if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
+        return "serve_qps_failed"
     return "train_throughput_flagship_K96_H64_Alpha158_failed"
 
 
@@ -287,6 +313,8 @@ def fail_unit() -> str:
     the longitudinal series never mixes units across records."""
     fleet = (USE_FLEET or os.environ.get("BENCH_FLEET", "0") == "1"
              or USE_MESH or os.environ.get("BENCH_MESH", "0") == "1")
+    if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
+        return "req/sec"
     return "windows/sec*seed" if fleet else "windows/sec/chip"
 
 
@@ -782,6 +810,164 @@ def run_obs_bench() -> dict:
     }
 
 
+def run_serve_bench() -> dict:
+    """Served-latency bench (BENCH_SERVE): cold-vs-warm request walls,
+    warm p50/p99 latency + QPS through the scoring daemon's request
+    path, and the fused multi-model dispatch — with the daemon's own
+    RUN stream proving the warm path compiles nothing (zero `compile`
+    records after warmup). One JSON line, same terminal contract;
+    `value` is the warm single-request QPS. The full payload also lands
+    in BENCH_SERVE.json."""
+    import dataclasses
+    import tempfile
+
+    import numpy as np
+
+    from factorvae_tpu import plan as planlib
+
+    # A FRESH per-invocation cache dir, never the shared /tmp cache the
+    # other bench modes warm for speed: cold_ms is a headline number,
+    # and a pre-warmed persistent cache would silently turn the
+    # measured "cold compile wall" into disk deserialization.
+    planlib.setup_compilation_cache(
+        tempfile.mkdtemp(prefix="bench_serve_cache_"))
+
+    from factorvae_tpu.models.factorvae import load_model
+    from factorvae_tpu.serve.daemon import ScoringDaemon
+    from factorvae_tpu.serve.registry import ModelRegistry
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
+
+    platform, _ = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    cfg, ds = bench_setup(knobs)
+    days = ds.split_days(None, None)
+
+    run_path = os.path.join(tempfile.mkdtemp(prefix="bench_serve_"),
+                            "RUN.jsonl")
+
+    def count_compiles() -> int:
+        try:
+            with open(run_path) as fh:
+                return sum(1 for line in fh
+                           if '"event": "compile"' in line
+                           or '"event": "compile_cached"' in line)
+        except OSError:
+            return 0
+
+    registry = ModelRegistry()
+    aliases = []
+    with MetricsLogger(jsonl_path=run_path, echo=False,
+                       run_name="bench_serve") as logger:
+        prev_tl = install_timeline(Timeline(logger))
+        try:
+            for i in range(SERVE_MODELS):
+                cfg_i = dataclasses.replace(
+                    cfg, train=dataclasses.replace(cfg.train, seed=i))
+                _, params = load_model(cfg_i, n_max=ds.n_max)
+                registry.register_params(params, cfg_i,
+                                         n_stocks=N_STOCKS,
+                                         alias=f"m{i}")
+                aliases.append(f"m{i}")
+            daemon = ScoringDaemon(registry, ds, stochastic=False)
+
+            # Cold start: the first request per model pays the lazy
+            # compile (amortized across SAME-shape models by the shared
+            # jit factory — m1's "cold" wall shows the amortization).
+            cold_ms = {}
+            for i, alias in enumerate(aliases):
+                t0 = time.perf_counter()
+                resp = daemon.handle({"model": alias,
+                                      "day": int(days[i % len(days)])})
+                assert resp["ok"], resp
+                cold_ms[alias] = round(
+                    (time.perf_counter() - t0) * 1e3, 3)
+            compiles_cold = count_compiles()
+
+            # Warm single-request loop: p50/p99/QPS.
+            lat_ms = []
+            t_loop = time.perf_counter()
+            for r in range(SERVE_REQUESTS):
+                req = {"model": aliases[r % len(aliases)],
+                       "day": int(days[r % len(days)])}
+                t0 = time.perf_counter()
+                resp = daemon.handle(req)
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+                assert resp["ok"], resp
+            warm_wall = time.perf_counter() - t_loop
+            compiles_warm = count_compiles() - compiles_cold
+
+            # One fused tick: every model variant, one day, one
+            # seed-batched dispatch (the "millions of users" lever).
+            tick = [{"id": i, "model": a, "day": int(days[0])}
+                    for i, a in enumerate(aliases)]
+            t0 = time.perf_counter()
+            fused = daemon.handle_batch(tick)
+            fused_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            fused_models = fused[0]["batched_with"] if fused else 0
+            compiles_fused = count_compiles() - compiles_cold \
+                - compiles_warm
+            stats = daemon.stats()
+        finally:
+            install_timeline(prev_tl)
+
+    qps = SERVE_REQUESTS / warm_wall
+    precision = stats["registry"]["entries"][0]["precision"] \
+        if stats["registry"]["entries"] else "float32"
+    payload = {
+        "metric": (
+            f"serve_qps_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+            f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}"
+            f"_models{SERVE_MODELS}"
+            + ("" if precision == "float32" else f"_{precision}")
+            + ("_cpu_fallback" if FORCED_CPU else "")
+            # The loud failure PERF.md promises: a warm path that
+            # compiled is a broken contract, and the *_failed suffix
+            # keeps the row out of the ledger (never tracked as a
+            # plausible-but-degraded QPS).
+            + ("" if compiles_warm == 0 else "_warm_compiles_failed")),
+        "value": round(qps, 2),
+        "unit": "req/sec",
+        # One request scores one day's cross-section: N_STOCKS windows.
+        "vs_baseline": round(
+            qps * N_STOCKS / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        "models": SERVE_MODELS,
+        "requests": SERVE_REQUESTS,
+        "precision": precision,
+        "latency_ms": {
+            "p50": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99": round(float(np.percentile(lat_ms, 99)), 3),
+            "mean": round(float(np.mean(lat_ms)), 3),
+        },
+        "windows_per_sec": round(qps * N_STOCKS, 1),
+        "cold_ms": cold_ms,
+        # compile wall the warm path does NOT pay: cold records minus
+        # warm records is the whole point of the registry.
+        "compile_records_cold": compiles_cold,
+        "compile_records_warm": compiles_warm,
+        "compile_records_fused": compiles_fused,
+        "warm_path_compiles_zero": compiles_warm == 0,
+        "fused_tick_ms": fused_ms,
+        "fused_models": fused_models,
+        "registry": {k: v for k, v in stats["registry"].items()
+                     if k != "entries"},
+        "plan": plan_block,
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SERVE.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return payload
+
+
 def _annotate_cell_program(cell: dict, trainer, mesh, state, s: int,
                            comm_budget: int = 0) -> None:
     """Attach the compiled-program bill to one executed mesh cell
@@ -964,7 +1150,8 @@ def run_mesh_bench() -> dict:
 def bench_payload() -> dict:
     """Fleet mode (--fleet / BENCH_FLEET=1), stream-residency A/B
     (--stream / BENCH_STREAM=1), probe-overhead A/B (--obs /
-    BENCH_OBS=1), composed mesh grid (--mesh / BENCH_MESH=1), or the
+    BENCH_OBS=1), composed mesh grid (--mesh / BENCH_MESH=1),
+    served-latency bench (--serve / BENCH_SERVE=1), or the
     single-model headline. The payload carries the MEASURING process's
     `run_meta` (git sha + backend env): the forced-CPU fallback and the
     accel child run under a different platform pin than the driver
@@ -979,6 +1166,8 @@ def bench_payload() -> dict:
         payload = run_obs_bench()
     elif USE_MESH:
         payload = run_mesh_bench()
+    elif USE_SERVE:
+        payload = run_serve_bench()
     else:
         payload = run_bench()
     try:
@@ -1133,7 +1322,7 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
-    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_TRACK
+    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_SERVE, USE_TRACK
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -1151,6 +1340,9 @@ def main() -> None:
     if "--mesh" in sys.argv:
         USE_MESH = True
         os.environ["BENCH_MESH"] = "1"
+    if "--serve" in sys.argv:
+        USE_SERVE = True
+        os.environ["BENCH_SERVE"] = "1"
 
     if ACCEL_CHILD:
         # Child: backend already validated by the parent's probe; any crash

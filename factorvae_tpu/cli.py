@@ -142,6 +142,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "the float path. Also applies to --export "
                         "(int8-baked serving artifact)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--compile_cache", type=str, default=None,
+                   metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(plan.setup_compilation_cache): repeat runs — "
+                        "and the scoring daemon's restarts — reuse "
+                        "compiled programs from disk instead of paying "
+                        "the compile wall again. Default: "
+                        "$FACTORVAE_COMPILE_CACHE if set, else off; "
+                        "pass 'off' to disable explicitly")
     p.add_argument("--obs", action=argparse.BooleanOptionalAction,
                    default=None,
                    help="run observatory (factorvae_tpu/obs): compile the "
@@ -331,6 +340,13 @@ def main(argv=None) -> int:
 
     maybe_initialize()
 
+    # Persistent XLA compilation cache (ISSUE 8): flag > env > off.
+    # Configured before any jit so the epoch/scoring programs of this
+    # run land in (or load from) the cache.
+    from factorvae_tpu import plan as planlib
+
+    compile_cache_dir = planlib.setup_compilation_cache(args.compile_cache)
+
     from factorvae_tpu.data import PanelDataset, build_panel, load_frame
     from factorvae_tpu.train import Trainer, load_params
     from factorvae_tpu.utils.logging import (
@@ -359,6 +375,8 @@ def main(argv=None) -> int:
     # (the close-on-error contract MetricsLogger now carries).
     try:
         logger.log("config", **{"json": cfg.to_json()})
+        if compile_cache_dir:
+            logger.log("compile_cache", dir=compile_cache_dir)
         if args.obs:
             logger.log("obs", probes=cfg.train.obs_probes,
                        run_jsonl=metrics_path)

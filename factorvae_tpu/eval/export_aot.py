@@ -11,6 +11,17 @@ Artifacts are platform-tagged: exporting under a TPU backend produces a
 TPU-servable function; pass `platforms=("tpu",)` to cross-export from a
 CPU host.
 
+Since ISSUE 8 every artifact opens with a validated header — one magic
+line plus a JSON record carrying the CONFIG HASH (the canonical
+`utils.logging.config_hash` of the full Config; the key the serving
+model registry admits artifacts under, serve/registry.py), the
+exporting jax version, and the call-shape facts a server needs before
+deserializing (n_max, seq_len/features, stochastic/int8, platforms).
+`load_exported` validates the header and fails with a ONE-LINE
+actionable error on a mismatch — a stale artifact must say "re-export
+me", not die in a StableHLO deserialization traceback three layers
+down. Pre-ISSUE-8 headerless blobs still load (header None).
+
 AOT cache behavior: the traceable core (`_predict_fn`) is hoisted and
 lru_cached on the frozen ModelConfig, consistent with the scoring
 path's jit factories (eval/predict.py) — but the jit+trace itself runs
@@ -26,13 +37,26 @@ alias the (D, N) f32 output anyway (x differs in shape, mask in dtype).
 from __future__ import annotations
 
 import functools
+import json
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from factorvae_tpu.config import Config, ModelConfig
+from factorvae_tpu.utils.logging import config_hash
 from factorvae_tpu.models.factorvae import day_prediction
+
+# Artifact container format: MAGIC + b"\n" + header-JSON + b"\n" + the
+# serialized jax.export payload. The magic is versioned separately from
+# the header's "format" field so a future container change can be told
+# apart from a future header-schema change.
+ARTIFACT_MAGIC = b"FVAE-AOT1"
+
+
+class ArtifactError(ValueError):
+    """An AOT artifact failed header validation — the message is the
+    one-line actionable contract (what mismatched, what to do)."""
 
 
 @functools.lru_cache(maxsize=8)
@@ -94,12 +118,101 @@ def export_prediction(
         exp = jexport.export(fn, platforms=tuple(platforms))(*args)
     else:
         exp = jexport.export(fn)(*args)
-    return bytes(exp.serialize())
+    header = {
+        "format": "factorvae-aot/1",
+        # The identity the serving registry keys on (one hash function
+        # repo-wide: utils/logging.config_hash — the same digest the
+        # run_meta headers and checkpoint metadata produce).
+        "config_hash": config_hash(config.to_dict()),
+        "jax": jax.__version__,
+        "n_max": int(n_max),
+        "seq_len": int(cfg.seq_len),
+        "num_features": int(cfg.num_features),
+        "stochastic": bool(stochastic),
+        "int8": bool(int8),
+        "platforms": list(platforms) if platforms is not None else None,
+    }
+    return (ARTIFACT_MAGIC + b"\n" + json.dumps(
+        header, sort_keys=True).encode() + b"\n" + bytes(exp.serialize()))
 
 
-def load_exported(blob: bytes):
-    """Deserialize an exported prediction artifact; returns an object with
-    `.call(x, mask)`."""
+def read_artifact_header(blob: bytes) -> Optional[dict]:
+    """The artifact's header dict, or None for a pre-ISSUE-8 headerless
+    blob. A blob that CLAIMS the magic but carries an unparseable
+    header is corrupt — ArtifactError, not a silent legacy fallback."""
+    if not blob.startswith(ARTIFACT_MAGIC + b"\n"):
+        return None
+    rest = blob[len(ARTIFACT_MAGIC) + 1:]
+    line, sep, _ = rest.partition(b"\n")
+    try:
+        if not sep:
+            raise ValueError("missing payload")
+        header = json.loads(line.decode())
+        if not isinstance(header, dict):
+            raise ValueError("header is not an object")
+    except ValueError as e:
+        raise ArtifactError(
+            f"AOT artifact header is corrupt ({e}); re-export with "
+            f"eval/export_aot.export_prediction or cli.py --export"
+        ) from None
+    return header
+
+
+class LoadedArtifact:
+    """A deserialized serving artifact: `.call(x, mask) -> (D, N)
+    scores` plus the validated `.header` (None on legacy blobs)."""
+
+    def __init__(self, exported, header: Optional[dict]):
+        self._exported = exported
+        self.header = header
+
+    @property
+    def call(self):
+        return self._exported.call
+
+    def __getattr__(self, attr):
+        return getattr(self._exported, attr)
+
+
+def load_exported(blob: bytes, expect_config_hash: Optional[str] = None,
+                  check_jax: bool = True) -> LoadedArtifact:
+    """Deserialize an exported prediction artifact; returns an object
+    with `.call(x, mask)` and `.header`.
+
+    Header validation happens BEFORE deserialization: a config-hash
+    mismatch (the caller knows which model it expects —
+    `expect_config_hash`, the registry admission path) or a jax-version
+    skew fails with a one-line error naming the fix, instead of the
+    StableHLO deserializer's traceback. `check_jax=False` opts out of
+    the version gate for consumers that accept cross-version artifacts.
+    Pre-ISSUE-8 headerless blobs load with `header=None` (nothing to
+    validate)."""
     from jax import export as jexport
 
-    return jexport.deserialize(blob)
+    header = read_artifact_header(blob)
+    payload = blob
+    if header is not None:
+        payload = blob.split(b"\n", 2)[2]
+        if (expect_config_hash is not None
+                and header.get("config_hash") != expect_config_hash):
+            raise ArtifactError(
+                f"AOT artifact is for config {header.get('config_hash')}, "
+                f"expected {expect_config_hash}; re-export from the "
+                f"matching checkpoint (cli.py --export)")
+        import jax
+
+        if check_jax and header.get("jax") != jax.__version__:
+            raise ArtifactError(
+                f"AOT artifact was exported under jax "
+                f"{header.get('jax')} but this runtime is "
+                f"{jax.__version__}; re-export with eval/export_aot "
+                f"(or pass check_jax=False to accept the skew)")
+    try:
+        exported = jexport.deserialize(payload)
+    except Exception as e:
+        raise ArtifactError(
+            f"AOT artifact failed to deserialize "
+            f"({type(e).__name__}: {e}); the file is not a "
+            f"factorvae_tpu export or is truncated — re-export with "
+            f"cli.py --export") from None
+    return LoadedArtifact(exported, header)

@@ -110,15 +110,26 @@ def _score_chunk_fleet_fn(
     model_cfg: ModelConfig,
     seq_len: int,
     stochastic: Optional[bool],
+    int8: bool = False,
 ):
     """Seed-batched single-chunk scorer for STREAM-resident datasets:
     S stacked param trees x one prefetched mini-panel chunk, panel and
-    key broadcast — the per-chunk twin of `_score_scan_fleet_fn`."""
+    key broadcast — the per-chunk twin of `_score_scan_fleet_fn`.
+    `int8=True` takes stacked QTensor trees (a seed axis on q and s
+    alike) and dequantizes inside the compiled program, like the serial
+    scorer — the multi-model serving dispatch (serve/daemon.py) buckets
+    int8 registry entries through this path."""
     chunk_scores = _make_chunk_scorer(model_cfg, seq_len, stochastic)
+    compute_dtype = model_cfg.dtype
 
     @jax.jit
     def score_chunk_fleet(stacked_p, values, last_valid, next_valid,
                           day_idx, key):
+        if int8:
+            from factorvae_tpu.ops.quant import dequantize_params
+
+            stacked_p = dequantize_params(stacked_p, compute_dtype)
+
         def one_seed(p):
             return chunk_scores(p, values, last_valid, next_valid,
                                 day_idx, key)
@@ -168,7 +179,7 @@ def _predict_stream(params, config, dataset, days, stochastic, seed,
     if stacked:
         lead = (int(jax.tree.leaves(params)[0].shape[0]),)
         score_chunk = _score_chunk_fleet_fn(
-            config.model, config.data.seq_len, stochastic)
+            config.model, config.data.seq_len, stochastic, int8)
     else:
         score_chunk = _score_chunk_fn(
             config.model, config.data.seq_len, stochastic, int8)
@@ -210,6 +221,7 @@ def _score_scan_fleet_fn(
     model_cfg: ModelConfig,
     seq_len: int,
     stochastic: Optional[bool],
+    int8: bool = False,
 ):
     """Seed-batched whole-pass scorer (train/fleet.py counterpart): S
     stacked param trees x ONE day-chunk scan -> (S, n_chunks, chunk,
@@ -217,12 +229,20 @@ def _score_scan_fleet_fn(
     buffer are broadcast (in_axes=None) — every seed scores the same
     days with the same RNG stream, exactly what `seed_sweep` does
     serially — so HBM holds one panel copy while every matmul in the
-    scan body gains an S-fold leading batch axis."""
+    scan body gains an S-fold leading batch axis. `int8=True` takes
+    stacked QTensor trees and dequantizes in-program (the serving
+    dispatch's int8 bucket; serial scorers already do the same)."""
     chunk_scores = _make_chunk_scorer(model_cfg, seq_len, stochastic)
+    compute_dtype = model_cfg.dtype
 
     @jax.jit
     def score_scan_fleet(stacked_p, values, last_valid, next_valid,
                          day_idx, keys):
+        if int8:
+            from factorvae_tpu.ops.quant import dequantize_params
+
+            stacked_p = dequantize_params(stacked_p, compute_dtype)
+
         def one_seed(p):
             def body(carry, inp):
                 days, key = inp
@@ -329,9 +349,11 @@ def predict_panel(
     if impl not in ("scan", "chunk_loop"):
         raise ValueError(f"impl must be 'scan' or 'chunk_loop'; got {impl!r}")
     if int8:
-        from factorvae_tpu.ops.quant import quantize_params
+        # Idempotent: a warm serving registry entry arrives pre-quantized
+        # (one quantization at admission, not one per request).
+        from factorvae_tpu.ops.quant import ensure_quantized
 
-        params = quantize_params(params)
+        params = ensure_quantized(params)
 
     n_days = len(days)
     if getattr(dataset, "residency", "hbm") == "stream":
@@ -383,6 +405,7 @@ def predict_panel_fleet(
     chunk: int = 32,
     num_seeds: Optional[int] = None,
     mesh=None,
+    int8: bool = False,
 ) -> np.ndarray:
     """(S, len(days), N_max) scores for S stacked param trees (leading
     seed axis on every leaf, as train/fleet.py produces) in ONE
@@ -391,7 +414,13 @@ def predict_panel_fleet(
     batched-dot reassociation would break the oracle), f32-close at S>1
     (pinned by tests/test_fleet.py). `seed` is the SCORING seed (the
     RNG stream of the stochastic path), shared across the fleet like
-    the serial sweep shares it across solo runs."""
+    the serial sweep shares it across solo runs. `int8=True` expects
+    stacked QTensor trees (or quantizes dense ones) and dequantizes
+    in-program — the serving dispatch's int8 bucket."""
+    if int8:
+        from factorvae_tpu.ops.quant import ensure_quantized
+
+        stacked_params = ensure_quantized(stacked_params)
     s = num_seeds
     if s is None:
         leaf = jax.tree.leaves(stacked_params)[0]
@@ -399,20 +428,20 @@ def predict_panel_fleet(
     if s == 1:
         one = jax.tree.map(lambda x: x[0], stacked_params)
         return predict_panel(one, config, dataset, days, stochastic, seed,
-                             chunk=chunk, mesh=mesh)[None]
+                             chunk=chunk, mesh=mesh, int8=int8)[None]
 
     n_days = len(days)
     if n_days == 0:
         return np.full((s, 0, dataset.n_max), np.nan, np.float32)
     if getattr(dataset, "residency", "hbm") == "stream":
         return _predict_stream(stacked_params, config, dataset, days,
-                               stochastic, seed, chunk, stacked=True,
-                               mesh=mesh)
+                               stochastic, seed, chunk, int8=int8,
+                               stacked=True, mesh=mesh)
     base = jax.random.PRNGKey(seed)
     day_idx, keys = _scan_inputs(
         days, chunk, base, _deterministic(config.model, stochastic))
     score_scan = _score_scan_fleet_fn(
-        config.model, config.data.seq_len, stochastic)
+        config.model, config.data.seq_len, stochastic, int8)
     scores = score_scan(stacked_params, dataset.values, dataset.last_valid,
                         dataset.next_valid, day_idx, keys)
     out = np.asarray(scores, dtype=np.float32).reshape(

@@ -71,6 +71,48 @@ def capture_disabled():
         _CAPTURE = prev
 
 
+# ---------------------------------------------------------------------------
+# Persistent-compilation-cache classification (ISSUE 8). A pjit cache
+# miss in a FRESH process is not necessarily an XLA compile: with a
+# persistent compilation cache (plan.setup_compilation_cache) the
+# executable may be deserialized from disk. jax announces that through
+# its monitoring events; a listener counts them so WatchedJit can emit
+# `compile_cached` instead of `compile` for disk-served builds — the
+# warm-restart contract a scoring daemon is judged on (zero `compile`
+# records on the second process, tests/test_serve.py). OPT-IN
+# (`track_persistent_cache()`): default-path consumers (tests that
+# count `compile` records, training runs sharing the test rig's cache)
+# keep the pre-ISSUE-8 event taxonomy unless a serving/bench path asks.
+# ---------------------------------------------------------------------------
+
+_PCACHE = {"hits": 0, "misses": 0}
+_PCACHE_CLASSIFY = False
+
+
+def _pcache_listener(event: str, **kwargs) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        _PCACHE["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        _PCACHE["misses"] += 1
+
+
+def track_persistent_cache() -> bool:
+    """Enable persistent-cache classification of compile records for
+    this process. Returns True when the jax monitoring hook is
+    available (idempotent); False leaves the taxonomy unchanged."""
+    global _PCACHE_CLASSIFY
+    if _PCACHE_CLASSIFY:
+        return True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_listener(_pcache_listener)
+    except Exception:
+        return False
+    _PCACHE_CLASSIFY = True
+    return True
+
+
 class WatchedJit:
     def __init__(self, fn: Callable, name: str,
                  storm_threshold: int = STORM_THRESHOLD):
@@ -106,6 +148,7 @@ class WatchedJit:
         from factorvae_tpu.obs import compile as compilelib
 
         before = self._cache_size()
+        pc0 = dict(_PCACHE)
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
         t1 = time.perf_counter()
@@ -130,6 +173,19 @@ class WatchedJit:
             # to one extra compile per watched jit per process. The
             # abstract snapshot happens AFTER the call: shape/dtype
             # metadata survives donation (only the buffer is deleted).
+            # With classification on (serving/bench paths), a miss
+            # whose executable came off the persistent disk cache —
+            # the in-call window saw cache_hits grow and no fresh
+            # cache_misses — records as `compile_cached`: the process
+            # built nothing, it deserialized. Everything else stays a
+            # `compile` record exactly as before. Judged BEFORE the
+            # capture replay below, whose second XLA compile would
+            # pollute the counter window.
+            event = "compile"
+            if (_PCACHE_CLASSIFY
+                    and _PCACHE["hits"] > pc0["hits"]
+                    and _PCACHE["misses"] == pc0["misses"]):
+                event = "compile_cached"
             cap = {}
             if self.compiles == 1 and _CAPTURE:
                 try:
@@ -140,7 +196,7 @@ class WatchedJit:
                     cap = {}
             self.last_compile = dict(cap, fn=self.name, wall_s=wall,
                                      compiles=self.compiles)
-            tl.logger.log("compile", _echo=False, **self.last_compile)
+            tl.logger.log(event, _echo=False, **self.last_compile)
             if self.compiles > self.storm_threshold:
                 tl.event(
                     "retrace_storm", cat="compile", resource="compile",

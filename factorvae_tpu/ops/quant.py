@@ -109,6 +109,25 @@ def quantize_params(params, min_size: int = 256):
     )
 
 
+def is_quantized(tree) -> bool:
+    """True when the tree already holds QTensor leaves — a
+    quantize_params output. The serving registry (serve/registry.py)
+    quantizes each int8 entry ONCE at admission; the scoring entry
+    points use this to skip a per-request re-quantization pass."""
+    return any(
+        isinstance(leaf, QTensor)
+        for leaf in jax.tree_util.tree_leaves(
+            tree, is_leaf=lambda x: isinstance(x, QTensor)))
+
+
+def ensure_quantized(params, min_size: int = 256):
+    """quantize_params, idempotently: an already-quantized tree passes
+    through untouched (double-quantizing a QTensor tree would wrap the
+    scales themselves)."""
+    return params if is_quantized(params) else quantize_params(
+        params, min_size)
+
+
 def dequantize_params(qparams, dtype=jnp.float32):
     """Rebuild a dense float tree from a quantize_params output. Safe to
     call inside jit (and that is the intended use: weights cross into

@@ -175,6 +175,17 @@ class Plan:
     self-consistent: a mesh block whose shape needs dps=2 must not ship
     next to the train race's dps=1.
 
+    `serve_precision` is the SERVING knob (serve/registry.py, ISSUE 8):
+    which rung of the precision ladder — "float32" (bitwise the
+    eval/predict scan path), "bfloat16" (activation cast) or "int8"
+    (per-channel weight quantization, ops/quant.py) — a scoring-service
+    registry entry of this shape should serve at. Raced by
+    `scripts/autotune_plan.py --serve` (a `"serve"` block:
+    `{"precision": ...}`; a non-f32 rung only wins when its measured
+    rank fidelity vs float32 clears the documented floor). Rows without
+    the block resolve to "float32" — the conservative, bitwise default,
+    same backward-compatibility rule as fleet/stream/obs/mesh.
+
     `budget_*` are the OBSERVABILITY envelopes (ISSUE 7): a row's
     optional `"budgets"` block (`{"compile_seconds": s,
     "peak_hbm_bytes": b, "comm_bytes_per_epoch": c}`) states what a
@@ -202,6 +213,7 @@ class Plan:
     panel_residency: str = "hbm"
     stream_chunk_days: int = 32
     obs_probes: bool = False
+    serve_precision: str = "float32"
     mesh_data_axis: int = 0
     mesh_stock_axis: int = 0
     mesh_days_per_step: int = 0
@@ -444,6 +456,12 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 # (the bitwise-neutral default).
                 obs_probes=bool(
                     (row.get("obs") or {}).get("probes", False)),
+                # Pre-ISSUE-8 rows have no "serve" block: float32 (the
+                # bitwise-vs-predict.py default) — precision downgrades
+                # are measured wins, never inferred.
+                serve_precision=str(
+                    (row.get("serve") or {}).get("precision")
+                    or "float32"),
                 # Pre-PR-6 rows have no "mesh" block: 0/0 = keep the
                 # run's own MeshConfig (no schema break).
                 mesh_data_axis=int(
@@ -552,6 +570,47 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
             stock_axis=plan.mesh_stock_axis)
     return dataclasses.replace(config, model=model, train=train, data=data,
                                mesh=mesh_cfg)
+
+
+# ---------------------------------------------------------------------------
+# Persistent XLA compilation cache (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+COMPILE_CACHE_ENV = "FACTORVAE_COMPILE_CACHE"
+
+
+def setup_compilation_cache(path: Optional[str] = None,
+                            min_compile_secs: float = 0.0) -> Optional[str]:
+    """Point jax at a persistent on-disk compilation cache so daemon
+    restarts, autotune races and repeated CLI runs stop paying
+    recompiles of programs XLA has already built.
+
+    Resolution: explicit `path` > the `FACTORVAE_COMPILE_CACHE` env var
+    > disabled (returns None). `path="off"` disables explicitly (the
+    CLI's documented opt-out even when the env var is set). Returns the
+    absolute cache dir when enabled, else None — callers log it.
+
+    `min_compile_secs=0.0` (the serving default) caches EVERY program:
+    a scoring daemon's whole value is its warm restart, and the small
+    per-entry disk cost is the price of zero `compile` records on the
+    second process (tests/test_serve.py pins exactly that). Training
+    CLIs may pass a higher floor to keep the cache to the expensive
+    epoch programs. No-op (None) on jax versions without the flags.
+    """
+    p = path or os.environ.get(COMPILE_CACHE_ENV)
+    if not p or p == "off":
+        return None
+    import jax
+
+    p = os.path.abspath(p)
+    try:
+        os.makedirs(p, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", p)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    except Exception:
+        return None
+    return p
 
 
 def score_model_config(model_cfg, plan: Plan):

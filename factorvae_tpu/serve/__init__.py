@@ -1,0 +1,35 @@
+"""Production scoring service (ISSUE 8): warm AOT model registry +
+long-lived scoring daemon.
+
+    python -m factorvae_tpu.serve --model best_models/<name> ...
+
+See docs/serving.md for the registry keying, the precision ladder's
+guarantees, the request/response schema and the latency envelope;
+`bench.py --serve` measures p50/p99/QPS on this machine.
+"""
+
+from factorvae_tpu.serve.daemon import (
+    ScoringDaemon,
+    serve_batch_file,
+    serve_http,
+    serve_stdin,
+)
+from factorvae_tpu.serve.registry import (
+    Entry,
+    ModelRegistry,
+    RegistryError,
+    checkpoint_config,
+    precision_config,
+)
+
+__all__ = [
+    "Entry",
+    "ModelRegistry",
+    "RegistryError",
+    "ScoringDaemon",
+    "checkpoint_config",
+    "precision_config",
+    "serve_batch_file",
+    "serve_http",
+    "serve_stdin",
+]

@@ -1,0 +1,277 @@
+"""CLI entry of the scoring daemon.
+
+    # serve two checkpoints over stdin JSONL, metrics to RUN.jsonl
+    python -m factorvae_tpu.serve \
+        --model best_models/VAE-Revision2_factor_96_... \
+        --model best_models/VAE-Revision2_factor_96_..._seed_43 \
+        --dataset ./data/csi_data.pkl --metrics_jsonl RUN_SERVE.jsonl
+
+    # one-shot batch file; HTTP instead of stdin
+    python -m factorvae_tpu.serve --model m.aot --batch reqs.jsonl
+    python -m factorvae_tpu.serve --model m.aot --http 8787
+
+Requests (one JSON object per line; an ARRAY line is one explicit
+batch/tick): {"id": 1, "model": "<key|alias>", "day": "2020-01-03"}
+plus optional "days"/"start"/"end", "top": k; commands {"cmd":
+"stats"|"models"|"ping"|"shutdown"}. Responses mirror the id, carry
+per-instrument scores, the serving precision and latency_ms. Full
+schema: docs/serving.md.
+
+Startup chatter goes to STDERR — stdout is the response stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m factorvae_tpu.serve",
+        description="long-lived scoring daemon over a warm AOT model "
+                    "registry (docs/serving.md)")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="PATH",
+                   help="model to admit at startup (repeatable): a "
+                        "weights-only checkpoint DIRECTORY (save_params "
+                        "layout; Config from the sibling *_ckpt metadata "
+                        "or a serve_config.json drop-in) or an AOT "
+                        "artifact FILE (eval/export_aot.py)")
+    p.add_argument("--dataset", type=str, default=None,
+                   help="panel pickle to serve days from (the qlib ETL "
+                        "artifact; data/README.md)")
+    p.add_argument("--synthetic", type=str, default=None,
+                   metavar="DAYS,STOCKS",
+                   help="serve a synthetic dense panel instead of "
+                        "--dataset (tests/bench): e.g. 64,96. Features/"
+                        "seq_len follow the first model's config")
+    p.add_argument("--max_stocks", type=int, default=None,
+                   help="cross-section pad target (default: inferred; "
+                        "must match an AOT artifact's exported n_max)")
+    p.add_argument("--precision",
+                   choices=["plan", "float32", "bfloat16", "int8"],
+                   default="plan",
+                   help="precision ladder rung for checkpoint models: "
+                        "'plan' (default) resolves per shape from a "
+                        "measured plan row's 'serve' block "
+                        "(autotune_plan.py --serve), falling back to "
+                        "float32 — the rung that is bitwise the offline "
+                        "scan (docs/serving.md)")
+    p.add_argument("--budget_mb", type=float, default=0,
+                   help="registry bytes budget; LRU eviction past it "
+                        "(0 = unbounded). Evicted disk-backed models "
+                        "cold-start back in on demand")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every model against the panel shape "
+                        "BEFORE serving (first request already warm)")
+    p.add_argument("--stochastic", action="store_true",
+                   help="sample at inference per each model's config "
+                        "(reference-faithful); default: deterministic "
+                        "scores (the reproducible serving mode)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="scoring RNG seed of the stochastic path")
+    p.add_argument("--batch", type=str, default=None, metavar="FILE",
+                   help="score this JSONL request file and exit "
+                        "(responses to --out or stdout)")
+    p.add_argument("--out", type=str, default=None,
+                   help="response JSONL path for --batch (default "
+                        "stdout)")
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="serve HTTP on 127.0.0.1:PORT (POST /score, "
+                        "GET /stats /models /healthz) instead of stdin")
+    p.add_argument("--tick_ms", type=float, default=20.0,
+                   help="stdin batching window: single-line requests "
+                        "arriving within this window fuse into one "
+                        "multi-model dispatch tick")
+    p.add_argument("--max_batch", type=int, default=64,
+                   help="max requests per tick")
+    p.add_argument("--metrics_jsonl", type=str, default=None,
+                   help="RUN.jsonl stream for request spans + compile "
+                        "records (render: python -m "
+                        "factorvae_tpu.obs.timeline)")
+    p.add_argument("--compile_cache", type=str, default=None,
+                   metavar="DIR",
+                   help="persistent XLA compilation cache dir (default: "
+                        "$FACTORVAE_COMPILE_CACHE; 'off' disables). "
+                        "With it, a daemon restart deserializes its "
+                        "programs instead of recompiling — compile "
+                        "records become compile_cached")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.model:
+        print("error: at least one --model is required", file=sys.stderr)
+        return 2
+    if not args.dataset and not args.synthetic:
+        print("error: pass --dataset PATH or --synthetic DAYS,STOCKS",
+              file=sys.stderr)
+        return 2
+
+    # Cache + cache-aware compile-record taxonomy BEFORE jax warms up.
+    from factorvae_tpu import plan as planlib
+
+    cache_dir = planlib.setup_compilation_cache(args.compile_cache)
+    if cache_dir:
+        from factorvae_tpu.obs.watchdog import track_persistent_cache
+
+        track_persistent_cache()
+
+    from factorvae_tpu.serve.registry import (
+        ModelRegistry,
+        RegistryError,
+        checkpoint_config,
+    )
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
+
+    logger = MetricsLogger(jsonl_path=args.metrics_jsonl, echo=False,
+                           run_name="serve")
+    prev_tl = None
+    if args.metrics_jsonl:
+        prev_tl = install_timeline(Timeline(logger))
+    try:
+        registry = ModelRegistry(
+            budget_bytes=int(args.budget_mb * 1e6))
+        precision = None if args.precision == "plan" else args.precision
+
+        # Resolve every model's architecture facts BEFORE building the
+        # panel: the panel's feature width and seq_len follow the
+        # first model, and checkpoint admission needs the panel's
+        # cross-section width so `--precision plan` can actually
+        # consult a measured row's serve block (n_stocks=None would
+        # silently fall through to f32).
+        import os
+
+        from factorvae_tpu.eval.export_aot import (
+            ArtifactError,
+            read_artifact_header,
+        )
+
+        specs = []          # (spec, kind, Config | header)
+        for spec in args.model:
+            try:
+                if os.path.isdir(spec):
+                    specs.append((spec, "checkpoint",
+                                  checkpoint_config(spec)))
+                else:
+                    with open(spec, "rb") as fh:
+                        header = read_artifact_header(fh.read())
+                    if header is None:
+                        raise RegistryError(
+                            f"artifact {spec} has no header "
+                            f"(pre-ISSUE-8 export); re-export it so "
+                            f"the registry can key it by config hash")
+                    specs.append((spec, "artifact", header))
+            except (RegistryError, ArtifactError, OSError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        _, kind0, facts0 = specs[0]
+        if kind0 == "checkpoint":
+            num_features = facts0.model.num_features
+            seq_len = facts0.model.seq_len
+        else:
+            num_features = int(facts0["num_features"])
+            seq_len = int(facts0["seq_len"])
+
+        from factorvae_tpu.data import PanelDataset
+
+        if args.synthetic:
+            from factorvae_tpu.data import synthetic_panel_dense
+
+            try:
+                n_days, n_stocks = (int(x) for x in
+                                    args.synthetic.split(","))
+            except ValueError:
+                print("error: --synthetic wants DAYS,STOCKS (e.g. "
+                      "64,96)", file=sys.stderr)
+                return 2
+            panel = synthetic_panel_dense(
+                num_days=n_days, num_instruments=n_stocks,
+                num_features=num_features)
+            dataset = PanelDataset(panel, seq_len=seq_len,
+                                   max_stocks=args.max_stocks)
+        else:
+            from factorvae_tpu.data import build_panel, load_frame
+
+            if not os.path.exists(args.dataset):
+                print(f"error: dataset not found: {args.dataset}",
+                      file=sys.stderr)
+                return 2
+            panel = build_panel(load_frame(args.dataset, None))
+            dataset = PanelDataset(panel, seq_len=seq_len,
+                                   max_stocks=args.max_stocks)
+
+        for spec, kind, facts in specs:
+            try:
+                if kind == "checkpoint":
+                    key = registry.register_checkpoint(
+                        spec, config=facts, precision=precision,
+                        n_stocks=dataset.n_max)
+                else:
+                    key = registry.register_artifact(spec)
+            except RegistryError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            entry = registry.get(key)
+            print(f"[serve] admitted {spec} as {key} "
+                  f"(alias {entry.alias}, {entry.precision}, "
+                  f"{entry.nbytes} bytes)", file=sys.stderr)
+
+        from factorvae_tpu.serve.daemon import (
+            ScoringDaemon,
+            serve_batch_file,
+            serve_http,
+            serve_stdin,
+        )
+
+        daemon = ScoringDaemon(
+            registry, dataset,
+            stochastic=(None if args.stochastic else False),
+            seed=args.seed)
+        if args.warmup:
+            walls = registry.warmup(dataset,
+                                    stochastic=daemon.stochastic)
+            for key, wall in walls.items():
+                print(f"[serve] warmed {key} in {wall:.3f}s",
+                      file=sys.stderr)
+        logger.log("serve_start", models=registry.keys(),
+                   compile_cache=cache_dir,
+                   n_days=len(dataset.dates), n_max=dataset.n_max)
+        print(f"[serve] ready: {len(registry.keys())} model(s), "
+              f"panel {len(dataset.dates)}d x {dataset.n_max} "
+              f"(cache: {cache_dir or 'off'})", file=sys.stderr)
+
+        if args.batch:
+            out = open(args.out, "w") if args.out else sys.stdout
+            try:
+                n = serve_batch_file(daemon, args.batch, out,
+                                     max_batch=args.max_batch)
+            finally:
+                if args.out:
+                    out.close()
+            print(f"[serve] answered {n} request(s) from {args.batch}",
+                  file=sys.stderr)
+        elif args.http is not None:
+            print(f"[serve] http://127.0.0.1:{args.http}/score",
+                  file=sys.stderr)
+            serve_http(daemon, args.http)
+        else:
+            serve_stdin(daemon, sys.stdin, sys.stdout,
+                        tick_s=args.tick_ms / 1e3,
+                        max_batch=args.max_batch)
+        logger.log("serve_stop", **daemon.stats())
+        return 0
+    finally:
+        if args.metrics_jsonl:
+            install_timeline(prev_tl)
+        logger.finish()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

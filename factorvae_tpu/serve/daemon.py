@@ -1,0 +1,527 @@
+"""Long-lived scoring daemon: the request path over the model registry.
+
+The deployment story of the paper is cross-sectional scoring of each
+new trading day; E2EAI (PAPERS.md) frames it as an end-to-end
+production loop. This module is that loop's serving half: a resident
+process that holds a panel dataset plus a `ModelRegistry` of warm
+models, takes JSONL scoring requests, and answers with per-instrument
+scores — through the SAME single-scan scoring jits the offline
+evaluator uses, so the f32 rung of the precision ladder is bitwise
+`eval/predict.predict_panel` by construction.
+
+**Batched multi-model dispatch.** Requests arriving in one tick are
+BUCKETED: params-backed entries that share (architecture, precision,
+stochasticity, requested days) stack their param trees and run ONE
+`predict_panel_fleet` program — S users' model variants for the price
+of one dispatch, the "millions of users" lever fleet training built
+(train/fleet.py). Requests that don't bucket (different days, artifact
+entries, lone models) dispatch serially through `registry.score`.
+Mixed-precision requests never share a bucket; S=1 buckets take the
+serial path, so a lone request is always bitwise the offline scan.
+
+**Drivers.** `serve_stdin` (JSONL in/out; a line may be one request
+object or an ARRAY of requests — an explicit tick; bursts of single
+lines within `tick_s` coalesce into one tick too), `serve_batch_file`
+(score a request file, write a response file, exit) and `serve_http`
+(stdlib http.server: POST /score, GET /stats /models /healthz) all
+funnel into `ScoringDaemon.handle_batch`. Responses preserve request
+order; malformed lines get `{"ok": false, "error": ...}` instead of
+killing the process.
+
+**Observability.** With a timeline installed (serve `--metrics_jsonl`)
+every request emits a `serve_request` span and every fused dispatch a
+`serve_dispatch` span into the same RUN.jsonl the scoring jits'
+`compile`/`compile_cached` records land in — `python -m
+factorvae_tpu.obs.timeline RUN.jsonl` renders the request-level Gantt
+with zero extra wiring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from factorvae_tpu.serve.registry import (
+    Entry,
+    ModelRegistry,
+    RegistryError,
+)
+from factorvae_tpu.utils.logging import timeline_span
+
+_CMDS = ("ping", "stats", "models", "shutdown")
+
+
+@dataclasses.dataclass
+class _Resolved:
+    """One parsed request, ready to dispatch."""
+
+    request: dict
+    entry: Optional[Entry] = None
+    days: Optional[np.ndarray] = None
+    error: Optional[str] = None
+    cmd: Optional[str] = None
+    scores: Optional[np.ndarray] = None   # filled by dispatch
+    batched_with: int = 1
+    done_t: Optional[float] = None        # when THIS request's scores landed
+
+
+class ScoringDaemon:
+    """Request handler over (registry, dataset).
+
+    `stochastic=False` (default) serves deterministic scores — the
+    reproducible-backtest mode; True defers to each entry's config the
+    way `predict_panel(stochastic=None)` does. `seed` is the scoring
+    RNG stream of the stochastic path, shared across models like the
+    sweep shares it across seeds."""
+
+    def __init__(self, registry: ModelRegistry, dataset,
+                 stochastic: Optional[bool] = False, seed: int = 0):
+        self.registry = registry
+        self.dataset = dataset
+        self.stochastic = stochastic
+        self.seed = seed
+        self.requests_served = 0
+        self.dispatches = 0
+        self.fused_requests = 0
+        self._closing = False
+        # Fused-dispatch stacked param tree of the MOST RECENT group
+        # (keyed by its tuple of entry keys; cleared whenever the
+        # registry mutates). Repeat ticks over the same warm models
+        # must not re-stack (and re-transfer) every model's weights —
+        # that copy would dominate the multi-model hot path — but the
+        # cache is capped at one group so the duplicate bytes it holds
+        # (invisible to the registry's budget) stay bounded.
+        self._stack_cache: dict = {}
+        self._stack_version: Optional[int] = None
+
+    # ---- request parsing -------------------------------------------------
+
+    def _resolve_days(self, req: dict) -> np.ndarray:
+        ds = self.dataset
+        if "day" in req:
+            sel = [req["day"]]
+        elif "days" in req:
+            sel = list(req["days"])
+        elif "start" in req or "end" in req:
+            return ds.split_days(req.get("start"), req.get("end"))
+        else:
+            raise ValueError(
+                "request needs 'day', 'days' or 'start'/'end'")
+        out = []
+        import pandas as pd
+
+        dates = pd.DatetimeIndex(ds.dates)
+        for d in sel:
+            if isinstance(d, (int, np.integer)) and not isinstance(d, bool):
+                i = int(d)
+                if not 0 <= i < len(dates):
+                    raise ValueError(
+                        f"day index {i} out of range [0, {len(dates)})")
+            else:
+                i = dates.get_indexer([pd.Timestamp(str(d))])[0]
+                if i < 0:
+                    raise ValueError(
+                        f"day {d!r} not in the serving panel "
+                        f"[{dates[0].date()}, {dates[-1].date()}]")
+            out.append(i)
+        return np.asarray(out, np.int64)
+
+    def _resolve(self, req) -> _Resolved:
+        if not isinstance(req, dict):
+            return _Resolved(request={}, error="request must be a JSON "
+                                               "object")
+        cmd = req.get("cmd")
+        if cmd is not None:
+            if cmd not in _CMDS:
+                return _Resolved(request=req,
+                                 error=f"unknown cmd {cmd!r} "
+                                       f"(known: {', '.join(_CMDS)})")
+            return _Resolved(request=req, cmd=cmd)
+        model = req.get("model")
+        if not model:
+            return _Resolved(request=req,
+                             error="request needs a 'model' (key or "
+                                   "alias; see {\"cmd\": \"models\"})")
+        try:
+            entry = self.registry.get(str(model))
+            days = self._resolve_days(req)
+        except Exception as e:
+            # Untrusted request input: whatever a malformed day value
+            # (or a failing cold-start) raises becomes an {"ok": false}
+            # response, never a daemon death.
+            return _Resolved(request=req, error=str(e))
+        return _Resolved(request=req, entry=entry, days=days)
+
+    # ---- dispatch --------------------------------------------------------
+
+    def _bucket_key(self, r: _Resolved):
+        """Requests fuse when one fleet program can serve them all:
+        same scoring config (architecture + rung dtype), same int8
+        flag, same day set. Artifact entries never fuse (their program
+        is fixed at export)."""
+        if r.entry.artifact is not None:
+            return None
+        return (r.entry.score_config.model, r.entry.int8,
+                tuple(int(d) for d in r.days))
+
+    def _dispatch(self, resolved: list) -> None:
+        """Fill `scores` on every resolvable request, fusing bucketed
+        multi-model groups into one `predict_panel_fleet` call."""
+        import jax
+        import jax.numpy as jnp
+
+        buckets: dict = {}
+        for r in resolved:
+            if r.error or r.cmd:
+                continue
+            key = self._bucket_key(r)
+            if key is None:
+                self._dispatch_serial(r)
+                continue
+            buckets.setdefault(key, []).append(r)
+        for key, group in buckets.items():
+            distinct: dict = {}
+            for r in group:
+                distinct.setdefault(r.entry.key, r.entry)
+            if len(distinct) == 1:
+                # One model (possibly asked for twice): the serial,
+                # bitwise path — score once, share the result.
+                first = None
+                for r in group:
+                    if first is None:
+                        self._dispatch_serial(r)
+                        first = r
+                    else:
+                        r.scores = first.scores
+                        r.done_t = first.done_t
+                        r.error = first.error
+                continue
+            entries = list(distinct.values())
+            days = group[0].days
+            from factorvae_tpu.eval.predict import predict_panel_fleet
+
+            if self._stack_version != self.registry.version:
+                self._stack_cache.clear()
+                self._stack_version = self.registry.version
+            cache_key = tuple(e.key for e in entries)
+            try:
+                stacked = self._stack_cache.get(cache_key)
+                if stacked is None:
+                    stacked = jax.tree.map(
+                        lambda *xs: jnp.stack(
+                            [jnp.asarray(x) for x in xs]),
+                        *[e.params for e in entries])
+                    self._stack_cache = {cache_key: stacked}
+                with timeline_span("serve_dispatch", cat="serve",
+                                   resource="device",
+                                   models=len(entries),
+                                   n_days=int(len(days))):
+                    fleet = predict_panel_fleet(
+                        stacked, entries[0].score_config, self.dataset,
+                        days, stochastic=self.stochastic,
+                        seed=self.seed, int8=entries[0].int8)
+            except Exception:
+                # One bad group (mismatched leaf shapes, an OOM in the
+                # S-way program) must not kill the daemon: fall back to
+                # the serial path, whose per-request error handling
+                # turns failures into {"ok": false} responses.
+                self._stack_cache.pop(cache_key, None)
+                for r in group:
+                    self._dispatch_serial(r)
+                continue
+            t1 = time.perf_counter()
+            self.dispatches += 1
+            by_key = {e.key: fleet[i] for i, e in enumerate(entries)}
+            # NOTE: entries are NOT marked compiled here — `compiled`
+            # means the SERIAL scan program is warm (registry.score /
+            # warmup semantics); the fleet program compiled above is a
+            # different executable, and marking entries warm off it
+            # would make warmup() skip the serial compile a later lone
+            # request then pays on the request path.
+            for r in group:
+                r.scores = by_key[r.entry.key]
+                r.batched_with = len(entries)
+                r.done_t = t1
+                r.entry.requests += 1
+                self.fused_requests += 1
+
+    def _dispatch_serial(self, r: _Resolved) -> None:
+        try:
+            r.scores = self.registry.score(
+                r.entry.key, self.dataset, r.days,
+                stochastic=self.stochastic, seed=self.seed,
+                entry=r.entry)
+            r.done_t = time.perf_counter()
+            self.dispatches += 1
+        except Exception as e:
+            # The execution leg of the never-kill-the-process contract:
+            # an XLA OOM or a panel/arch shape mismatch (TypeError from
+            # the jit) must answer THIS request with {"ok": false}, not
+            # take down every other warm model — and the fused path's
+            # serial fallback relies on exactly this.
+            r.error = str(e)
+
+    # ---- responses -------------------------------------------------------
+
+    def _respond(self, r: _Resolved, t0: float) -> dict:
+        rid = (r.request or {}).get("id")
+        if r.error is not None:
+            return {"id": rid, "ok": False, "error": r.error}
+        if r.cmd is not None:
+            if r.cmd == "shutdown":
+                self._closing = True
+                return {"id": rid, "ok": True, "cmd": "shutdown"}
+            if r.cmd == "ping":
+                return {"id": rid, "ok": True, "cmd": "ping"}
+            if r.cmd == "models":
+                return {"id": rid, "ok": True, "cmd": "models",
+                        "models": self.registry.stats()["entries"]}
+            return {"id": rid, "ok": True, "cmd": "stats",
+                    **self.stats()}
+        ds = self.dataset
+        top = (r.request or {}).get("top")
+        results = []
+        n_total = 0
+        valid = ds.valid[r.days]
+        inst = np.asarray(ds.instruments)
+        for i, day in enumerate(r.days):
+            # valid is (n_max,)-padded; instruments covers the REAL
+            # cross-section only (pad slots are never valid, but clip
+            # defensively rather than index out of range).
+            idx = np.nonzero(valid[i])[0]
+            idx = idx[idx < inst.size]
+            names = inst[idx]
+            vals = np.asarray(r.scores[i], np.float32)[idx]
+            if top:
+                order = np.argsort(-vals)[: int(top)]
+                names, vals = names[order], vals[order]
+            n_total += int(vals.size)
+            results.append({
+                "day": str(np.datetime_as_string(
+                    np.datetime64(ds.dates[int(day)]), unit="D")),
+                "instruments": [str(n) for n in names],
+                "scores": [float(v) for v in vals],
+            })
+        self.requests_served += 1
+        return {
+            "id": rid, "ok": True,
+            "model": r.entry.key, "alias": r.entry.alias,
+            "precision": r.entry.precision,
+            "n": n_total,
+            "batched_with": r.batched_with,
+            "results": results,
+            # Tick arrival -> THIS request's scores landing: batch-file
+            # ticks of many serial dispatch groups must not report
+            # every request at the full tick wall.
+            "latency_ms": round(
+                ((r.done_t or time.perf_counter()) - t0) * 1e3, 3),
+        }
+
+    # ---- public API ------------------------------------------------------
+
+    def handle_batch(self, requests: list) -> list:
+        """Responses (in order) for one tick's worth of requests."""
+        t0 = time.perf_counter()
+        with timeline_span("serve_tick", cat="serve", resource="serve",
+                           requests=len(requests)):
+            resolved = [self._resolve(r) for r in requests]
+            self._dispatch(resolved)
+            out = []
+            for r in resolved:
+                with timeline_span("serve_request", cat="serve",
+                                   resource="serve",
+                                   model=(r.entry.key if r.entry
+                                          else None)):
+                    out.append(self._respond(r, t0))
+        return out
+
+    def handle(self, request: dict) -> dict:
+        return self.handle_batch([request])[0]
+
+    @property
+    def closing(self) -> bool:
+        return self._closing
+
+    def stats(self) -> dict:
+        return {
+            "requests_served": self.requests_served,
+            "dispatches": self.dispatches,
+            "fused_requests": self.fused_requests,
+            "registry": self.registry.stats(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+
+def _parse_line(line: str) -> list:
+    """One JSONL line -> a list of request dicts (an array is an
+    explicit batch). A parse failure yields one error-carrying dict the
+    daemon turns into an {"ok": false} response."""
+    try:
+        obj = json.loads(line)
+    except ValueError as e:
+        return [{"_parse_error": f"bad JSON: {e}"}]
+    return obj if isinstance(obj, list) else [obj]
+
+
+def _with_parse_errors(daemon: ScoringDaemon, requests: list) -> list:
+    ok, responses_at = [], {}
+    for i, r in enumerate(requests):
+        if isinstance(r, dict) and "_parse_error" in r:
+            responses_at[i] = {"id": None, "ok": False,
+                               "error": r["_parse_error"]}
+        else:
+            ok.append((i, r))
+    answered = daemon.handle_batch([r for _, r in ok])
+    for (i, _), resp in zip(ok, answered):
+        responses_at[i] = resp
+    return [responses_at[i] for i in range(len(requests))]
+
+
+def _stdin_ticks(inp, tick_s: float, max_batch: int):
+    """Yield lists of raw lines, one list per tick. On a selectable
+    stream, lines arriving within `tick_s` of each other coalesce into
+    one tick (up to `max_batch`); otherwise (StringIO tests) each line
+    is its own tick. Reads the RAW fd exclusively — mixing readline
+    with select would strand data in Python's buffer."""
+    try:
+        fd = inp.fileno()
+    except (AttributeError, OSError, ValueError):
+        for line in inp:
+            if line.strip():
+                yield [line]
+        return
+    import select
+
+    buf = b""
+    pending: list = []
+    eof = False
+    while True:
+        while b"\n" in buf and len(pending) < max_batch:
+            line, buf = buf.split(b"\n", 1)
+            if line.strip():
+                pending.append(line.decode(errors="replace"))
+        if pending and len(pending) >= max_batch:
+            yield pending
+            pending = []
+            continue
+        if eof:
+            if buf.strip():
+                pending.append(buf.decode(errors="replace"))
+                buf = b""
+            if pending:
+                yield pending
+            return
+        try:
+            ready, _, _ = select.select(
+                [fd], [], [], tick_s if pending else None)
+        except OSError:  # fd closed under us
+            eof = True
+            continue
+        if not ready:
+            if pending:
+                yield pending
+                pending = []
+            continue
+        data = os.read(fd, 65536)
+        if not data:
+            eof = True
+        else:
+            buf += data
+
+
+def serve_stdin(daemon: ScoringDaemon, inp, out,
+                tick_s: float = 0.02, max_batch: int = 64) -> int:
+    """JSONL request/response loop until EOF or a shutdown cmd.
+    Returns the number of requests answered."""
+    answered = 0
+    for lines in _stdin_ticks(inp, tick_s, max_batch):
+        requests = [r for line in lines for r in _parse_line(line)]
+        for resp in _with_parse_errors(daemon, requests):
+            out.write(json.dumps(resp) + "\n")
+            answered += 1
+        out.flush()
+        if daemon.closing:
+            break
+    return answered
+
+
+def serve_batch_file(daemon: ScoringDaemon, path: str, out,
+                     max_batch: int = 64) -> int:
+    """Score a JSONL request file as maximally-fused ticks; write JSONL
+    responses to `out`. Returns the number answered."""
+    with open(path) as fh:
+        lines = [ln for ln in fh if ln.strip()]
+    requests = [r for line in lines for r in _parse_line(line)]
+    answered = 0
+    for i in range(0, len(requests), max_batch):
+        for resp in _with_parse_errors(daemon,
+                                       requests[i:i + max_batch]):
+            out.write(json.dumps(resp) + "\n")
+            answered += 1
+    out.flush()
+    return answered
+
+
+def serve_http(daemon: ScoringDaemon, port: int,
+               host: str = "127.0.0.1"):
+    """Minimal stdlib HTTP front: POST /score (object or array body),
+    GET /stats, /models, /healthz. Single-threaded by design — jax
+    dispatch is the bottleneck and wants no concurrency. Blocks until
+    a shutdown request arrives."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            if self.path == "/healthz":
+                self._send(200, {"ok": True})
+            elif self.path == "/stats":
+                self._send(200, daemon.stats())
+            elif self.path == "/models":
+                self._send(200, daemon.registry.stats()["entries"])
+            else:
+                self._send(404, {"ok": False,
+                                 "error": f"unknown path {self.path}"})
+
+        def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            if self.path != "/score":
+                self._send(404, {"ok": False,
+                                 "error": f"unknown path {self.path}"})
+                return
+            n = int(self.headers.get("Content-Length") or 0)
+            requests = _parse_line(self.rfile.read(n).decode())
+            responses = _with_parse_errors(daemon, requests)
+            # An empty array body gets an empty array back — never an
+            # IndexError-dropped connection.
+            self._send(200, responses if len(responses) != 1
+                       else responses[0])
+
+        def log_message(self, fmt, *args):  # quiet: stdout is sacred
+            from factorvae_tpu.utils.logging import timeline_event
+
+            timeline_event("http", cat="serve", resource="serve",
+                           line=fmt % args)
+
+    server = HTTPServer((host, port), Handler)
+    try:
+        while not daemon.closing:
+            server.handle_request()
+    finally:
+        server.server_close()
+    return server

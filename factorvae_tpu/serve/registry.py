@@ -1,0 +1,493 @@
+"""Warm AOT model registry: N compiled scoring models resident at once.
+
+The serving side of the repo (ISSUE 8 / ROADMAP 1): a long-lived
+scoring daemon must hold MANY model variants warm — different seeds,
+different architectures, yesterday's refit next to today's — and admit
+or evict them under a bytes budget, without ever paying a recompile on
+the request path. This module owns that state:
+
+- **Keying.** Every entry is keyed by the canonical config hash
+  (`utils.logging.config_hash` of the full Config dict) — the same
+  digest the `run_meta` stream headers carry, the full-state checkpoint
+  metadata embeds (`config` in Checkpointer meta), and the AOT artifact
+  header records (eval/export_aot.py). Whatever produced the model, the
+  registry and its clients agree on its identity.
+
+- **Sources.** `register_params` admits an in-memory (params, Config)
+  pair; `register_checkpoint` admits a weights-only orbax directory
+  (the `save_params` layout the trainer writes), resolving the Config
+  from the sibling full-state `<dir>_ckpt` manager's metadata or a
+  `serve_config.json` drop-in; `register_artifact` admits a serialized
+  AOT export through the validated `load_exported` round-trip — the
+  cold-start path that involves no flax, no checkpoint and no trace.
+
+- **Precision ladder.** Each entry serves at one rung of
+  f32 → bf16 → int8, resolved per entry: an explicit request at
+  admission wins, else the measured plan row's `"serve"` block
+  (`Plan.serve_precision`, raced by `scripts/autotune_plan.py
+  --serve`), else float32. f32 entries score BITWISE what
+  `eval/predict.predict_panel` scores (they call exactly that scan
+  path); bf16 casts activations; int8 quantizes the weight matrices
+  ONCE at admission (`ops/quant.ensure_quantized`) and dequantizes
+  inside the compiled program. Tolerances are pinned in
+  tests/test_serve.py and documented in docs/serving.md.
+
+- **Warmth.** Compilation is LAZY (first request per entry compiles;
+  `warmup()` prefronts it) and SHARED (entries with the same
+  (architecture, precision, stochasticity) reuse one compiled scan —
+  eval/predict's lru-cached jit factories are the cache). Eviction is
+  LRU by parameter bytes against `budget_bytes`; an evicted key
+  re-admits from its recorded source on the next request when possible
+  (checkpoint/artifact sources reload lazily; in-memory sources are
+  gone and say so).
+
+The registry performs NO jit of its own — the request path runs through
+`eval/predict.py`'s watched scoring jits, so every compile lands as a
+`compile` (or persistent-cache `compile_cached`) record on the
+installed timeline for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from factorvae_tpu.config import Config
+from factorvae_tpu.utils.logging import config_hash, timeline_span
+
+PRECISIONS = ("float32", "bfloat16", "int8")
+
+
+class RegistryError(ValueError):
+    """Admission/lookup failure with an actionable one-line message."""
+
+
+def precision_config(config: Config, precision: str) -> Config:
+    """The Config an entry actually scores under at one ladder rung:
+    f32/bf16 set the activation compute dtype; int8 keeps float32
+    activations (the quantization lives on the WEIGHTS — ops/quant.py;
+    `scoring_int8` below carries the flag the scorer needs)."""
+    if precision not in PRECISIONS:
+        raise RegistryError(
+            f"precision must be one of {PRECISIONS}; got {precision!r}")
+    dtype = "float32" if precision == "int8" else precision
+    return dataclasses.replace(
+        config, model=dataclasses.replace(config.model,
+                                          compute_dtype=dtype))
+
+
+def _params_nbytes(tree) -> int:
+    from factorvae_tpu.ops.quant import tree_nbytes
+
+    return int(tree_nbytes(tree))
+
+
+@dataclasses.dataclass
+class Entry:
+    """One resident model. `params` is the SERVING tree (pre-quantized
+    for int8 entries); `score_config` already carries the rung's
+    compute dtype, so the request path never re-derives either."""
+
+    key: str
+    config: Config
+    precision: str
+    params: object = None
+    artifact: object = None            # LoadedArtifact (artifact source)
+    score_config: Optional[Config] = None
+    nbytes: int = 0
+    source: str = "params"             # params | checkpoint | artifact
+    source_path: Optional[str] = None  # reload origin for re-admission
+    alias: Optional[str] = None
+    compiled: bool = False
+    compile_s: Optional[float] = None
+    requests: int = 0
+
+    @property
+    def int8(self) -> bool:
+        return self.precision == "int8"
+
+    def describe(self) -> dict:
+        if self.artifact is not None:
+            # The arch facts an artifact HAS live in its validated
+            # header; h/k/m are baked into the serialized program and
+            # honestly unknown — self.config here is only a default
+            # placeholder, never report it as the architecture.
+            h = self.artifact.header or {}
+            arch = {"c": h.get("num_features"), "t": h.get("seq_len"),
+                    "h": None, "k": None, "m": None,
+                    "n_max": h.get("n_max")}
+        else:
+            arch = {
+                "c": self.config.model.num_features,
+                "t": self.config.model.seq_len,
+                "h": self.config.model.hidden_size,
+                "k": self.config.model.num_factors,
+                "m": self.config.model.num_portfolios,
+            }
+        return {
+            "key": self.key, "alias": self.alias,
+            "precision": self.precision, "source": self.source,
+            "nbytes": self.nbytes, "compiled": self.compiled,
+            "compile_s": self.compile_s, "requests": self.requests,
+            "arch": arch,
+        }
+
+
+def checkpoint_config(path: str) -> Config:
+    """Resolve the Config of a weights-only checkpoint directory (the
+    `save_params` layout): the sibling full-state `<path>_ckpt`
+    manager's latest metadata (the trainer embeds `config` in every
+    Checkpointer meta), or a `serve_config.json` inside the directory.
+    One-line actionable error when neither exists."""
+    path = os.path.abspath(path)
+    drop_in = os.path.join(path, "serve_config.json")
+    if os.path.exists(drop_in):
+        with open(drop_in) as fh:
+            return Config.from_dict(json.load(fh))
+    mgr_dir = path if path.endswith("_ckpt") else path + "_ckpt"
+    if os.path.isdir(mgr_dir):
+        import orbax.checkpoint as ocp
+
+        mgr = ocp.CheckpointManager(mgr_dir)
+        try:
+            step = mgr.latest_step()
+            if step is not None:
+                out = mgr.restore(step, args=ocp.args.Composite(
+                    meta=ocp.args.JsonRestore()))
+                cfg_dict = (out["meta"] or {}).get("config")
+                if cfg_dict:
+                    return Config.from_dict(cfg_dict)
+        finally:
+            mgr.close()
+    raise RegistryError(
+        f"cannot resolve the Config for checkpoint {path}: no "
+        f"{os.path.basename(mgr_dir)} full-state metadata and no "
+        f"serve_config.json — train with checkpoint_every>0 or drop a "
+        f"serve_config.json (Config.to_dict) next to the weights")
+
+
+class ModelRegistry:
+    """LRU-by-bytes registry of warm scoring models.
+
+    `budget_bytes=0` (default) means unbounded. `plan_table` overrides
+    the planner's table for precision resolution (tests)."""
+
+    def __init__(self, budget_bytes: int = 0, plan_table=None):
+        self.budget_bytes = int(budget_bytes)
+        self._plan_table = plan_table
+        self._entries: "OrderedDict[str, Entry]" = OrderedDict()
+        self._aliases: dict = {}
+        # Evicted entries with a reload origin on disk leave a
+        # tombstone so a later request COLD-STARTS them back in
+        # (checkpoint reload or the artifact `load_exported` round
+        # trip) instead of failing.
+        self._tombstones: dict = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.cold_starts = 0
+        # Bumped on every admission/eviction (weights may have
+        # changed): consumers caching derived state — the daemon's
+        # stacked fused-dispatch param trees — invalidate on it.
+        self.version = 0
+
+    # ---- admission -------------------------------------------------------
+
+    def _admit(self, entry: Entry) -> str:
+        self.version += 1
+        self._entries[entry.key] = entry
+        self._entries.move_to_end(entry.key)
+        if entry.alias:
+            self._aliases[entry.alias] = entry.key
+        self._evict_to_budget()
+        return entry.key
+
+    def _resolve_precision(self, config: Config,
+                           precision: Optional[str],
+                           n_stocks: Optional[int]) -> str:
+        """Explicit choice > measured plan row's serve block > float32.
+        The plan lookup needs the real cross-section width; without one
+        the conservative f32 rung is the only honest answer."""
+        if precision is not None:
+            if precision not in PRECISIONS:
+                raise RegistryError(
+                    f"precision must be one of {PRECISIONS}; "
+                    f"got {precision!r}")
+            return precision
+        if n_stocks:
+            from factorvae_tpu import plan as planlib
+
+            pl = planlib.plan_for_config(config, int(n_stocks),
+                                         table=self._plan_table)
+            return pl.serve_precision
+        return "float32"
+
+    def register_params(self, params, config: Config,
+                        precision: Optional[str] = None,
+                        n_stocks: Optional[int] = None,
+                        alias: Optional[str] = None,
+                        source: str = "params",
+                        source_path: Optional[str] = None) -> str:
+        """Admit an in-memory (params, Config) pair; returns the key:
+        the config hash, suffixed `:{precision}` below the f32 rung so
+        one model's f32 and int8 variants are DISTINCT entries (same
+        config hash — without the suffix the second admission would
+        silently replace the first while both aliases kept resolving).
+        Re-admitting an existing key refreshes the entry in place
+        (same identity, freshest weights win)."""
+        precision = self._resolve_precision(config, precision, n_stocks)
+        key = config_hash(config.to_dict())
+        if precision != "float32":
+            key = f"{key}:{precision}"
+        if precision == "int8":
+            from factorvae_tpu.ops.quant import ensure_quantized
+
+            params = ensure_quantized(params)
+        entry = Entry(
+            key=key, config=config, precision=precision, params=params,
+            score_config=precision_config(config, precision),
+            nbytes=_params_nbytes(params), source=source,
+            source_path=source_path, alias=alias)
+        return self._admit(entry)
+
+    def register_checkpoint(self, path: str,
+                            config: Optional[Config] = None,
+                            precision: Optional[str] = None,
+                            n_stocks: Optional[int] = None,
+                            alias: Optional[str] = None) -> str:
+        """Admit a weights-only checkpoint directory (save_params
+        layout). Config resolves per `checkpoint_config` unless given."""
+        path = os.path.abspath(path)
+        if not os.path.isdir(path):
+            raise RegistryError(
+                f"no checkpoint directory at {path}; train first "
+                f"(cli.py) or pass an AOT artifact file instead")
+        if config is None:
+            config = checkpoint_config(path)
+        from factorvae_tpu.models.factorvae import load_model
+
+        _, params = load_model(config, checkpoint_path=path, n_max=1)
+        return self.register_params(
+            params, config, precision=precision, n_stocks=n_stocks,
+            alias=alias or os.path.basename(path), source="checkpoint",
+            source_path=path)
+
+    def register_artifact(self, path_or_blob,
+                          alias: Optional[str] = None) -> str:
+        """Admit a serialized AOT export (eval/export_aot.py) through
+        the validated `load_exported` round-trip — the cold-start path.
+        The key comes from the artifact HEADER's config hash (headerless
+        pre-ISSUE-8 blobs cannot be admitted: the registry has nothing
+        to key them on — re-export them)."""
+        from factorvae_tpu.eval.export_aot import (
+            ArtifactError,
+            load_exported,
+        )
+
+        path = None
+        if isinstance(path_or_blob, (bytes, bytearray)):
+            blob = bytes(path_or_blob)
+        else:
+            path = os.path.abspath(path_or_blob)
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        try:
+            art = load_exported(blob)
+        except ArtifactError as e:
+            raise RegistryError(str(e)) from None
+        if art.header is None:
+            raise RegistryError(
+                f"artifact {path or '<bytes>'} has no header (pre-ISSUE-8 "
+                f"export); re-export it with cli.py --export so the "
+                f"registry can key it by config hash")
+        precision = "int8" if art.header.get("int8") else "float32"
+        key = str(art.header["config_hash"])
+        if precision != "float32":
+            # Same suffix rule as register_params: an f32 and an int8
+            # export of one checkpoint are distinct registry entries.
+            key = f"{key}:{precision}"
+        entry = Entry(
+            key=key,
+            config=Config(),  # arch facts live in the header
+            precision=precision,
+            artifact=art, nbytes=len(blob), source="artifact",
+            source_path=path,
+            alias=alias or (os.path.basename(path) if path else None),
+            compiled=True)  # nothing left to trace — the program IS the blob
+        return self._admit(entry)
+
+    # ---- lookup / eviction ----------------------------------------------
+
+    def resolve_key(self, name: str) -> str:
+        if name in self._entries or name in self._tombstones:
+            return name
+        if name in self._aliases:
+            return self._aliases[name]
+        known = sorted(set(self._entries) | set(self._aliases)
+                       | set(self._tombstones))
+        raise RegistryError(
+            f"unknown model {name!r} (known: {', '.join(known) or 'none'})")
+
+    def get(self, name: str) -> Entry:
+        """Entry by key or alias; LRU-touches it. A key that was
+        EVICTED but has a reloadable source cold-starts back in
+        transparently (checkpoint reload / artifact round-trip; counted
+        as a miss, not a hit); a truly unknown key is a miss+error."""
+        try:
+            key = self.resolve_key(name)
+        except RegistryError:
+            self.misses += 1
+            raise
+        entry = self._entries.get(key)
+        if entry is None:
+            # Tombstone stays until the reload SUCCEEDS: a failed
+            # cold-start (deleted/corrupt source) must answer this and
+            # every later request with an actionable error, never
+            # KeyError the daemon on the retry.
+            stone = self._tombstones[key]
+            self.misses += 1
+            try:
+                if stone["source"] == "artifact":
+                    self.register_artifact(stone["source_path"],
+                                           alias=stone.get("alias"))
+                else:
+                    self.register_checkpoint(
+                        stone["source_path"], config=stone.get("config"),
+                        precision=stone.get("precision"),
+                        alias=stone.get("alias"))
+            except RegistryError:
+                raise
+            except Exception as e:
+                # orbax/OSError/... from a vanished or corrupt source:
+                # the request path speaks RegistryError only.
+                raise RegistryError(
+                    f"cold-start of evicted model {name!r} from "
+                    f"{stone['source']} {stone['source_path']} failed: "
+                    f"{e}") from e
+            self.cold_starts += 1
+            self._tombstones.pop(key, None)
+            return self._entries[key]
+        self.hits += 1
+        self._entries.move_to_end(key)
+        return entry
+
+    def _evict_to_budget(self) -> None:
+        if self.budget_bytes <= 0:
+            return
+        while (len(self._entries) > 1
+               and sum(e.nbytes for e in self._entries.values())
+               > self.budget_bytes):
+            key, entry = self._entries.popitem(last=False)
+            self.version += 1
+            self.evictions += 1
+            if entry.source_path:
+                # Reloadable source: leave a tombstone so the next
+                # request cold-starts the model back in instead of 404.
+                self._tombstones[key] = {
+                    "source": entry.source,
+                    "source_path": entry.source_path,
+                    "precision": entry.precision,
+                    "config": entry.config,
+                    "alias": entry.alias,
+                }
+            elif (entry.alias
+                  and self._aliases.get(entry.alias) == key):
+                del self._aliases[entry.alias]
+
+    def total_bytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def keys(self) -> list:
+        return list(self._entries)
+
+    def stats(self) -> dict:
+        return {
+            "models": len(self._entries),
+            "bytes": self.total_bytes(),
+            "budget_bytes": self.budget_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "cold_starts": self.cold_starts,
+            "entries": [e.describe() for e in self._entries.values()],
+        }
+
+    # ---- scoring ---------------------------------------------------------
+
+    def score(self, name: str, dataset, days: np.ndarray,
+              stochastic: Optional[bool] = False,
+              seed: int = 0, chunk: Optional[int] = None,
+              entry: Optional[Entry] = None) -> np.ndarray:
+        """(len(days), N_max) scores for one entry — the serial request
+        path. Params entries run the single-scan scoring jit
+        (`eval/predict.predict_panel`): the f32 rung is BITWISE that
+        path because it IS that path. Artifact entries replay their
+        serialized program per day (pre-gathered windows; ~1-ulp from
+        the in-graph gather, documented in docs/serving.md). Lazy
+        compile-on-first-request: the first call per (arch, precision)
+        pays the trace, tracked on the entry. A caller that already
+        resolved the Entry (the daemon's request path does, at parse
+        time) passes it to keep hits/misses one-count-per-request."""
+        if entry is None:
+            entry = self.get(name)
+        t0 = time.perf_counter()
+        first = not entry.compiled
+        with timeline_span(f"serve_score:{entry.key}", cat="serve",
+                           resource="device", model=entry.key,
+                           n_days=int(len(days))):
+            if entry.artifact is not None:
+                out = self._score_artifact(entry, dataset, days)
+            else:
+                from factorvae_tpu.eval.predict import predict_panel
+
+                kw = {} if chunk is None else {"chunk": int(chunk)}
+                out = predict_panel(
+                    entry.params, entry.score_config, dataset, days,
+                    stochastic=stochastic, seed=seed, int8=entry.int8,
+                    **kw)
+        if first:
+            entry.compiled = True
+            entry.compile_s = round(time.perf_counter() - t0, 6)
+        entry.requests += 1
+        return out
+
+    def _score_artifact(self, entry: Entry, dataset,
+                        days: np.ndarray) -> np.ndarray:
+        header = entry.artifact.header or {}
+        n_max = header.get("n_max")
+        if n_max is not None and int(n_max) != int(dataset.n_max):
+            raise RegistryError(
+                f"artifact {entry.alias or entry.key} was exported for "
+                f"n_max={n_max} but the serving panel pads to "
+                f"{dataset.n_max}; re-export at this width or align "
+                f"--max_stocks")
+        out = np.full((len(days), dataset.n_max), np.nan, np.float32)
+        for i, day in enumerate(np.asarray(days, np.int64)):
+            x, _, mask = dataset.day_batch(int(day))
+            scores = entry.artifact.call(
+                np.asarray(x, np.float32)[None],
+                np.asarray(mask, bool)[None])
+            out[i] = np.asarray(scores, np.float32)[0]
+        return out
+
+    def warmup(self, dataset, names: Optional[list] = None,
+               stochastic: Optional[bool] = False) -> dict:
+        """Compile every (or the named) entries against this dataset's
+        shapes with a one-day scoring pass — the daemon's --warmup
+        path, so the first REAL request is already warm. Returns
+        {key: compile_seconds}."""
+        days = dataset.split_days(None, None)[:1]
+        walls = {}
+        for key in list(names or self._entries):
+            entry = self.get(key)
+            if entry.compiled:
+                continue
+            self.score(key, dataset, days, stochastic=stochastic)
+            walls[entry.key] = entry.compile_s
+        return walls

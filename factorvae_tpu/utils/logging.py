@@ -79,6 +79,16 @@ def backend_env() -> dict:
     }
 
 
+def config_hash(config: dict) -> str:
+    """Canonical 12-hex digest of a config dict — THE identity every
+    subsystem keys on: the `run_meta` header of a metrics stream, the
+    AOT artifact header (eval/export_aot.py) and the serving model
+    registry (serve/registry.py) must all agree on what "same config"
+    means, so the hash function lives in exactly one place."""
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
 def run_meta(config: Optional[dict] = None,
              run_name: Optional[str] = None) -> dict:
     """Header fields for the first record of a metrics stream. jax is
@@ -96,8 +106,7 @@ def run_meta(config: Optional[dict] = None,
             meta["platform"] = None
             meta["device_count"] = None
     if config is not None:
-        blob = json.dumps(config, sort_keys=True, default=str)
-        meta["config_hash"] = hashlib.sha256(blob.encode()).hexdigest()[:12]
+        meta["config_hash"] = config_hash(config)
     return meta
 
 

@@ -42,7 +42,15 @@ Usage:
     python scripts/autotune_plan.py --fleet               # + fleet knob race
     python scripts/autotune_plan.py --stream              # + residency race
     python scripts/autotune_plan.py --mesh                # + mesh-shape race
+    python scripts/autotune_plan.py --serve               # + precision ladder
         [--out PLAN_TABLE.json] [--dry_run] [--metrics_jsonl RUN.jsonl]
+
+`--serve` races the serving-precision ladder (f32/bf16/int8) through
+the model-registry scoring path (serve/registry.py) on the winning
+score layout; a sub-f32 winner persists as the row's `serve` block
+(`Plan.serve_precision`) ONLY when its measured rank fidelity vs f32
+clears the floor — rows without the block serve float32, bitwise the
+offline scan.
 
 Race progress is emitted as structured events through MetricsLogger
 (echoed to stderr; stdout stays the table-JSON artifact). With
@@ -101,6 +109,15 @@ FLEET_CANDIDATES = [1, 2, 4, 8]
 # host->device transfer, data/stream.py). HBM is always in the raced
 # set, so a persisted row can never regress an in-memory workload.
 STREAM_CHUNK_CANDIDATES = [16, 32, 64]
+# --serve: serving-precision ladder raced through the registry scoring
+# path (serve/registry.py; ISSUE 8) on the winning SCORE knobs. f32 is
+# always in the raced set (it IS the offline scan, bitwise), and a
+# lower rung only wins when its measured per-day Spearman rank
+# correlation vs f32 clears the floor — serving speed must not buy
+# rank-order corruption the backtest would feel. bench_int8_scoring.py
+# is a thin shim over the same race (one variant per rung).
+SERVE_PRECISIONS = ["float32", "bfloat16", "int8"]
+SERVE_FIDELITY_FLOOR = 0.99
 # --mesh: mesh-shape race on the winning train knobs — every
 # (data x stock) factorization of the visible devices, with the no-mesh
 # serial path always in the raced set (a persisted "mesh" block can
@@ -295,6 +312,85 @@ def race_stream(name: str, shape: dict, train_knobs: dict,
     }
 
 
+def _rank_corr(a, b) -> float:
+    """Mean per-day Spearman rank correlation between two (D, N_max)
+    score grids (NaN = padding), through `ops.stats.masked_spearman` —
+    average-rank (scipy) semantics, the SAME statistic eval/metrics
+    RankIC consumes. Tie handling matters exactly here: int8
+    quantization coarsens scores and CREATES ties, and argsort-based
+    ranking would break them arbitrarily, biasing the fidelity number
+    the SERVE_FIDELITY_FLOOR gates."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from factorvae_tpu.ops.stats import masked_spearman
+
+    cs = []
+    for i in range(a.shape[0]):
+        v = np.isfinite(a[i]) & np.isfinite(b[i])
+        if v.sum() < 3:
+            continue
+        c = float(masked_spearman(
+            jnp.asarray(np.nan_to_num(a[i]), jnp.float32),
+            jnp.asarray(np.nan_to_num(b[i]), jnp.float32),
+            jnp.asarray(v)))
+        if np.isfinite(c):
+            cs.append(c)
+    return float(np.mean(cs)) if cs else float("nan")
+
+
+def race_serve(name: str, shape: dict, score_knobs: dict,
+               days: int, reps: int, logger=None) -> dict:
+    """Race the serving-precision ladder (f32 -> bf16 -> int8) through
+    the registry scoring path on the winning SCORE layout; return the
+    row's `serve` block. A rung is only eligible when its measured
+    rank fidelity vs float32 clears SERVE_FIDELITY_FLOOR — f32 (the
+    bitwise offline scan) is always eligible, so a persisted winner can
+    never corrupt rank order past the documented floor."""
+    from factorvae_tpu.serve.registry import ModelRegistry
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, "float32", score_knobs["flatten_days"],
+                     dps=1, days=days)
+    state = Trainer(cfg, ds, logger=MetricsLogger(echo=False)).init_state()
+    day_idx = ds.split_days(None, None)
+    reg = ModelRegistry()
+    measured: dict = {}
+    fidelity: dict = {}
+    baseline = None
+    best, best_wps = "float32", None
+    for prec in SERVE_PRECISIONS:
+        key = reg.register_params(state.params, cfg, precision=prec)
+        reg.score(key, ds, day_idx)  # warmup/compile
+        t0 = time.time()
+        for _ in range(reps):
+            out = reg.score(key, ds, day_idx)
+        wps = reps * len(day_idx) * shape["stocks"] / (time.time() - t0)
+        if prec == "float32":
+            baseline = out
+            corr = 1.0
+        else:
+            corr = _rank_corr(out, baseline)
+        measured[prec] = round(wps, 1)
+        fidelity[prec] = round(corr, 4)
+        _log(logger, "autotune_serve_candidate", shape=name,
+             precision=prec, windows_per_sec=round(wps, 1),
+             rank_fidelity=round(corr, 4))
+        eligible = corr == corr and corr >= SERVE_FIDELITY_FLOOR
+        if eligible and (best_wps is None or wps > best_wps):
+            best, best_wps = prec, wps
+    return {
+        "precision": best,
+        "measured": measured,
+        "fidelity": fidelity,
+        "source": f"serve precision race on score "
+                  f"flat={int(score_knobs['flatten_days'])}: best {best} "
+                  f"at {best_wps:,.0f} w/s (rank-fidelity floor "
+                  f"{SERVE_FIDELITY_FLOOR})",
+    }
+
+
 def _time_serial_mesh(shape: dict, train_knobs: dict, dps: int,
                       days: int, reps: int, mesh=None) -> float:
     """Seconds per trained day for one (mesh-or-none, days_per_step)
@@ -428,7 +524,8 @@ def _existing_measured_row(shape: dict, platform: str):
 
 def race_shape(name: str, shape: dict, days: int, reps: int,
                fleet: bool = False, stream: bool = False,
-               mesh: bool = False, logger=None) -> dict:
+               mesh: bool = False, serve: bool = False,
+               logger=None) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
     plan-table row.
@@ -514,6 +611,10 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
     if stream:
         stream_block = race_stream(name, shape, best_train_key, days,
                                    reps, logger=logger)
+    serve_block = None
+    if serve:
+        serve_block = race_serve(name, shape, best_score_key, days,
+                                 reps, logger=logger)
     mesh_block = None
     if mesh:
         mesh_block = race_mesh(name, shape, best_train_key, days,
@@ -527,6 +628,9 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         measured["fleet"] = fleet_block.pop("measured")
     if stream_block is not None:
         measured["stream"] = stream_block.pop("measured")
+    if serve_block is not None:
+        measured["serve"] = {"rates": serve_block.pop("measured"),
+                             "fidelity": serve_block.pop("fidelity")}
     if mesh_block is not None:
         measured["mesh"] = mesh_block.pop("measured")
     row = {
@@ -553,6 +657,13 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         row["stream"] = {"panel_residency": stream_block["panel_residency"],
                          "chunk_days": stream_block["chunk_days"]}
         row["source"] += f"; {stream_block['source']}"
+    if serve_block is not None:
+        row["source"] += f"; {serve_block['source']}"
+        if serve_block["precision"] != "float32":
+            # f32 winners persist NO block (the conservative default —
+            # plan_for resolves absent blocks to float32, which is
+            # bitwise the offline scan), same rule as no-mesh winners.
+            row["serve"] = {"precision": serve_block["precision"]}
     if mesh_block is not None:
         row["source"] += f"; {mesh_block['source']}"
         if mesh_block["data_axis"] > 0 and mesh_block["stock_axis"] > 0:
@@ -569,7 +680,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
 
 def race_widths(name: str, shape: dict, days: int, reps: int,
                 fleet: bool = False, stream: bool = False,
-                mesh: bool = False, logger=None) -> list:
+                mesh: bool = False, serve: bool = False,
+                logger=None) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
     envelope row — both bounds measured, no extrapolation beyond them
@@ -580,15 +692,15 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
         widths = [widths]
     rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
                        fleet=fleet, stream=stream, mesh=mesh,
-                       logger=logger)
+                       serve=serve, logger=logger)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
         p = merged[-1]
         if (r["train"], r["score"], r.get("fleet"), r.get("stream"),
-                r.get("mesh")) != (
+                r.get("mesh"), r.get("serve")) != (
                 p["train"], p["score"], p.get("fleet"), p.get("stream"),
-                p.get("mesh")):
+                p.get("mesh"), p.get("serve")):
             merged.append(r)
             continue
         if not any(k.startswith("n=") for k in p["measured"]):
@@ -641,6 +753,18 @@ def main() -> int:
                         "-> Plan.mesh_data_axis/mesh_stock_axis; "
                         "no-mesh winners persist NO block, and rows "
                         "without one keep the run's own MeshConfig)")
+    p.add_argument("--serve", action="store_true",
+                   help="also race the serving-precision ladder "
+                        f"({'/'.join(SERVE_PRECISIONS)}, "
+                        "serve/registry.py) through the registry "
+                        "scoring path on each shape's winning score "
+                        "layout; a sub-f32 winner (eligible only past "
+                        f"the {SERVE_FIDELITY_FLOOR} rank-fidelity "
+                        "floor vs f32) is persisted on the row's "
+                        "'serve' block (plan_for -> "
+                        "Plan.serve_precision; f32 winners persist NO "
+                        "block and rows without one serve float32 — "
+                        "bitwise the offline scan)")
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="with --mesh under JAX_PLATFORMS=cpu: force "
                         "this many virtual host-CPU devices (the test-"
@@ -701,7 +825,8 @@ def main() -> int:
                         for r in race_widths(n, SHAPES[n], args.days,
                                              args.reps, fleet=args.fleet,
                                              stream=args.stream,
-                                             mesh=args.mesh, logger=lg)]
+                                             mesh=args.mesh,
+                                             serve=args.serve, logger=lg)]
             print(json.dumps({"rows": rows}, indent=1))
             if args.dry_run:
                 lg.log("autotune_dry_run", rows=len(rows),

@@ -1,23 +1,30 @@
-"""Race f32 vs int8-weight scoring at flagship shapes on the current
-backend. Prints one JSON line per variant plus a summary.
+"""Race the serving-precision ladder (f32 vs int8, optionally bf16) at
+the flagship shape on the current backend — a THIN SHIM over the
+serve/plan precision path (serve/registry.py; the same rungs
+`scripts/autotune_plan.py --serve` races into the plan table and the
+scoring daemon serves).
+
+Prints one JSON line per variant plus a summary, and ALWAYS writes the
+`BENCH_INT8_SCORING.json` artifact with the resolved `plan` block and
+the measuring process's `run_meta` (the bench_reference_cpu.py
+convention), so the perf ledger can track the series
+(`python -m factorvae_tpu.obs.ledger --backfill` picks it up; the
+artifact IS a ledger payload: metric/value/unit at top level).
 
 Usage: python scripts/bench_int8_scoring.py [--days 256] [--reps 5]
-
-The scoring path (eval/predict.predict_panel) is chunked jitted
-day-batched inference; the int8 variant stores weights in HBM as
-per-channel int8 and dequantizes in the compiled program (ops/quant.py).
-At FactorVAE sizes the win to measure is parameter-byte residency and
-any bandwidth-bound speedup; fidelity is tested in tests/test_quant.py.
+           [--bf16] [--out BENCH_INT8_SCORING.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 
 def main() -> int:
@@ -25,15 +32,30 @@ def main() -> int:
     ap.add_argument("--days", type=int, default=256)
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--stocks", type=int, default=356)
+    ap.add_argument("--bf16", action="store_true",
+                    help="race the bfloat16 rung too (default: the "
+                         "historical f32-vs-int8 A/B)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: BENCH_INT8_SCORING."
+                         "json at the repo root)")
     args = ap.parse_args()
 
     import jax
     import numpy as np
 
-    from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from factorvae_tpu import plan as planlib
+    from factorvae_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        TrainConfig,
+    )
     from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
-    from factorvae_tpu.eval.predict import predict_panel
+    from factorvae_tpu.models.factorvae import day_prediction
     from factorvae_tpu.ops.quant import quantize_params, tree_nbytes
+    from factorvae_tpu.serve.registry import ModelRegistry
+    from factorvae_tpu.utils.logging import run_meta
 
     platform = jax.devices()[0].platform
     cfg = Config(
@@ -44,13 +66,12 @@ def main() -> int:
         train=TrainConfig(seed=0),
     )
     ds = PanelDataset(
-        synthetic_panel_dense(num_days=args.days, num_instruments=356,
+        synthetic_panel_dense(num_days=args.days,
+                              num_instruments=args.stocks,
                               num_features=158),
         seq_len=20, pad_multiple=8,
     )
     import jax.numpy as jnp
-
-    from factorvae_tpu.models.factorvae import day_prediction
 
     model = day_prediction(cfg.model, stochastic=False)
     x0 = jnp.zeros((1, ds.n_max, 20, 158), jnp.float32)
@@ -60,35 +81,74 @@ def main() -> int:
         x0, m0)
     days = ds.split_days(None, None)
 
+    # The planner's decision block for this (platform, shape) — the
+    # provenance every tracked bench row carries.
+    shape = planlib.shape_of(cfg, args.stocks)
+    plan = planlib.plan_for(shape, platform=platform)
+    plan_block = plan.describe(shape, platform=platform)
+
     f32_bytes = tree_nbytes(params)
     i8_bytes = tree_nbytes(quantize_params(params))
 
-    results = {}
-    for name, kw in [("f32", {}), ("int8", {"int8": True})]:
-        # compile + warm
-        predict_panel(params, cfg, ds, days[: args.chunk], stochastic=False,
-                      chunk=args.chunk, **kw)
+    reg = ModelRegistry()
+    ladder = ["f32"] + (["bf16"] if args.bf16 else []) + ["int8"]
+    precision_of = {"f32": "float32", "bf16": "bfloat16", "int8": "int8"}
+    results: dict = {}
+    variants: dict = {}
+    for name in ladder:
+        key = reg.register_params(params, cfg,
+                                  precision=precision_of[name])
+        # compile + warm through the registry's scoring entry point —
+        # the exact request path the daemon serves.
+        reg.score(key, ds, days[: args.chunk], stochastic=False,
+                  chunk=args.chunk)
         times = []
         for _ in range(args.reps):
             t0 = time.perf_counter()
-            out = predict_panel(params, cfg, ds, days, stochastic=False,
-                                chunk=args.chunk, **kw)
+            out = reg.score(key, ds, days, stochastic=False,
+                            chunk=args.chunk)
             times.append(time.perf_counter() - t0)
         med = float(np.median(times))
         dps = len(days) / med
         results[name] = dps
-        print(json.dumps({
+        variants[name] = {
             "variant": name, "platform": platform, "days": len(days),
             "seconds": round(med, 4), "days_per_sec": round(dps, 1),
             "windows_per_sec": round(dps * ds.n_max, 1),
             "param_bytes": i8_bytes if name == "int8" else f32_bytes,
             "finite": bool(np.isfinite(out).any()),
-        }))
-    print(json.dumps({
+        }
+        print(json.dumps(variants[name]))
+    summary = {
         "summary": "int8_vs_f32_scoring",
         "speedup": round(results["int8"] / results["f32"], 3),
         "bytes_ratio": round(f32_bytes / i8_bytes, 2),
-    }))
+    }
+    print(json.dumps(summary))
+
+    # Ledger-trackable artifact (always written): the int8 rung's
+    # windows/sec is the headline — the rung this script exists to
+    # watch — with every variant, the plan block and the measuring
+    # rig's run_meta alongside.
+    artifact = {
+        "metric": f"serve_int8_scoring_N{args.stocks}_d{args.days}",
+        "value": round(results["int8"] * ds.n_max, 1),
+        "unit": "windows/sec",
+        "platform": platform,
+        "vs_baseline": None,
+        "speedup_vs_f32": summary["speedup"],
+        "bytes_ratio": summary["bytes_ratio"],
+        "variants": variants,
+        "plan": plan_block,
+        "run_meta": run_meta(config=cfg.to_dict()),
+    }
+    out_path = args.out or os.path.join(REPO, "BENCH_INT8_SCORING.json")
+    try:
+        with open(out_path, "w") as fh:
+            json.dump(artifact, fh, indent=1)
+            fh.write("\n")
+    except OSError as e:  # read-only checkout: report, don't crash
+        print(f"[bench_int8] artifact not written: {e}", file=sys.stderr)
     return 0
 
 

@@ -54,9 +54,15 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # The PR-6 composition classes are quick BY DESIGN: tier-1 must exercise
 # the mesh x fleet x stream oracles on a real multi-device CPU mesh
 # (this rig's 8 virtual devices -> a genuine 2x2), not a 1x1 degenerate;
-# the widest grids stay slow (TestComposedWideGrid).
+# the widest grids stay slow (TestComposedWideGrid). The ISSUE-8 serve
+# classes are quick BY DESIGN too: tier-1 must exercise the scoring
+# daemon path — registry/ladder/dispatch in-process plus the stdin
+# subprocess end-to-end and the compile-cache warm restart.
 _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
-                  "TestComposeValidate", "TestComposedOracles"}
+                  "TestComposeValidate", "TestComposedOracles",
+                  "TestRegistry", "TestPrecisionLadder",
+                  "TestMultiModelDispatch", "TestDaemonProtocol",
+                  "TestServeDaemonE2E", "TestWarmRestart"}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -3,6 +3,7 @@ selection, envelope matching, table persistence round-trips, the
 scale-aware pad policy, and config application."""
 
 import dataclasses
+import os
 import json
 
 import pytest
@@ -450,3 +451,79 @@ class TestMeshKnob:
         save_rows([row()], path=str(path2))
         p2 = plan_for(K60, "cpu", table=load_table(str(path2)))
         assert (p2.mesh_data_axis, p2.mesh_stock_axis) == (0, 0)
+
+
+class TestServeKnob:
+    """serve_precision (serve/registry.py's planner knob, ISSUE 8):
+    raced rows carry a 'serve' block; rows without one (every existing
+    table) must keep resolving exactly as before — float32, the rung
+    that is bitwise the offline scan."""
+
+    def test_serve_row_resolves_precision(self):
+        p = plan_for(K60, "cpu",
+                     table=[row(serve={"precision": "int8"})])
+        assert p.provenance == "measured"
+        assert p.serve_precision == "int8"
+        assert p.describe(K60, platform="cpu")["serve_precision"] == \
+            "int8"
+
+    def test_pre_issue8_row_serves_float32(self):
+        p = plan_for(K60, "cpu", table=[row()])
+        assert p.provenance == "measured"
+        assert p.serve_precision == "float32"
+
+    def test_default_plan_serves_float32(self):
+        assert plan_for(K60, "cpu", table=[]).serve_precision == \
+            "float32"
+        assert plan_for(FLAGSHIP, "tpu", table=[]).serve_precision == \
+            "float32"
+
+    def test_null_serve_block_tolerated(self):
+        assert plan_for(K60, "cpu",
+                        table=[row(serve=None)]).serve_precision == \
+            "float32"
+        assert plan_for(K60, "cpu",
+                        table=[row(serve={})]).serve_precision == \
+            "float32"
+
+    def test_serve_table_file_round_trip(self, tmp_path):
+        path = tmp_path / "table.json"
+        save_rows([row(serve={"precision": "bfloat16"})],
+                  path=str(path))
+        p = plan_for(K60, "cpu", table=load_table(str(path)))
+        assert p.serve_precision == "bfloat16"
+
+
+class TestCompilationCache:
+    """plan.setup_compilation_cache (ISSUE 8): flag > env > off, 'off'
+    is the explicit opt-out, and the returned dir is what jax was
+    pointed at."""
+
+    def test_disabled_without_path_or_env(self, monkeypatch):
+        monkeypatch.delenv(planlib.COMPILE_CACHE_ENV, raising=False)
+        assert planlib.setup_compilation_cache() is None
+
+    def test_off_sentinel_disables_despite_env(self, monkeypatch,
+                                               tmp_path):
+        monkeypatch.setenv(planlib.COMPILE_CACHE_ENV,
+                           str(tmp_path / "envcache"))
+        assert planlib.setup_compilation_cache("off") is None
+
+    def test_explicit_path_wins_and_configures_jax(self, monkeypatch,
+                                                   tmp_path):
+        import jax
+
+        monkeypatch.setenv(planlib.COMPILE_CACHE_ENV,
+                           str(tmp_path / "envcache"))
+        before = jax.config.jax_compilation_cache_dir
+        try:
+            got = planlib.setup_compilation_cache(
+                str(tmp_path / "flagcache"))
+            assert got == str(tmp_path / "flagcache")
+            assert os.path.isdir(got)
+            assert jax.config.jax_compilation_cache_dir == got
+            # env-only resolution
+            got2 = planlib.setup_compilation_cache()
+            assert got2 == str(tmp_path / "envcache")
+        finally:
+            jax.config.update("jax_compilation_cache_dir", before)

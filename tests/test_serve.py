@@ -1,0 +1,613 @@
+"""Production scoring service (ISSUE 8): registry, precision ladder,
+multi-model dispatch, daemon drivers, warm restart.
+
+The contracts pinned here are the acceptance bar of the serving PR:
+- registry hit/miss/LRU-eviction/cold-start accounting and config-hash
+  keying (the same digest run_meta and the AOT headers carry);
+- the f32 rung of the precision ladder is BITWISE `eval/predict`'s
+  scan path (it IS that path); bf16/int8 stay within the documented
+  tolerances (rank fidelity for int8 — what the backtest consumes);
+- fused multi-model dispatch equals per-model serial scoring within
+  the fleet-scoring tolerance (tests/test_fleet.py's pin);
+- the stdin JSONL daemon works end-to-end as a subprocess;
+- with a persistent compilation cache, a daemon RESTART emits ZERO
+  `compile` records (they classify as `compile_cached` — the process
+  deserialized, it built nothing) and serves byte-identical responses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+from factorvae_tpu.models.factorvae import load_model
+from factorvae_tpu.serve.daemon import ScoringDaemon, serve_stdin
+from factorvae_tpu.serve.registry import (
+    ModelRegistry,
+    RegistryError,
+    precision_config,
+)
+from factorvae_tpu.utils.logging import config_hash
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(num_features=6, hidden_size=8, num_factors=4,
+            num_portfolios=8, seq_len=5)
+
+
+def tiny_cfg(seed: int = 0, **model_kw) -> Config:
+    return Config(
+        model=ModelConfig(stochastic_inference=False, **TINY, **model_kw),
+        data=DataConfig(seq_len=TINY["seq_len"], start_time=None,
+                        fit_end_time=None, val_start_time=None,
+                        val_end_time=None),
+        train=TrainConfig(seed=seed),
+    )
+
+
+def tiny_params(cfg: Config, n_max: int):
+    return load_model(cfg, n_max=n_max)[1]
+
+
+@pytest.fixture(scope="module")
+def tiny_ds():
+    panel = synthetic_panel_dense(num_days=16, num_instruments=12,
+                                  num_features=TINY["num_features"])
+    return PanelDataset(panel, seq_len=TINY["seq_len"])
+
+
+@pytest.fixture(scope="module")
+def registry_two(tiny_ds):
+    """A registry holding two distinct model variants (seeds 0/1 —
+    different config hashes) plus their configs/params for oracles."""
+    reg = ModelRegistry()
+    models = {}
+    for s in (0, 1):
+        cfg = tiny_cfg(seed=s)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        key = reg.register_params(params, cfg, alias=f"seed{s}")
+        models[key] = (cfg, params)
+    return reg, models
+
+
+class TestRegistry:
+    def test_key_is_config_hash(self, tiny_ds):
+        reg = ModelRegistry()
+        cfg = tiny_cfg(seed=7)
+        key = reg.register_params(tiny_params(cfg, tiny_ds.n_max), cfg)
+        assert key == config_hash(cfg.to_dict())
+
+    def test_hit_miss_accounting(self, tiny_ds):
+        reg = ModelRegistry()
+        cfg = tiny_cfg()
+        key = reg.register_params(tiny_params(cfg, tiny_ds.n_max), cfg,
+                                  alias="a")
+        reg.get(key)
+        reg.get("a")  # alias resolves to the same entry
+        with pytest.raises(RegistryError, match="unknown model"):
+            reg.get("nope")
+        assert (reg.hits, reg.misses) == (2, 1)
+
+    def test_unknown_model_names_known_keys(self, tiny_ds):
+        reg = ModelRegistry()
+        cfg = tiny_cfg()
+        reg.register_params(tiny_params(cfg, tiny_ds.n_max), cfg,
+                            alias="prod")
+        with pytest.raises(RegistryError, match="prod"):
+            reg.get("staging")
+
+    def test_lru_eviction_by_bytes(self, tiny_ds):
+        reg = ModelRegistry(budget_bytes=1)  # only the newest survives
+        for s in (0, 1, 2):
+            cfg = tiny_cfg(seed=s)
+            reg.register_params(tiny_params(cfg, tiny_ds.n_max), cfg,
+                                alias=f"s{s}")
+        assert reg.evictions == 2
+        assert reg.keys() == [config_hash(tiny_cfg(seed=2).to_dict())]
+        # LRU order follows USE, not insertion: touch the older of two.
+        reg2 = ModelRegistry()
+        k0 = reg2.register_params(
+            tiny_params(tiny_cfg(0), tiny_ds.n_max), tiny_cfg(0))
+        k1 = reg2.register_params(
+            tiny_params(tiny_cfg(1), tiny_ds.n_max), tiny_cfg(1))
+        reg2.get(k0)  # k1 is now least-recently-used
+        reg2.budget_bytes = reg2.total_bytes() - 1
+        k2 = reg2.register_params(
+            tiny_params(tiny_cfg(2), tiny_ds.n_max), tiny_cfg(2))
+        assert k1 not in reg2.keys()
+        assert set(reg2.keys()) <= {k0, k1, k2}
+
+    def test_eviction_cold_starts_from_disk(self, tiny_ds, tmp_path):
+        from factorvae_tpu.train.checkpoint import save_params
+
+        reg = ModelRegistry()
+        cfg = tiny_cfg(seed=3)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        save_params(str(tmp_path), "w3", params)
+        with open(tmp_path / "w3" / "serve_config.json", "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        key = reg.register_checkpoint(str(tmp_path / "w3"))
+        # Evict it by admitting another model under a tiny budget...
+        reg.budget_bytes = 1
+        cfg2 = tiny_cfg(seed=4)
+        reg.register_params(tiny_params(cfg2, tiny_ds.n_max), cfg2)
+        assert key not in reg.keys()
+        # ...then the next request brings it back from its source path.
+        entry = reg.get(key)
+        assert entry.key == key and reg.cold_starts == 1
+
+    def test_failed_cold_start_stays_actionable(self, tiny_ds, tmp_path):
+        """A tombstoned entry whose source vanished answers EVERY
+        retry with a RegistryError (the daemon's {"ok": false} path) —
+        the first failed reload must not consume the tombstone and
+        turn the second request into a KeyError crash."""
+        import shutil
+
+        from factorvae_tpu.train.checkpoint import save_params
+
+        reg = ModelRegistry()
+        cfg = tiny_cfg(seed=5)
+        save_params(str(tmp_path), "w5", tiny_params(cfg, tiny_ds.n_max))
+        with open(tmp_path / "w5" / "serve_config.json", "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        key = reg.register_checkpoint(str(tmp_path / "w5"), alias="prod")
+        reg.budget_bytes = 1
+        cfg2 = tiny_cfg(seed=6)
+        reg.register_params(tiny_params(cfg2, tiny_ds.n_max), cfg2)
+        assert key not in reg.keys()
+        shutil.rmtree(tmp_path / "w5")
+        for _ in range(2):  # the retry is the regression
+            with pytest.raises(RegistryError):
+                reg.get("prod")
+        assert reg.cold_starts == 0
+
+    def test_precisions_are_distinct_entries(self, tiny_ds):
+        """One config's f32 and int8 variants coexist: the sub-f32 key
+        carries a :{precision} suffix, so the second admission must
+        not silently replace the first."""
+        reg = ModelRegistry()
+        cfg = tiny_cfg()
+        p = tiny_params(cfg, tiny_ds.n_max)
+        kf = reg.register_params(p, cfg, precision="float32")
+        ki = reg.register_params(p, cfg, precision="int8")
+        assert kf != ki and ki == f"{kf}:int8"
+        assert set(reg.keys()) == {kf, ki}
+        assert reg.get(kf).precision == "float32"
+        assert reg.get(ki).precision == "int8"
+
+    def test_plan_row_resolves_precision(self, tiny_ds):
+        cfg = tiny_cfg()
+        m = cfg.model
+        row = {
+            "platform": "cpu",
+            "shape": {"c": m.num_features, "t": m.seq_len,
+                      "h": m.hidden_size, "k": m.num_factors,
+                      "m": m.num_portfolios},
+            "n_min": 1, "n_max": 64,
+            "train": {"flatten_days": False, "days_per_step": 1,
+                      "compute_dtype": "float32"},
+            "serve": {"precision": "int8"},
+            "source": "test row",
+        }
+        reg = ModelRegistry(plan_table=[row])
+        key = reg.register_params(tiny_params(cfg, tiny_ds.n_max), cfg,
+                                  n_stocks=12)
+        assert reg.get(key).precision == "int8"
+        # Without a width there is nothing to match: conservative f32.
+        reg2 = ModelRegistry(plan_table=[row])
+        key2 = reg2.register_params(tiny_params(cfg, tiny_ds.n_max), cfg)
+        assert reg2.get(key2).precision == "float32"
+
+
+class TestPrecisionLadder:
+    def test_f32_bitwise_predict_scan(self, registry_two, tiny_ds):
+        from factorvae_tpu.eval.predict import predict_panel
+
+        reg, models = registry_two
+        days = tiny_ds.split_days(None, None)
+        for key, (cfg, params) in models.items():
+            served = reg.score(key, tiny_ds, days, stochastic=False)
+            ref = predict_panel(params, cfg, tiny_ds, days,
+                                stochastic=False)
+            np.testing.assert_array_equal(served, ref)
+
+    def test_int8_entry_prequantized_and_faithful(self, tiny_ds):
+        from factorvae_tpu.ops.quant import is_quantized
+
+        cfg = tiny_cfg(seed=5)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        reg = ModelRegistry()
+        kf = reg.register_params(params, cfg, precision="float32")
+        f32 = reg.score(kf, tiny_ds, tiny_ds.split_days(None, None),
+                        stochastic=False)
+        k8 = reg.register_params(params, cfg, precision="int8")
+        assert is_quantized(reg.get(k8).params)  # quantized at admission
+        i8 = reg.score(k8, tiny_ds, tiny_ds.split_days(None, None),
+                       stochastic=False)
+        # int8's documented guarantee is RANK fidelity (docs/serving.md)
+        # judged by the SAME average-rank statistic the fidelity floor
+        # uses (ops.stats.masked_spearman): int8 coarsening creates
+        # ties, and argsort-based ranking would break them arbitrarily.
+        import jax.numpy as jnp
+
+        from factorvae_tpu.ops.stats import masked_spearman
+
+        for a, b in zip(f32, i8):
+            v = np.isfinite(a) & np.isfinite(b)
+            corr = float(masked_spearman(
+                jnp.asarray(np.nan_to_num(a), jnp.float32),
+                jnp.asarray(np.nan_to_num(b), jnp.float32),
+                jnp.asarray(v)))
+            assert corr >= 0.99
+
+    def test_bf16_within_tolerance(self, tiny_ds):
+        cfg = tiny_cfg(seed=6)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        reg = ModelRegistry()
+        days = tiny_ds.split_days(None, None)
+        kf = reg.register_params(params, cfg, precision="float32")
+        f32 = reg.score(kf, tiny_ds, days, stochastic=False)
+        kb = reg.register_params(params, cfg, precision="bfloat16")
+        bf16 = reg.score(kb, tiny_ds, days, stochastic=False)
+        v = np.isfinite(f32)
+        assert np.isfinite(bf16)[v].all()
+        np.testing.assert_allclose(bf16[v], f32[v], rtol=0.1, atol=0.05)
+
+    def test_precision_config_rungs(self):
+        cfg = tiny_cfg()
+        assert precision_config(cfg, "bfloat16").model.compute_dtype == \
+            "bfloat16"
+        # int8 quantizes WEIGHTS; activations stay f32
+        assert precision_config(cfg, "int8").model.compute_dtype == \
+            "float32"
+        with pytest.raises(RegistryError, match="precision"):
+            precision_config(cfg, "fp8")
+
+
+class TestMultiModelDispatch:
+    def test_fused_equals_serial(self, registry_two, tiny_ds):
+        reg, models = registry_two
+        daemon = ScoringDaemon(reg, tiny_ds)
+        day = 3
+        serial = {alias: daemon.handle({"model": alias, "day": day})
+                  for alias in ("seed0", "seed1")}
+        fused = daemon.handle_batch([
+            {"id": 0, "model": "seed0", "day": day},
+            {"id": 1, "model": "seed1", "day": day},
+        ])
+        assert [r["batched_with"] for r in fused] == [2, 2]
+        for resp in fused:
+            ref = serial[resp["alias"]]
+            assert (resp["results"][0]["instruments"]
+                    == ref["results"][0]["instruments"])
+            np.testing.assert_allclose(
+                np.asarray(resp["results"][0]["scores"], np.float32),
+                np.asarray(ref["results"][0]["scores"], np.float32),
+                rtol=2e-4, atol=2e-5)  # the fleet-scoring pin
+
+    def test_same_model_twice_shares_one_dispatch(self, registry_two,
+                                                  tiny_ds):
+        reg, _ = registry_two
+        daemon = ScoringDaemon(reg, tiny_ds)
+        before = daemon.dispatches
+        out = daemon.handle_batch([
+            {"id": 0, "model": "seed0", "day": 1},
+            {"id": 1, "model": "seed0", "day": 1},
+        ])
+        assert daemon.dispatches == before + 1
+        assert out[0]["results"][0]["scores"] == \
+            out[1]["results"][0]["scores"]
+        # duplicate keys are ONE lane, not a fleet
+        assert [r["batched_with"] for r in out] == [1, 1]
+
+    def test_mixed_precision_never_fuses(self, tiny_ds):
+        reg = ModelRegistry()
+        c0, c1 = tiny_cfg(seed=0), tiny_cfg(seed=1)
+        reg.register_params(tiny_params(c0, tiny_ds.n_max), c0,
+                            precision="float32", alias="f")
+        reg.register_params(tiny_params(c1, tiny_ds.n_max), c1,
+                            precision="int8", alias="q")
+        daemon = ScoringDaemon(reg, tiny_ds)
+        out = daemon.handle_batch([
+            {"id": 0, "model": "f", "day": 0},
+            {"id": 1, "model": "q", "day": 0},
+        ])
+        assert [r["batched_with"] for r in out] == [1, 1]
+        assert [r["precision"] for r in out] == ["float32", "int8"]
+
+    def test_int8_fleet_bucket(self, tiny_ds):
+        """Two int8 entries of one architecture DO fuse (the stacked
+        QTensor path through predict_panel_fleet's int8 leg)."""
+        reg = ModelRegistry()
+        serial = {}
+        for s in (0, 1):
+            cfg = tiny_cfg(seed=s)
+            reg.register_params(tiny_params(cfg, tiny_ds.n_max), cfg,
+                                precision="int8", alias=f"q{s}")
+        daemon = ScoringDaemon(reg, tiny_ds)
+        for s in (0, 1):
+            serial[f"q{s}"] = daemon.handle(
+                {"model": f"q{s}", "day": 2})
+        fused = daemon.handle_batch([
+            {"id": 0, "model": "q0", "day": 2},
+            {"id": 1, "model": "q1", "day": 2},
+        ])
+        assert [r["batched_with"] for r in fused] == [2, 2]
+        for resp in fused:
+            np.testing.assert_allclose(
+                np.asarray(resp["results"][0]["scores"], np.float32),
+                np.asarray(serial[resp["alias"]]["results"][0]["scores"],
+                           np.float32),
+                rtol=2e-4, atol=2e-5)
+
+
+class TestDaemonProtocol:
+    def test_day_by_date_and_errors(self, registry_two, tiny_ds):
+        import pandas as pd
+
+        reg, _ = registry_two
+        daemon = ScoringDaemon(reg, tiny_ds)
+        date = str(pd.Timestamp(tiny_ds.dates[2]).date())
+        ok = daemon.handle({"model": "seed0", "day": date})
+        assert ok["ok"] and ok["results"][0]["day"] == date
+        bad_day = daemon.handle({"model": "seed0",
+                                 "day": "1999-01-01"})
+        assert not bad_day["ok"] and "not in the serving panel" in \
+            bad_day["error"]
+        oob = daemon.handle({"model": "seed0", "day": 10 ** 6})
+        assert not oob["ok"] and "out of range" in oob["error"]
+        no_model = daemon.handle({"day": 0})
+        assert not no_model["ok"] and "model" in no_model["error"]
+
+    def test_cmds_and_top(self, registry_two, tiny_ds):
+        reg, _ = registry_two
+        daemon = ScoringDaemon(reg, tiny_ds)
+        stats = daemon.handle({"cmd": "stats"})
+        assert stats["ok"] and "registry" in stats
+        models = daemon.handle({"cmd": "models"})
+        assert models["ok"] and len(models["models"]) == 2
+        top = daemon.handle({"model": "seed0", "day": 0, "top": 3})
+        scores = top["results"][0]["scores"]
+        assert len(scores) == 3 and scores == sorted(scores,
+                                                     reverse=True)
+        down = daemon.handle({"cmd": "shutdown"})
+        assert down["ok"] and daemon.closing
+
+    def test_stdin_driver_inprocess(self, registry_two, tiny_ds):
+        """The driver loop itself, on non-selectable streams: arrays
+        are explicit ticks, bad JSON answers in place, order holds."""
+        import io
+
+        reg, _ = registry_two
+        daemon = ScoringDaemon(reg, tiny_ds)
+        inp = io.StringIO(
+            '{"id": 1, "model": "seed0", "day": 0}\n'
+            'not json\n'
+            '[{"id": 2, "model": "seed0", "day": 1},'
+            ' {"id": 3, "model": "seed1", "day": 1}]\n')
+        out = io.StringIO()
+        n = serve_stdin(daemon, inp, out)
+        rows = [json.loads(x) for x in out.getvalue().splitlines()]
+        assert n == 4 and len(rows) == 4
+        assert rows[0]["ok"] and rows[0]["id"] == 1
+        assert not rows[1]["ok"] and "bad JSON" in rows[1]["error"]
+        assert [r["id"] for r in rows[2:]] == [2, 3]
+        assert [r["batched_with"] for r in rows[2:]] == [2, 2]
+
+
+def _make_checkpoints(root, seeds=(0, 1)):
+    """Weights-only checkpoint dirs + serve_config.json drop-ins, the
+    daemon CLI's admission path (no training needed)."""
+    from factorvae_tpu.train.checkpoint import save_params
+
+    paths = []
+    for s in seeds:
+        cfg = tiny_cfg(seed=s)
+        params = tiny_params(cfg, 16)
+        name = f"m{s}"
+        save_params(str(root), name, params)
+        with open(os.path.join(str(root), name,
+                               "serve_config.json"), "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        paths.append(os.path.join(str(root), name))
+    return paths
+
+
+def _run_daemon(models, workdir, extra, inp=None):
+    cmd = [sys.executable, "-m", "factorvae_tpu.serve"]
+    for m in models:
+        cmd += ["--model", m]
+    cmd += ["--synthetic", "16,12"] + extra
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run(cmd, input=inp, capture_output=True,
+                          text=True, timeout=600, cwd=str(workdir),
+                          env=env)
+
+
+class TestServeDaemonE2E:
+    def test_stdin_daemon_subprocess(self, tmp_path):
+        models = _make_checkpoints(tmp_path)
+        reqs = ('{"id": 1, "model": "m0", "day": 0}\n'
+                '[{"id": 2, "model": "m0", "day": 1},'
+                ' {"id": 3, "model": "m1", "day": 1}]\n'
+                '{"cmd": "shutdown"}\n')
+        r = _run_daemon(models, tmp_path, [], inp=reqs)
+        assert r.returncode == 0, r.stderr
+        rows = [json.loads(x) for x in r.stdout.splitlines()]
+        assert [row["ok"] for row in rows] == [True] * 4
+        assert rows[0]["n"] == 12
+        assert [row["batched_with"] for row in rows[1:3]] == [2, 2]
+        assert rows[3]["cmd"] == "shutdown"
+        assert "[serve] ready: 2 model(s)" in r.stderr
+
+
+class TestWarmRestart:
+    def test_second_process_compiles_nothing(self, tmp_path):
+        """The compilation-cache warm restart: run the daemon twice
+        against the same cache dir. The first process pays real
+        compiles; the second deserializes everything — ZERO `compile`
+        records (they classify `compile_cached`) — and serves
+        byte-identical responses."""
+        models = _make_checkpoints(tmp_path)
+        with open(tmp_path / "reqs.jsonl", "w") as fh:
+            fh.write('{"id": 1, "model": "m0", "day": 0}\n'
+                     '[{"id": 2, "model": "m0", "day": 1},'
+                     ' {"id": 3, "model": "m1", "day": 1}]\n')
+        cache = str(tmp_path / "xla_cache")
+
+        def events(run):
+            with open(tmp_path / run) as fh:
+                return [json.loads(x).get("event") for x in fh]
+
+        outs = []
+        for i in (1, 2):
+            r = _run_daemon(
+                models, tmp_path,
+                ["--batch", "reqs.jsonl", "--out", f"out{i}.jsonl",
+                 "--metrics_jsonl", f"run{i}.jsonl",
+                 "--compile_cache", cache])
+            assert r.returncode == 0, r.stderr
+            outs.append((tmp_path / f"out{i}.jsonl").read_text())
+        ev1, ev2 = events("run1.jsonl"), events("run2.jsonl")
+        assert ev1.count("compile") > 0          # cold process built
+        assert ev2.count("compile") == 0         # warm restart: zero
+        assert ev2.count("compile_cached") > 0   # ...it deserialized
+        # latency fields differ run to run; scores must not
+        strip = [
+            [{k: v for k, v in json.loads(line).items()
+              if k != "latency_ms"} for line in out.splitlines()]
+            for out in outs
+        ]
+        assert strip[0] == strip[1]
+
+
+class TestFleetInt8Path:
+    def test_fleet_int8_matches_serial_int8(self, tiny_ds):
+        """The new int8 leg of predict_panel_fleet (the serving
+        dispatch's bucket) vs per-model serial int8 scoring."""
+        import jax
+        import jax.numpy as jnp
+
+        from factorvae_tpu.eval.predict import (
+            predict_panel,
+            predict_panel_fleet,
+        )
+        from factorvae_tpu.ops.quant import ensure_quantized
+
+        days = tiny_ds.split_days(None, None)[:4]
+        cfgs = [tiny_cfg(seed=s) for s in (0, 1)]
+        trees = [ensure_quantized(tiny_params(c, tiny_ds.n_max))
+                 for c in cfgs]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+        fleet = predict_panel_fleet(stacked, cfgs[0], tiny_ds, days,
+                                    stochastic=False, int8=True)
+        for i, (cfg, tree) in enumerate(zip(cfgs, trees)):
+            solo = predict_panel(tree, cfg, tiny_ds, days,
+                                 stochastic=False, int8=True)
+            np.testing.assert_allclose(
+                fleet[i][np.isfinite(solo)], solo[np.isfinite(solo)],
+                rtol=2e-4, atol=2e-5)
+
+
+class TestArtifactHeader:
+    @pytest.fixture(scope="class")
+    def export(self, tiny_ds):
+        from factorvae_tpu.eval.export_aot import export_prediction
+
+        cfg = tiny_cfg(seed=9)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        blob = export_prediction(params, cfg, n_max=tiny_ds.n_max,
+                                 stochastic=False)
+        return cfg, params, blob
+
+    def test_header_embeds_config_hash(self, export, tiny_ds):
+        from factorvae_tpu.eval.export_aot import (
+            load_exported,
+            read_artifact_header,
+        )
+
+        cfg, _, blob = export
+        header = read_artifact_header(blob)
+        assert header["config_hash"] == config_hash(cfg.to_dict())
+        assert header["n_max"] == tiny_ds.n_max
+        import jax
+
+        assert header["jax"] == jax.__version__
+        art = load_exported(blob,
+                            expect_config_hash=header["config_hash"])
+        assert art.header == header
+
+    def test_mismatches_fail_one_line(self, export):
+        from factorvae_tpu.eval.export_aot import (
+            ArtifactError,
+            load_exported,
+        )
+
+        _, _, blob = export
+        with pytest.raises(ArtifactError, match="re-export"):
+            load_exported(blob, expect_config_hash="deadbeef0000")
+        # jax-version skew: rewrite the header in place
+        magic, header, payload = blob.split(b"\n", 2)
+        h = json.loads(header)
+        h["jax"] = "0.0.1"
+        skewed = magic + b"\n" + json.dumps(h).encode() + b"\n" + payload
+        with pytest.raises(ArtifactError, match="0.0.1"):
+            load_exported(skewed)
+        # ...unless the caller opts out of the gate
+        assert load_exported(skewed, check_jax=False).header["jax"] == \
+            "0.0.1"
+
+    def test_corrupt_and_garbage_blobs(self, export):
+        from factorvae_tpu.eval.export_aot import (
+            ARTIFACT_MAGIC,
+            ArtifactError,
+            load_exported,
+            read_artifact_header,
+        )
+
+        with pytest.raises(ArtifactError, match="corrupt"):
+            read_artifact_header(ARTIFACT_MAGIC + b"\nnot json\nx")
+        with pytest.raises(ArtifactError, match="re-export"):
+            load_exported(b"garbage bytes that are no export")
+
+    def test_artifact_round_trip_scores(self, export, tiny_ds):
+        """The registry's cold-start path: admit the serialized
+        artifact, serve scores through its replayed program, compare
+        to the scan path (documented ~1-ulp tolerance: the artifact
+        consumes pre-gathered windows)."""
+        from factorvae_tpu.eval.predict import predict_panel
+        from factorvae_tpu.serve.registry import ModelRegistry
+
+        cfg, params, blob = export
+        reg = ModelRegistry()
+        key = reg.register_artifact(blob, alias="aot")
+        assert key == config_hash(cfg.to_dict())
+        entry = reg.get(key)
+        assert entry.source == "artifact" and entry.compiled
+        days = tiny_ds.split_days(None, None)[:3]
+        served = reg.score("aot", tiny_ds, days)
+        ref = predict_panel(params, cfg, tiny_ds, days,
+                            stochastic=False)
+        v = np.isfinite(ref)
+        np.testing.assert_allclose(served[v], ref[v],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_headerless_blob_not_admissible(self, export):
+        from factorvae_tpu.serve.registry import (
+            ModelRegistry,
+            RegistryError,
+        )
+
+        _, _, blob = export
+        payload = blob.split(b"\n", 2)[2]  # strip the header: legacy
+        with pytest.raises(RegistryError, match="re-export"):
+            ModelRegistry().register_artifact(payload)
