@@ -59,6 +59,16 @@ QPS, and the fused multi-model dispatch, with zero `compile` records
 on the warm path proven from the daemon's own RUN stream
 (BENCH_SERVE.json). Same robustness contract.
 
+Chaos mode (`python bench.py --chaos`, or BENCH_CHAOS=1): the MTTR
+bench (ISSUE 9) — inject one deterministic fault per chaos class
+(factorvae_tpu/chaos: poisoned gradients, kill-mid-save, checkpoint/
+artifact byte corruption, torn JSONL, failing stream transfer, stalled
+serve backend, flaky cold start) and time each from fault onset to
+verified recovery. Every class must recover or the payload becomes the
+`*_failed` metric the ledger refuses. BENCH_CHAOS.json carries the
+per-class MTTR; `--track` adds one history row per fault class. Same
+robustness contract.
+
 Stream mode (`python bench.py --stream`, or BENCH_STREAM=1 with
 BENCH_STREAM_CHUNK=n): A/B the panel residency — HBM-resident
 whole-epoch scan vs the out-of-core stream path (data/stream.py,
@@ -188,6 +198,21 @@ MESH_RESIDENCY = os.environ.get("BENCH_MESH_RESIDENCY", "hbm")
 USE_SERVE = os.environ.get("BENCH_SERVE", "0") == "1"
 SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 100))
 SERVE_MODELS = int(os.environ.get("BENCH_SERVE_MODELS", 2))
+# Chaos mode (`python bench.py --chaos` or BENCH_CHAOS=1): the MTTR
+# bench (ISSUE 9, docs/robustness.md). One representative fault per
+# class from factorvae_tpu/chaos — poisoned gradients, a hard-killed
+# checkpoint save, checkpoint/artifact byte corruption, a torn JSONL
+# tail, a failing stream transfer, a stalled serve backend, a flaky
+# cold start — each injected deterministically and timed from fault
+# onset to verified recovery. Shapes are FIXED tiny (the recovery
+# machinery under test is host-side; model throughput has its own
+# modes), so rows are comparable across rigs of the same platform. The
+# headline `value` is recoveries/sec across the suite (1/mean-MTTR:
+# higher is better, matching the ledger's regression direction), with
+# per-class MTTR seconds in the payload and — under --track — one
+# `chaos_recovery_rate_<class>` history row per fault class
+# (BENCH_CHAOS.json carries the full detail).
+USE_CHAOS = os.environ.get("BENCH_CHAOS", "0") == "1"
 # Track mode (`--track` or BENCH_TRACK=1): append the emitted headline
 # row to BENCH_HISTORY.jsonl (obs/ledger.py) so every bench run extends
 # the longitudinal perf trajectory instead of producing a one-off
@@ -305,6 +330,8 @@ def fail_metric() -> str:
         return "mesh_train_throughput_failed"
     if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
         return "serve_qps_failed"
+    if USE_CHAOS or os.environ.get("BENCH_CHAOS", "0") == "1":
+        return "chaos_recovery_rate_failed"
     return "train_throughput_flagship_K96_H64_Alpha158_failed"
 
 
@@ -315,6 +342,8 @@ def fail_unit() -> str:
              or USE_MESH or os.environ.get("BENCH_MESH", "0") == "1")
     if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
         return "req/sec"
+    if USE_CHAOS or os.environ.get("BENCH_CHAOS", "0") == "1":
+        return "recoveries/sec"
     return "windows/sec*seed" if fleet else "windows/sec/chip"
 
 
@@ -968,6 +997,343 @@ def run_serve_bench() -> dict:
     return payload
 
 
+def run_chaos_bench() -> dict:
+    """MTTR bench (BENCH_CHAOS): one representative fault per chaos
+    class, each timed from fault onset to VERIFIED recovery (the
+    per-class clocks are documented in docs/robustness.md). Every
+    scenario must actually recover — a fault class whose recovery fails
+    turns the whole payload into a `*_failed` metric the ledger refuses.
+    `value` is recoveries/sec across the suite (1/mean-MTTR); the full
+    per-class detail lands in BENCH_CHAOS.json, and --track appends one
+    `chaos_recovery_rate_<class>` row per class."""
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from factorvae_tpu import chaos
+    from factorvae_tpu.chaos import ChaosPlan, Fault
+    from factorvae_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, synthetic_panel
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.train.checkpoint import Checkpointer, save_params
+    from factorvae_tpu.train.state import TrainState
+    from factorvae_tpu.utils.logging import MetricsLogger
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    platform, _ = detect_platform()
+    work = tempfile.mkdtemp(prefix="bench_chaos_")
+    mttr: dict[str, float] = {}
+    recovered: dict[str, bool] = {}
+
+    # Fixed tiny rig: MTTR measures the recovery machinery, not model
+    # throughput (which has its own bench modes).
+    def tiny_cfg(save_dir, **train_kw):
+        defaults = dict(num_epochs=6, lr=1e-3, seed=0, save_dir=save_dir,
+                        checkpoint_every=1, days_per_step=2,
+                        recover_after=2)
+        defaults.update(train_kw)
+        return Config(
+            model=ModelConfig(num_features=8, hidden_size=8,
+                              num_factors=4, num_portfolios=6, seq_len=5),
+            data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                            val_start_time=None, val_end_time=None),
+            train=TrainConfig(**defaults),
+        )
+
+    def plain_state():
+        params = {"w": jnp.arange(8, dtype=jnp.float32)}
+        tx = optax.adam(1e-3)
+        return TrainState(step=jnp.asarray(0), params=params,
+                          opt_state=tx.init(params),
+                          rng=jax.random.PRNGKey(0))
+
+    class Recorder(MetricsLogger):
+        def __init__(self):
+            super().__init__(echo=False)
+            self.records = []
+
+        def log(self, event, _echo=None, **fields):
+            self.records.append(
+                {"event": event, "ts": time.time(), **fields})
+            super().log(event, _echo=_echo, **fields)
+
+    # --- nan_grads: fault onset = start of the first poisoned epoch;
+    # recovered = the replay of the last poisoned epoch completes clean.
+    logger = Recorder()
+    plan = ChaosPlan([Fault("nan_grads", epoch=2),
+                      Fault("nan_grads", epoch=3)])
+    with chaos.active(plan):
+        tr = Trainer(tiny_cfg(os.path.join(work, "nan")), PanelDataset(
+            synthetic_panel(num_days=16, num_instruments=6,
+                            num_features=8, missing_prob=0.1, seed=0),
+            seq_len=5), logger=logger)
+        params, _ = tr.fit()
+    epochs = [r for r in logger.records if r["event"] == "epoch"]
+    bad = [i for i, r in enumerate(epochs)
+           if r.get("skipped_steps", 0.0) > 0]
+    healed = [i for i, r in enumerate(epochs)
+              if bad and i > bad[-1] and r["epoch"] == epochs[bad[-1]]
+              ["epoch"] and r.get("skipped_steps", 1.0) == 0.0]
+    finite = all(bool(np.isfinite(np.asarray(x)).all())
+                 for x in jax.tree.leaves(params))
+    recovered["nan_grads"] = bool(bad and healed and finite)
+    if recovered["nan_grads"]:
+        onset = (epochs[bad[0]]["ts"]
+                 - float(epochs[bad[0]].get("seconds", 0.0)))
+        mttr["nan_grads"] = max(epochs[healed[0]]["ts"] - onset, 1e-4)
+
+    # --- kill_mid_save: a child checkpointer SIGKILLed inside save();
+    # recovered = the parent restores the newest committed step. MTTR is
+    # the restore wall (the post-crash work a resuming run actually pays).
+    kill_dir = os.path.join(work, "kill_ck")
+    child = f"""
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+from factorvae_tpu.utils.testing import force_host_devices
+force_host_devices(1)
+import jax, jax.numpy as jnp, optax
+from factorvae_tpu.train.checkpoint import Checkpointer
+from factorvae_tpu.train.state import TrainState
+params = {{"w": jnp.arange(8, dtype=jnp.float32)}}
+tx = optax.adam(1e-3)
+state = TrainState(step=jnp.asarray(0), params=params,
+                   opt_state=tx.init(params), rng=jax.random.PRNGKey(0))
+ck = Checkpointer({kill_dir!r}, async_save=True)
+for s in range(3):
+    ck.save(s, state.replace(step=jnp.asarray(s)),
+            dict(epoch=s, best_val=0.0, config=dict(v=1)))
+    if s < 2:
+        ck.wait_until_finished()
+"""
+    plan = ChaosPlan([Fault("kill_mid_save", step=2)])
+    r = subprocess.run(
+        [sys.executable, "-c", child], capture_output=True, text=True,
+        timeout=300,
+        env=chaos.child_env(plan, env={**os.environ,
+                                       "JAX_PLATFORMS": "cpu"}))
+    t0 = time.perf_counter()
+    try:
+        ck = Checkpointer(kill_dir)
+        _, meta = ck.restore(plain_state())
+        ck.close()
+        recovered["kill_mid_save"] = (
+            r.returncode == -_signal.SIGKILL and meta["epoch"] >= 1)
+    except Exception:
+        recovered["kill_mid_save"] = False
+    if recovered["kill_mid_save"]:
+        mttr["kill_mid_save"] = max(time.perf_counter() - t0, 1e-4)
+
+    # --- corrupt_checkpoint: newest step's bytes flipped; recovered =
+    # implicit restore quarantines it and lands on the older verified
+    # step. MTTR = the verify + fallback-restore wall.
+    ck_dir = os.path.join(work, "corrupt_ck")
+    ck = Checkpointer(ck_dir, async_save=False)
+    st = plain_state()
+    for s in range(3):
+        ck.save(s, st.replace(step=jnp.asarray(s)),
+                dict(epoch=s, best_val=0.0, config=dict(v=1)))
+    chaos.ops.corrupt_checkpoint_step(ck_dir, 2, rng_seed=0)
+    t0 = time.perf_counter()
+    try:
+        _, meta = ck.restore(st)
+        recovered["corrupt_checkpoint"] = (
+            meta["epoch"] == 1 and ck.quarantined_steps() == [2])
+    except Exception:
+        recovered["corrupt_checkpoint"] = False
+    if recovered["corrupt_checkpoint"]:
+        mttr["corrupt_checkpoint"] = max(time.perf_counter() - t0, 1e-4)
+    ck.close()
+
+    # --- corrupt_artifact: a weights dir whose bytes no longer match
+    # its save_params manifest; recovery = DETECTION (the registry must
+    # refuse — silently serving garbage is the failure mode). MTTR =
+    # the verification wall.
+    from factorvae_tpu.train.checkpoint import verify_params_dir
+
+    art = save_params(os.path.join(work, "art"), "w0",
+                      {"w": jnp.arange(64, dtype=jnp.float32)})
+    files = [os.path.join(root, n) for root, _, ns in os.walk(art)
+             for n in ns if os.path.getsize(os.path.join(root, n))]
+    chaos.ops.corrupt_file(files[0], rng_seed=0)
+    t0 = time.perf_counter()
+    recovered["corrupt_artifact"] = verify_params_dir(art) is not None
+    if recovered["corrupt_artifact"]:
+        mttr["corrupt_artifact"] = max(time.perf_counter() - t0, 1e-4)
+
+    # --- torn_jsonl: a run stream truncated mid-record; recovered = the
+    # obs loaders parse the intact prefix and flag the tear as a
+    # warning. MTTR = the tolerant-load wall.
+    from factorvae_tpu.obs.timeline import open_run
+
+    run_path = os.path.join(work, "RUN.jsonl")
+    with MetricsLogger(jsonl_path=run_path, echo=False) as lg:
+        for e in range(50):
+            lg.log("epoch", epoch=e, train_loss=1.0, seconds=0.01)
+    chaos.ops.tear_jsonl(run_path, keep_frac=0.8, rng_seed=0)
+    t0 = time.perf_counter()
+    try:
+        run, warnings = open_run(run_path)
+        recovered["torn_jsonl"] = bool(run["epochs"]) and bool(warnings)
+    except Exception:
+        recovered["torn_jsonl"] = False
+    if recovered["torn_jsonl"]:
+        mttr["torn_jsonl"] = max(time.perf_counter() - t0, 1e-4)
+
+    # --- stream_fail: one transient transfer failure; recovered = the
+    # bounded-backoff retry reproduces the chunk. MTTR = faulted
+    # iteration wall minus the clean wall measured in the same process
+    # (dominated by the injected failure + backoff).
+    from factorvae_tpu.data.stream import ChunkStream
+
+    def make_chunk(i):
+        return {"x": np.full((64, 64), float(i), np.float32)}
+
+    t0 = time.perf_counter()
+    clean = [c for c in ChunkStream(make_chunk, 4)]
+    clean_wall = time.perf_counter() - t0
+    plan = ChaosPlan([Fault("stream_fail", chunk=1)])
+    with chaos.active(plan):
+        stream = ChunkStream(make_chunk, 4)
+        t0 = time.perf_counter()
+        chaotic = [c for c in stream]
+        fault_wall = time.perf_counter() - t0
+    same = all(
+        bool(np.array_equal(np.asarray(a["x"]), np.asarray(b["x"])))
+        for a, b in zip(clean, chaotic))
+    recovered["stream_fail"] = stream.retries == 1 and same
+    if recovered["stream_fail"]:
+        mttr["stream_fail"] = max(fault_wall - clean_wall, 1e-4)
+
+    # --- serve_stall (+ the breaker behind it): K deadline misses open
+    # the circuit; recovered = the first post-cooldown request answers
+    # ok. MTTR = first miss -> that ok (misses + fast-fail + cooldown +
+    # half-open probe).
+    from factorvae_tpu.data import synthetic_panel_dense
+    from factorvae_tpu.models.factorvae import load_model
+    from factorvae_tpu.serve.daemon import ScoringDaemon
+    from factorvae_tpu.serve.registry import ModelRegistry
+
+    scfg = Config(
+        model=ModelConfig(stochastic_inference=False, num_features=6,
+                          hidden_size=8, num_factors=4, num_portfolios=8,
+                          seq_len=5),
+        data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(seed=0))
+    sds = PanelDataset(
+        synthetic_panel_dense(num_days=12, num_instruments=10,
+                              num_features=6), seq_len=5)
+    reg = ModelRegistry()
+    sparams = load_model(scfg, n_max=sds.n_max)[1]
+    reg.register_params(sparams, scfg, alias="m0")
+    day = int(sds.split_days(None, None)[0])
+    daemon = ScoringDaemon(reg, sds, stochastic=False, breaker_k=2,
+                           breaker_cooldown_s=0.2)
+    warm = daemon.handle({"model": "m0", "day": day})   # compile outside
+    daemon.deadline_ms = 150.0    # server policy, armed after warmup
+    req = {"model": "m0", "day": day}
+    plan = ChaosPlan([Fault("serve_stall", times=2, delay_s=0.3)])
+    t0 = time.perf_counter()
+    with chaos.active(plan):
+        misses = [daemon.handle(dict(req)) for _ in range(3)]
+    time.sleep(daemon.breaker_cooldown_s + 0.05)
+    ok_again = daemon.handle(dict(req))
+    t1 = time.perf_counter()
+    recovered["serve_stall"] = (
+        warm.get("ok", False) and all(not m["ok"] for m in misses)
+        and any("circuit open" in m.get("error", "") for m in misses)
+        and ok_again.get("ok", False))
+    if recovered["serve_stall"]:
+        mttr["serve_stall"] = max(t1 - t0, 1e-4)
+
+    # --- serve_cold_fail: an evicted model's cold-start reload flakes
+    # once; recovered = the backoff retry admits it. MTTR = the
+    # tombstone get() wall (failed attempt + backoff + reload).
+    reg2 = ModelRegistry()
+    cold_src = save_params(os.path.join(work, "cold"), "w0", sparams)
+    with open(os.path.join(work, "cold", "w0", "serve_config.json"),
+              "w") as fh:
+        json.dump(scfg.to_dict(), fh)
+    key = reg2.register_checkpoint(os.path.join(work, "cold", "w0"),
+                                   alias="prod")
+    reg2.budget_bytes = 1
+    cfg2 = Config(model=scfg.model, data=scfg.data,
+                  train=TrainConfig(seed=1))
+    reg2.register_params(load_model(cfg2, n_max=sds.n_max)[1], cfg2)
+    plan = ChaosPlan([Fault("serve_cold_fail", times=1)])
+    t0 = time.perf_counter()
+    try:
+        with chaos.active(plan):
+            entry = reg2.get("prod")
+        recovered["serve_cold_fail"] = (
+            entry.key == key and reg2.cold_starts == 1)
+    except Exception:
+        recovered["serve_cold_fail"] = False
+    if recovered["serve_cold_fail"]:
+        mttr["serve_cold_fail"] = max(time.perf_counter() - t0, 1e-4)
+
+    shutil.rmtree(work, ignore_errors=True)
+    all_recovered = all(recovered.values()) and len(mttr) == len(recovered)
+    mean_mttr = (sum(mttr.values()) / len(mttr)) if mttr else 0.0
+    rate = (1.0 / mean_mttr) if mean_mttr > 0 else 0.0
+    payload = {
+        # A fault class that failed to recover is the loud failure the
+        # ledger must refuse (the *_failed suffix keeps the row out).
+        "metric": ("chaos_recovery_rate" if all_recovered
+                   else "chaos_recovery_rate_failed"),
+        "value": round(rate, 3),
+        "unit": "recoveries/sec",
+        # No reference baseline exists for recovery speed; the ledger
+        # compares same-rig rows against their own trailing median.
+        "vs_baseline": None,
+        "platform": platform,
+        "fault_classes": len(recovered),
+        "recovered": recovered,
+        "mttr_s": {k: round(v, 4) for k, v in sorted(mttr.items())},
+        "mean_mttr_s": round(mean_mttr, 4),
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_CHAOS.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    # Per-fault-class history rows (the ledger tracks each class's
+    # recovery rate as its own longitudinal series).
+    if USE_TRACK and not ACCEL_CHILD and all_recovered:
+        try:
+            from factorvae_tpu.obs.ledger import append_row
+            from factorvae_tpu.utils.logging import run_meta
+
+            meta = run_meta()
+            for cls, t in sorted(mttr.items()):
+                append_row({
+                    "metric": f"chaos_recovery_rate_{cls}",
+                    "value": round(1.0 / t, 3),
+                    "unit": "recoveries/sec",
+                    "platform": platform,
+                    "vs_baseline": None,
+                    "run_meta": meta,
+                })
+        except Exception as e:
+            print(f"[bench] --chaos per-class track failed: {e}",
+                  file=sys.stderr)
+    return payload
+
+
 def _annotate_cell_program(cell: dict, trainer, mesh, state, s: int,
                            comm_budget: int = 0) -> None:
     """Attach the compiled-program bill to one executed mesh cell
@@ -1168,6 +1534,8 @@ def bench_payload() -> dict:
         payload = run_mesh_bench()
     elif USE_SERVE:
         payload = run_serve_bench()
+    elif USE_CHAOS:
+        payload = run_chaos_bench()
     else:
         payload = run_bench()
     try:
@@ -1322,7 +1690,8 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
-    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_SERVE, USE_TRACK
+    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_SERVE, \
+        USE_CHAOS, USE_TRACK
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -1343,6 +1712,9 @@ def main() -> None:
     if "--serve" in sys.argv:
         USE_SERVE = True
         os.environ["BENCH_SERVE"] = "1"
+    if "--chaos" in sys.argv:
+        USE_CHAOS = True
+        os.environ["BENCH_CHAOS"] = "1"
 
     if ACCEL_CHILD:
         # Child: backend already validated by the parent's probe; any crash

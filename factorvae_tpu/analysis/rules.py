@@ -1,4 +1,4 @@
-"""graftlint rules JGL001–JGL006.
+"""graftlint rules JGL001–JGL007.
 
 Each rule is a function `(ModuleModel) -> list[Finding]`. JGL002 (key
 reuse), JGL004 (read-after-donation) and the loop flavor of JGL001 share
@@ -766,5 +766,97 @@ def rule_jgl006(model: ModuleModel) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# JGL007 — silent exception swallow in library code
+
+
+# Call names (terminal attribute or plain name) that count as surfacing
+# the failure: the MetricsLogger/timeline sinks, stdlib logging levels,
+# warnings.warn, and print (stderr recipes in CLI-adjacent helpers).
+JGL007_SURFACING_CALLS = {
+    "log", "timeline_event", "print", "warn", "warning", "error",
+    "exception", "debug", "info", "critical", "fail", "skip", "xfail",
+}
+
+BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    """Bare `except:`, or a type (possibly in a tuple) resolving to
+    Exception/BaseException. Narrow handlers (OSError, ValueError, ...)
+    state what they expect and are out of scope."""
+    if h.type is None:
+        return True
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    return any(_terminal_name(t) in BROAD_EXC_NAMES for t in types)
+
+
+def _handler_walk(body):
+    """ast.walk over handler statements WITHOUT descending into nested
+    function/lambda definitions: a `return` (or a Load of the bound
+    name) inside a callback the handler merely defines runs later, in
+    another frame — it does not surface THIS exception, and counting it
+    would let `except Exception: callbacks.append(lambda: ...)` pass as
+    an explicit failure policy."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _handler_surfaces(h: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, return, log, or capture the
+    exception into a value? Any of these makes the failure policy
+    explicit; a body with none of them swallowed the error silently."""
+    for node in _handler_walk(h.body):
+        if isinstance(node, (ast.Raise, ast.Return)):
+            return True
+        if isinstance(node, ast.Call) \
+                and _terminal_name(node.func) in JGL007_SURFACING_CALLS:
+            return True
+        # `except Exception as e: out["error"] = str(e)` — the bound
+        # exception flows into a value the caller will see
+        if h.name and isinstance(node, ast.Name) \
+                and isinstance(node.ctx, ast.Load) and node.id == h.name:
+            return True
+    return False
+
+
+def rule_jgl007(model: ModuleModel) -> List[Finding]:
+    """Broad `except Exception` handlers in `factorvae_tpu/` library
+    modules must make their failure policy explicit: re-raise, log the
+    error (MetricsLogger / timeline_event / warnings / print-to-stderr),
+    return an explicit error/fallback value, or convert the bound
+    exception into a value. `except Exception: pass` (and fallthrough
+    fallback assignments that never mention the error) hide real faults
+    exactly where the self-healing machinery needs to see them
+    (docs/robustness.md); deliberate best-effort swallows carry a
+    justified suppression so the audit trail survives."""
+    norm = model.path.replace(os.sep, "/")
+    if "factorvae_tpu/" not in norm:
+        return []  # scripts/, tests/, bench.py own their error policy
+    findings: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _broad_handler(node):
+            continue
+        if _handler_surfaces(node):
+            continue
+        what = "bare except:" if node.type is None else "except Exception"
+        findings.append(Finding(
+            "JGL007", model.path, node.lineno,
+            f"{what} swallows the error silently — log it "
+            "(MetricsLogger/timeline_event), re-raise, or return an "
+            "explicit error value; a deliberate best-effort swallow "
+            "needs a justified suppression",
+        ))
+    return findings
+
+
 ALL_RULES = (rule_jgl001, rule_jgl002, rule_jgl003, rule_jgl004,
-             rule_jgl005, rule_jgl006)
+             rule_jgl005, rule_jgl006, rule_jgl007)

@@ -1,0 +1,230 @@
+"""Deterministic, seeded fault injection for self-healing runs.
+
+The observatory (PR 5/7) *detects* non-finite grads, divergence and torn
+streams; this package exists to *exercise the recovery* those signals
+should trigger, reproducibly. A `ChaosPlan` is an explicit list of
+`Fault`s — each targeted by coordinates (epoch, seed lane, chunk index,
+checkpoint step, ...) and bounded by a fire count — installed either
+in-process (`install` / the `active` context manager) or through the
+`FACTORVAE_CHAOS` env var (JSON; the subprocess path the kill-mid-save
+tests use). Injection points across the stack ask `fault(kind, ...)`
+and act only on a match:
+
+    kind                 injection point            recovery exercised
+    ------------------------------------------------------------------
+    nan_grads            train step gradients       in-graph all-finite
+                         (train/loop.py; per-seed   gate skips the
+                         lanes in fleets)           update; host
+                                                    rollback + lr backoff
+    kill_mid_save        Checkpointer.save, after   atomic step commit +
+                         the write is enqueued      manifest verify +
+                         (SIGKILL-hard)             group-resume rewind
+    corrupt_checkpoint   host-side byte flips       sha256 manifest ->
+    corrupt_artifact     (ops.corrupt_file /        quarantine, restore
+                         corrupt_checkpoint_step)   falls back
+    torn_jsonl           ops.tear_jsonl             obs.timeline/report
+                                                    torn-tail tolerance
+    stream_fail          ChunkStream._produce       bounded exponential-
+    stream_stall         (worker thread)            backoff retry
+    serve_cold_fail      registry tombstone         cold-start retry +
+                         cold-start reload          backoff window
+    serve_stall          registry.score             per-request deadline
+                                                    + circuit breaker
+    serve_malformed      (no hook needed: the       {"ok": false}
+                         bench/tests feed garbage)  responses
+
+Opt-in and zero-cost when off: with no plan installed and no env var,
+`fault()` is a None check — no allocation, no locking, no jax import —
+and every in-graph injection is gated at TRACE time (`has_fault`), so
+the compiled programs of a chaos-free run are byte-identical to a
+pre-chaos build (pinned in tests/test_chaos.py).
+
+Determinism: faults fire on exact coordinate matches, `times` bounds
+how often (the consumption is what lets a retry/rollback find clean
+ground — exactly how transient real-world faults behave), and byte
+corruption draws from `numpy.default_rng(fault.rng_seed)`. Two runs of
+the same plan against the same workload inject identically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+from factorvae_tpu.chaos import ops  # noqa: F401  (re-export: chaos.ops)
+
+KINDS = (
+    "nan_grads",
+    "kill_mid_save",
+    "corrupt_checkpoint",
+    "corrupt_artifact",
+    "torn_jsonl",
+    "stream_fail",
+    "stream_stall",
+    "serve_cold_fail",
+    "serve_stall",
+    "serve_malformed",
+)
+
+# Coordinate fields a Fault can pin (-1 / "" = wildcard, matches any).
+_COORDS = ("epoch", "step", "lane", "chunk", "request")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One injected fault. Coordinates default to wildcard; `times`
+    bounds how many matching queries fire (-1 = every match — a
+    permanent fault; the default 1 is a transient)."""
+
+    kind: str
+    epoch: int = -1
+    step: int = -1
+    lane: int = -1           # fleet seed lane (-1 = all lanes)
+    chunk: int = -1          # ChunkStream chunk index
+    request: int = -1        # serve request index
+    times: int = 1
+    delay_s: float = 0.0     # stall faults: injected latency
+    rng_seed: int = 0        # corruption determinism
+    path: str = ""           # corruption target (informational)
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown chaos fault kind {self.kind!r}; "
+                f"choose from {KINDS}")
+
+    def matches(self, coords: dict) -> bool:
+        """A pinned coordinate must be PRESENT in the query and equal:
+        a fault pinned to lane=2 must not fire at an injection point
+        that has no lane (the serial trainer), and a fault pinned to a
+        coordinate no injection point supplies simply never fires —
+        pins narrow, they never widen."""
+        for k in _COORDS:
+            pin = getattr(self, k)
+            if pin == -1:
+                continue
+            if k not in coords or int(coords[k]) != int(pin):
+                return False
+        return True
+
+
+class ChaosPlan:
+    """A seeded list of faults plus their consumption state. `find` is
+    thread-safe (stream workers and the serve dispatch pool query from
+    their own threads) and CONSUMES one firing per match, so the plan's
+    injection history (`fired`) is itself a deterministic artifact."""
+
+    def __init__(self, faults: Sequence[Fault], seed: int = 0):
+        self.faults: List[Fault] = list(faults)
+        self.seed = int(seed)
+        self._remaining = [f.times for f in self.faults]
+        self.fired: List[dict] = []
+        self._lock = threading.Lock()
+
+    # ---- query -----------------------------------------------------------
+
+    def find(self, kind: str, **coords) -> Optional[Fault]:
+        """First live fault of `kind` matching `coords`, consuming one
+        firing; None otherwise."""
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if f.kind != kind or self._remaining[i] == 0:
+                    continue
+                if not f.matches(coords):
+                    continue
+                if self._remaining[i] > 0:
+                    self._remaining[i] -= 1
+                self.fired.append({"kind": kind, **coords})
+                return f
+        return None
+
+    def has(self, kind: str) -> bool:
+        """Non-consuming: is any fault of `kind` installed (live or
+        spent)? Trace-time gates key on this so the compiled program is
+        stable for the whole run, not per-epoch."""
+        return any(f.kind == kind for f in self.faults)
+
+    # ---- (de)serialization ----------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "faults": [dataclasses.asdict(f) for f in self.faults],
+        })
+
+    @classmethod
+    def from_json(cls, blob: str) -> "ChaosPlan":
+        d = json.loads(blob)
+        return cls([Fault(**f) for f in d.get("faults", [])],
+                   seed=int(d.get("seed", 0)))
+
+
+# ---------------------------------------------------------------------------
+# process-wide registry (the zero-cost-off gate)
+
+ENV_VAR = "FACTORVAE_CHAOS"
+
+_PLAN: Optional[ChaosPlan] = None
+_ENV_CHECKED = False
+
+
+def install(plan: Optional[ChaosPlan]) -> Optional[ChaosPlan]:
+    """Install the process-wide chaos plan (None = off); returns the
+    previous plan so callers can restore it."""
+    global _PLAN, _ENV_CHECKED
+    prev = _PLAN
+    _PLAN = plan
+    _ENV_CHECKED = True   # an explicit install wins over the env var
+    return prev
+
+
+def current_plan() -> Optional[ChaosPlan]:
+    """The installed plan, checking FACTORVAE_CHAOS once lazily (the
+    subprocess activation path: a child that never queries never pays
+    even the env read)."""
+    global _PLAN, _ENV_CHECKED
+    if _PLAN is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        blob = os.environ.get(ENV_VAR)
+        if blob:
+            _PLAN = ChaosPlan.from_json(blob)
+    return _PLAN
+
+
+def fault(kind: str, **coords) -> Optional[Fault]:
+    """The injection-point query: None unless a live matching fault is
+    installed. With chaos off this is a None check."""
+    plan = _PLAN if _ENV_CHECKED else current_plan()
+    return None if plan is None else plan.find(kind, **coords)
+
+
+def has_fault(kind: str) -> bool:
+    """Non-consuming trace-time gate (see ChaosPlan.has)."""
+    plan = _PLAN if _ENV_CHECKED else current_plan()
+    return plan is not None and plan.has(kind)
+
+
+@contextlib.contextmanager
+def active(plan: ChaosPlan) -> Iterator[ChaosPlan]:
+    """Scoped install for tests/bench: restores the previous plan (and
+    re-arms the env check) on exit."""
+    global _ENV_CHECKED
+    prev_checked = _ENV_CHECKED
+    prev = install(plan)
+    try:
+        yield plan
+    finally:
+        install(prev)
+        _ENV_CHECKED = prev_checked
+
+
+def child_env(plan: ChaosPlan, env: Optional[dict] = None) -> dict:
+    """Environment dict for a subprocess that should run under `plan`
+    (the kill-mid-save harness: the fault must fire in the CHILD)."""
+    out = dict(os.environ if env is None else env)
+    out[ENV_VAR] = plan.to_json()
+    return out

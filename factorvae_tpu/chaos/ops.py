@@ -1,0 +1,96 @@
+"""Host-side fault operators: deterministic byte corruption, torn JSONL
+tails, and the hard-kill the kill-mid-save harness uses.
+
+These are the DESTRUCTIVE half of the chaos harness — pure host file
+operations, no jax — used by tests and `bench.py --chaos` to create the
+on-disk states the recovery machinery (checkpoint manifests/quarantine,
+obs torn-tail tolerance) must survive. Every operator is seeded and
+returns what it did, so a failing recovery test can print the exact
+bytes it flipped.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import List, Optional
+
+import numpy as np
+
+
+def corrupt_file(path: str, rng_seed: int = 0, n_bytes: int = 16) -> List[int]:
+    """Flip `n_bytes` deterministically-chosen bytes of `path` in place
+    (XOR 0xFF — never a no-op flip). Returns the corrupted offsets."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot corrupt empty file {path}")
+    rng = np.random.default_rng(rng_seed)
+    offsets = sorted(set(
+        int(o) for o in rng.integers(0, size, size=min(n_bytes, size))))
+    with open(path, "r+b") as fh:
+        for off in offsets:
+            fh.seek(off)
+            b = fh.read(1)
+            fh.seek(off)
+            fh.write(bytes([b[0] ^ 0xFF]))
+    return offsets
+
+
+def _payload_files(step_dir: str) -> List[str]:
+    """Data-carrying files of a committed checkpoint step, largest
+    first (corrupting metadata-only sidecars would miss the arrays the
+    integrity story is about)."""
+    out = []
+    for root, _, names in os.walk(step_dir):
+        for n in names:
+            if n == "manifest.json":
+                continue
+            p = os.path.join(root, n)
+            if os.path.getsize(p) > 0:
+                out.append(p)
+    return sorted(out, key=os.path.getsize, reverse=True)
+
+
+def corrupt_checkpoint_step(directory: str, step: int,
+                            rng_seed: int = 0,
+                            n_bytes: int = 16) -> str:
+    """Corrupt the largest payload file of one committed step directory
+    (the orbax layout `directory/step/...`). Returns the file hit."""
+    step_dir = os.path.join(os.path.abspath(directory), str(step))
+    files = _payload_files(step_dir)
+    if not files:
+        raise FileNotFoundError(
+            f"no payload files under {step_dir} — is step {step} "
+            f"committed?")
+    corrupt_file(files[0], rng_seed=rng_seed, n_bytes=n_bytes)
+    return files[0]
+
+
+def tear_jsonl(path: str, keep_frac: float = 0.6,
+               rng_seed: int = 0) -> int:
+    """Tear a JSONL stream the way an async kill does: truncate the
+    file MID-LINE, leaving a partial record as the new tail. The cut
+    point is a seeded draw inside the final kept line. Returns the new
+    byte size."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    lines = data.splitlines(keepends=True)
+    if not lines:
+        raise ValueError(f"cannot tear empty stream {path}")
+    keep = max(1, int(len(lines) * keep_frac))
+    head = b"".join(lines[:keep - 1])
+    last = lines[keep - 1]
+    rng = np.random.default_rng(rng_seed)
+    # cut strictly inside the line body: at least 1 byte survives, at
+    # least the newline (and one byte) is lost — a genuine torn record
+    cut = int(rng.integers(1, max(2, len(last) - 1)))
+    with open(path, "wb") as fh:
+        fh.write(head + last[:cut])
+    return len(head) + cut
+
+
+def kill_now(sig: Optional[int] = None) -> None:
+    """Hard-kill this process (default SIGKILL): no atexit, no flushed
+    buffers, no orbax finalize — the crash the checkpoint commit
+    machinery must make survivable."""
+    os.kill(os.getpid(), signal.SIGKILL if sig is None else sig)

@@ -147,6 +147,28 @@ class TrainConfig:
     # is BITWISE the pre-observatory trace (tests/test_obs.py). CLI
     # `--obs`; a measured plan row can switch it via its "obs" block.
     obs_probes: bool = False
+    # In-graph all-finite gate (train/loop.py): every optimizer update
+    # is applied through a jnp.where select keyed on "all gradient
+    # elements finite", so ONE poisoned step (NaN/inf grads — hardware
+    # flakes, the k60 posterior-KL degenerate regime) skips its update
+    # instead of destroying the params; per-seed on fleets (one bad
+    # lane skips alone). With the gate compiled in and no fault firing
+    # the select always takes the updated branch, so params/metrics
+    # stay BITWISE the ungated path (tests/test_chaos.py); the epoch
+    # metric `skipped_steps` counts skips. docs/robustness.md.
+    finite_guard: bool = True
+    # Host-side escalation (docs/robustness.md): after `recover_after`
+    # CONSECUTIVE bad epochs (non-finite train loss, or any steps
+    # skipped by the finite guard) the serial Trainer rolls back to the
+    # last checkpoint written before the bad streak, scales the peak lr
+    # by `recover_lr_backoff`, and re-runs — at most
+    # `recover_max_rollbacks` times per fit, each logged as a
+    # `recovery` event + `recovery_rollback` timeline mark. 0 disables.
+    # FleetTrainer rolls back only the bad lanes (no lr change: the
+    # optimizer is shared across lanes) and continues forward.
+    recover_after: int = 2
+    recover_lr_backoff: float = 0.5
+    recover_max_rollbacks: int = 2
 
 
 @dataclass(frozen=True)

@@ -32,7 +32,8 @@ from typing import Any, Callable, Iterator
 
 import jax
 
-from factorvae_tpu.utils.logging import timeline_span_at
+from factorvae_tpu.chaos import fault as chaos_fault
+from factorvae_tpu.utils.logging import timeline_event, timeline_span_at
 
 
 def _tree_nbytes(tree: Any) -> int:
@@ -68,6 +69,13 @@ class ChunkStream:
     host ships only its addressable slice of the slab.
     """
 
+    #: produce-side failures retry this many extra times with bounded
+    #: exponential backoff before surfacing — a transient host/transfer
+    #: flake costs one retry, never the epoch. The retried gather+put is
+    #: deterministic, so a retry is bitwise the first attempt.
+    MAX_RETRIES = 2
+    RETRY_BACKOFF_S = 0.05
+
     def __init__(self, make_chunk: Callable[[int], Any], n_chunks: int,
                  placement: Callable[[Any], Any] | None = None):
         self._make_chunk = make_chunk
@@ -76,15 +84,46 @@ class ChunkStream:
         self.bytes_put = 0
         self.produce_seconds = 0.0
         self.wait_seconds = 0.0
+        self.retries = 0
 
     def _produce(self, i: int):
+        """Worker-side gather + device_put with bounded-backoff retry.
+        The chaos hooks (factorvae_tpu/chaos: `stream_stall` injects
+        latency, `stream_fail` a failure) are None checks when no plan
+        is installed — the clean path is byte-identical to pre-chaos."""
+        last = None
+        for attempt in range(self.MAX_RETRIES + 1):
+            try:
+                stall = chaos_fault("stream_stall", chunk=i)
+                if stall is not None:
+                    time.sleep(stall.delay_s)
+                if chaos_fault("stream_fail", chunk=i) is not None:
+                    raise RuntimeError(
+                        f"chaos: injected stream transfer failure "
+                        f"(chunk {i})")
+                return self._produce_once(i)
+            except Exception as e:
+                last = e
+                if attempt == self.MAX_RETRIES:
+                    raise
+                self.retries += 1
+                timeline_event("stream_retry", cat="recovery",
+                               resource="stream", chunk=i,
+                               attempt=attempt + 1, error=str(e))
+                time.sleep(self.RETRY_BACKOFF_S * (2 ** attempt))
+        raise last  # unreachable; keeps control flow explicit
+
+    def _produce_once(self, i: int):
         t0 = time.perf_counter()
         host = self._make_chunk(i)
         nbytes = _tree_nbytes(host)
-        self.bytes_put += nbytes
         # ONE chunk-granularity transfer; async on accelerators, so the
         # copy itself also overlaps the worker's next gather.
         dev = self._placement(host)
+        # Counted only AFTER the put succeeds: a failed attempt that the
+        # bounded retry re-runs must not double-count the chunk in the
+        # transfer ledger the stream bench reports.
+        self.bytes_put += nbytes
         t1 = time.perf_counter()
         self.produce_seconds += t1 - t0
         # The ledger as timeline spans (no-op without an installed

@@ -35,6 +35,27 @@ raises health flags:
                     budget_peak_hbm_bytes / budget_compile_s; rows
                     without the block promise nothing and flag nothing).
 
+Recovery events (ISSUE 9, docs/robustness.md) render as first-class
+flags too — a run that HEALED is not a clean run, and the report is
+where the healing becomes visible:
+
+- `skip_step`      — the in-graph finite guard skipped updates this
+                     epoch (`skipped_steps` metric; per seed lane on
+                     fleets).
+- `rollback`       — host-side escalation restored a checkpoint
+                     (`recovery` events: serial rollback + lr backoff,
+                     or a fleet lane rolling back alone; the
+                     *_unavailable kinds mean it wanted to and could
+                     not).
+- `quarantine`     — a checkpoint step or serve weights directory
+                     failed sha256 manifest verification and was fenced
+                     (`ckpt_quarantine` / `serve_quarantine` marks).
+- `circuit_open`   — a served model's breaker opened after K
+                     consecutive failures (`circuit_open` marks).
+- `retry`          — a bounded-backoff retry fired (`stream_retry` /
+                     `cold_start_retry` marks): the fault healed below
+                     the epoch/request level.
+
 Human output by default; `--json` for the machine-readable form. An
 empty, missing, or non-JSONL stream exits with a one-line error; a
 trailing torn line (async-kill artifact) is a warning, never fatal.
@@ -60,7 +81,16 @@ from factorvae_tpu.obs.timeline import (
 # referenced preserves the public import path tests rely on.
 __all__ = ["build_report", "format_report", "health_flags", "load_run",
            "main", "open_run", "plan_measured_days_per_sec",
-           "program_flags"]
+           "program_flags", "recovery_flags"]
+
+# timeline marks that announce a recovery action -> report flag name
+RECOVERY_MARK_FLAGS = {
+    "ckpt_quarantine": "quarantine",
+    "serve_quarantine": "quarantine",
+    "circuit_open": "circuit_open",
+    "stream_retry": "retry",
+    "cold_start_retry": "retry",
+}
 
 # autotune_plan rows carry "train 0.1234 s/day" in their source string;
 # a matched value is the measured envelope the planner promised.
@@ -350,10 +380,64 @@ def program_flags(run: dict) -> List[dict]:
     return flags
 
 
+def recovery_flags(run: dict) -> List[dict]:
+    """Recovery actions (ISSUE 9) as first-class flags. Three sources:
+    epoch records whose `skipped_steps` metric shows the in-graph
+    finite guard fired (per seed lane on fleets), `recovery` logger
+    events (rollbacks — including the *_unavailable kinds, which mean
+    the escalation wanted a checkpoint and had none), and the recovery
+    timeline marks (quarantines, circuit breakers, bounded retries)."""
+    flags: List[dict] = []
+    for rec in run.get("epochs", []):
+        if "skipped_steps" not in rec:
+            continue
+        lanes = _nums(rec.get("skipped_steps"))
+        hit = [(s, n) for s, n in enumerate(lanes) if n > 0]
+        if not hit:
+            continue
+        width = len(lanes)
+        detail = ", ".join(
+            f"{n:g} update(s) skipped"
+            + (f" (seed lane {s})" if width > 1 else "")
+            for s, n in hit)
+        flags.append({"epoch": rec.get("epoch"), "line": rec.get("_line"),
+                      "flag": "skip_step",
+                      "detail": f"finite guard: {detail}"})
+    for rec in run.get("events", []):
+        if rec.get("event") != "recovery":
+            continue
+        kind = rec.get("kind", "rollback")
+        if kind in ("rollback", "lane_rollback"):
+            lane = (f"seed lane {rec['lane']} " if "lane" in rec else "")
+            lr = (f", lr_scale={rec['lr_scale']:g}"
+                  if isinstance(rec.get("lr_scale"), (int, float)) else "")
+            detail = (f"{lane}rolled back to checkpoint step "
+                      f"{rec.get('restored_step')}{lr}")
+        else:
+            detail = f"{kind}: {rec.get('note', '')}".strip(": ")
+        flags.append({"epoch": rec.get("epoch"), "line": rec.get("_line"),
+                      "flag": "rollback", "detail": detail})
+    for m in run.get("marks", []):
+        kind = RECOVERY_MARK_FLAGS.get(m.get("name"))
+        if kind is None:
+            continue
+        what = {k: v for k, v in m.items()
+                if k in ("step", "reason", "model", "path", "chunk",
+                         "attempt", "error", "fails")}
+        detail = (m.get("name") + (" " + " ".join(
+            f"{k}={v}" for k, v in sorted(what.items())) if what else ""))
+        flags.append({"epoch": m.get("epoch"), "line": m.get("_line"),
+                      "flag": kind, "detail": detail})
+    flags.sort(key=lambda f: (f.get("line") is None, f.get("line") or 0))
+    return flags
+
+
 def build_report(run: dict, **kw) -> dict:
     epochs = run["epochs"]
     flags = health_flags(epochs, run["events"], **kw)
     flags += program_flags(run)
+    recov = recovery_flags(run)
+    flags += recov
     by_kind: dict = {}
     for f in flags:
         by_kind[f["flag"]] = by_kind.get(f["flag"], 0) + 1
@@ -371,6 +455,13 @@ def build_report(run: dict, **kw) -> dict:
         "summary": {
             "flag_counts": by_kind,
             "healthy": not flags,
+            # recovery actions alone (subset of flag_counts): the run
+            # took damage AND healed — distinct from undetected-problem
+            # flags like grad_spike
+            "recovery_counts": {
+                k: n for k, n in sorted(by_kind.items())
+                if k in ("skip_step", "rollback", "quarantine",
+                         "circuit_open", "retry")},
             "best": finals[-1] if finals else None,
             "scores": scores[-1] if scores else None,
         },
@@ -431,6 +522,12 @@ def format_report(rep: dict) -> str:
             lines.append(f"  {where}: [{f['flag']}] {f['detail']}")
     else:
         lines.append("no health flags — run looks clean")
+    recov = rep["summary"].get("recovery_counts") or {}
+    if recov:
+        lines.append(
+            "recovery actions: "
+            + ", ".join(f"{k} x{n}" for k, n in recov.items())
+            + " (the run took damage and healed — docs/robustness.md)")
     best = rep["summary"]["best"]
     if best:
         vals = best.get("best_val")

@@ -32,6 +32,28 @@ Interval = Tuple[float, float]
 
 DEVICE_RESOURCE = "device"
 
+# Recovery-action marks (ISSUE 9, docs/robustness.md): shown on the
+# Gantt as `!` instants and summarized in a RECOVERY line, so a healed
+# run's damage is visible in the same rendering as its pipeline.
+RECOVERY_MARK_NAMES = (
+    "recovery_rollback",
+    "recovery_rollback_unavailable",
+    "ckpt_quarantine",
+    "ckpt_unverified",
+    "serve_quarantine",
+    "circuit_open",
+    "circuit_close",
+    "stream_retry",
+    "cold_start_retry",
+    "sigterm_drain",
+)
+
+
+def recovery_marks(run: dict) -> List[dict]:
+    """The stream's recovery-action marks, in stream order."""
+    return [m for m in run.get("marks", [])
+            if m.get("name") in RECOVERY_MARK_NAMES]
+
 
 def load_run(path: str) -> dict:
     """Split a RUN.jsonl into {"spans", "marks", "epochs", "meta",
@@ -195,24 +217,37 @@ def overlap_report(spans: List[dict]) -> List[dict]:
     return rows
 
 
-def gantt(spans: List[dict], width: int = 72) -> str:
-    """One text lane per resource over the run window."""
+def gantt(spans: List[dict], width: int = 72,
+          marks: Optional[List[dict]] = None) -> str:
+    """One text lane per resource over the run window. `marks`
+    (recovery events, ISSUE 9) overlay as `!` at their instant on their
+    resource's lane — a lane that only ever saw marks (e.g. `recovery`)
+    still appears."""
     res = resource_intervals(spans)
-    if not res:
+    marks = [m for m in (marks or []) if isinstance(m.get("t"),
+                                                    (int, float))]
+    if not res and not marks:
         return "(no spans)"
-    lo = min(iv[0][0] for iv in res.values() if iv)
-    hi = max(iv[-1][1] for iv in res.values() if iv)
+    los = [iv[0][0] for iv in res.values() if iv] + [m["t"] for m in marks]
+    his = [iv[-1][1] for iv in res.values() if iv] + [m["t"] for m in marks]
+    lo, hi = min(los), max(his)
     window = max(hi - lo, 1e-9)
-    name_w = max(len(r) for r in res)
+    lanes = sorted(set(res) | {m.get("resource", "host") for m in marks})
+    name_w = max(len(r) for r in lanes)
     lines = [f"{'':<{name_w}}  |{'run window':-^{width}}| "
              f"{lo:.3f}s .. {hi:.3f}s"]
-    for r in sorted(res):
+    for r in lanes:
         cells = [" "] * width
-        for a, b in res[r]:
+        for a, b in res.get(r, []):
             c0 = int((a - lo) / window * width)
             c1 = max(c0 + 1, int((b - lo) / window * width + 0.5))
             for c in range(c0, min(c1, width)):
                 cells[c] = "#"
+        for m in marks:
+            if m.get("resource", "host") != r:
+                continue
+            c = min(int((m["t"] - lo) / window * width), width - 1)
+            cells[c] = "!"
         lines.append(f"{r:<{name_w}}  |{''.join(cells)}|")
     return "\n".join(lines)
 
@@ -239,14 +274,38 @@ def span_sections(run: dict) -> List[List[dict]]:
     return [sec for sec in sections if sec]
 
 
+def _marks_for_section(run: dict, spans: List[dict],
+                       rmarks: List[dict]) -> List[dict]:
+    """The recovery marks sharing a span section's time base: those
+    between the same pair of `run_meta` headers (each process/section
+    has its own perf_counter origin — a mark from another section
+    overlaid here would land at a fabricated spot). Single-section
+    streams and positionless records keep everything."""
+    if not spans or not rmarks:
+        return []
+    bounds = sorted(m["_line"] for m in run.get("meta", [])
+                    if m.get("_line") is not None)
+    if len(bounds) <= 1 or any(s.get("_line") is None for s in spans):
+        return rmarks
+    # the section is owned by the last header preceding its spans
+    first = min(s["_line"] for s in spans)
+    i = max(sum(1 for b in bounds if b < first) - 1, 0)
+    lo = bounds[i]
+    hi = bounds[i + 1] if i + 1 < len(bounds) else float("inf")
+    return [m for m in rmarks
+            if m.get("_line") is None or lo <= m["_line"] < hi]
+
+
 def format_report(run: dict, width: int = 72, top: int = 10) -> str:
     sections = span_sections(run)
+    rmarks = recovery_marks(run)
     lines: List[str] = []
     for i, spans in enumerate(sections):
         if len(sections) > 1:
             lines.append(f"=== run section {i + 1}/{len(sections)} "
                          "(separate process: own time base) ===")
-        lines.append(gantt(spans, width=width))
+        lines.append(gantt(spans, width=width,
+                           marks=_marks_for_section(run, spans, rmarks)))
         lines.append("")
         rows = overlap_report(spans)
         if rows:
@@ -289,6 +348,14 @@ def format_report(run: dict, width: int = 72, top: int = 10) -> str:
             # the cost dimension (ISSUE 7): what the storm actually
             # burned, from the per-miss compile records
             + (f" — {cost:.2f}s of compile wall" if cost else ""))
+    if rmarks:
+        by: dict = {}
+        for m in rmarks:
+            by[m["name"]] = by.get(m["name"], 0) + 1
+        lines.append(
+            "RECOVERY: "
+            + ", ".join(f"{k} x{n}" for k, n in sorted(by.items()))
+            + " (`!` marks on the Gantt; detail: obs.report)")
     return "\n".join(lines)
 
 
@@ -353,6 +420,7 @@ def main(argv: Optional[list] = None) -> int:
             "compiles": compile_summary(run),
             "retrace_storms": [m for m in run["marks"]
                                if m.get("name") == "retrace_storm"],
+            "recovery_marks": recovery_marks(run),
         }, indent=2))
     else:
         print(format_report(run, width=args.width, top=args.top))

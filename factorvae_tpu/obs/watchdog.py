@@ -192,7 +192,7 @@ class WatchedJit:
                     cap = compilelib.capture_compile(
                         self._fn, compilelib.abstractify(args),
                         compilelib.abstractify(kwargs))
-                except Exception:
+                except Exception:  # graftlint: disable=JGL007 capture is best-effort telemetry; failure degrades to an empty compile record that IS logged unconditionally below
                     cap = {}
             self.last_compile = dict(cap, fn=self.name, wall_s=wall,
                                      compiles=self.compiles)

@@ -41,7 +41,7 @@ def make_mesh(
     shape = cfg.shape(len(devices))
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
-    except Exception:
+    except Exception:  # graftlint: disable=JGL007 create_device_mesh only optimizes topology order; the reshape fallback uses the same devices and is deterministic — nothing was lost worth surfacing
         dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, (DATA_AXIS, STOCK_AXIS))
 

@@ -86,6 +86,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "multi-model dispatch tick")
     p.add_argument("--max_batch", type=int, default=64,
                    help="max requests per tick")
+    p.add_argument("--deadline_ms", type=float, default=0.0,
+                   help="per-request scoring deadline (0 = none; a "
+                        "request-level 'deadline_ms' field overrides): "
+                        "scores landing later answer ok:false with the "
+                        "measured latency (docs/robustness.md)")
+    p.add_argument("--breaker_k", type=int, default=3,
+                   help="consecutive failures (dispatch errors or "
+                        "deadline misses) that open a model's circuit "
+                        "breaker — later requests fast-fail with "
+                        "retry_after_s until the cooldown elapses")
+    p.add_argument("--breaker_cooldown_s", type=float, default=5.0,
+                   help="open-breaker cooldown before one half-open "
+                        "probe request is let through")
     p.add_argument("--metrics_jsonl", type=str, default=None,
                    help="RUN.jsonl stream for request spans + compile "
                         "records (render: python -m "
@@ -233,7 +246,9 @@ def main(argv=None) -> int:
         daemon = ScoringDaemon(
             registry, dataset,
             stochastic=(None if args.stochastic else False),
-            seed=args.seed)
+            seed=args.seed, deadline_ms=args.deadline_ms,
+            breaker_k=args.breaker_k,
+            breaker_cooldown_s=args.breaker_cooldown_s)
         if args.warmup:
             walls = registry.warmup(dataset,
                                     stochastic=daemon.stochastic)

@@ -38,10 +38,12 @@ with zero extra wiring.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -51,7 +53,7 @@ from factorvae_tpu.serve.registry import (
     ModelRegistry,
     RegistryError,
 )
-from factorvae_tpu.utils.logging import timeline_span
+from factorvae_tpu.utils.logging import timeline_event, timeline_span
 
 _CMDS = ("ping", "stats", "models", "shutdown")
 
@@ -68,6 +70,13 @@ class _Resolved:
     scores: Optional[np.ndarray] = None   # filled by dispatch
     batched_with: int = 1
     done_t: Optional[float] = None        # when THIS request's scores landed
+    deadline_ms: float = 0.0              # 0 = none
+    deadline_from_request: bool = False   # client override, not config
+    paid_compile: bool = False            # entry was cold at resolve time
+    retry_after_s: Optional[float] = None  # circuit-breaker fast-fail
+    fast_failed: bool = False             # never dispatched (breaker open)
+    server_fault: bool = False            # resolve failed on OUR side
+    shared_outcome: bool = False          # copy of another request's dispatch
 
 
 class ScoringDaemon:
@@ -77,18 +86,50 @@ class ScoringDaemon:
     reproducible-backtest mode; True defers to each entry's config the
     way `predict_panel(stochastic=None)` does. `seed` is the scoring
     RNG stream of the stochastic path, shared across models like the
-    sweep shares it across seeds."""
+    sweep shares it across seeds.
+
+    **Resilience (ISSUE 9, docs/robustness.md).** `deadline_ms` bounds
+    every scoring request (a per-request "deadline_ms" field overrides;
+    0 disables): a request whose scores land past its deadline answers
+    `ok:false` with the measured latency instead of pretending the
+    stall didn't happen. A per-entry CIRCUIT BREAKER opens after
+    `breaker_k` consecutive failures (dispatch errors or deadline
+    misses): requests fast-fail with `retry_after_s` for
+    `breaker_cooldown_s` without touching the sick model, then ONE
+    probe request is let through (half-open) — success closes the
+    breaker, failure re-opens it. `health()` summarizes a sliding
+    window of the last `health_window` scoring outcomes into
+    ok → degraded → failing (`/healthz` returns 503 only on failing).
+    Every breaker transition lands on the timeline as a `circuit_open`
+    / `circuit_close` recovery mark."""
 
     def __init__(self, registry: ModelRegistry, dataset,
-                 stochastic: Optional[bool] = False, seed: int = 0):
+                 stochastic: Optional[bool] = False, seed: int = 0,
+                 deadline_ms: float = 0.0, breaker_k: int = 3,
+                 breaker_cooldown_s: float = 5.0,
+                 health_window: int = 64, degraded_at: float = 0.1,
+                 failing_at: float = 0.5):
         self.registry = registry
         self.dataset = dataset
         self.stochastic = stochastic
         self.seed = seed
+        self.deadline_ms = float(deadline_ms)
+        self.breaker_k = max(1, int(breaker_k))
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.degraded_at = float(degraded_at)
+        self.failing_at = float(failing_at)
         self.requests_served = 0
         self.dispatches = 0
         self.fused_requests = 0
+        self.deadline_misses = 0
+        self.breaker_fast_fails = 0
         self._closing = False
+        self._draining = False
+        # key -> {"fails": consecutive failures, "open_until": t}
+        self._breakers: dict = {}
+        # Sliding scoring-outcome window (True=answered ok) — the
+        # error-rate the health status derives from.
+        self._outcomes: deque = deque(maxlen=max(1, int(health_window)))
         # Fused-dispatch stacked param tree of the MOST RECENT group
         # (keyed by its tuple of entry keys; cleared whenever the
         # registry mutates). Repeat ticks over the same warm models
@@ -98,6 +139,12 @@ class ScoringDaemon:
         # (invisible to the registry's budget) stay bounded.
         self._stack_cache: dict = {}
         self._stack_version: Optional[int] = None
+        # Fused groups that already paid their one-time fleet-program
+        # compile (keyed by (entry keys, n_days) — the jit cache's
+        # effective key here). `paid_compile` from _resolve only knows
+        # the SERIAL warm state; without this, a daemon that only ever
+        # scores fused would forgive deadline misses forever.
+        self._fused_compiled: set = set()
 
     # ---- request parsing -------------------------------------------------
 
@@ -147,15 +194,92 @@ class ScoringDaemon:
             return _Resolved(request=req,
                              error="request needs a 'model' (key or "
                                    "alias; see {\"cmd\": \"models\"})")
+        from_req = "deadline_ms" in req
         try:
-            entry = self.registry.get(str(model))
+            deadline = float(req.get("deadline_ms", self.deadline_ms) or 0)
             days = self._resolve_days(req)
         except Exception as e:
-            # Untrusted request input: whatever a malformed day value
-            # (or a failing cold-start) raises becomes an {"ok": false}
-            # response, never a daemon death.
+            # Untrusted request input: whatever a malformed deadline or
+            # day value raises becomes an {"ok": false} response, never
+            # a daemon death — and never a health-window sample (one
+            # misconfigured client replaying garbage must not 503 a
+            # daemon that is scoring everyone else correctly).
             return _Resolved(request=req, error=str(e))
-        return _Resolved(request=req, entry=entry, days=days)
+        try:
+            entry = self.registry.get(str(model))
+        except Exception as e:
+            # A name the registry KNOWS that fails to produce an entry
+            # (cold-start reload death after retries) is OUR failure
+            # and feeds /healthz; an unknown name is client input.
+            try:
+                self.registry.resolve_key(str(model))
+                known = True
+            except RegistryError:
+                known = False
+            return _Resolved(request=req, error=str(e),
+                             server_fault=known)
+        return _Resolved(request=req, entry=entry, days=days,
+                         deadline_ms=deadline,
+                         deadline_from_request=from_req,
+                         paid_compile=not entry.compiled)
+
+    # ---- circuit breaker -------------------------------------------------
+
+    def _breaker_gate(self, r: _Resolved) -> bool:
+        """True when this request may dispatch. An OPEN breaker inside
+        its cooldown fast-fails the request (retry_after_s tells the
+        client when); a breaker whose cooldown elapsed goes HALF-OPEN —
+        the request proceeds as the probe."""
+        b = self._breakers.get(r.entry.key)
+        if b is None or b.get("open_until") is None:
+            return True
+        remaining = b["open_until"] - time.perf_counter()
+        if remaining <= 0:
+            # half-open: exactly this request probes; re-arm the window
+            # so a slow probe doesn't let a burst through behind it.
+            b["open_until"] = time.perf_counter() + self.breaker_cooldown_s
+            b["half_open"] = True
+            return True
+        r.error = (
+            f"circuit open for model {r.entry.alias or r.entry.key} "
+            f"after {b['fails']} consecutive failures; "
+            f"retry in {remaining:.2f}s")
+        r.retry_after_s = round(remaining, 3)
+        r.fast_failed = True
+        self.breaker_fast_fails += 1
+        return False
+
+    def _breaker_record(self, entry: Entry, ok: bool) -> None:
+        """Feed one dispatch outcome (including deadline misses) into
+        the entry's breaker; opens after `breaker_k` consecutive
+        failures, closes on any success."""
+        b = self._breakers.setdefault(
+            entry.key, {"fails": 0, "open_until": None,
+                        "half_open": False})
+        if ok:
+            if b["open_until"] is not None:
+                # Only an actually-open breaker CLOSES; resetting a
+                # sub-threshold failure streak is not a breaker cycle
+                # and must not fabricate circuit_close marks in the
+                # recovery telemetry.
+                timeline_event("circuit_close", cat="recovery",
+                               resource="serve", model=entry.key)
+            b.update(fails=0, open_until=None, half_open=False)
+            return
+        b["fails"] += 1
+        if b["fails"] >= self.breaker_k or b["half_open"]:
+            b["open_until"] = time.perf_counter() + self.breaker_cooldown_s
+            b["half_open"] = False
+            timeline_event("circuit_open", cat="recovery",
+                           resource="serve", model=entry.key,
+                           fails=b["fails"],
+                           retry_after_s=self.breaker_cooldown_s)
+
+    def open_breakers(self) -> list:
+        now = time.perf_counter()
+        return sorted(k for k, b in self._breakers.items()
+                      if b.get("open_until") is not None
+                      and b["open_until"] > now)
 
     # ---- dispatch --------------------------------------------------------
 
@@ -179,6 +303,8 @@ class ScoringDaemon:
         for r in resolved:
             if r.error or r.cmd:
                 continue
+            if not self._breaker_gate(r):
+                continue
             key = self._bucket_key(r)
             if key is None:
                 self._dispatch_serial(r)
@@ -190,7 +316,12 @@ class ScoringDaemon:
                 distinct.setdefault(r.entry.key, r.entry)
             if len(distinct) == 1:
                 # One model (possibly asked for twice): the serial,
-                # bitwise path — score once, share the result.
+                # bitwise path — score once, share the result. Copies
+                # answer normally but must not re-feed the breaker or
+                # the health window: ONE dispatch is one piece of
+                # evidence, and K duplicate requests sharing one
+                # transient failure must not count as K consecutive
+                # failures.
                 first = None
                 for r in group:
                     if first is None:
@@ -200,6 +331,7 @@ class ScoringDaemon:
                         r.scores = first.scores
                         r.done_t = first.done_t
                         r.error = first.error
+                        r.shared_outcome = True
                 continue
             entries = list(distinct.values())
             days = group[0].days
@@ -225,11 +357,14 @@ class ScoringDaemon:
                         stacked, entries[0].score_config, self.dataset,
                         days, stochastic=self.stochastic,
                         seed=self.seed, int8=entries[0].int8)
-            except Exception:
+            except Exception as e:
                 # One bad group (mismatched leaf shapes, an OOM in the
                 # S-way program) must not kill the daemon: fall back to
                 # the serial path, whose per-request error handling
                 # turns failures into {"ok": false} responses.
+                timeline_event("fused_fallback", cat="serve",
+                               resource="serve", models=len(entries),
+                               error=str(e))
                 self._stack_cache.pop(cache_key, None)
                 for r in group:
                     self._dispatch_serial(r)
@@ -237,16 +372,26 @@ class ScoringDaemon:
             t1 = time.perf_counter()
             self.dispatches += 1
             by_key = {e.key: fleet[i] for i, e in enumerate(entries)}
+            fused_key = (cache_key, int(len(days)))
+            paid_fused = fused_key not in self._fused_compiled
+            self._fused_compiled.add(fused_key)
             # NOTE: entries are NOT marked compiled here — `compiled`
             # means the SERIAL scan program is warm (registry.score /
             # warmup semantics); the fleet program compiled above is a
             # different executable, and marking entries warm off it
             # would make warmup() skip the serial compile a later lone
             # request then pays on the request path.
+            seen_keys: set = set()
             for r in group:
                 r.scores = by_key[r.entry.key]
                 r.batched_with = len(entries)
                 r.done_t = t1
+                # the fleet program's compile is the fused path's
+                # one-time wall (entry.compiled only tracks the SERIAL
+                # program — see the NOTE above)
+                r.paid_compile = paid_fused
+                r.shared_outcome = r.entry.key in seen_keys
+                seen_keys.add(r.entry.key)
                 r.entry.requests += 1
                 self.fused_requests += 1
 
@@ -271,7 +416,29 @@ class ScoringDaemon:
     def _respond(self, r: _Resolved, t0: float) -> dict:
         rid = (r.request or {}).get("id")
         if r.error is not None:
-            return {"id": rid, "ok": False, "error": r.error}
+            if (r.entry is not None and not r.fast_failed
+                    and not r.shared_outcome):
+                # Dispatch-stage failure: feeds the entry's breaker.
+                # Fast-fails don't re-record — the breaker is already
+                # open and a queue of fast-fails must not extend it.
+                # Shared copies don't either: one dispatch, one piece
+                # of evidence.
+                self._breaker_record(r.entry, False)
+            if (r.cmd is None and not r.fast_failed
+                    and not r.shared_outcome
+                    and (r.entry is not None or r.server_fault)):
+                # Health samples are OUR scoring outcomes only.
+                # Fast-fails are the BREAKER working, not new evidence:
+                # a sick model under client retry traffic must surface
+                # as degraded (open_breakers) — not 503 the whole
+                # daemon and starve the half-open probe. And client
+                # input errors (unknown model, malformed day) are not
+                # evidence about the daemon at all.
+                self._outcomes.append(False)
+            out = {"id": rid, "ok": False, "error": r.error}
+            if r.retry_after_s is not None:
+                out["retry_after_s"] = r.retry_after_s
+            return out
         if r.cmd is not None:
             if r.cmd == "shutdown":
                 self._closing = True
@@ -283,6 +450,61 @@ class ScoringDaemon:
                         "models": self.registry.stats()["entries"]}
             return {"id": rid, "ok": True, "cmd": "stats",
                     **self.stats()}
+        # Per-request deadline: judged from tick arrival to THIS
+        # request's scores landing (the same clock latency_ms reports).
+        # The work is already done — the contract is honesty, not
+        # cancellation (one jit dispatch is not interruptible): a
+        # stalled backend answers ok:false with the measured latency,
+        # and K of those in a row open the entry's breaker so later
+        # requests stop queueing behind the stall.
+        done_lat_ms = ((r.done_t or time.perf_counter()) - t0) * 1e3
+        # A miss against the SERVER's own deadline is evidence the
+        # model is sick no matter whose deadline the RESPONSE used —
+        # including a client that RAISED (or disabled) its deadline and
+        # gets ok:true for a dispatch the server's policy calls a stall.
+        server_miss = bool(self.deadline_ms) \
+            and done_lat_ms > self.deadline_ms
+        if r.deadline_ms and done_lat_ms > r.deadline_ms:
+            self.deadline_misses += 1
+            if not r.shared_outcome:
+                if r.paid_compile or (r.deadline_from_request
+                                      and not server_miss):
+                    # A CLIENT-chosen deadline is that client's latency
+                    # budget: as long as the server's own policy holds,
+                    # one client sending deadline_ms=0.001 must not
+                    # open the shared breaker (fast-failing everyone
+                    # else) or drag /healthz toward failing — but a
+                    # stall past the SERVER deadline stays a failure
+                    # even on a client-deadline response, else override
+                    # traffic interleaved with real misses would keep
+                    # resetting the streak on a genuinely stalled
+                    # backend. A request that paid the ONE-TIME jit
+                    # compile (cold first tick without --warmup) is
+                    # forgiven outright: the wall it blew the deadline
+                    # on is gone for every later request.
+                    self._breaker_record(r.entry, True)
+                    self._outcomes.append(True)
+                else:
+                    self._breaker_record(r.entry, False)
+                    self._outcomes.append(False)
+            return {
+                "id": rid, "ok": False,
+                "error": (f"deadline exceeded: scores landed at "
+                          f"{done_lat_ms:.1f}ms > deadline_ms="
+                          f"{r.deadline_ms:g}"),
+                "model": r.entry.key, "alias": r.entry.alias,
+                "latency_ms": round(done_lat_ms, 3),
+            }
+        if not r.shared_outcome:
+            # ok response, but the evidence is judged by SERVER policy:
+            # a stall past --deadline_ms that only answered ok because
+            # the client raised its own deadline still feeds the
+            # breaker/health as a failure (one-time compile walls
+            # excepted) — otherwise override traffic would keep
+            # resetting the failure streak on a stalled backend.
+            ok_ev = r.paid_compile or not server_miss
+            self._breaker_record(r.entry, ok_ev)
+            self._outcomes.append(ok_ev)
         ds = self.dataset
         top = (r.request or {}).get("top")
         results = []
@@ -318,8 +540,7 @@ class ScoringDaemon:
             # Tick arrival -> THIS request's scores landing: batch-file
             # ticks of many serial dispatch groups must not report
             # every request at the full tick wall.
-            "latency_ms": round(
-                ((r.done_t or time.perf_counter()) - t0) * 1e3, 3),
+            "latency_ms": round(done_lat_ms, 3),
         }
 
     # ---- public API ------------------------------------------------------
@@ -347,11 +568,51 @@ class ScoringDaemon:
     def closing(self) -> bool:
         return self._closing
 
+    def request_drain(self) -> None:
+        """Graceful-shutdown request (the SIGTERM path): the serving
+        loop finishes its in-flight tick, answers it, and exits — the
+        timeline/metrics stream flushes through the driver's normal
+        teardown instead of being torn mid-record."""
+        if not self._draining:
+            self._draining = True
+            timeline_event("sigterm_drain", cat="recovery",
+                           resource="serve",
+                           requests_served=self.requests_served)
+        self._closing = True
+
+    def health(self) -> dict:
+        """Sliding-window health: error rate over the last
+        `health_window` scoring outcomes, degraded past `degraded_at`,
+        failing past `failing_at` (or while DRAINING — a terminating
+        daemon must tell its load balancer to stop sending). Open
+        breakers degrade an otherwise-clean window: some models are
+        fast-failing even if the overall rate looks fine."""
+        n = len(self._outcomes)
+        errs = sum(1 for ok in self._outcomes if not ok)
+        rate = errs / n if n else 0.0
+        open_b = self.open_breakers()
+        if self._closing or rate >= self.failing_at:
+            status = "failing" if not self._closing else "draining"
+        elif rate >= self.degraded_at or open_b:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "ok": status in ("ok", "degraded"),
+            "error_rate": round(rate, 4),
+            "window": n,
+            "open_breakers": open_b,
+            "deadline_misses": self.deadline_misses,
+            "breaker_fast_fails": self.breaker_fast_fails,
+        }
+
     def stats(self) -> dict:
         return {
             "requests_served": self.requests_served,
             "dispatches": self.dispatches,
             "fused_requests": self.fused_requests,
+            "health": self.health(),
             "registry": self.registry.stats(),
         }
 
@@ -386,12 +647,38 @@ def _with_parse_errors(daemon: ScoringDaemon, requests: list) -> list:
     return [responses_at[i] for i in range(len(requests))]
 
 
-def _stdin_ticks(inp, tick_s: float, max_batch: int):
+@contextlib.contextmanager
+def _drain_on_sigterm(daemon: ScoringDaemon):
+    """Install a SIGTERM handler that requests a graceful drain (the
+    serving loop finishes the in-flight tick, then exits normally so
+    the metrics/timeline stream flushes). Restores the previous handler
+    on exit; a non-main thread (HTTP tests drive the server from a
+    worker) cannot install handlers and serves without one."""
+    import signal
+
+    def on_term(signum, frame):
+        daemon.request_drain()
+
+    prev = None
+    try:
+        prev = signal.signal(signal.SIGTERM, on_term)
+    except ValueError:  # not the main thread — no handler, no drain
+        yield
+        return
+    try:
+        yield
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def _stdin_ticks(inp, tick_s: float, max_batch: int, stop=None):
     """Yield lists of raw lines, one list per tick. On a selectable
     stream, lines arriving within `tick_s` of each other coalesce into
     one tick (up to `max_batch`); otherwise (StringIO tests) each line
     is its own tick. Reads the RAW fd exclusively — mixing readline
-    with select would strand data in Python's buffer."""
+    with select would strand data in Python's buffer. `stop` (a
+    callable) is polled on idle so a drain request ends the loop
+    instead of blocking in select forever."""
     try:
         fd = inp.fileno()
     except (AttributeError, OSError, ValueError):
@@ -421,8 +708,12 @@ def _stdin_ticks(inp, tick_s: float, max_batch: int):
                 yield pending
             return
         try:
+            # Bounded idle wait when a stop callback exists: SIGTERM
+            # interrupts nothing (PEP 475 retries select), so the drain
+            # check needs a periodic wake-up.
+            idle = 0.25 if stop is not None else None
             ready, _, _ = select.select(
-                [fd], [], [], tick_s if pending else None)
+                [fd], [], [], tick_s if pending else idle)
         except OSError:  # fd closed under us
             eof = True
             continue
@@ -430,6 +721,8 @@ def _stdin_ticks(inp, tick_s: float, max_batch: int):
             if pending:
                 yield pending
                 pending = []
+            elif stop is not None and stop():
+                return
             continue
         data = os.read(fd, 65536)
         if not data:
@@ -440,17 +733,20 @@ def _stdin_ticks(inp, tick_s: float, max_batch: int):
 
 def serve_stdin(daemon: ScoringDaemon, inp, out,
                 tick_s: float = 0.02, max_batch: int = 64) -> int:
-    """JSONL request/response loop until EOF or a shutdown cmd.
+    """JSONL request/response loop until EOF, a shutdown cmd, or a
+    SIGTERM drain (the in-flight tick is finished and answered first).
     Returns the number of requests answered."""
     answered = 0
-    for lines in _stdin_ticks(inp, tick_s, max_batch):
-        requests = [r for line in lines for r in _parse_line(line)]
-        for resp in _with_parse_errors(daemon, requests):
-            out.write(json.dumps(resp) + "\n")
-            answered += 1
-        out.flush()
-        if daemon.closing:
-            break
+    with _drain_on_sigterm(daemon):
+        for lines in _stdin_ticks(inp, tick_s, max_batch,
+                                  stop=lambda: daemon.closing):
+            requests = [r for line in lines for r in _parse_line(line)]
+            for resp in _with_parse_errors(daemon, requests):
+                out.write(json.dumps(resp) + "\n")
+                answered += 1
+            out.flush()
+            if daemon.closing:
+                break
     return answered
 
 
@@ -476,7 +772,13 @@ def serve_http(daemon: ScoringDaemon, port: int,
     """Minimal stdlib HTTP front: POST /score (object or array body),
     GET /stats, /models, /healthz. Single-threaded by design — jax
     dispatch is the bottleneck and wants no concurrency. Blocks until
-    a shutdown request arrives."""
+    a shutdown request arrives or SIGTERM requests a drain (the
+    in-flight request finishes, then the loop exits so the timeline
+    flushes).
+
+    `/healthz` reports the sliding-window health (ScoringDaemon.health):
+    200 while ok/degraded, 503 once failing or draining — the signal a
+    load balancer keys eviction on."""
     from http.server import BaseHTTPRequestHandler, HTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -490,7 +792,8 @@ def serve_http(daemon: ScoringDaemon, port: int,
 
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             if self.path == "/healthz":
-                self._send(200, {"ok": True})
+                health = daemon.health()
+                self._send(200 if health["ok"] else 503, health)
             elif self.path == "/stats":
                 self._send(200, daemon.stats())
             elif self.path == "/models":
@@ -519,9 +822,14 @@ def serve_http(daemon: ScoringDaemon, port: int,
                            line=fmt % args)
 
     server = HTTPServer((host, port), Handler)
-    try:
-        while not daemon.closing:
-            server.handle_request()
-    finally:
-        server.server_close()
+    # Bounded accept wait: handle_request returns after `timeout` with
+    # no connection, so a SIGTERM drain ends the loop within one tick
+    # instead of blocking in accept forever.
+    server.timeout = 0.25
+    with _drain_on_sigterm(daemon):
+        try:
+            while not daemon.closing:
+                server.handle_request()
+        finally:
+            server.server_close()
     return server

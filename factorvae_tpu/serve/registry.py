@@ -58,8 +58,13 @@ from typing import Optional
 
 import numpy as np
 
+from factorvae_tpu.chaos import fault as chaos_fault
 from factorvae_tpu.config import Config
-from factorvae_tpu.utils.logging import config_hash, timeline_span
+from factorvae_tpu.utils.logging import (
+    config_hash,
+    timeline_event,
+    timeline_span,
+)
 
 PRECISIONS = ("float32", "bfloat16", "int8")
 
@@ -178,6 +183,15 @@ class ModelRegistry:
     `budget_bytes=0` (default) means unbounded. `plan_table` overrides
     the planner's table for precision resolution (tests)."""
 
+    #: tombstone cold-start reloads retry this many extra times with
+    #: bounded exponential backoff before answering with a
+    #: RegistryError — a transient IO/orbax flake costs one retry,
+    #: never a dead model. Deterministic admission failures
+    #: (RegistryError: missing config, manifest mismatch) never retry:
+    #: a corrupt source does not heal on the second read.
+    COLD_RETRIES = 2
+    COLD_BACKOFF_S = 0.05
+
     def __init__(self, budget_bytes: int = 0, plan_table=None):
         self.budget_bytes = int(budget_bytes)
         self._plan_table = plan_table
@@ -268,6 +282,24 @@ class ModelRegistry:
             raise RegistryError(
                 f"no checkpoint directory at {path}; train first "
                 f"(cli.py) or pass an AOT artifact file instead")
+        # Integrity (ISSUE 9): the same sha256 manifest discipline the
+        # trainer's restore path enforces — a weights directory whose
+        # bytes no longer match its save_params manifest is never
+        # loaded (silently serving garbage scores is the worst failure
+        # mode a scoring service has). Pre-manifest directories have no
+        # manifest and admit unverified, exactly like pre-manifest
+        # training checkpoints.
+        from factorvae_tpu.train.checkpoint import verify_params_dir
+
+        bad = verify_params_dir(path)
+        if bad is not None:
+            timeline_event("serve_quarantine", cat="recovery",
+                           resource="serve", path=path, reason=bad)
+            raise RegistryError(
+                f"checkpoint {path} failed manifest verification ({bad}) "
+                f"— the weights on disk are not the bytes save_params "
+                f"wrote; re-export from the full-state checkpoint or "
+                f"retrain")
         if config is None:
             config = checkpoint_config(path)
         from factorvae_tpu.models.factorvae import load_model
@@ -352,24 +384,43 @@ class ModelRegistry:
             # KeyError the daemon on the retry.
             stone = self._tombstones[key]
             self.misses += 1
-            try:
-                if stone["source"] == "artifact":
-                    self.register_artifact(stone["source_path"],
-                                           alias=stone.get("alias"))
-                else:
-                    self.register_checkpoint(
-                        stone["source_path"], config=stone.get("config"),
-                        precision=stone.get("precision"),
-                        alias=stone.get("alias"))
-            except RegistryError:
-                raise
-            except Exception as e:
-                # orbax/OSError/... from a vanished or corrupt source:
-                # the request path speaks RegistryError only.
-                raise RegistryError(
-                    f"cold-start of evicted model {name!r} from "
-                    f"{stone['source']} {stone['source_path']} failed: "
-                    f"{e}") from e
+            for attempt in range(self.COLD_RETRIES + 1):
+                try:
+                    # Chaos hook (factorvae_tpu/chaos): a transient
+                    # cold-start failure — the recovery exercised is
+                    # exactly this retry loop. A None check when off.
+                    if chaos_fault("serve_cold_fail") is not None:
+                        raise RuntimeError(
+                            "chaos: injected cold-start reload failure")
+                    if stone["source"] == "artifact":
+                        self.register_artifact(stone["source_path"],
+                                               alias=stone.get("alias"))
+                    else:
+                        self.register_checkpoint(
+                            stone["source_path"],
+                            config=stone.get("config"),
+                            precision=stone.get("precision"),
+                            alias=stone.get("alias"))
+                    break
+                except RegistryError:
+                    # Deterministic admission failure (missing config,
+                    # manifest mismatch): a retry cannot heal it, and
+                    # the message is already actionable.
+                    raise
+                except Exception as e:
+                    # orbax/OSError/... from a vanished or flaky
+                    # source: bounded exponential-backoff retry, then
+                    # the request path speaks RegistryError only.
+                    if attempt == self.COLD_RETRIES:
+                        raise RegistryError(
+                            f"cold-start of evicted model {name!r} from "
+                            f"{stone['source']} {stone['source_path']} "
+                            f"failed after {attempt + 1} attempts: "
+                            f"{e}") from e
+                    timeline_event("cold_start_retry", cat="recovery",
+                                   resource="serve", model=key,
+                                   attempt=attempt + 1, error=str(e))
+                    time.sleep(self.COLD_BACKOFF_S * (2 ** attempt))
             self.cold_starts += 1
             self._tombstones.pop(key, None)
             return self._entries[key]
@@ -436,6 +487,13 @@ class ModelRegistry:
         time) passes it to keep hits/misses one-count-per-request."""
         if entry is None:
             entry = self.get(name)
+        # Chaos hook: a stalled backend (slow device, contended host).
+        # The recovery exercised lives in the DAEMON: the per-request
+        # deadline turns the stall into an explicit ok:false, and the
+        # circuit breaker fast-fails the entry after K of them.
+        stall = chaos_fault("serve_stall")
+        if stall is not None:
+            time.sleep(stall.delay_s)
         t0 = time.perf_counter()
         first = not entry.compiled
         with timeline_span(f"serve_score:{entry.key}", cat="serve",

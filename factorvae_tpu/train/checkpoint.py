@@ -1,4 +1,4 @@
-"""Orbax checkpointing with full resume.
+"""Orbax checkpointing with full resume + byte-level integrity.
 
 The reference only ever writes the best-validation model weights
 (main.py:73-80); optimizer/scheduler state and the RNG are lost, so a
@@ -13,24 +13,50 @@ compute and the barrier lives on the read side (restore/steps/close) —
 see `Checkpointer`. Crash semantics are unchanged because orbax commits
 step directories atomically: a kill mid-save is a lost step, never a
 corrupt one.
+
+**Integrity (ISSUE 9).** Every committed step gets a MANIFEST — sha256
+per payload file + the canonical config hash — written as a sibling
+(`<dir>/manifests/<step>.json`, never inside the orbax step layout).
+Restore verifies the chosen step against its manifest first; a mismatch
+QUARANTINES the step (`<dir>/quarantine/<step>.json`, a
+`ckpt_quarantine` timeline mark) and falls back to the next older
+verified step instead of loading garbage or crashing. `all_steps` /
+`latest_step` exclude quarantined steps, so the fleet's group-resume
+max-common-step rule skips a corrupt member automatically
+(`verified_steps` verifies eagerly for exactly that scan). Steps
+written before this PR have no manifest and restore UNVERIFIED (logged
+as such) — integrity is additive, not a format break.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import threading
 import time
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 from factorvae_tpu.train.state import TrainState
 from factorvae_tpu.utils.logging import (
+    config_hash,
     current_timeline,
+    timeline_event,
     timeline_span,
     timeline_span_at,
 )
+
+MANIFEST_DIRNAME = "manifests"
+QUARANTINE_DIRNAME = "quarantine"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """An EXPLICITLY requested step failed manifest verification (the
+    latest-step path never raises this — it quarantines and falls back).
+    Carries a one-line actionable message."""
 
 
 def _own_buffers(tree):
@@ -46,6 +72,42 @@ def _own_buffers(tree):
     return jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
 
 
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def step_manifest(step_dir: str, cfg_hash: Optional[str] = None) -> dict:
+    """Manifest dict for one COMMITTED step directory: sha256 of every
+    file (path-relative), total bytes, and the canonical config hash of
+    the run that wrote it."""
+    files = {}
+    nbytes = 0
+    for root, _, names in os.walk(step_dir):
+        for n in sorted(names):
+            p = os.path.join(root, n)
+            rel = os.path.relpath(p, step_dir)
+            files[rel] = _sha256_file(p)
+            nbytes += os.path.getsize(p)
+    return {"config_hash": cfg_hash, "files": files, "nbytes": nbytes,
+            "created": round(time.time(), 3)}
+
+
+def verify_manifest(step_dir: str, manifest: dict) -> Optional[str]:
+    """None when every manifest file exists with matching sha256;
+    otherwise a one-line reason naming the first mismatch."""
+    for rel, digest in sorted((manifest.get("files") or {}).items()):
+        p = os.path.join(step_dir, rel)
+        if not os.path.exists(p):
+            return f"payload file missing: {rel}"
+        if _sha256_file(p) != digest:
+            return f"sha256 mismatch: {rel}"
+    return None
+
+
 class Checkpointer:
     """Full-state checkpoint manager, ASYNC by default.
 
@@ -59,6 +121,14 @@ class Checkpointer:
     directory atomically on finalize, so readers (including the fleet's
     group-resume max-common-step scan) only ever see COMPLETE steps
     (tested: tests/test_stream.py kill-between-saves).
+
+    Manifests ride the same barrier: the WRITER process records each
+    saved step and writes its sha256 manifest right after the commit
+    drains (read-side barrier / close). A kill between commit and
+    barrier leaves a complete step without a manifest — it restores
+    UNVERIFIED, exactly like a pre-manifest checkpoint. Restore-side
+    verification/quarantine semantics live in ``restore`` /
+    ``verified_steps``.
 
     ``async_save=False`` restores the old blocking behavior
     (TrainConfig.async_checkpointing wires it through the trainers).
@@ -76,8 +146,148 @@ class Checkpointer:
             ),
         )
         self._async = async_save
+        # (step, cfg_hash) saved by THIS process whose manifest is not
+        # yet on disk; flushed at every read-side barrier.
+        self._pending_manifests: List[Tuple[int, Optional[str]]] = []
+        # Guards the pending list only (swap/append/filter): manifest
+        # hashing and writes happen OUTSIDE it, so the training loop's
+        # append never blocks behind a background flush's sha256 pass.
+        self._pending_lock = threading.Lock()
+
+    # ---- manifest / quarantine paths ---------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, MANIFEST_DIRNAME,
+                            f"{int(step)}.json")
+
+    def _quarantine_path(self, step: int) -> str:
+        return os.path.join(self.directory, QUARANTINE_DIRNAME,
+                            f"{int(step)}.json")
+
+    def is_quarantined(self, step: int) -> bool:
+        return os.path.exists(self._quarantine_path(step))
+
+    def quarantine(self, step: int, reason: str) -> None:
+        """Mark a step as corrupt: excluded from latest/all/verified
+        steps from now on, never deleted (forensics want the bytes)."""
+        qdir = os.path.join(self.directory, QUARANTINE_DIRNAME)
+        os.makedirs(qdir, exist_ok=True)
+        with open(self._quarantine_path(step), "w") as fh:
+            json.dump({"step": int(step), "reason": reason,
+                       "ts": round(time.time(), 3)}, fh)
+        timeline_event("ckpt_quarantine", cat="recovery",
+                       resource="checkpoint", step=int(step),
+                       reason=reason)
+
+    def quarantined_steps(self) -> list:
+        qdir = os.path.join(self.directory, QUARANTINE_DIRNAME)
+        try:
+            return sorted(int(os.path.splitext(n)[0])
+                          for n in os.listdir(qdir) if n.endswith(".json"))
+        except OSError:
+            return []
+
+    def _flush_manifests(self, drained: bool = True) -> None:
+        """Write manifests for steps saved by this process whose commits
+        have landed. Under the read-side barrier (`drained=True`) every
+        pending step is either committed or lost; the opportunistic
+        flush at the next save() (`drained=False`) writes manifests
+        only for steps whose final directory exists — orbax commits by
+        atomic rename, so an absent dir means the write is still in
+        flight and the step stays pending. That flush is what bounds a
+        mid-run crash to ONE unverified step instead of a whole
+        manifest-less run."""
+        with self._pending_lock:
+            pending, self._pending_manifests = self._pending_manifests, []
+        requeue = []
+        for step, cfg_hash in pending:
+            step_dir = os.path.join(self.directory, str(step))
+            if not os.path.isdir(step_dir):
+                if not drained:
+                    requeue.append((step, cfg_hash))
+                continue  # drained: retention dropped it, or save failed
+            mdir = os.path.join(self.directory, MANIFEST_DIRNAME)
+            os.makedirs(mdir, exist_ok=True)
+            manifest = dict(step_manifest(step_dir, cfg_hash), step=step)
+            tmp = self._manifest_path(step) + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(manifest, fh)
+            os.replace(tmp, self._manifest_path(step))
+        if requeue:
+            with self._pending_lock:
+                self._pending_manifests.extend(requeue)
+
+    def manifest(self, step: int) -> Optional[dict]:
+        """The step's manifest, or None when none was ever written.
+        A manifest that EXISTS but cannot be read or parsed raises —
+        corruption landing in the manifest file itself must fail the
+        step's verification (verify_step), not silently demote it to
+        the legacy pre-manifest 'unverified' path."""
+        try:
+            with open(self._manifest_path(step)) as fh:
+                return json.load(fh)
+        except FileNotFoundError:
+            return None
+
+    def verify_step(self, step: int) -> Tuple[bool, Optional[str]]:
+        """(ok, reason). A quarantined step is not ok; a step whose
+        directory is ABSENT is not ok with reason "missing" (retention
+        evicted it or it never committed — manifests outlive retained
+        steps, and an evicted step is gone, not corrupt); a present step
+        without a manifest is ok-but-unverified (reason "unverified":
+        pre-manifest layout, or the writer died between commit and
+        barrier)."""
+        if self.is_quarantined(step):
+            return False, "quarantined"
+        step_dir = os.path.join(self.directory, str(step))
+        if not os.path.isdir(step_dir):
+            return False, "missing"
+        try:
+            manifest = self.manifest(step)
+        except (OSError, ValueError) as e:
+            return False, f"manifest unreadable: {e}"
+        if manifest is None:
+            return True, "unverified"
+        bad = verify_manifest(step_dir, manifest)
+        return (False, bad) if bad else (True, None)
+
+    # ---- save --------------------------------------------------------
 
     def save(self, step: int, state: TrainState, meta: dict) -> None:
+        step = int(step)
+        if step in self._mgr.all_steps():
+            # Overwrite semantics: a rollback-recovery replay re-saves
+            # epochs it already checkpointed, and orbax's manager
+            # silently SKIPS a save for an existing step — which would
+            # leave the pre-rollback bytes on disk (and stale rollback
+            # anchors pointing at them) while the run moves on. The
+            # REPLAYED trajectory is the one that must persist: drain
+            # any in-flight write, drop the old step, its manifest and
+            # any quarantine marker, then save fresh.
+            self._mgr.wait_until_finished()
+            with self._pending_lock:
+                self._pending_manifests = [
+                    (s, h) for s, h in self._pending_manifests
+                    if s != step]
+            self._mgr.delete(step)
+            for stale in (self._manifest_path(step),
+                          self._quarantine_path(step)):
+                try:
+                    os.remove(stale)
+                except FileNotFoundError:
+                    pass
+        if self._async:
+            # Opportunistic manifest flush for EARLIER saves whose
+            # atomic commit has landed: a crash between now and the next
+            # barrier then leaves at most this save unverified, not the
+            # whole run manifest-less (see _flush_manifests). On a
+            # BACKGROUND thread: the flush sha256-hashes the previous
+            # step's full payload, exactly the host wall the async save
+            # path exists to keep off the training loop; the read-side
+            # barrier still flushes synchronously.
+            threading.Thread(target=self._flush_manifests,
+                             kwargs={"drained": False}, daemon=True,
+                             name="ckpt-manifest-flush").start()
         # `ckpt_save` on the timeline is the part the TRAINING LOOP
         # pays: snapshot + enqueue under async, the whole serialization
         # under sync — the number that shows whether async checkpointing
@@ -103,8 +313,22 @@ class Checkpointer:
                     meta=ocp.args.JsonSave(meta),
                 ),
             )
+        cfg = meta.get("config") if isinstance(meta, dict) else None
+        with self._pending_lock:
+            self._pending_manifests.append(
+                (step, config_hash(cfg) if isinstance(cfg, dict) else None))
+        # Chaos harness (factorvae_tpu/chaos): a kill_mid_save fault
+        # hard-kills HERE — write enqueued (async) or finished (sync
+        # commit happens below at the wait), manifest not yet on disk —
+        # the exact crash window the atomic-commit + manifest-at-barrier
+        # design must survive. A None check when no plan is installed.
+        from factorvae_tpu import chaos
+
+        if chaos.fault("kill_mid_save", step=int(step)) is not None:
+            chaos.ops.kill_now()
         if not self._async:
             self._mgr.wait_until_finished()
+            self._flush_manifests()
         elif current_timeline() is not None:
             self._watch_commit(step)
 
@@ -133,56 +357,158 @@ class Checkpointer:
         threading.Thread(target=poll, daemon=True,
                          name=f"ckpt-commit-watch-{step}").start()
 
+    # ---- read side (barrier + verification) --------------------------
+
     def wait_until_finished(self) -> None:
-        """Drain any in-flight async save (the moved barrier)."""
+        """Drain any in-flight async save (the moved barrier), then
+        write the drained steps' manifests."""
         with timeline_span("ckpt_barrier", cat="checkpoint",
                            resource="checkpoint"):
             self._mgr.wait_until_finished()
+        self._flush_manifests()
 
     def latest_step(self) -> Optional[int]:
-        self._mgr.wait_until_finished()
-        return self._mgr.latest_step()
+        steps = self.all_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self) -> list:
-        """Every retained COMPLETE step, ascending (the fleet
-        group-resume picks the max step common to all members,
+        """Every retained COMPLETE, non-quarantined step, ascending (the
+        fleet group-resume picks the max step common to all members,
         train/fleet.py)."""
-        self._mgr.wait_until_finished()
-        return sorted(self._mgr.all_steps())
+        self.wait_until_finished()
+        bad = set(self.quarantined_steps())
+        return sorted(s for s in self._mgr.all_steps() if s not in bad)
+
+    def verified_steps(self) -> list:
+        """`all_steps` with EAGER manifest verification: steps that fail
+        are quarantined now, so a group-resume scan over every member
+        settles on a max-common step that is actually loadable
+        (unverified manifest-less steps stay in — rejecting every
+        pre-manifest checkpoint would break old runs' resume)."""
+        out = []
+        for s in self.all_steps():
+            ok, reason = self.verify_step(s)
+            if ok:
+                out.append(s)
+            else:
+                self.quarantine(s, reason or "corrupt")
+        return out
 
     def restore(
-        self, template: TrainState, step: Optional[int] = None
+        self, template: TrainState, step: Optional[int] = None,
+        verified: bool = False,
     ) -> Tuple[TrainState, dict]:
         """`template` supplies the pytree structure/shapes (an abstract
-        eval_shape of the state works)."""
-        self._mgr.wait_until_finished()
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
+        eval_shape of the state works).
+
+        Integrity: the chosen step is verified against its manifest
+        first. An implicit (latest) restore quarantines a corrupt step
+        and FALLS BACK to the next older verified one; an explicit
+        `step=` request raises `CheckpointIntegrityError` instead —
+        the caller asked for those exact bytes and must decide.
+        `verified=True` (explicit-step callers that JUST ran this step
+        through `verified_steps`, e.g. the fleet group-resume scan)
+        skips the redundant second sha256 pass over the same bytes;
+        deserialization failures still quarantine."""
+        explicit = step is not None
+        candidates = [int(step)] if explicit else \
+            list(reversed(self.all_steps()))
+        if explicit:
+            self.wait_until_finished()
+        if not candidates:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        out = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
-        return _own_buffers(out["state"]), out["meta"]
+        for s in candidates:
+            ok, reason = ((True, None) if (verified and explicit)
+                          else self.verify_step(s))
+            if not ok:
+                if reason == "missing":
+                    # Retention-evicted (or never-committed) step: gone,
+                    # not corrupt — never quarantine an absence.
+                    if explicit:
+                        raise FileNotFoundError(
+                            f"no checkpoint step {s} in "
+                            f"{self.directory} (evicted by retention or "
+                            f"never committed; retained steps: "
+                            f"{sorted(self._mgr.all_steps())})")
+                    continue
+                self.quarantine(s, reason or "corrupt")
+                if explicit:
+                    raise CheckpointIntegrityError(
+                        f"checkpoint step {s} in {self.directory} failed "
+                        f"integrity verification ({reason}); it is now "
+                        f"quarantined — restore another step or retrain")
+                continue
+            if reason == "unverified":
+                timeline_event("ckpt_unverified", cat="checkpoint",
+                               resource="checkpoint", step=s)
+            try:
+                out = self._mgr.restore(
+                    s,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract),
+                        meta=ocp.args.JsonRestore(),
+                    ),
+                )
+            except Exception as e:
+                # Deserialization died on a step the manifest could not
+                # vouch for (unverified legacy layout, or damage in a
+                # byte sha256 happens not to cover): fence it like any
+                # other corruption and fall back instead of crashing.
+                self.quarantine(
+                    s, f"restore failed: {type(e).__name__}: {e}")
+                if explicit:
+                    raise CheckpointIntegrityError(
+                        f"checkpoint step {s} in {self.directory} failed "
+                        f"to deserialize ({type(e).__name__}: {e}); it "
+                        f"is now quarantined — restore another step or "
+                        f"retrain") from e
+                continue
+            return _own_buffers(out["state"]), out["meta"]
+        raise FileNotFoundError(
+            f"no verifiable checkpoint in {self.directory} (all "
+            f"retained steps quarantined: {self.quarantined_steps()})")
 
     def close(self):
+        self._mgr.wait_until_finished()
+        self._flush_manifests()
         self._mgr.close()
 
 
 def save_params(directory: str, name: str, params: Any) -> str:
     """Best-model weights-only export under a parameter-encoding name —
     the analogue of the reference's torch.save(state_dict) filename scheme
-    (main.py:78-79)."""
+    (main.py:78-79). Writes a sibling `<path>.manifest.json` (sha256 per
+    payload file) that `serve.registry` cold-starts verify before
+    loading."""
     path = os.path.join(os.path.abspath(directory), name)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, params, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
+    manifest = step_manifest(path)
+    tmp = path + ".manifest.json.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh)
+    os.replace(tmp, path + ".manifest.json")
     return path
+
+
+def verify_params_dir(path: str) -> Optional[str]:
+    """Verify a `save_params` directory against its sibling manifest.
+    None when clean OR when no manifest exists (pre-manifest artifact —
+    unverifiable, not corrupt); a one-line reason on mismatch."""
+    path = os.path.abspath(path)
+    try:
+        with open(path + ".manifest.json") as fh:
+            manifest = json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        # The manifest exists but is torn/corrupt: that is damage, not
+        # a pre-manifest artifact — refuse, don't admit unverified.
+        return f"manifest unreadable: {e}"
+    return verify_manifest(path, manifest)
 
 
 def load_params(path: str, template: Any) -> Any:

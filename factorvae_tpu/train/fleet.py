@@ -57,7 +57,11 @@ from factorvae_tpu.parallel.sharding import (
     replicated,
     shard_dataset,
 )
-from factorvae_tpu.train.checkpoint import Checkpointer, save_params
+from factorvae_tpu.train.checkpoint import (
+    Checkpointer,
+    CheckpointIntegrityError,
+    save_params,
+)
 from factorvae_tpu.train.loop import concat_auxes, make_step_fns
 from factorvae_tpu.train.state import (
     TrainState,
@@ -65,7 +69,11 @@ from factorvae_tpu.train.state import (
     learning_rate_at,
     make_optimizer,
 )
-from factorvae_tpu.utils.logging import MetricsLogger, timeline_span
+from factorvae_tpu.utils.logging import (
+    MetricsLogger,
+    timeline_event,
+    timeline_span,
+)
 
 
 def stack_states(states: Sequence[TrainState]) -> TrainState:
@@ -210,9 +218,16 @@ class FleetTrainer:
         resolved from the partition-rule tables (parallel/partition.py):
         stacked states/orders/keys ride the seed ('data') axis, the
         panel — whole or per-chunk mini — rides 'stock'."""
+        from factorvae_tpu import chaos
+
         cfg = self.cfg
         mesh = self.mesh
         self.tx = make_optimizer(cfg.train, self.total_steps)
+        # Trace-time chaos gate (same rule as the serial Trainer): the
+        # poison argument exists only on builds made under an installed
+        # nan_grads fault plan; per-LANE on the vmapped path, so one bad
+        # seed skips its update while the others train on.
+        self._inject = chaos.has_fault("nan_grads")
         # S=1 keeps the serial Trainer's exact step graph — including,
         # on a mesh, its in-step batch constraint — so the single-seed
         # fleet stays bitwise the serial Trainer mesh path. The vmapped
@@ -224,6 +239,7 @@ class FleetTrainer:
         self.fns = make_step_fns(
             self.model, self.model_eval, self.tx, cfg.data.seq_len,
             shard_batch=shard_batch, obs=cfg.train.obs_probes,
+            guard=cfg.train.finite_guard, inject_nan=self._inject,
         )
         from factorvae_tpu.obs.watchdog import watch_jit
 
@@ -232,6 +248,11 @@ class FleetTrainer:
         if mesh is not None:
             rep = replicated(mesh)
             pan_s = panel_shardings(mesh)
+        # Chaos traces carry one extra poison argument on the train
+        # entry points: a replicated scalar on the serial path, an
+        # (S,)-per-lane vector riding the seed axis on the vmapped one.
+        extra = (replicated(mesh),) if (self._inject and mesh is not None
+                                        ) else ()
         if self.num_seeds == 1:
             # Bitwise-oracle path: identical jits to the serial Trainer
             # (mesh or not).
@@ -239,7 +260,7 @@ class FleetTrainer:
                 ord_s = order_sharding(mesh)
                 self._train_epoch_jit = watch_jit(jax.jit(
                     self.fns.train_epoch, donate_argnums=(0,),
-                    in_shardings=(rep, ord_s, pan_s),
+                    in_shardings=(rep, ord_s, pan_s) + extra,
                     out_shardings=(rep, rep)), "fleet_train_epoch")
                 self._eval_epoch_jit = watch_jit(jax.jit(
                     self.fns.eval_epoch,
@@ -256,7 +277,8 @@ class FleetTrainer:
                 eval_chunk_kw = {}
                 if mesh is not None:
                     ord_s = order_sharding(mesh)
-                    chunk_kw = dict(in_shardings=(rep, ord_s, pan_s),
+                    chunk_kw = dict(in_shardings=(rep, ord_s, pan_s)
+                                    + extra,
                                     out_shardings=(rep, rep))
                     eval_chunk_kw = dict(
                         in_shardings=(rep, ord_s, rep, pan_s),
@@ -305,9 +327,16 @@ class FleetTrainer:
                 # which then mismatches the next call's explicit
                 # in_shardings — the state is a carried value, so its
                 # placement must be a fixed point of the epoch jit.
+            # Per-lane poison vector on chaos traces: vmapped over the
+            # seed axis like the state/orders ((S,) sharded seed_pref
+            # under a mesh).
+            inject = self._inject
+            if mesh is not None:
                 seed_pref = partition.named(
                     mesh, jax.sharding.PartitionSpec(partition.SEED_AXIS))
-                jit_kw = dict(in_shardings=(state_sh, ord_sh, pan_s),
+                stacked_extra = (seed_pref,) if inject else ()
+                jit_kw = dict(in_shardings=(state_sh, ord_sh, pan_s)
+                              + stacked_extra,
                               out_shardings=(state_sh, seed_pref))
                 eval_kw = dict(in_shardings=(state_sh.params, val_ord_sh,
                                              keys_sh, pan_s),
@@ -316,14 +345,16 @@ class FleetTrainer:
                     partition.named(mesh, s)
                     for s in partition.panel_partition_specs(stacked=True))
                 chunk_kw = dict(
-                    in_shardings=(state_sh, ord_sh, pan_stacked),
+                    in_shardings=(state_sh, ord_sh, pan_stacked)
+                    + stacked_extra,
                     out_shardings=(state_sh, seed_pref))
                 eval_chunk_kw = dict(
                     in_shardings=(state_sh.params, val_ord_sh, keys_sh,
                                   pan_s),
                     out_shardings=seed_pref)
+            train_axes = (0, 0, None, 0) if inject else (0, 0, None)
             self._train_epoch_jit = watch_jit(jax.jit(
-                jax.vmap(self.fns.train_epoch, in_axes=(0, 0, None)),
+                jax.vmap(self.fns.train_epoch, in_axes=train_axes),
                 donate_argnums=(0,), **jit_kw,
             ), "fleet_train_epoch")
             # params/key are per-seed; the validation order is shared
@@ -345,8 +376,9 @@ class FleetTrainer:
                     self._eval_chunk_placement = chunk_placement(
                         mesh, order_spec=partition.
                         eval_order_partition_spec(mesh, stacked=True))
+                chunk_axes = (0, 0, 0, 0) if inject else (0, 0, 0)
                 self._train_chunk_jit = watch_jit(jax.jit(
-                    jax.vmap(self.fns.train_chunk, in_axes=(0, 0, 0)),
+                    jax.vmap(self.fns.train_chunk, in_axes=chunk_axes),
                     donate_argnums=(0,), **chunk_kw,
                 ), "fleet_train_chunk")
                 self._eval_chunk_jit = watch_jit(jax.jit(
@@ -443,15 +475,33 @@ class FleetTrainer:
             return run_state
         return jax.tree.map(lambda x: x[None], run_state)
 
+    def _poison(self, epoch: int) -> tuple:
+        """() on chaos-free builds; one poison arg on injecting builds —
+        NaN on the lanes a `nan_grads` fault targets this epoch (each
+        lane consumes its own firing; a lane=-1 wildcard with times>1
+        or times=-1 poisons several), exact 1.0 elsewhere."""
+        if not self._inject:
+            return ()
+        from factorvae_tpu import chaos
+
+        vals = [float("nan")
+                if chaos.fault("nan_grads", epoch=epoch, lane=i) is not None
+                else 1.0 for i in range(self.num_seeds)]
+        if self.num_seeds == 1:
+            return (jnp.float32(vals[0]),)
+        return (jnp.asarray(vals, jnp.float32),)
+
     def _run_train_epoch(self, run_state, epoch):
         orders = self._epoch_orders(epoch)
+        poison = self._poison(epoch)
         if self.stream:
-            return self._stream_train_epoch(run_state, orders)
+            return self._stream_train_epoch(run_state, orders, poison)
         if self.num_seeds == 1:
             st, m = self._train_epoch_jit(
-                run_state, orders[0], self.panel_args())
+                run_state, orders[0], self.panel_args(), *poison)
             return st, {k: v[None] for k, v in m.items()}
-        return self._train_epoch_jit(run_state, orders, self.panel_args())
+        return self._train_epoch_jit(run_state, orders, self.panel_args(),
+                                     *poison)
 
     def _run_eval_epoch(self, run_params, val_order, epoch):
         keys = self._eval_keys(epoch)
@@ -466,7 +516,7 @@ class FleetTrainer:
 
     # ---- streaming residency -----------------------------------------
 
-    def _stream_train_epoch(self, run_state, orders):
+    def _stream_train_epoch(self, run_state, orders, poison: tuple = ()):
         """Chunked stream fleet epoch: per-seed mini-panels (each seed's
         shuffled order gathers different slabs) stacked into one
         prefetched chunk, consumed by the vmapped chunk scan. S=1 runs
@@ -485,7 +535,7 @@ class FleetTrainer:
                 placement=self._chunk_placement)
             for order_local, panel_chunk in chunks:
                 run_state, aux = self._train_chunk_jit(
-                    run_state, order_local, panel_chunk)
+                    run_state, order_local, panel_chunk, *poison)
                 parts.append(aux)
             self.last_stream_stats = chunks
             m = self._finalize_train_jit(concat_auxes(parts))
@@ -511,7 +561,7 @@ class FleetTrainer:
                              placement=self._chunk_placement)
         for order_local, panel_chunk in chunks:
             run_state, aux = self._train_chunk_jit(
-                run_state, order_local, panel_chunk)
+                run_state, order_local, panel_chunk, *poison)
             parts.append(aux)
         self.last_stream_stats = chunks
         return run_state, self._finalize_train_jit(
@@ -581,12 +631,29 @@ class FleetTrainer:
         # XLA on backends with donation support.
         best_params = jax.tree.map(jnp.copy, state.params)
         start_epoch = 0
+        # Per-lane recovery escalation (docs/robustness.md): one bad
+        # lane (non-finite loss or finite-guard skips) rolls back ALONE
+        # from its own last-good checkpoint and the fleet continues
+        # forward — no epoch replay, no lr change (the optimizer is
+        # shared across lanes; the restored lane's rewound step count
+        # re-positions its schedule instead).
+        recover_after = max(0, int(cfg.train.recover_after))
+        lane_streak = [0] * self.num_seeds
+        lane_rollbacks = [0] * self.num_seeds
+        lane_anchor = [None] * self.num_seeds
         if resume and cfg.train.checkpoint_every:
             restored = self._restore_checkpoints(state)
             if restored is not None:
-                state, bv, start_epoch = restored
+                state, bv, start_epoch, lane_clean = restored
                 best_val = jnp.asarray(bv)
                 best_params = self._load_best(state.params, bv)
+                # Only members whose restored checkpoint was saved at a
+                # no-bad-signal epoch (meta "clean"; pre-ISSUE-9 metas
+                # default clean) may anchor a rollback — resuming a
+                # lane from a mid-bad-streak cadence save must not make
+                # the hazard state its rollback target.
+                lane_anchor = [start_epoch - 1 if c else None
+                               for c in lane_clean]
                 self.logger.log("fleet_resume", epoch=start_epoch,
                                 seeds=self.seeds,
                                 best_val=[float(v) for v in bv])
@@ -664,6 +731,11 @@ class FleetTrainer:
                     self.num_seeds * float(np.asarray(train_m["days"])[0])
                     / max(dt, 1e-9)),
             )
+            if "skipped_steps" in train_m:
+                # Per-lane finite-guard skip counts (train/loop.py) —
+                # obs.report renders any >0 as a `skip_step` flag.
+                rec["skipped_steps"] = [
+                    float(v) for v in np.asarray(train_m["skipped_steps"])]
             if cfg.train.obs_probes:
                 # Per-seed probe lists (obs/probes.py): the vmapped
                 # epoch returns every scalar probe (S,)-shaped.
@@ -687,6 +759,55 @@ class FleetTrainer:
             from factorvae_tpu.obs.memory import watermark_event
 
             watermark_event(epoch=epoch, seeds=self.num_seeds)
+            # ---- per-lane recovery escalation --------------------------
+            loss_np = np.asarray(train_m["loss"], np.float64)
+            skip_np = (np.asarray(rec["skipped_steps"], np.float64)
+                       if "skipped_steps" in rec
+                       else np.zeros(self.num_seeds))
+            nf_np = (np.nan_to_num(np.asarray(
+                rec["nonfinite_grads"], np.float64))
+                if "nonfinite_grads" in rec else np.zeros(self.num_seeds))
+            bad_lanes = ~np.isfinite(loss_np) | (skip_np > 0) | (nf_np > 0)
+            for i in range(self.num_seeds):
+                lane_streak[i] = lane_streak[i] + 1 if bad_lanes[i] else 0
+            to_roll = [
+                i for i in range(self.num_seeds)
+                if recover_after and lane_streak[i] >= recover_after
+                and lane_rollbacks[i] < cfg.train.recover_max_rollbacks
+                and lane_anchor[i] is not None
+            ]
+            if to_roll:
+                run_state = self._rollback_lanes(run_state, to_roll,
+                                                 lane_anchor, epoch)
+                for i in to_roll:
+                    lane_rollbacks[i] += 1
+                    lane_streak[i] = 0
+            for i in range(self.num_seeds):
+                # A lane that crossed the escalation threshold with
+                # nowhere to roll back to (bad from epoch 0 so no
+                # good-epoch anchor, checkpointing off, or rollback
+                # budget spent) must say so — the serial trainer logs
+                # the same crossing — instead of burning its epoch
+                # budget bad in silence. Fires once per streak, at the
+                # crossing.
+                if (recover_after and lane_streak[i] == recover_after
+                        and i not in to_roll):
+                    reason = (
+                        "checkpointing disabled"
+                        if not cfg.train.checkpoint_every
+                        else "rollback budget spent "
+                        f"({lane_rollbacks[i]}"
+                        f"/{cfg.train.recover_max_rollbacks})"
+                        if lane_rollbacks[i]
+                        >= cfg.train.recover_max_rollbacks
+                        else "no good-epoch checkpoint anchor yet")
+                    self.logger.log(
+                        "recovery", kind="lane_rollback_unavailable",
+                        lane=i, seed=self.seeds[i], epoch=epoch,
+                        note=f"{reason}; lane continues un-rolled")
+                    timeline_event("recovery_rollback_unavailable",
+                                   cat="recovery", resource="recovery",
+                                   epoch=epoch, lane=i, reason=reason)
             # Serial save cadence, fleet-wide: improved seeds' best-val
             # snapshots hit disk THIS epoch (a killed multi-hour run
             # keeps every seed's best so far, exactly like the serial
@@ -699,8 +820,15 @@ class FleetTrainer:
             self._save_best(best_params, best_val_np, only=improved)
             if cfg.train.checkpoint_every and (
                     epoch % ckpt_every == 0 or epoch == epochs - 1):
-                self._save_checkpoints(self._stacked(run_state), epoch,
-                                       best_val_np)
+                self._save_checkpoints(
+                    self._stacked(run_state), epoch, best_val_np,
+                    clean=[lane_streak[i] == 0
+                           for i in range(self.num_seeds)])
+                for i in range(self.num_seeds):
+                    if lane_streak[i] == 0:
+                        # Rollback anchor: newest checkpoint written
+                        # while THIS lane showed no bad signal.
+                        lane_anchor[i] = epoch
 
         # Finalize any in-flight async checkpoint saves (the barrier the
         # per-epoch loop no longer pays).
@@ -718,6 +846,48 @@ class FleetTrainer:
         }
 
     # ------------------------------------------------------------------
+
+    def _rollback_lanes(self, run_state, lanes, lane_anchor, epoch):
+        """Restore the named seed lanes from their last-good per-seed
+        checkpoints and splice them into the running (possibly stacked)
+        state; healthy lanes are untouched. A lane whose anchor went
+        corrupt falls back to its newest VERIFIED step (restore
+        quarantines as it scans); a lane with nothing verifiable keeps
+        training forward un-rolled — one sick member never stops the
+        fleet."""
+        stacked = self.num_seeds > 1
+        for i in lanes:
+            seed = self.seeds[i]
+            ckpt = self._seed_checkpointer(seed)
+            template = (unstack_state(run_state, i) if stacked
+                        else run_state)
+            restored_step = lane_anchor[i]
+            try:
+                row, _ = ckpt.restore(template, step=restored_step)
+            except Exception:
+                try:
+                    row, meta = ckpt.restore(template)
+                    restored_step = int(meta.get("epoch", -1))
+                except FileNotFoundError:
+                    self.logger.log(
+                        "recovery", kind="lane_rollback_unavailable",
+                        lane=i, seed=seed, epoch=epoch,
+                        note="no verifiable checkpoint for this lane; "
+                             "continuing forward")
+                    continue
+            if stacked:
+                run_state = jax.tree.map(
+                    lambda x, r: x.at[i].set(jnp.asarray(r)),
+                    run_state, row)
+            else:
+                run_state = self._place_run_state(row)
+            self.logger.log("recovery", kind="lane_rollback", lane=i,
+                            seed=seed, epoch=epoch,
+                            restored_step=restored_step)
+            timeline_event("recovery_rollback", cat="recovery",
+                           resource="recovery", lane=i, seed=seed,
+                           epoch=epoch, step=restored_step)
+        return run_state
 
     def seed_config(self, seed: int) -> Config:
         """The per-seed Config a solo run of this fleet member would use
@@ -785,7 +955,8 @@ class FleetTrainer:
             )
 
     def _restore_checkpoints(self, template_state):
-        """(stacked state, best_val (S,), start_epoch) from the per-seed
+        """(stacked state, best_val (S,), start_epoch, per-lane clean
+        flags) from the per-seed
         full-state checkpoints, or None when no step is common to every
         member. The restore epoch is the MAX step present in ALL
         members' dirs: a kill mid-way through the per-seed save loop
@@ -802,7 +973,12 @@ class FleetTrainer:
             if not os.path.isdir(d):
                 return None
             ckpt = Checkpointer(d, keep=cfg_s.train.keep_checkpoints)
-            steps = set(ckpt.all_steps())
+            # verified_steps (not all_steps): a corrupt member step is
+            # quarantined HERE, so the max-common-step rule settles on
+            # an epoch every member can actually load — the whole group
+            # rewinds past one member's corruption instead of crashing
+            # on it mid-restore.
+            steps = set(ckpt.verified_steps())
             ckpt.close()
             if not steps:
                 return None
@@ -815,16 +991,34 @@ class FleetTrainer:
                      "starting the group fresh")
             return None
         epoch = max(common)
-        states, best_vals = [], []
+        states, best_vals, cleans = [], [], []
         for i, seed in enumerate(self.seeds):
             cfg_s = self.seed_config(seed)
             ckpt = Checkpointer(ckpt_dirs[i],
                                 keep=cfg_s.train.keep_checkpoints)
-            st, meta = ckpt.restore(unstack_state(template_state, i),
-                                    step=epoch)
+            try:
+                # verified=True: this exact step just passed the
+                # verified_steps scan above — do not sha256 the same
+                # bytes a second time on the resume path.
+                st, meta = ckpt.restore(unstack_state(template_state, i),
+                                        step=epoch, verified=True)
+            except CheckpointIntegrityError as e:
+                # A member step that passed the manifest scan but failed
+                # at restore time (unverified legacy step, or damage the
+                # digest did not cover) is quarantined by restore();
+                # rescan — the max-common rule now settles below it.
+                # Bounded: every retry quarantines at least one step.
+                ckpt.close()
+                self.logger.log(
+                    "fleet_resume_retry", seed=seed, step=epoch,
+                    error=str(e),
+                    note="member checkpoint failed integrity at restore; "
+                         "rescanning for an older common step")
+                return self._restore_checkpoints(template_state)
             ckpt.close()
             states.append(st)
             best_vals.append(float(meta.get("best_val", float("inf"))))
+            cleans.append(bool(meta.get("clean", True)))
             saved_cfg = meta.get("config")
             if saved_cfg is not None and saved_cfg != cfg_s.to_dict():
                 self.logger.log(
@@ -832,7 +1026,7 @@ class FleetTrainer:
                     note="resuming with a different config than the "
                          "checkpoint was written with")
         return (stack_states(states),
-                np.asarray(best_vals, np.float32), epoch + 1)
+                np.asarray(best_vals, np.float32), epoch + 1, cleans)
 
     def _load_best(self, params_template, best_val: np.ndarray):
         """Stacked best-params buffer rebuilt from the per-seed best-val
@@ -874,7 +1068,8 @@ class FleetTrainer:
         self._ckpts = {}
 
     def _save_checkpoints(self, fleet_state, epoch: int,
-                          best_val: np.ndarray) -> None:
+                          best_val: np.ndarray,
+                          clean: Optional[list] = None) -> None:
         """Lockstep full-state checkpoint per seed (every
         `checkpoint_every` epochs + the final one), format-compatible
         with the serial Checkpointer layout so a serial `Trainer` resume
@@ -897,5 +1092,6 @@ class FleetTrainer:
                 epoch,
                 row,
                 {"epoch": epoch, "best_val": float(best_val[i]),
-                 "config": cfg_s.to_dict()},
+                 "config": cfg_s.to_dict(),
+                 "clean": bool(clean[i]) if clean is not None else True},
             )

@@ -28,6 +28,7 @@ path keeps working unchanged.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -64,6 +65,15 @@ class StepFns(NamedTuple):
     finalize_eval: Callable     # (auxes (steps,)) -> metrics
 
 
+def _all_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every element of every leaf is finite. The reduction
+    the in-graph gate keys on — cheap relative to the backward pass that
+    produced the tree."""
+    flags = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(tree)]
+    return functools.reduce(jnp.logical_and, flags,
+                            jnp.asarray(True))
+
+
 def make_step_fns(
     model_train: Any,
     model_eval: Any,
@@ -71,6 +81,8 @@ def make_step_fns(
     seq_len: int,
     shard_batch: Any = None,
     obs: bool = False,
+    guard: bool = False,
+    inject_nan: bool = False,
 ) -> StepFns:
     """`model_train` / `model_eval` are the day-batched forward variants
     (models.day_forward with train=True/False; they share one param tree).
@@ -95,7 +107,21 @@ def make_step_fns(
     metric. `obs=False` (the default) is gated at TRACE TIME: the traced
     graph is the pre-observatory one, so the default path stays bitwise
     identical (pinned in tests/test_obs.py, the `panel_residency`
-    discipline)."""
+    discipline).
+
+    `guard=True` (TrainConfig.finite_guard, the self-healing default)
+    compiles the in-graph all-finite gate: the optimizer update is
+    applied through a `jnp.where` select on "all gradient elements
+    finite", so a poisoned step keeps the previous params/opt_state
+    (step and RNG still advance — the scan length and key stream stay
+    static) and the per-step `skipped` aux counts it. With no fault the
+    select always takes the updated branch and the params are BITWISE
+    the unguarded path's (tests/test_chaos.py); vmapped over a fleet,
+    each seed lane carries its own gate. `inject_nan=True` (trace-gated
+    on an installed chaos plan, factorvae_tpu/chaos) appends a `poison`
+    gradient multiplier argument to the train entry points — NaN on the
+    epochs/lanes a fault targets, 1.0 elsewhere — applied between the
+    backward pass and the gate."""
 
     def batch_for(days: jnp.ndarray, panel):
         values, last_valid, next_valid = panel
@@ -139,13 +165,31 @@ def make_step_fns(
             aux.update(loss_probes(out, day_w))
         return loss, aux
 
-    def train_step(state: TrainState, days: jnp.ndarray, panel):
+    def train_step(state: TrainState, days: jnp.ndarray, panel,
+                   poison=None):
         state, key = state.advance_rng()
         (_, aux), grads = jax.value_and_grad(weighted_day_loss, has_aux=True)(
             state.params, days, key, panel, True
         )
+        if inject_nan:
+            # Chaos-only trace (factorvae_tpu/chaos): poison is 1.0 on
+            # clean epochs/lanes (an exact float multiply — identity),
+            # NaN where a nan_grads fault targets.
+            grads = jax.tree.map(lambda g: g * poison, grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if guard:
+            # The all-finite gate: a poisoned step KEEPS the previous
+            # params/opt_state (a pure elementwise select — bitwise the
+            # ungated path when ok is always True); step and RNG still
+            # advance so the scan stays static-length and the key
+            # stream is unchanged.
+            ok = _all_finite(grads)
+            new_params = jax.tree.map(
+                lambda a, b: jnp.where(ok, a, b), new_params, state.params)
+            new_opt = jax.tree.map(
+                lambda a, b: jnp.where(ok, a, b), new_opt, state.opt_state)
+            aux["skipped"] = (~ok).astype(jnp.float32)
         state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt
         )
@@ -167,6 +211,10 @@ def make_step_fns(
             "kl": jnp.sum(auxes["kl_sum"]) / days,
             "days": jnp.sum(auxes["days"]),
         }
+        if guard:
+            # Steps whose update the gate skipped this epoch — the
+            # host-side escalation signal (trainer.py recovery).
+            m["skipped_steps"] = jnp.sum(auxes["skipped"])
         if obs:
             from factorvae_tpu.obs.probes import finalize_train_probes
 
@@ -191,7 +239,8 @@ def make_step_fns(
             m.update(finalize_eval_probes(auxes, days))
         return m
 
-    def train_chunk(state: TrainState, order: jnp.ndarray, panel):
+    def train_chunk(state: TrainState, order: jnp.ndarray, panel,
+                    poison=None):
         """One epoch SEGMENT: the epoch scan body over a (k, B) slice of
         the step order, returning the UN-reduced per-step aux so the
         caller can finalize over the whole epoch. The stream path runs
@@ -201,16 +250,19 @@ def make_step_fns(
         per-step updates stay bitwise (pre-gathered batches as jit
         inputs were measured to perturb XLA's backward fusion by ~1 ulp;
         keeping the gather in-graph is what makes stream == hbm exact).
+        `poison` exists only on chaos traces (`inject_nan`; see
+        make_step_fns) and is threaded to every step of the segment.
         """
         def body(st, days):
-            st, aux = train_step(st, days, panel)
+            st, aux = train_step(st, days, panel, poison)
             return st, aux
 
         return jax.lax.scan(body, state, order)
 
-    def train_epoch(state: TrainState, order: jnp.ndarray, panel):
+    def train_epoch(state: TrainState, order: jnp.ndarray, panel,
+                    poison=None):
         """order: (S, B) int32 day indices (-1 = pad)."""
-        state, auxes = train_chunk(state, order, panel)
+        state, auxes = train_chunk(state, order, panel, poison)
         return state, finalize_train(auxes)
 
     def eval_chunk(params, order: jnp.ndarray, key: jax.Array, panel):

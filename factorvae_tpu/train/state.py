@@ -37,14 +37,21 @@ class TrainState:
 
 
 def make_optimizer(
-    cfg: TrainConfig, total_steps: Optional[int] = None
+    cfg: TrainConfig, total_steps: Optional[int] = None,
+    lr_scale: float = 1.0,
 ) -> optax.GradientTransformation:
+    """`lr_scale` multiplies the peak lr WITHOUT changing the opt-state
+    tree structure (it scales the schedule, it does not add a
+    transform) — the recovery path's lr backoff (trainer.py rollback)
+    rebuilds the optimizer at a reduced peak and restores yesterday's
+    opt_state into it unchanged."""
+    lr = cfg.lr * float(lr_scale)
     if cfg.cosine_schedule and total_steps:
         schedule = optax.cosine_decay_schedule(
-            init_value=cfg.lr, decay_steps=total_steps, alpha=0.0
+            init_value=lr, decay_steps=total_steps, alpha=0.0
         )
     else:
-        schedule = cfg.lr
+        schedule = lr
     return optax.adam(schedule)
 
 
@@ -57,11 +64,14 @@ def create_train_state(params, tx: optax.GradientTransformation, seed: int) -> T
     )
 
 
-def learning_rate_at(cfg: TrainConfig, total_steps: int, step: int) -> float:
+def learning_rate_at(cfg: TrainConfig, total_steps: int, step: int,
+                     lr_scale: float = 1.0) -> float:
     """Host-side LR readback for logging (reference logs
-    scheduler.get_last_lr(), main.py:83)."""
+    scheduler.get_last_lr(), main.py:83). `lr_scale` mirrors
+    make_optimizer's recovery backoff."""
+    lr = cfg.lr * float(lr_scale)
     if cfg.cosine_schedule and total_steps:
         import math
 
-        return 0.5 * cfg.lr * (1 + math.cos(math.pi * min(step, total_steps) / total_steps))
-    return cfg.lr
+        return 0.5 * lr * (1 + math.cos(math.pi * min(step, total_steps) / total_steps))
+    return lr

@@ -44,7 +44,11 @@ from factorvae_tpu.train.state import (
     learning_rate_at,
     make_optimizer,
 )
-from factorvae_tpu.utils.logging import MetricsLogger, timeline_span
+from factorvae_tpu.utils.logging import (
+    MetricsLogger,
+    timeline_event,
+    timeline_span,
+)
 
 
 class Trainer:
@@ -143,9 +147,20 @@ class Trainer:
         """(Re)build optimizer + jitted epoch fns for the current
         `self.total_steps`. Called again by `fit(num_epochs=...)` when the
         override changes the cosine-schedule horizon (ADVICE round 1: the
-        LR horizon must follow the actual run length)."""
+        LR horizon must follow the actual run length), and by the
+        recovery rollback when it backs the peak lr off
+        (`self._lr_scale`; the opt-state TREE is unchanged, so the
+        restored optimizer state drops in)."""
+        from factorvae_tpu import chaos
+
         cfg = self.cfg
-        self.tx = make_optimizer(cfg.train, self.total_steps)
+        self._lr_scale = getattr(self, "_lr_scale", 1.0)
+        # Trace-time chaos gate: poison only exists on traces built
+        # while a nan_grads fault is installed (tests/bench); a chaos-
+        # free build compiles a program with no poison argument at all.
+        self._inject = chaos.has_fault("nan_grads")
+        self.tx = make_optimizer(cfg.train, self.total_steps,
+                                 lr_scale=self._lr_scale)
         self.fns = make_step_fns(
             self.model,
             self.model_eval,
@@ -153,6 +168,8 @@ class Trainer:
             cfg.data.seq_len,
             shard_batch=self._shard_batch,
             obs=cfg.train.obs_probes,
+            guard=cfg.train.finite_guard,
+            inject_nan=self._inject,
         )
 
         # Every jit goes through the compile watchdog (obs/watchdog.py):
@@ -162,6 +179,10 @@ class Trainer:
         from factorvae_tpu.obs.watchdog import watch_jit
 
         donate = (0,)
+        # Chaos traces carry one extra replicated scalar (the poison
+        # multiplier) on the train entry points.
+        extra = (replicated(self.mesh),) if (
+            self._inject and self.mesh is not None) else ()
         if self.mesh is not None:
             rep = replicated(self.mesh)
             ord_s = order_sharding(self.mesh)
@@ -170,7 +191,7 @@ class Trainer:
             self._train_epoch_jit = watch_jit(jax.jit(
                 self.fns.train_epoch,
                 donate_argnums=donate,
-                in_shardings=(rep, ord_s, pan_s),
+                in_shardings=(rep, ord_s, pan_s) + extra,
                 out_shardings=(rep, rep),
             ), "train_epoch")
             self._eval_epoch_jit = watch_jit(jax.jit(
@@ -202,7 +223,7 @@ class Trainer:
                 # the chunk jit, and an unpinned output lets GSPMD
                 # re-shard a leaf that then mismatches the next call's
                 # explicit in_shardings.
-                chunk_kw = dict(in_shardings=(rep, ord_s, pan_s),
+                chunk_kw = dict(in_shardings=(rep, ord_s, pan_s) + extra,
                                 out_shardings=(rep, rep))
                 eval_chunk_kw = dict(in_shardings=(rep, ord_s, rep, pan_s),
                                      out_shardings=rep)
@@ -238,16 +259,30 @@ class Trainer:
             lambda x: x if is_global(x) else global_put(x, sharding), tree
         )
 
-    def _train_epoch(self, state, order):
+    def _poison(self, epoch: int) -> tuple:
+        """Extra train-entry-point args for chaos traces: () normally;
+        (scalar,) when this build injects — NaN where a `nan_grads`
+        fault targets this epoch (consuming one firing), an exact 1.0
+        multiply elsewhere."""
+        if not self._inject:
+            return ()
+        from factorvae_tpu import chaos
+
+        hit = chaos.fault("nan_grads", epoch=epoch) is not None
+        return (jnp.float32(float("nan") if hit else 1.0),)
+
+    def _train_epoch(self, state, order, epoch: int = 0):
+        poison = self._poison(epoch)
         if self.stream:
             if self.mesh is not None:
                 state = self._globalize(state, replicated(self.mesh))
-            return self._train_epoch_stream(state, order)
+            return self._train_epoch_stream(state, order, poison)
         if self.mesh is not None:
             state = self._globalize(state, replicated(self.mesh))
             order = self._globalize(
                 jnp.asarray(order), order_sharding(self.mesh))
-        return self._train_epoch_jit(state, order, self.panel_args())
+        return self._train_epoch_jit(state, order, self.panel_args(),
+                                     *poison)
 
     def _eval_epoch(self, params, order, key):
         if self.stream:
@@ -264,7 +299,7 @@ class Trainer:
 
     # ---- streaming residency -----------------------------------------
 
-    def _train_epoch_stream(self, state, order):
+    def _train_epoch_stream(self, state, order, poison: tuple = ()):
         """Chunked stream epoch: the prefetcher gathers + device_puts
         chunk k+1 on a worker thread while the jitted scan consumes
         chunk k. Step order, RNG stream, updates and the metric
@@ -278,7 +313,7 @@ class Trainer:
         parts = []
         for order_local, panel_chunk in chunks:
             state, aux = self._train_chunk_jit(state, order_local,
-                                               panel_chunk)
+                                               panel_chunk, *poison)
             parts.append(aux)
         self.last_stream_stats = chunks
         return state, self._finalize_train_jit(concat_auxes(parts))
@@ -376,11 +411,29 @@ class Trainer:
                 keep=cfg.train.keep_checkpoints,
                 async_save=cfg.train.async_checkpointing,
             )
+        # Host-side recovery escalation (docs/robustness.md): a streak of
+        # `recover_after` consecutive bad epochs — non-finite train loss,
+        # finite-guard skipped updates, or (with obs probes) non-finite
+        # gradient elements — rolls back to the last checkpoint written
+        # before the streak, backs the peak lr off by
+        # `recover_lr_backoff`, and replays. Bounded by
+        # `recover_max_rollbacks` per fit.
+        recover_after = max(0, int(cfg.train.recover_after))
+        bad_streak = 0
+        rollbacks = 0
+        last_good_step: Optional[int] = None
         if state is None:
             state = self.init_state()
             if resume and ckpt is not None and ckpt.latest_step() is not None:
                 state, meta = ckpt.restore(state)
                 start_epoch = int(meta.get("epoch", 0)) + 1
+                # Only a checkpoint saved at an epoch with NO bad
+                # signal may anchor a future rollback (the meta
+                # records it; pre-ISSUE-9 checkpoints default to
+                # clean): resuming from a mid-bad-streak cadence save
+                # must not make the hazard state a rollback target.
+                if meta.get("clean", True):
+                    last_good_step = start_epoch - 1
                 best_val = float(meta.get("best_val", best_val))
                 saved_cfg = meta.get("config")
                 if saved_cfg is not None and saved_cfg != cfg.to_dict():
@@ -401,7 +454,8 @@ class Trainer:
 
         val_order = self._val_order()
         history = []
-        for epoch in range(start_epoch, epochs):
+        epoch = start_epoch
+        while epoch < epochs:
             t0 = time.time()
             order = self._epoch_orders(epoch)
             # The timeline span shares its name with the profiler
@@ -411,7 +465,7 @@ class Trainer:
             with step_annotation(f"train_epoch_{epoch}"), \
                     timeline_span(f"train_epoch_{epoch}", cat="train",
                                   resource="device", epoch=epoch):
-                state, train_m = self._train_epoch(state, order)
+                state, train_m = self._train_epoch(state, order, epoch)
                 train_loss = float(train_m["loss"])
             if val_order is not None:
                 eval_key = jax.random.fold_in(
@@ -429,7 +483,8 @@ class Trainer:
                 val_loss = float("nan")
                 selection_loss = train_loss
             dt = time.time() - t0
-            lr = learning_rate_at(cfg.train, self.total_steps, int(state.step))
+            lr = learning_rate_at(cfg.train, self.total_steps,
+                                  int(state.step), lr_scale=self._lr_scale)
             rec = dict(
                 epoch=epoch,
                 train_loss=train_loss,
@@ -450,6 +505,11 @@ class Trainer:
                 seconds=dt,
                 days_per_sec=float(train_m["days"]) / max(dt, 1e-9),
             )
+            if "skipped_steps" in train_m:
+                # Updates the in-graph finite gate skipped this epoch
+                # (train/loop.py) — obs.report renders >0 as a
+                # `skip_step` recovery flag.
+                rec["skipped_steps"] = float(train_m["skipped_steps"])
             if cfg.train.obs_probes:
                 # On-device health probes (obs/probes.py), already in
                 # the fetched metric dicts — same per-epoch host sync
@@ -476,6 +536,80 @@ class Trainer:
 
             watermark_event(epoch=epoch)
 
+            # ---- recovery escalation -----------------------------------
+            bad = (not np.isfinite(train_loss)
+                   or float(train_m.get("skipped_steps", 0.0) or 0.0) > 0
+                   or float(train_m.get("nonfinite_grads", 0.0) or 0.0) > 0)
+            bad_streak = bad_streak + 1 if bad else 0
+            escalate = bool(recover_after and bad_streak >= recover_after)
+            if (escalate
+                    and not (rollbacks < cfg.train.recover_max_rollbacks
+                             and ckpt is not None
+                             and last_good_step is not None)
+                    and bad_streak == recover_after):
+                # Escalation point with nowhere to roll back to — run bad
+                # from epoch 0 (no good-epoch anchor yet, the k60
+                # degenerate-init regime), checkpointing off, or rollback
+                # budget spent. The operator asked for action at this
+                # streak: degrade to lr backoff alone (unless the budget
+                # is the blocker — then the backoffs already happened)
+                # and say so, instead of burning the epoch budget in
+                # silence. Fires once per streak, at the crossing.
+                budget_spent = rollbacks >= cfg.train.recover_max_rollbacks
+                reason = ("rollback budget spent "
+                          f"({rollbacks}/{cfg.train.recover_max_rollbacks})"
+                          if budget_spent
+                          else "checkpointing disabled" if ckpt is None
+                          else "no good-epoch checkpoint anchor yet")
+                if not budget_spent:
+                    self._lr_scale *= cfg.train.recover_lr_backoff
+                    self._build_step_fns()
+                self.logger.log(
+                    "recovery", kind="rollback_unavailable", epoch=epoch,
+                    lr_scale=self._lr_scale,
+                    note=f"{reason}; continuing with lr backoff only")
+                timeline_event("recovery_rollback_unavailable",
+                               cat="recovery", resource="recovery",
+                               epoch=epoch, reason=reason)
+            if (escalate
+                    and rollbacks < cfg.train.recover_max_rollbacks
+                    and ckpt is not None and last_good_step is not None):
+                rollbacks += 1
+                bad_streak = 0
+                self._lr_scale *= cfg.train.recover_lr_backoff
+                # Same opt-state tree at a backed-off peak lr: the
+                # restored optimizer state drops into the rebuilt tx
+                # unchanged (train/state.make_optimizer).
+                self._build_step_fns()
+                try:
+                    state, _ = ckpt.restore(state, step=last_good_step)
+                    restored = last_good_step
+                except Exception:
+                    # The anchor step went corrupt under us: fall back to
+                    # the newest VERIFIED step (restore quarantines as it
+                    # scans); with none left, continue forward un-rolled
+                    # rather than die.
+                    try:
+                        state, meta = ckpt.restore(state)
+                        restored = int(meta.get("epoch", epoch))
+                    except FileNotFoundError:
+                        self.logger.log(
+                            "recovery", kind="rollback_unavailable",
+                            epoch=epoch,
+                            note="no verifiable checkpoint to roll back "
+                                 "to; continuing with lr backoff only")
+                        epoch += 1
+                        continue
+                self.logger.log(
+                    "recovery", kind="rollback", epoch=epoch,
+                    restored_step=restored, lr_scale=self._lr_scale,
+                    rollbacks=rollbacks)
+                timeline_event("recovery_rollback", cat="recovery",
+                               resource="recovery", epoch=epoch,
+                               step=restored, lr_scale=self._lr_scale)
+                epoch = restored + 1
+                continue
+
             improved = selection_loss < best_val
             if improved:
                 best_val = selection_loss
@@ -486,8 +620,15 @@ class Trainer:
                 ckpt.save(
                     epoch,
                     state,
-                    {"epoch": epoch, "best_val": best_val, "config": cfg.to_dict()},
+                    {"epoch": epoch, "best_val": best_val,
+                     "config": cfg.to_dict(), "clean": not bad},
                 )
+                if not bad:
+                    # Rollback anchor: the newest checkpoint written at
+                    # an epoch with NO bad signal (a mid-streak save
+                    # would re-enter the hazard on restore).
+                    last_good_step = epoch
+            epoch += 1
         if ckpt is not None:
             ckpt.close()
         self.logger.log("best", best_val=best_val)

@@ -28,14 +28,14 @@ def force_host_devices(n: int = 8) -> None:
 
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
+    except Exception:  # graftlint: disable=JGL007 best-effort pin for jax versions without the config key; the env var above already covers them
         pass
     try:
         from jax._src import xla_bridge as xb
 
         for plugin in ("axon", "neuron"):
             xb._backend_factories.pop(plugin, None)
-    except Exception:
+    except Exception:  # graftlint: disable=JGL007 jax-internal API probe — absent on some versions; the factories then never existed and need no removal
         pass
 
 
@@ -56,5 +56,5 @@ def enable_persistent_compile_cache(
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
+    except Exception:  # graftlint: disable=JGL007 documented no-op on JAX versions without the cache flags (docstring); runs are correct without the cache, just slower
         pass

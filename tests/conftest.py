@@ -42,6 +42,7 @@ _SLOW_FILES = {
     "test_eval.py",              # trained-model fixtures, CLI end-to-end
     "test_quant.py",             # trained-model fixture
     "test_reference_oracle.py",  # flagship-shape torch+jax compiles
+    "test_chaos.py",             # fleet recovery + subprocess harnesses
 }
 # Heavy classes inside otherwise-quick files (full-model jit compiles).
 _SLOW_CLASSES = {
@@ -57,12 +58,22 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # the widest grids stay slow (TestComposedWideGrid). The ISSUE-8 serve
 # classes are quick BY DESIGN too: tier-1 must exercise the scoring
 # daemon path — registry/ladder/dispatch in-process plus the stdin
-# subprocess end-to-end and the compile-cache warm restart.
+# subprocess end-to-end and the compile-cache warm restart. The ISSUE-9
+# chaos classes are quick BY DESIGN as well: tier-1 drives ONE fault
+# per class (NaN recovery, kill-mid-save, corruption quarantine, torn
+# JSONL, stream retry, serve deadlines/breaker/cold-start) plus the
+# serial guard-bitwise pin; fleet-scale recovery and the remaining
+# bitwise pins ride the slow tier (test_chaos.py in _SLOW_FILES).
 _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestComposeValidate", "TestComposedOracles",
                   "TestRegistry", "TestPrecisionLadder",
                   "TestMultiModelDispatch", "TestDaemonProtocol",
-                  "TestServeDaemonE2E", "TestWarmRestart"}
+                  "TestServeDaemonE2E", "TestWarmRestart",
+                  "TestChaosPlan", "TestChaosOps",
+                  "TestCheckpointIntegrity", "TestKillMidSave",
+                  "TestNaNRecovery", "TestGuardBitwise",
+                  "TestStreamChaos", "TestRecoveryObs",
+                  "TestServeChaos"}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,34 @@
+"""Seeded JGL007 violations: broad handlers that swallow silently."""
+
+
+def swallow_pass(path):
+    try:
+        return open(path).read()
+    except Exception:
+        pass
+
+
+def swallow_bare(fn):
+    try:
+        fn()
+    except:  # noqa: E722 - the bare form is the seeded violation
+        return_code = 1  # fallback never mentions the error
+    return locals().get("return_code", 0)
+
+
+def swallow_fallback_assign(build, devices):
+    try:
+        arr = build(devices)
+    except Exception:
+        arr = list(devices)  # silent degradation, nothing surfaced
+    return arr
+
+def swallow_into_nested_callback(callbacks):
+    try:
+        risky()
+    except Exception:
+        # the return/Load live in ANOTHER frame, run later: nothing
+        # surfaces THIS exception
+        def _noop():
+            return None
+        callbacks.append(_noop)

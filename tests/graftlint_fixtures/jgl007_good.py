@@ -1,0 +1,33 @@
+"""Corrected twin: every broad handler makes its policy explicit."""
+
+
+def reraise_with_context(path):
+    try:
+        return open(path).read()
+    except Exception as e:
+        raise RuntimeError(f"unreadable artifact {path}") from e
+
+
+def return_error_value(fn):
+    try:
+        return fn(), None
+    except Exception as e:
+        return None, str(e)
+
+
+def log_and_continue(logger, jobs):
+    done = 0
+    for job in jobs:
+        try:
+            job()
+            done += 1
+        except Exception as e:
+            logger.log("job_failed", error=str(e))
+    return done
+
+
+def narrow_handler_is_fine(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return None  # narrow type states what is expected

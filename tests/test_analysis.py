@@ -381,6 +381,81 @@ class TestJGL006:
         assert [f.rule for f in findings if f.suppressed] == ["JGL006"]
 
 
+class TestJGL007:
+    """Silent exception swallow in library code (path-keyed like
+    JGL006: broad handlers under factorvae_tpu/ must log, re-raise,
+    return an explicit value, or capture the bound exception)."""
+
+    def _analyze(self, fixture, path):
+        with open(_fixture(fixture)) as fh:
+            return analyze_source(fh.read(), path)
+
+    def test_fires_on_seeded_violations(self):
+        findings = _active(self._analyze(
+            "jgl007_bad.py", "factorvae_tpu/train/newmod.py"))
+        hits = [f for f in findings if f.rule == "JGL007"]
+        assert len(hits) == 4, [(f.line, f.message) for f in findings]
+        assert _rules(findings) == ["JGL007"]  # no cross-rule noise
+
+    def test_nested_defs_do_not_surface(self):
+        # a `return`, surfacing call, or Load of the bound name inside
+        # a nested def/lambda runs later in another frame — it must not
+        # count as this handler's failure policy
+        for body in ("        def _noop():\n"
+                     "            return None\n"
+                     "        cb.append(_noop)\n",
+                     "        cb.append(lambda: str(e))\n"):
+            src = ("def f(fn, cb):\n"
+                   "    try:\n"
+                   "        fn()\n"
+                   "    except Exception as e:\n" + body)
+            findings = _active(analyze_source(
+                src, "factorvae_tpu/train/newmod.py"))
+            assert [f.rule for f in findings] == ["JGL007"], (body,
+                                                              findings)
+
+    def test_silent_on_corrected_twin(self):
+        assert _active(self._analyze(
+            "jgl007_good.py", "factorvae_tpu/train/newmod.py")) == []
+
+    def test_outside_library_paths_is_exempt(self):
+        # scripts/, tests/, bench.py own their error policy
+        assert _active(self._analyze(
+            "jgl007_bad.py", "scripts/some_driver.py")) == []
+        assert _active(analyze_paths([_fixture("jgl007_bad.py")])) == []
+
+    def test_bound_exception_flowing_into_a_value_passes(self):
+        src = ("def resolve(req):\n"
+               "    out = {}\n"
+               "    try:\n"
+               "        out['v'] = req()\n"
+               "    except Exception as e:\n"
+               "        out['error'] = str(e)\n"
+               "    return out\n")
+        assert _active(analyze_source(
+            src, "factorvae_tpu/serve/newmod.py")) == []
+
+    def test_timeline_event_counts_as_surfacing(self):
+        src = ("def produce(i, fn):\n"
+               "    try:\n"
+               "        fn(i)\n"
+               "    except Exception:\n"
+               "        timeline_event('retry', chunk=i)\n")
+        assert _active(analyze_source(
+            src, "factorvae_tpu/data/newmod.py")) == []
+
+    def test_suppressible_with_justification(self):
+        src = ("def f(fn):\n"
+               "    try:\n"
+               "        fn()\n"
+               "    except Exception:  # graftlint: disable=JGL007 "
+               "fixture: deliberate best-effort swallow\n"
+               "        pass\n")
+        findings = analyze_source(src, "factorvae_tpu/train/newmod.py")
+        assert _active(findings) == []
+        assert [f.rule for f in findings if f.suppressed] == ["JGL007"]
+
+
 # ---------------------------------------------------------------------------
 # tier-1 gates
 

@@ -1,0 +1,1045 @@
+"""Chaos-engineering tests (ISSUE 9): the seeded fault-injection harness
+and the recovery it exercises across all four layers.
+
+Quick tier (conftest `_QUICK_CLASSES`) drives ONE fault per class —
+nan_grads through the serial trainer's skip/rollback escalation,
+kill_mid_save through a hard-killed checkpointer child, byte corruption
+through manifest quarantine, torn JSONL through the obs loaders,
+stream_fail/stream_stall through ChunkStream's bounded retry, and the
+serve faults (stall → deadline → breaker, cold_fail → backoff retry,
+malformed → ok:false) through the daemon — plus the serial bitwise pin:
+guards compiled in, no fault installed → params/metrics bitwise-equal
+to the unguarded path. The slow tier extends the pins to the stream and
+fleet S=2 paths, exercises per-lane fleet rollback, and runs the full
+kill-mid-save + corrupt-member fleet group-resume subprocess harness
+(the test_stream kill-between-saves pattern).
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from factorvae_tpu import chaos
+from factorvae_tpu.chaos import ChaosPlan, Fault
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.data.stream import ChunkStream
+from factorvae_tpu.train import Trainer
+from factorvae_tpu.train.checkpoint import (
+    Checkpointer,
+    CheckpointIntegrityError,
+    save_params,
+    verify_params_dir,
+)
+from factorvae_tpu.train.state import TrainState
+from factorvae_tpu.utils.logging import MetricsLogger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def small_config(tmp_path, **train_kw) -> Config:
+    defaults = dict(num_epochs=4, lr=1e-3, seed=0, save_dir=str(tmp_path),
+                    checkpoint_every=1, days_per_step=2)
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5),
+        data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                        val_start_time=None, val_end_time=None),
+        train=TrainConfig(**defaults),
+    )
+
+
+def stream_small_config(tmp_path, chunk_days=4, **train_kw) -> Config:
+    cfg = small_config(tmp_path, **train_kw)
+    import dataclasses
+    return dataclasses.replace(
+        cfg, data=dataclasses.replace(
+            cfg.data, panel_residency="stream",
+            stream_chunk_days=chunk_days))
+
+
+@pytest.fixture(scope="module")
+def tiny_dataset():
+    panel = synthetic_panel(num_days=16, num_instruments=6,
+                            num_features=8, missing_prob=0.1, seed=0)
+    return PanelDataset(panel, seq_len=5)
+
+
+class RecordingLogger(MetricsLogger):
+    def __init__(self, **kw):
+        kw.setdefault("echo", False)
+        super().__init__(**kw)
+        self.records = []
+
+    def log(self, event, _echo=None, **fields):
+        self.records.append((event, fields))
+        super().log(event, _echo=_echo, **fields)
+
+    def events(self, name):
+        return [f for e, f in self.records if e == name]
+
+
+def assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def plain_state(n: int = 8) -> TrainState:
+    """A small non-model TrainState — checkpoint-layer tests need the
+    layout, not a trained network."""
+    params = {"w": jnp.arange(n, dtype=jnp.float32),
+              "b": jnp.ones((n, n), jnp.float32)}
+    tx = optax.adam(1e-3)
+    return TrainState(step=jnp.asarray(0), params=params,
+                      opt_state=tx.init(params),
+                      rng=jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# the harness itself
+
+
+class TestChaosPlan:
+    def test_exact_match_consumes_one_firing(self):
+        plan = ChaosPlan([Fault("nan_grads", epoch=3)])
+        assert plan.find("nan_grads", epoch=2) is None
+        assert plan.find("nan_grads", epoch=3) is not None
+        assert plan.find("nan_grads", epoch=3) is None  # consumed
+        assert plan.fired == [{"kind": "nan_grads", "epoch": 3}]
+
+    def test_wildcards_and_permanent_faults(self):
+        plan = ChaosPlan([Fault("stream_fail", times=2),
+                          Fault("serve_stall", times=-1)])
+        assert plan.find("stream_fail", chunk=0) is not None
+        assert plan.find("stream_fail", chunk=5) is not None
+        assert plan.find("stream_fail", chunk=6) is None   # times=2 spent
+        for _ in range(5):                                  # permanent
+            assert plan.find("serve_stall") is not None
+
+    def test_lane_pinning(self):
+        plan = ChaosPlan([Fault("nan_grads", epoch=1, lane=1)])
+        assert plan.find("nan_grads", epoch=1, lane=0) is None
+        assert plan.find("nan_grads", epoch=1, lane=1) is not None
+
+    def test_pinned_coordinate_never_widens(self):
+        """A pin on a coordinate the query does not supply must NOT
+        match: a lane-pinned fault is for a fleet injection point, and
+        the serial trainer (which queries without lane=) must stay
+        clean."""
+        plan = ChaosPlan([Fault("nan_grads", lane=2),
+                          Fault("serve_stall", request=5)])
+        assert plan.find("nan_grads", epoch=0) is None      # no lane
+        assert plan.find("serve_stall") is None             # no request
+        assert plan.find("nan_grads", epoch=0, lane=2) is not None
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown chaos fault kind"):
+            Fault("made_up_kind")
+
+    def test_off_is_none(self):
+        assert chaos.current_plan() is None
+        assert chaos.fault("nan_grads", epoch=0) is None
+        assert chaos.has_fault("nan_grads") is False
+
+    def test_active_restores_previous(self):
+        plan = ChaosPlan([Fault("torn_jsonl")])
+        with chaos.active(plan) as p:
+            assert chaos.current_plan() is p
+            assert chaos.has_fault("torn_jsonl")
+        assert chaos.current_plan() is None
+
+    def test_env_roundtrip_and_child_env(self):
+        plan = ChaosPlan([Fault("kill_mid_save", step=2, rng_seed=7)],
+                         seed=3)
+        env = chaos.child_env(plan, env={})
+        again = ChaosPlan.from_json(env[chaos.ENV_VAR])
+        assert again.seed == 3
+        assert again.faults[0].kind == "kill_mid_save"
+        assert again.faults[0].step == 2
+        assert again.faults[0].rng_seed == 7
+
+    def test_has_is_nonconsuming(self):
+        plan = ChaosPlan([Fault("nan_grads", epoch=0)])
+        with chaos.active(plan):
+            assert chaos.has_fault("nan_grads")
+            assert chaos.fault("nan_grads", epoch=0) is not None
+            # spent, but the trace-time gate still reports it installed
+            assert chaos.has_fault("nan_grads")
+
+
+class TestChaosOps:
+    def test_corrupt_file_deterministic(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        payload = bytes(range(256)) * 4
+        p.write_bytes(payload)
+        offs1 = chaos.ops.corrupt_file(str(p), rng_seed=1)
+        after1 = p.read_bytes()
+        assert after1 != payload
+        p.write_bytes(payload)
+        offs2 = chaos.ops.corrupt_file(str(p), rng_seed=1)
+        assert offs1 == offs2 and p.read_bytes() == after1
+        # a different seed picks different offsets
+        p.write_bytes(payload)
+        assert chaos.ops.corrupt_file(str(p), rng_seed=2) != offs1
+
+    def test_corrupt_empty_raises(self, tmp_path):
+        p = tmp_path / "empty"
+        p.write_bytes(b"")
+        with pytest.raises(ValueError, match="empty"):
+            chaos.ops.corrupt_file(str(p))
+
+    def test_tear_jsonl_cuts_midline(self, tmp_path):
+        p = tmp_path / "RUN.jsonl"
+        lines = [json.dumps({"event": "epoch", "epoch": i}) for i in
+                 range(10)]
+        p.write_text("\n".join(lines) + "\n")
+        orig = p.stat().st_size
+        new_size = chaos.ops.tear_jsonl(str(p), keep_frac=0.5, rng_seed=0)
+        assert new_size < orig
+        data = p.read_text()
+        assert not data.endswith("\n")          # genuinely torn tail
+        tail = data.rsplit("\n", 1)[-1]
+        with pytest.raises(ValueError):
+            json.loads(tail)                     # partial record
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: manifests, quarantine, fallback
+
+
+class TestCheckpointIntegrity:
+    def _saved(self, tmp_path, steps=3):
+        state = plain_state()
+        ck = Checkpointer(str(tmp_path / "ck"), async_save=False)
+        for s in range(steps):
+            ck.save(s, state.replace(step=jnp.asarray(s)),
+                    {"epoch": s, "best_val": 0.0, "config": {"v": 1}})
+        return state, ck
+
+    def test_manifest_written_and_verifies(self, tmp_path):
+        state, ck = self._saved(tmp_path)
+        for s in range(3):
+            ok, reason = ck.verify_step(s)
+            assert (ok, reason) == (True, None)
+            m = ck.manifest(s)
+            assert m["files"] and m["nbytes"] > 0
+            assert m["config_hash"]  # canonical config hash rode along
+        ck.close()
+
+    def test_corrupt_step_quarantined_with_fallback(self, tmp_path):
+        state, ck = self._saved(tmp_path)
+        chaos.ops.corrupt_checkpoint_step(str(tmp_path / "ck"), 2,
+                                          rng_seed=0)
+        restored, meta = ck.restore(state)       # implicit: falls back
+        assert meta["epoch"] == 1
+        assert ck.quarantined_steps() == [2]
+        assert ck.all_steps() == [0, 1]          # fenced from readers
+        assert ck.latest_step() == 1
+        ck.close()
+
+    def test_explicit_restore_of_corrupt_step_raises(self, tmp_path):
+        state, ck = self._saved(tmp_path)
+        chaos.ops.corrupt_checkpoint_step(str(tmp_path / "ck"), 1,
+                                          rng_seed=0)
+        with pytest.raises(CheckpointIntegrityError, match="quarantined"):
+            ck.restore(state, step=1)
+        ck.close()
+
+    def test_premanifest_step_restores_unverified(self, tmp_path):
+        state, ck = self._saved(tmp_path)
+        os.unlink(os.path.join(str(tmp_path / "ck"), "manifests",
+                               "2.json"))
+        ok, reason = ck.verify_step(2)
+        assert (ok, reason) == (True, "unverified")
+        restored, meta = ck.restore(state)       # never fatal
+        assert meta["epoch"] == 2
+        assert ck.verified_steps() == [0, 1, 2]  # unverified stays in
+        ck.close()
+
+    def test_all_steps_quarantined_is_loud(self, tmp_path):
+        state, ck = self._saved(tmp_path, steps=2)
+        for s in (0, 1):
+            chaos.ops.corrupt_checkpoint_step(str(tmp_path / "ck"), s,
+                                              rng_seed=s)
+        with pytest.raises(FileNotFoundError, match="quarantined"):
+            ck.restore(state)
+        ck.close()
+
+    def test_verified_steps_quarantines_eagerly(self, tmp_path):
+        state, ck = self._saved(tmp_path)
+        chaos.ops.corrupt_checkpoint_step(str(tmp_path / "ck"), 0,
+                                          rng_seed=0)
+        assert ck.verified_steps() == [1, 2]
+        assert ck.quarantined_steps() == [0]
+        ck.close()
+
+    def test_retention_evicted_step_is_missing_not_corrupt(self,
+                                                           tmp_path):
+        """Manifests outlive retained steps: an explicit restore of a
+        step max_to_keep evicted must say 'gone' (FileNotFoundError),
+        never quarantine it as corrupt — the bytes were garbage-
+        collected, not damaged."""
+        state = plain_state()
+        ck = Checkpointer(str(tmp_path / "ck"), keep=2, async_save=False)
+        for s in range(4):
+            ck.save(s, state.replace(step=jnp.asarray(s)),
+                    {"epoch": s, "best_val": 0.0, "config": {"v": 1}})
+        assert ck.all_steps() == [2, 3]          # 0 and 1 evicted
+        assert ck.verify_step(1) == (False, "missing")
+        with pytest.raises(FileNotFoundError, match="evicted"):
+            ck.restore(state, step=1)
+        assert ck.quarantined_steps() == []      # absence never fenced
+        restored, meta = ck.restore(state)       # latest still fine
+        assert meta["epoch"] == 3
+        ck.close()
+
+    def test_resave_overwrites_existing_step(self, tmp_path):
+        """Rollback-recovery replays re-save epochs they already
+        checkpointed; orbax's manager silently SKIPS an existing step,
+        so save() must drop-and-rewrite — the REPLAYED trajectory is
+        the one that persists (and the manifest must describe it)."""
+        for mode in (False, True):
+            state = plain_state()
+            ck = Checkpointer(str(tmp_path / f"ck_{mode}"),
+                              async_save=mode)
+            ck.save(0, state.replace(step=jnp.asarray(7)),
+                    {"epoch": 0, "best_val": 0.5, "config": {"v": 1}})
+            ck.save(0, state.replace(step=jnp.asarray(11)),
+                    {"epoch": 0, "best_val": 0.25, "config": {"v": 1}})
+            restored, meta = ck.restore(state, step=0)
+            assert int(restored.step) == 11      # the re-save won
+            assert meta["best_val"] == 0.25
+            assert ck.verify_step(0) == (True, None)   # manifest fresh
+            ck.close()
+
+    def test_resave_clears_quarantine_marker(self, tmp_path):
+        """Overwriting a quarantined step with fresh bytes must lift
+        the quarantine — the marker described bytes that are gone."""
+        state, ck = self._saved(tmp_path)
+        chaos.ops.corrupt_checkpoint_step(str(tmp_path / "ck"), 2,
+                                          rng_seed=0)
+        ck.restore(state)                        # quarantines step 2
+        assert ck.quarantined_steps() == [2]
+        ck.save(2, state.replace(step=jnp.asarray(2)),
+                {"epoch": 2, "best_val": 0.0, "config": {"v": 1}})
+        assert ck.quarantined_steps() == []
+        assert ck.verify_step(2) == (True, None)
+        restored, meta = ck.restore(state)
+        assert meta["epoch"] == 2
+        ck.close()
+
+    def test_corrupt_manifest_fails_verification(self, tmp_path):
+        """Corruption landing in the MANIFEST file (not the payload)
+        must fail the step, not demote it to the legacy 'unverified'
+        path that loads without checking."""
+        state, ck = self._saved(tmp_path)
+        mpath = os.path.join(str(tmp_path / "ck"), "manifests", "2.json")
+        with open(mpath, "w") as fh:
+            fh.write('{"files": {tor')             # torn mid-write
+        ok, reason = ck.verify_step(2)
+        assert not ok and "manifest unreadable" in reason
+        restored, meta = ck.restore(state)         # falls back, logged
+        assert meta["epoch"] == 1
+        assert ck.quarantined_steps() == [2]
+        ck.close()
+
+    def test_save_params_manifest_roundtrip(self, tmp_path):
+        path = save_params(str(tmp_path), "weights",
+                           {"w": jnp.arange(16, dtype=jnp.float32)})
+        assert verify_params_dir(path) is None
+        # corrupt any payload file -> a one-line reason
+        victim = next(
+            os.path.join(root, n) for root, _, names in os.walk(path)
+            for n in names if os.path.getsize(os.path.join(root, n)))
+        chaos.ops.corrupt_file(victim, rng_seed=0)
+        assert verify_params_dir(path) is not None
+        # a TORN manifest is damage, not a pre-manifest artifact
+        with open(path + ".manifest.json", "w") as fh:
+            fh.write('{"files": {tor')
+        bad = verify_params_dir(path)
+        assert bad is not None and "manifest unreadable" in bad
+        # a pre-manifest directory is unverifiable, not corrupt
+        os.unlink(path + ".manifest.json")
+        assert verify_params_dir(path) is None
+
+
+class TestKillMidSave:
+    """The kill_mid_save fault: a child hard-killed (SIGKILL, no atexit,
+    no orbax finalize) inside Checkpointer.save must leave the directory
+    restorable at the newest COMMITTED step — checkpoint-layer only, so
+    the quick tier pays no model compile."""
+
+    CHILD = """
+import sys
+sys.path.insert(0, {repo!r})
+from factorvae_tpu.utils.testing import force_host_devices
+force_host_devices(1)
+import jax, jax.numpy as jnp, optax
+from factorvae_tpu.train.checkpoint import Checkpointer
+from factorvae_tpu.train.state import TrainState
+params = {{"w": jnp.arange(8, dtype=jnp.float32),
+           "b": jnp.ones((8, 8), jnp.float32)}}
+tx = optax.adam(1e-3)
+state = TrainState(step=jnp.asarray(0), params=params,
+                   opt_state=tx.init(params), rng=jax.random.PRNGKey(0))
+ck = Checkpointer({ckdir!r}, async_save=True)
+for s in range(3):
+    ck.save(s, state.replace(step=jnp.asarray(s)),
+            dict(epoch=s, best_val=0.0, config=dict(v=1)))
+    if s < 2:
+        ck.wait_until_finished()
+raise SystemExit(3)  # unreachable: the chaos fault SIGKILLs inside save(2)
+"""
+
+    def test_killed_save_is_invisible_and_resumable(self, tmp_path):
+        ckdir = str(tmp_path / "kill_ck")
+        plan = ChaosPlan([Fault("kill_mid_save", step=2)])
+        child = self.CHILD.format(repo=REPO, ckdir=ckdir)
+        r = subprocess.run(
+            [sys.executable, "-c", child], capture_output=True, text=True,
+            timeout=300,
+            env=chaos.child_env(plan, env={**os.environ,
+                                           "JAX_PLATFORMS": "cpu"}))
+        assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+
+        ck = Checkpointer(ckdir)
+        steps = ck.all_steps()
+        # steps 0..1 committed + barriered before the kill; step 2 was
+        # enqueued when the SIGKILL landed — it either committed whole
+        # (restores UNVERIFIED: its manifest never hit disk) or is
+        # invisible. Torn intermediate states must not exist.
+        assert set(steps) >= {0, 1} and set(steps) <= {0, 1, 2}, steps
+        ok, reason = ck.verify_step(1)
+        assert (ok, reason) == (True, None)      # manifest flushed
+        if 2 in steps:
+            assert ck.verify_step(2) == (True, "unverified")
+        restored, meta = ck.restore(plain_state())
+        assert meta["epoch"] == steps[-1]
+        assert np.asarray(restored.params["w"]).shape == (8,)
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# training-layer recovery
+
+
+class TestNaNRecovery:
+    def test_serial_skip_rollback_replay(self, tiny_dataset, tmp_path):
+        """One fault class end-to-end (quick tier): poisoned gradients
+        at epochs 2-3 are skipped in-graph, the 2-epoch streak triggers
+        rollback to the last-good checkpoint with lr backoff, the
+        replayed epochs run clean, and the fit completes with finite
+        params and a logged recovery trail."""
+        cfg = small_config(tmp_path, num_epochs=6, recover_after=2)
+        logger = RecordingLogger()
+        plan = ChaosPlan([Fault("nan_grads", epoch=2),
+                          Fault("nan_grads", epoch=3)])
+        with chaos.active(plan):
+            tr = Trainer(cfg, tiny_dataset, logger=logger)
+            params, out = tr.fit()
+        hist = out["history"]
+        epochs = [h["epoch"] for h in hist]
+        assert epochs == [0, 1, 2, 3, 2, 3, 4, 5]        # replay
+        skipped = [h.get("skipped_steps", 0.0) for h in hist]
+        assert skipped[2] > 0 and skipped[3] > 0          # gate fired
+        assert skipped[4] == 0 and skipped[5] == 0        # replay clean
+        rec = logger.events("recovery")
+        assert len(rec) == 1 and rec[0]["kind"] == "rollback"
+        assert rec[0]["restored_step"] == 1
+        assert rec[0]["lr_scale"] == cfg.train.recover_lr_backoff
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(params))
+        assert len(plan.fired) == 2
+
+    def test_rollback_unavailable_continues_forward(self, tiny_dataset,
+                                                    tmp_path):
+        """A bad streak with NO checkpoint to roll back to must keep
+        training (logged), never die."""
+        cfg = small_config(tmp_path, num_epochs=4, recover_after=2,
+                           checkpoint_every=0)   # no checkpoints at all
+        logger = RecordingLogger()
+        plan = ChaosPlan([Fault("nan_grads", epoch=0),
+                          Fault("nan_grads", epoch=1)])
+        with chaos.active(plan):
+            tr = Trainer(cfg, tiny_dataset, logger=logger)
+            params, out = tr.fit()
+        assert [h["epoch"] for h in out["history"]] == [0, 1, 2, 3]
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(params))
+        # the escalation point is VISIBLE (once, at the streak
+        # crossing) and degrades to lr backoff alone
+        rec = logger.events("recovery")
+        assert len(rec) == 1, rec
+        assert rec[0]["kind"] == "rollback_unavailable"
+        assert rec[0]["epoch"] == 1
+        assert rec[0]["lr_scale"] == cfg.train.recover_lr_backoff
+
+
+class TestGuardBitwise:
+    """The acceptance pin: finite guard compiled IN but no fault
+    installed -> bitwise-equal params and metrics vs the unguarded
+    path. (Stream and fleet S=2 pins: TestSlowBitwise.)"""
+
+    def _fit(self, tmp_path, name, guard, dataset):
+        cfg = small_config(tmp_path / name, num_epochs=3,
+                           finite_guard=guard, checkpoint_every=0)
+        tr = Trainer(cfg, dataset, logger=MetricsLogger(echo=False))
+        return tr.fit()
+
+    def test_serial_guard_bitwise_neutral(self, tiny_dataset, tmp_path):
+        p_on, out_on = self._fit(tmp_path, "on", True, tiny_dataset)
+        p_off, out_off = self._fit(tmp_path, "off", False, tiny_dataset)
+        assert_trees_bitwise(p_on, p_off)
+        on = [h["train_loss"] for h in out_on["history"]]
+        off = [h["train_loss"] for h in out_off["history"]]
+        assert on == off
+        # the guarded path reports its skip metric, and it is all-zero
+        assert all(h["skipped_steps"] == 0.0 for h in out_on["history"])
+        assert all("skipped_steps" not in h for h in out_off["history"])
+
+
+# ---------------------------------------------------------------------------
+# stream-layer recovery
+
+
+def _chunks(i):
+    return {"x": np.full((4, 4), float(i), np.float32), "i": np.int32(i)}
+
+
+class TestStreamChaos:
+    def test_transient_failure_retries_bitwise(self):
+        clean = [c for c in ChunkStream(_chunks, 3)]
+        plan = ChaosPlan([Fault("stream_fail", chunk=1)])
+        with chaos.active(plan):
+            stream = ChunkStream(_chunks, 3)
+            chaotic = [c for c in stream]
+        assert stream.retries == 1
+        assert len(plan.fired) == 1
+        for a, b in zip(clean, chaotic):
+            assert_trees_bitwise(a, b)
+
+    def test_stall_injects_latency_data_intact(self):
+        plan = ChaosPlan([Fault("stream_stall", chunk=0, delay_s=0.2)])
+        t0 = time.perf_counter()
+        with chaos.active(plan):
+            out = [c for c in ChunkStream(_chunks, 2)]
+        assert time.perf_counter() - t0 >= 0.2
+        assert [int(c["i"]) for c in out] == [0, 1]
+
+    def test_permanent_failure_surfaces_after_bounded_retries(self):
+        plan = ChaosPlan([Fault("stream_fail", chunk=0, times=-1)])
+        with chaos.active(plan):
+            stream = ChunkStream(_chunks, 1)
+            with pytest.raises(RuntimeError, match="stream transfer"):
+                list(stream)
+        assert stream.retries == ChunkStream.MAX_RETRIES
+
+
+# ---------------------------------------------------------------------------
+# obs: torn tails tolerated, recovery rendered
+
+
+class TestRecoveryObs:
+    def _run_stream(self, tmp_path):
+        """A RUN.jsonl with epochs, recovery events and recovery marks."""
+        from factorvae_tpu.utils.logging import Timeline, install_timeline
+
+        path = str(tmp_path / "RUN.jsonl")
+        with MetricsLogger(jsonl_path=path, echo=False) as logger:
+            tl = Timeline(logger)
+            prev = install_timeline(tl)
+            try:
+                with tl.span("train_epoch_0", cat="train",
+                             resource="device"):
+                    time.sleep(0.01)
+                logger.log("epoch", epoch=0, train_loss=1.0,
+                           skipped_steps=0.0, seconds=0.01)
+                logger.log("epoch", epoch=1, train_loss=1.2,
+                           skipped_steps=3.0, seconds=0.01)
+                logger.log("recovery", kind="rollback", epoch=2,
+                           restored_step=0, lr_scale=0.5, rollbacks=1)
+                tl.event("recovery_rollback", cat="recovery",
+                         resource="recovery", epoch=2, step=0)
+                tl.event("ckpt_quarantine", cat="recovery",
+                         resource="checkpoint", step=2, reason="sha256")
+                tl.event("circuit_open", cat="recovery", resource="serve",
+                         model="m0", fails=3)
+                tl.event("stream_retry", cat="recovery", resource="stream",
+                         chunk=1, attempt=1, error="flake")
+                logger.log("epoch", epoch=2, train_loss=1.05,
+                           skipped_steps=0.0, seconds=0.01)
+            finally:
+                install_timeline(prev)
+        return path
+
+    def test_recovery_flags_and_counts(self, tmp_path):
+        from factorvae_tpu.obs import report as replib
+
+        run = replib.load_run(self._run_stream(tmp_path))
+        flags = replib.recovery_flags(run)
+        kinds = sorted(f["flag"] for f in flags)
+        assert kinds == sorted(["skip_step", "rollback", "quarantine",
+                                "circuit_open", "retry"])
+        rep = replib.build_report(run)
+        counts = rep["summary"]["recovery_counts"]
+        assert counts == {"circuit_open": 1, "quarantine": 1,
+                          "retry": 1, "rollback": 1, "skip_step": 1}
+        text = replib.format_report(rep)
+        assert "recovery actions:" in text
+        assert "rollback x1" in text
+
+    def test_timeline_renders_recovery_marks(self, tmp_path):
+        from factorvae_tpu.obs import timeline as tllib
+
+        run = tllib.load_run(self._run_stream(tmp_path))
+        marks = tllib.recovery_marks(run)
+        assert {m["name"] for m in marks} == {
+            "recovery_rollback", "ckpt_quarantine", "circuit_open",
+            "stream_retry"}
+        text = tllib.format_report(run)
+        assert "RECOVERY:" in text
+        assert "!" in text        # marks overlaid on the Gantt
+
+    def test_torn_tail_is_warning_not_fatal(self, tmp_path):
+        from factorvae_tpu.obs import report as replib
+        from factorvae_tpu.obs import timeline as tllib
+
+        path = self._run_stream(tmp_path)
+        chaos.ops.tear_jsonl(path, keep_frac=0.9, rng_seed=0)
+        run, warnings = tllib.open_run(path)
+        assert any("partial line" in w for w in warnings)
+        assert run["epochs"]          # the intact prefix still parses
+        rep = replib.build_report(run)
+        assert "summary" in rep
+
+
+# ---------------------------------------------------------------------------
+# serve-layer resilience
+
+
+class TestServeChaos:
+    TINY = dict(num_features=6, hidden_size=8, num_factors=4,
+                num_portfolios=8, seq_len=5)
+
+    @pytest.fixture(scope="class")
+    def serve_rig(self):
+        from factorvae_tpu.data import synthetic_panel_dense
+        from factorvae_tpu.models.factorvae import load_model
+        from factorvae_tpu.serve.registry import ModelRegistry
+
+        cfg = Config(
+            model=ModelConfig(stochastic_inference=False, **self.TINY),
+            data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                            val_start_time=None, val_end_time=None),
+            train=TrainConfig(seed=0))
+        panel = synthetic_panel_dense(num_days=12, num_instruments=10,
+                                      num_features=6)
+        ds = PanelDataset(panel, seq_len=5)
+        reg = ModelRegistry()
+        params = load_model(cfg, n_max=ds.n_max)[1]
+        reg.register_params(params, cfg, alias="m0")
+        day = int(ds.split_days(None, None)[0])
+        return cfg, ds, reg, params, day
+
+    def _daemon(self, serve_rig, **kw):
+        from factorvae_tpu.serve.daemon import ScoringDaemon
+
+        _, ds, reg, _, _ = serve_rig
+        kw.setdefault("stochastic", False)
+        return ScoringDaemon(reg, ds, **kw)
+
+    def test_stall_deadline_breaker_and_recovery(self, serve_rig):
+        """serve_stall -> deadline ok:false; K misses open the breaker
+        (fast-fail with retry_after_s); the half-open probe after the
+        cooldown closes it again. The daemon answers EVERY request."""
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, breaker_k=2, breaker_cooldown_s=0.2)
+        warm = d.handle({"model": "m0", "day": day})   # no deadline:
+        assert warm["ok"], warm                        # compile is legal
+        d.deadline_ms = 150.0        # server policy, armed after warmup
+        req = {"model": "m0", "day": day}
+        plan = ChaosPlan([Fault("serve_stall", times=2, delay_s=0.4)])
+        with chaos.active(plan):
+            r1 = d.handle(dict(req))
+            r2 = d.handle(dict(req))
+            r3 = d.handle(dict(req))
+        assert not r1["ok"] and "deadline exceeded" in r1["error"]
+        assert r1["latency_ms"] >= 400
+        assert not r2["ok"] and "deadline exceeded" in r2["error"]
+        assert not r3["ok"] and r3["retry_after_s"] > 0    # fast-fail
+        assert "circuit open" in r3["error"]
+        assert d.deadline_misses == 2 and d.breaker_fast_fails == 1
+        assert d.open_breakers()
+        time.sleep(0.25)
+        r4 = d.handle(dict(req))                       # half-open probe
+        assert r4["ok"], r4
+        assert d.open_breakers() == []
+
+    def test_client_deadline_cannot_open_the_breaker(self, serve_rig):
+        """A client-supplied deadline_ms is that client's latency
+        budget, not evidence of a sick model: its misses answer
+        ok:false but must not open the shared breaker or drag health
+        toward failing for everyone else."""
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, breaker_k=2, breaker_cooldown_s=60.0)
+        d.handle({"model": "m0", "day": day})          # warm
+        for _ in range(3):
+            r = d.handle({"model": "m0", "day": day,
+                          "deadline_ms": 0.001})
+            assert not r["ok"] and "deadline exceeded" in r["error"]
+        assert d.deadline_misses == 3
+        assert d.open_breakers() == []                 # breaker untouched
+        assert d.breaker_fast_fails == 0
+        assert d.health()["status"] == "ok"            # health untouched
+        ok = d.handle({"model": "m0", "day": day})     # others unaffected
+        assert ok["ok"], ok
+
+    def test_client_deadline_miss_past_server_deadline_is_evidence(
+            self, serve_rig):
+        """Client-override misses are forgiven only while the SERVER's
+        own deadline holds: a stall past BOTH deadlines is a sick
+        model no matter whose deadline the response used, else
+        override traffic interleaved with real misses would keep
+        resetting the failure streak on a genuinely stalled backend."""
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, breaker_k=1, breaker_cooldown_s=60.0,
+                         deadline_ms=100.0)
+        d.handle({"model": "m0", "day": day})           # warm, ok
+        plan = ChaosPlan([Fault("serve_stall", times=1, delay_s=0.3)])
+        with chaos.active(plan):
+            r = d.handle({"model": "m0", "day": day, "deadline_ms": 10.0})
+        assert not r["ok"] and "deadline exceeded" in r["error"]
+        assert d.open_breakers()            # server policy violated too
+
+    def test_raised_client_deadline_does_not_hide_server_stall(
+            self, serve_rig):
+        """A client RAISING its deadline past the server's gets ok:true
+        for a slow dispatch, but breaker/health evidence is judged by
+        SERVER policy: a stall past --deadline_ms must not record
+        success (which would reset the failure streak a stalled
+        backend's breaker needs)."""
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, breaker_k=1, breaker_cooldown_s=60.0,
+                         deadline_ms=100.0)
+        d.handle({"model": "m0", "day": day})           # warm, ok
+        plan = ChaosPlan([Fault("serve_stall", times=1, delay_s=0.3)])
+        with chaos.active(plan):
+            r = d.handle({"model": "m0", "day": day,
+                          "deadline_ms": 60000.0})
+        assert r["ok"]                                  # client budget held
+        assert d.open_breakers()                        # server policy didn't
+        assert d.health()["error_rate"] > 0
+
+    def test_shared_tick_failure_counts_once(self, serve_rig):
+        """Duplicate same-model requests in one tick share ONE
+        dispatch; its outcome is one piece of breaker/health evidence,
+        not K 'consecutive failures' from a single transient fault."""
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, breaker_k=3, breaker_cooldown_s=60.0,
+                         health_window=10)
+        d.handle({"model": "m0", "day": day})           # warm, ok
+        d.deadline_ms = 100.0
+        plan = ChaosPlan([Fault("serve_stall", times=1, delay_s=0.3)])
+        with chaos.active(plan):
+            outs = d.handle_batch([{"id": i, "model": "m0", "day": day}
+                                   for i in range(3)])
+        assert all(not o["ok"] for o in outs)           # all answered
+        assert d.deadline_misses == 3                   # honesty per request
+        assert d.open_breakers() == []                  # ONE failure, not 3
+        assert d.health()["window"] == 2                # warm + one sample
+
+    def test_fast_fails_do_not_poison_health(self, serve_rig):
+        """An open breaker fast-failing retry traffic is the breaker
+        WORKING: health shows degraded (open_breakers), and the retry
+        storm must not push the window to failing/503."""
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, breaker_k=1, breaker_cooldown_s=60.0,
+                         health_window=10, failing_at=0.5)
+        for _ in range(3):                              # warm, ok baseline
+            assert d.handle({"model": "m0", "day": day})["ok"]
+        plan = ChaosPlan([Fault("serve_stall", times=1, delay_s=0.3)])
+        d.deadline_ms = 100.0
+        with chaos.active(plan):
+            miss = d.handle({"model": "m0", "day": day})
+        assert not miss["ok"] and d.open_breakers()     # breaker opened
+        for _ in range(8):                              # retry storm
+            r = d.handle({"model": "m0", "day": day})
+            assert not r["ok"] and r.get("retry_after_s")
+        h = d.health()
+        assert h["status"] == "degraded", h             # never failing
+        assert h["error_rate"] < 0.5
+
+    def test_health_degrades_from_error_window(self, serve_rig):
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, health_window=10, degraded_at=0.1,
+                         failing_at=0.5, breaker_k=5)
+        assert d.health()["status"] == "ok"
+        d.handle({"model": "m0", "day": day})    # warm, ok
+        d.deadline_ms = 1e-6                     # every dispatch misses
+        for _ in range(2):                       # 2/3 failures -> failing
+            r = d.handle({"model": "m0", "day": day})
+            assert not r["ok"] and "deadline exceeded" in r["error"]
+        h = d.health()
+        assert h["status"] == "failing" and h["ok"] is False
+        d.deadline_ms = 0.0
+        for _ in range(7):
+            d.handle({"model": "m0", "day": day})
+        assert d.health()["status"] in ("ok", "degraded")
+
+    def test_client_garbage_does_not_poison_health(self, serve_rig):
+        """Unknown models and malformed day values are CLIENT input:
+        they answer ok:false but are not evidence about the daemon —
+        a misconfigured client replaying garbage must not 503 an
+        otherwise-healthy /healthz."""
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, health_window=10, degraded_at=0.1,
+                         failing_at=0.5)
+        d.handle({"model": "m0", "day": day})    # warm, ok
+        for bad in ({"model": "no_such_model", "day": day},
+                    {"model": "m0", "day": "not-a-date"},
+                    {"model": "m0"},             # no day selector
+                    {"model": "m0", "day": day, "deadline_ms": "x"}):
+            for _ in range(4):
+                r = d.handle(bad)
+                assert not r["ok"]
+        h = d.health()
+        assert h["status"] == "ok", h
+        assert h["error_rate"] == 0.0
+        assert d.open_breakers() == []
+
+    def test_drain_reports_and_finishes(self, serve_rig):
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig)
+        d.request_drain()
+        h = d.health()
+        assert h["status"] == "draining" and h["ok"] is False
+        assert d.closing
+        # draining is idempotent
+        d.request_drain()
+
+    def test_full_fault_mix_answers_every_request(self, serve_rig):
+        """Acceptance: under the full fault mix the daemon answers every
+        request — ok:false at worst, one response per line, process
+        alive."""
+        from factorvae_tpu.serve.daemon import serve_stdin
+
+        _, _, _, _, day = serve_rig
+        d = self._daemon(serve_rig, deadline_ms=50.0, breaker_k=2,
+                         breaker_cooldown_s=60.0)
+        lines = [
+            json.dumps({"id": 1, "model": "m0", "day": day}),
+            "{not json at all",
+            json.dumps({"id": 2, "model": "ghost", "day": day}),
+            json.dumps({"id": 3, "day": day}),               # no model
+            json.dumps({"id": 4, "model": "m0", "day": 10**9}),
+            json.dumps({"id": 5, "model": "m0", "day": day}),
+            json.dumps({"id": 6, "model": "m0", "day": day}),
+            json.dumps({"id": 7, "model": "m0", "day": day}),
+        ]
+        plan = ChaosPlan([Fault("serve_stall", times=2, delay_s=0.2)])
+        out = io.StringIO()
+        with chaos.active(plan):
+            n = serve_stdin(d, io.StringIO("\n".join(lines) + "\n"), out)
+        responses = [json.loads(line) for line in
+                     out.getvalue().splitlines()]
+        assert n == len(lines) == len(responses)
+        assert all("ok" in r for r in responses)
+        assert any(not r["ok"] for r in responses)   # faults surfaced
+        stats = d.stats()
+        assert stats["health"]["window"] > 0
+
+    def test_cold_start_retry_heals_transient_flake(self, serve_rig,
+                                                    tmp_path):
+        from factorvae_tpu.serve.registry import ModelRegistry
+
+        cfg, ds, _, params, _ = serve_rig
+        reg = ModelRegistry()
+        save_params(str(tmp_path), "w0", params)
+        with open(tmp_path / "w0" / "serve_config.json", "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        key = reg.register_checkpoint(str(tmp_path / "w0"), alias="prod")
+        reg.budget_bytes = 1                    # evict to a tombstone
+        cfg2 = Config(
+            model=ModelConfig(stochastic_inference=False, **self.TINY),
+            data=cfg.data, train=TrainConfig(seed=9))
+        from factorvae_tpu.models.factorvae import load_model
+        reg.register_params(load_model(cfg2, n_max=ds.n_max)[1], cfg2)
+        assert key not in reg.keys()
+        plan = ChaosPlan([Fault("serve_cold_fail", times=1)])
+        with chaos.active(plan):
+            entry = reg.get("prod")             # retry heals the flake
+        assert entry.key == key
+        assert reg.cold_starts == 1 and len(plan.fired) == 1
+
+    def test_corrupt_weights_never_served(self, serve_rig, tmp_path):
+        from factorvae_tpu.serve.registry import ModelRegistry, RegistryError
+
+        cfg, _, _, params, _ = serve_rig
+        path = save_params(str(tmp_path), "wc", params)
+        with open(tmp_path / "wc" / "serve_config.json", "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        victim = next(
+            os.path.join(root, n) for root, _, names in os.walk(path)
+            for n in names if os.path.getsize(os.path.join(root, n)))
+        chaos.ops.corrupt_file(victim, rng_seed=0)
+        reg = ModelRegistry()
+        with pytest.raises(RegistryError, match="manifest"):
+            reg.register_checkpoint(path)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: fleet recovery, group resume with a corrupt member, and the
+# stream/fleet bitwise pins
+
+
+@pytest.mark.slow
+class TestFleetChaos:
+    def test_lane_rolls_back_alone(self, tiny_dataset, tmp_path):
+        from factorvae_tpu.train.fleet import FleetTrainer
+
+        cfg = small_config(tmp_path, num_epochs=6, recover_after=2)
+        logger = RecordingLogger()
+        plan = ChaosPlan([Fault("nan_grads", epoch=2, lane=1),
+                          Fault("nan_grads", epoch=3, lane=1)])
+        with chaos.active(plan):
+            ft = FleetTrainer(cfg, tiny_dataset, seeds=(0, 1),
+                              logger=logger)
+            fleet_state, out = ft.fit()
+        skipped = [h.get("skipped_steps") for h in out["history"]]
+        assert skipped[2][1] > 0 and skipped[3][1] > 0   # lane 1 poisoned
+        assert all(s[0] == 0 for s in skipped)           # lane 0 untouched
+        rec = logger.events("recovery")
+        assert len(rec) == 1 and rec[0]["kind"] == "lane_rollback"
+        assert rec[0]["lane"] == 1 and rec[0]["restored_step"] == 1
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(fleet_state.params))
+
+    def test_group_resume_skips_corrupt_member_after_kill(self, tmp_path):
+        """Satellite: kill-mid-save extended to fleet group resume with
+        an injected corrupt member (the test_stream subprocess-harness
+        pattern). The child fleet is SIGKILLed by a kill_mid_save fault
+        during the epoch-2 save of seed 0; the parent then corrupts
+        seed 1's newest surviving step and group-resumes: the corrupt
+        step is quarantined, the max-common-step rule rewinds past it,
+        and the resumed fleet completes."""
+        from factorvae_tpu.train.fleet import FleetTrainer
+
+        child = f"""
+import sys
+sys.path.insert(0, {REPO!r})
+from factorvae_tpu.utils.testing import force_host_devices
+force_host_devices(1)
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.train.fleet import FleetTrainer
+from factorvae_tpu.utils.logging import MetricsLogger
+panel = synthetic_panel(num_days=16, num_instruments=6, num_features=8,
+                        missing_prob=0.1, seed=0)
+ds = PanelDataset(panel, seq_len=5)
+cfg = Config(
+    model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                      num_portfolios=6, seq_len=5),
+    data=DataConfig(seq_len=5, start_time=None, fit_end_time=None,
+                    val_start_time=None, val_end_time=None),
+    train=TrainConfig(num_epochs=4, lr=1e-3, seed=0,
+                      save_dir={str(tmp_path)!r}, checkpoint_every=1,
+                      days_per_step=2))
+ft = FleetTrainer(cfg, ds, seeds=(0, 1), logger=MetricsLogger(echo=False))
+ft.fit()
+raise SystemExit(3)  # unreachable: chaos SIGKILLs inside a save
+"""
+        plan = ChaosPlan([Fault("kill_mid_save", step=2)])
+        r = subprocess.run(
+            [sys.executable, "-c", child], capture_output=True, text=True,
+            timeout=600,
+            env=chaos.child_env(plan, env={**os.environ,
+                                           "JAX_PLATFORMS": "cpu"}))
+        assert r.returncode == -signal.SIGKILL, (r.returncode,
+                                                 r.stderr[-2000:])
+
+        # every member must have SOME committed steps from before the kill
+        panel = synthetic_panel(num_days=16, num_instruments=6,
+                                num_features=8, missing_prob=0.1, seed=0)
+        ds = PanelDataset(panel, seq_len=5)
+        cfg = small_config(tmp_path, num_epochs=4)
+        logger = RecordingLogger()
+        ft = FleetTrainer(cfg, ds, seeds=(0, 1), logger=logger)
+        dirs = []
+        for seed in (0, 1):
+            cfg_s = ft.seed_config(seed)
+            d = f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt"
+            ck = Checkpointer(d)
+            steps = ck.all_steps()
+            ck.close()
+            assert steps, f"seed {seed} has no committed steps"
+            dirs.append((d, steps))
+
+        # corrupt seed 1's newest MANIFESTED step (the opportunistic
+        # flush at each save guarantees earlier steps have manifests
+        # even though the kill skipped the final barrier)
+        d1, steps1 = dirs[1]
+        ck1 = Checkpointer(d1)
+        manifested = [s for s in steps1 if ck1.manifest(s) is not None]
+        ck1.close()
+        assert manifested, "no member step carries a manifest"
+        victim = manifested[-1]
+        chaos.ops.corrupt_checkpoint_step(d1, victim, rng_seed=0)
+
+        fleet_state, out = ft.fit(resume=True)
+        resumed = logger.events("fleet_resume")
+        assert resumed, "group resume did not engage"
+        ck = Checkpointer(d1)
+        assert victim in ck.quarantined_steps()   # fenced, never loaded
+        assert victim not in ck.all_steps()
+        ck.close()
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(fleet_state.params))
+
+
+@pytest.mark.slow
+class TestSlowBitwise:
+    """Stream and fleet S=2 halves of the acceptance pin (the serial
+    half runs in the quick tier, TestGuardBitwise)."""
+
+    def test_stream_guard_bitwise_neutral(self, tiny_dataset, tmp_path):
+        runs = {}
+        for name, guard in [("on", True), ("off", False)]:
+            cfg = stream_small_config(tmp_path / name, num_epochs=3,
+                                      finite_guard=guard,
+                                      checkpoint_every=0)
+            tr = Trainer(cfg, tiny_dataset,
+                         logger=MetricsLogger(echo=False))
+            runs[name] = tr.fit()
+        assert_trees_bitwise(runs["on"][0], runs["off"][0])
+        on = [h["train_loss"] for h in runs["on"][1]["history"]]
+        off = [h["train_loss"] for h in runs["off"][1]["history"]]
+        assert on == off
+
+    def test_fleet_s2_guard_bitwise_neutral(self, tiny_dataset, tmp_path):
+        from factorvae_tpu.train.fleet import FleetTrainer
+
+        runs = {}
+        for name, guard in [("on", True), ("off", False)]:
+            cfg = small_config(tmp_path / name, num_epochs=3,
+                               finite_guard=guard, checkpoint_every=0)
+            ft = FleetTrainer(cfg, tiny_dataset, seeds=(0, 1),
+                              logger=MetricsLogger(echo=False))
+            runs[name] = ft.fit()
+        assert_trees_bitwise(runs["on"][0].params, runs["off"][0].params)
+        on = [h["train_loss"] for h in runs["on"][1]["history"]]
+        off = [h["train_loss"] for h in runs["off"][1]["history"]]
+        assert on == off

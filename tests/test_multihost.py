@@ -11,6 +11,7 @@ GSPMD computation over a global mesh spanning both processes, so the
 assumed.
 """
 
+import functools
 import os
 import socket
 import subprocess
@@ -20,6 +21,91 @@ import textwrap
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# environment detection (ISSUE 9 satellite): some sandboxes ship a jaxlib
+# whose CPU backend has no cross-process collective implementation — every
+# jitted computation over a process-spanning mesh dies with
+# "INVALID_ARGUMENT: Multiprocess computations aren't implemented on the
+# CPU backend". That is a property of the RIG, not of parallel/multihost.py
+# (the same tests pass on jaxlib builds with gloo collectives), so the
+# multi-process tests probe once per session and SKIP with the probe's
+# actual error instead of failing the slow tier forever on such hosts.
+
+_PROBE_WORKER = textwrap.dedent(
+    """
+    import sys
+    port, pid = sys.argv[1], int(sys.argv[2])
+    import jax
+    jax.distributed.initialize(f"127.0.0.1:{port}", 2, pid)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # the smallest process-spanning collective: a jitted global sum over
+    # an array sharded across both processes' devices
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    x = jax.make_array_from_callback(
+        (4,), NamedSharding(mesh, P("d")),
+        lambda idx: np.arange(4.0, dtype=np.float32)[idx])
+    t = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+    assert float(np.asarray(t)) == 6.0, t
+    print(f"PROBE_OK p{pid}")
+    """
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _multiprocess_cpu_support():
+    """(ok, reason): can THIS host run a jitted collective across two
+    jax.distributed CPU processes? Cached — one ~10s probe per session."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE_WORKER, str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=120))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        return False, "2-process collective probe hung >120s"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if all(p.returncode == 0 for p in procs):
+        return True, ""
+    err = next((e for p, (_, e) in zip(procs, outs) if p.returncode != 0),
+               "")
+    tail = (err.strip().splitlines() or ["no stderr"])[-1]
+    return False, tail
+
+
+def _require_multiprocess_cpu():
+    ok, reason = _multiprocess_cpu_support()
+    if not ok:
+        pytest.skip(
+            "this host cannot run jitted collectives across "
+            f"jax.distributed CPU processes ({reason}); needs a jaxlib "
+            "CPU backend with cross-process collectives — pre-existing "
+            "rig limitation, verified identical at seed (CHANGES.md)")
 
 WORKER = textwrap.dedent(
     """
@@ -286,6 +372,7 @@ def test_four_process_hierarchical_mesh_train():
     stock collectives stay inside each process's device pair, and two
     epochs produce identical losses on every process AND equal to a
     single-process run of the same configuration."""
+    _require_multiprocess_cpu()
     # generous bound: 4 concurrent jax processes compiling on the 1-core
     # CI box (with other suite load) have been observed near 500 s
     outs = _run_group(HIER_WORKER, "MULTIHOST_HIER_OK", 4, timeout=900)
@@ -314,6 +401,7 @@ def test_two_process_full_train_step():
     epochs on a 2-process 2x2 dp x sp mesh; both processes see the same
     per-epoch losses, and those losses equal a single-process run of the
     identical configuration (VERDICT r2 #4)."""
+    _require_multiprocess_cpu()
     outs = _run_pair(TRAIN_WORKER, "MULTIHOST_TRAIN_OK")
     per_proc = []
     for _, out, _ in outs:
@@ -336,6 +424,7 @@ def test_two_process_full_train_step():
 
 
 def test_two_process_distributed_init_and_collective(tmp_path):
+    _require_multiprocess_cpu()
     # bounded by the communicate(timeout=220) below
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
