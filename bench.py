@@ -778,8 +778,16 @@ def run_obs_bench() -> dict:
     on-device health probes compiled out (the default) and in
     (TrainConfig.obs_probes), at the same planner-resolved knobs, and
     report both rates plus `probe_overhead_frac` — the windows/sec the
-    probes cost. One JSON line, same terminal contract; `value` is the
-    PROBES-ON rate (the path under test)."""
+    probes cost. ISSUE 10 adds the live-follower A/B: the same
+    probes-on workload writing a RUN.jsonl stream, with and without an
+    in-process `obs.live` follower tailing it (flag recomputation per
+    epoch record) — `live_overhead_frac` is the windows/sec the
+    WATCHER costs, reported next to `probe_overhead_frac` and carried
+    on the --track history row. One JSON line, same terminal contract;
+    `value` is the PROBES-ON rate (the path under test)."""
+    import tempfile
+    import threading
+
     import jax
 
     from factorvae_tpu.utils.testing import enable_persistent_compile_cache
@@ -787,8 +795,13 @@ def run_obs_bench() -> dict:
     enable_persistent_compile_cache()
 
     from factorvae_tpu.data import synthetic_panel_dense
+    from factorvae_tpu.obs.live import follow_run
     from factorvae_tpu.train import Trainer
-    from factorvae_tpu.utils.logging import MetricsLogger
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
 
     platform, _ = detect_platform()
     knobs, plan_block = resolve_plan(platform)
@@ -797,23 +810,63 @@ def run_obs_bench() -> dict:
         num_features=NUM_FEATURES)
 
     results = {}
-    for obs in (False, True):
+    # Four legs: probes off/on (the pillar-1 A/B, unchanged), then the
+    # probes-on stream WITH an attached live follower vs without one
+    # (the pillar-5 A/B — both legs pay the file-backed stream, so the
+    # delta isolates the watcher, not the JSONL writes).
+    for leg in ("off", "on", "live_off", "live_on"):
+        obs = leg != "off"
         cfg, ds = bench_setup(knobs, panel=panel, obs=obs)
-        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
-        state = trainer.init_state()
-        state, m = trainer._train_epoch(state, trainer._epoch_orders(0))
-        jax.block_until_ready(m["loss"])
-        days_per_epoch = float(m["days"])
-        t0 = time.time()
-        for epoch in range(1, EPOCHS_TIMED + 1):
-            state, m = trainer._train_epoch(
-                state, trainer._epoch_orders(epoch))
-        jax.block_until_ready(m["loss"])
-        dt = time.time() - t0
-        results["on" if obs else "off"] = (
-            EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt)
+        run_path = None
+        prev_tl = None
+        stop_follow = threading.Event()
+        follower = None
+        if leg.startswith("live"):
+            run_path = os.path.join(
+                tempfile.mkdtemp(prefix="bench_obs_live_"), "RUN.jsonl")
+            logger = MetricsLogger(jsonl_path=run_path, echo=False,
+                                   run_name=f"bench_obs_{leg}")
+            prev_tl = install_timeline(Timeline(logger))
+        else:
+            logger = MetricsLogger(echo=False)
+        try:
+            if leg == "live_on":
+                follower = threading.Thread(
+                    target=follow_run, args=(run_path,),
+                    kwargs=dict(poll_s=0.2, update_every=4,
+                                stop=stop_follow.is_set),
+                    daemon=True)
+                follower.start()
+            trainer = Trainer(cfg, ds, logger=logger)
+            state = trainer.init_state()
+            state, m = trainer._train_epoch(state,
+                                            trainer._epoch_orders(0))
+            jax.block_until_ready(m["loss"])
+            days_per_epoch = float(m["days"])
+            t0 = time.time()
+            for epoch in range(1, EPOCHS_TIMED + 1):
+                state, m = trainer._train_epoch(
+                    state, trainer._epoch_orders(epoch))
+                if run_path:
+                    # the live legs stream per-epoch records like a
+                    # real --obs run (the follower needs records to
+                    # chew, and both legs pay the same writes)
+                    logger.log("epoch", _echo=False, epoch=epoch,
+                               train_loss=float(m["loss"]))
+            jax.block_until_ready(m["loss"])
+            dt = time.time() - t0
+        finally:
+            stop_follow.set()
+            if follower is not None:
+                follower.join(timeout=10)
+            if prev_tl is not None or run_path:
+                install_timeline(prev_tl)
+            logger.finish()
+        results[leg] = EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt
 
     overhead = 1.0 - results["on"] / max(results["off"], 1e-9)
+    live_overhead = 1.0 - results["live_on"] / max(results["live_off"],
+                                                   1e-9)
     use_pallas = knobs["pallas_attention"]
     return {
         "metric": (
@@ -835,6 +888,12 @@ def run_obs_bench() -> dict:
         # speed training up); reported as measured, not clamped.
         "probe_overhead_frac": round(overhead, 4),
         "probe_overhead_ok": overhead <= 0.05,
+        # the live-follower A/B (ISSUE 10): both legs write the same
+        # RUN.jsonl stream; the delta is the attached watcher alone
+        "windows_per_sec_live_off": round(results["live_off"], 1),
+        "windows_per_sec_live_on": round(results["live_on"], 1),
+        "live_overhead_frac": round(live_overhead, 4),
+        "live_overhead_ok": live_overhead <= 0.05,
         "plan": plan_block,
     }
 

@@ -1,4 +1,4 @@
-"""graftlint rules JGL001–JGL007.
+"""graftlint rules JGL001–JGL008.
 
 Each rule is a function `(ModuleModel) -> list[Finding]`. JGL002 (key
 reuse), JGL004 (read-after-donation) and the loop flavor of JGL001 share
@@ -858,5 +858,59 @@ def rule_jgl007(model: ModuleModel) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# JGL008 — wall-clock duration measurement in library code
+
+
+def _is_walltime_call(model: ModuleModel, expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) \
+        and model.resolve(expr.func) == "time.time" and not expr.args
+
+
+def rule_jgl008(model: ModuleModel) -> List[Finding]:
+    """`time.time()` used to MEASURE a duration — its value (directly
+    or through an assigned name) participates in a subtraction — in
+    `factorvae_tpu/` library code. The Timeline contract
+    (utils/logging.py) is monotonic `time.perf_counter` for every
+    span/duration: wall-clock `time.time()` jumps under NTP steps and
+    DST, so a duration measured on it can come out negative or wildly
+    wrong, and its records land on a DIFFERENT time base than the rest
+    of the run's spans. `time.time()` as a TIMESTAMP (the `ts` field
+    of metric records, checkpoint `created` stamps) never subtracts
+    and stays exempt — that is exactly what a wall clock is for."""
+    norm = model.path.replace(os.sep, "/")
+    if "factorvae_tpu/" not in norm:
+        return []  # scripts/, tests/, bench.py own their clocks
+    # names bound to time.time() anywhere in the module (the engine's
+    # standard name-based over-approximation)
+    tracked: Set[str] = set()
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.Assign) \
+                and _is_walltime_call(model, node.value):
+            tracked.update(_target_names(node.targets))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                and node.value is not None \
+                and _is_walltime_call(model, node.value):
+            tracked.update(_target_names([node.target]))
+
+    def measures(expr: ast.AST) -> bool:
+        return _is_walltime_call(model, expr) or (
+            isinstance(expr, ast.Name) and expr.id in tracked)
+
+    findings: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                and (measures(node.left) or measures(node.right)):
+            findings.append(Finding(
+                "JGL008", model.path, node.lineno,
+                "duration measured with wall-clock time.time() — the "
+                "Timeline contract is monotonic time.perf_counter "
+                "(an NTP step or DST jump corrupts the span, and the "
+                "value shares no time base with the run's spans); "
+                "keep time.time() for record timestamps only",
+            ))
+    return findings
+
+
 ALL_RULES = (rule_jgl001, rule_jgl002, rule_jgl003, rule_jgl004,
-             rule_jgl005, rule_jgl006, rule_jgl007)
+             rule_jgl005, rule_jgl006, rule_jgl007, rule_jgl008)

@@ -142,6 +142,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "the float path. Also applies to --export "
                         "(int8-baked serving artifact)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--prom_textfile", type=str, default=None,
+                   metavar="PATH",
+                   help="write a Prometheus textfile (node-exporter "
+                        "textfile-collector format) of the latest "
+                        "epoch's metrics to PATH after every epoch — "
+                        "the trainer-side half of the live telemetry "
+                        "plane (the daemon's is GET /metrics); atomic "
+                        "rewrite, scraper-safe (obs/metrics.py)")
     p.add_argument("--compile_cache", type=str, default=None,
                    metavar="DIR",
                    help="persistent XLA compilation cache directory "
@@ -370,6 +378,18 @@ def main(argv=None) -> int:
         # async checkpoint saves and the jit compile watchdog all emit
         # spans into the same stream the metrics land in.
         prev_tl = install_timeline(Timeline(logger))
+    prev_exp = None
+    if args.prom_textfile:
+        # Trainer-side scrape surface (ISSUE 10): the epoch loops
+        # rewrite this textfile after every epoch; same registry
+        # pattern (and same restore-in-finally contract) as the
+        # timeline.
+        from factorvae_tpu.obs.metrics import (
+            TextfileExporter,
+            install_exporter,
+        )
+
+        prev_exp = install_exporter(TextfileExporter(args.prom_textfile))
     # try/finally so EVERY exit path — including the early `return 2`
     # error paths — detaches the timeline and closes the metrics stream
     # (the close-on-error contract MetricsLogger now carries).
@@ -668,6 +688,10 @@ def main(argv=None) -> int:
             # (stray spans from daemon watchers become no-ops) and
             # RESTORE whatever the in-process caller had installed.
             install_timeline(prev_tl)
+        if args.prom_textfile:
+            from factorvae_tpu.obs.metrics import install_exporter
+
+            install_exporter(prev_exp)
         logger.finish()
 
 

@@ -1,6 +1,7 @@
 """Run observatory: training-health telemetry for the whole pipeline.
 
-Three pillars (ISSUE 5; docs/observability.md has the long-form story):
+Five pillars (ISSUE 5/7/10; docs/observability.md has the long-form
+story):
 
 - **On-device health probes** (`obs.probes`, wired through
   `train/loop.py make_step_fns(obs=True)`): scalar probes — grad/param/
@@ -34,6 +35,14 @@ Three pillars (ISSUE 5; docs/observability.md has the long-form story):
   which `python -m factorvae_tpu.obs.ledger` checks for regressions
   against the trailing median — the perf trajectory, not one-off
   artifacts.
+- **Live telemetry plane** (ISSUE 10; `obs/live.py`, `obs/metrics.py`,
+  `obs/drift.py`): a streaming RUN.jsonl follower that emits
+  `obs.report`'s flags as alerts while the run is IN FLIGHT (torn-line
+  tolerant; flags pinned identical to the post-hoc report), Prometheus
+  text exposition — the daemon's `GET /metrics` plus a trainer-side
+  textfile exporter — and served-score drift monitors (per-model
+  distribution digests, day-over-day rank correlation, `score_drift`
+  flags) feeding the walk-forward loop of ROADMAP item 4.
 """
 
 from factorvae_tpu.obs.compile import (

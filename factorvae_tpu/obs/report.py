@@ -1,8 +1,12 @@
 """Run report: per-epoch health tables + flags from a RUN.jsonl.
 
-    python -m factorvae_tpu.obs.report RUN.jsonl [--json]
+    python -m factorvae_tpu.obs.report RUN.jsonl [--json] [--follow]
         [--spike-mult 10] [--slow-frac 0.5] [--diverge-frac 0.2]
         [--diverge-epochs 3]
+
+`--follow` tails an IN-FLIGHT stream instead (delegating to
+`obs/live.py`, pillar 5): the same flags, emitted as alerts while the
+run is still writing, pinned identical to this report run post-hoc.
 
 Aggregates the metric stream (epoch / fleet_epoch records, the health
 probes when `obs` was on, the `plan` decision block, the compiled-
@@ -56,6 +60,11 @@ where the healing becomes visible:
                      `cold_start_retry` marks): the fault healed below
                      the epoch/request level.
 
+Served-score drift (ISSUE 10) renders as `score_drift`: the scoring
+daemon's drift monitor (obs/drift.py) saw a model's day-over-day
+served rank correlation collapse below its threshold — the signal
+degraded while every request kept answering 200.
+
 Human output by default; `--json` for the machine-readable form. An
 empty, missing, or non-JSONL stream exits with a one-line error; a
 trailing torn line (async-kill artifact) is a warning, never fatal.
@@ -79,9 +88,10 @@ from factorvae_tpu.obs.timeline import (
 
 # load_run/open_run are re-exported CLI plumbing here; keeping the names
 # referenced preserves the public import path tests rely on.
-__all__ = ["build_report", "format_report", "health_flags", "load_run",
-           "main", "open_run", "plan_measured_days_per_sec",
-           "program_flags", "recovery_flags"]
+__all__ = ["build_report", "drift_flags", "format_report",
+           "health_flags", "load_run", "main", "open_run",
+           "plan_measured_days_per_sec", "program_flags",
+           "recovery_flags"]
 
 # timeline marks that announce a recovery action -> report flag name
 RECOVERY_MARK_FLAGS = {
@@ -90,6 +100,13 @@ RECOVERY_MARK_FLAGS = {
     "circuit_open": "circuit_open",
     "stream_retry": "retry",
     "cold_start_retry": "retry",
+}
+
+# serve-side drift marks (obs/drift.py) -> report flag name. Distinct
+# from recovery: the daemon took no action — the SIGNAL degraded, and
+# the report is where that becomes a first-class flag (ISSUE 10).
+DRIFT_MARK_FLAGS = {
+    "score_drift": "score_drift",
 }
 
 # autotune_plan rows carry "train 0.1234 s/day" in their source string;
@@ -432,10 +449,34 @@ def recovery_flags(run: dict) -> List[dict]:
     return flags
 
 
+def drift_flags(run: dict) -> List[dict]:
+    """Served-score drift (ISSUE 10; obs/drift.py emits the marks): a
+    model whose day-over-day served ranking collapsed below the drift
+    threshold — the Rank-IC-decay signature of regime shift — raises a
+    `score_drift` flag per mark."""
+    flags: List[dict] = []
+    for m in run.get("marks", []):
+        kind = DRIFT_MARK_FLAGS.get(m.get("name"))
+        if kind is None:
+            continue
+        corr = m.get("rank_corr")
+        corr_s = (f"{corr:.3f}" if isinstance(corr, (int, float))
+                  else str(corr))
+        flags.append({
+            "epoch": None, "line": m.get("_line"), "flag": kind,
+            "detail": (f"model {m.get('alias') or m.get('model')}: "
+                       f"day-over-day rank corr {corr_s} < "
+                       f"{m.get('threshold')} (day {m.get('day')} vs "
+                       f"{m.get('prev_day')}, n={m.get('n_common')})"),
+        })
+    return flags
+
+
 def build_report(run: dict, **kw) -> dict:
     epochs = run["epochs"]
     flags = health_flags(epochs, run["events"], **kw)
     flags += program_flags(run)
+    flags += drift_flags(run)
     recov = recovery_flags(run)
     flags += recov
     by_kind: dict = {}
@@ -547,12 +588,36 @@ def main(argv: Optional[list] = None) -> int:
         description="Per-epoch health table + flags for a RUN.jsonl")
     ap.add_argument("run_jsonl")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail an in-flight stream instead of reading "
+                         "a finished one: delegates to the live "
+                         "follower (obs/live.py), emitting each flag "
+                         "as an alert when it appears; flags are "
+                         "pinned identical to this report run post-hoc")
+    ap.add_argument("--idle-timeout", type=float, default=None,
+                    help="with --follow: stop after this many seconds "
+                         "without new bytes (default: follow forever)")
     ap.add_argument("--spike-mult", type=float, default=10.0)
     ap.add_argument("--slow-frac", type=float, default=0.5)
     ap.add_argument("--diverge-frac", type=float, default=0.2)
     ap.add_argument("--diverge-epochs", type=int, default=3)
     args = ap.parse_args(argv)
     import sys
+
+    if args.follow:
+        from factorvae_tpu.obs import live
+
+        follow_args = [args.run_jsonl, "--follow"]
+        if args.json:
+            follow_args.append("--json")
+        if args.idle_timeout is not None:
+            follow_args += ["--idle-timeout", str(args.idle_timeout)]
+        follow_args += [
+            "--spike-mult", str(args.spike_mult),
+            "--slow-frac", str(args.slow_frac),
+            "--diverge-frac", str(args.diverge_frac),
+            "--diverge-epochs", str(args.diverge_epochs)]
+        return live.main(follow_args)
 
     try:
         run, warnings = open_run(args.run_jsonl)

@@ -1,7 +1,7 @@
 """Render a RUN.jsonl host timeline as a text Gantt + overlap report.
 
     python -m factorvae_tpu.obs.timeline RUN.jsonl [--width 72]
-        [--top 10] [--json]
+        [--top 10] [--json] [--follow]
 
 Reads the `span` / `mark` records that `utils.logging.Timeline` emits
 (Trainer/FleetTrainer epochs on the "device" resource, ChunkStream
@@ -400,8 +400,27 @@ def main(argv: Optional[list] = None) -> int:
                     help="longest spans listed (0 disables)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable overlap report instead of text")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail an in-flight stream instead: delegates "
+                         "to the live follower (obs/live.py), emitting "
+                         "health/compile/recovery flags as alerts while "
+                         "the run writes (Gantt rendering needs the "
+                         "finished stream — rerun without --follow)")
+    ap.add_argument("--idle-timeout", type=float, default=None,
+                    help="with --follow: stop after this many seconds "
+                         "without new bytes (default: follow forever)")
     args = ap.parse_args(argv)
     import sys
+
+    if args.follow:
+        from factorvae_tpu.obs import live
+
+        follow_args = [args.run_jsonl, "--follow"]
+        if args.json:
+            follow_args.append("--json")
+        if args.idle_timeout is not None:
+            follow_args += ["--idle-timeout", str(args.idle_timeout)]
+        return live.main(follow_args)
 
     try:
         run, warnings = open_run(args.run_jsonl)

@@ -88,6 +88,19 @@ def capture_disabled():
 _PCACHE = {"hits": 0, "misses": 0}
 _PCACHE_CLASSIFY = False
 
+# Process-wide tally of emitted compile records by taxonomy, for the
+# /metrics exposition (obs/metrics.py): a warm-restarted daemon with a
+# persistent cache scrapes compile==0 / compile_cached>0 — the same
+# contract tests/test_serve.py pins on the RUN stream, now visible to
+# a scraper without parsing JSONL. Counts only what was LOGGED (i.e.
+# with a timeline installed): the no-timeline path stays untouched.
+_EVENT_COUNTS = {"compile": 0, "compile_cached": 0}
+
+
+def compile_event_counts() -> dict:
+    """Copy of this process's compile-record tally by taxonomy."""
+    return dict(_EVENT_COUNTS)
+
 
 def _pcache_listener(event: str, **kwargs) -> None:
     if event == "/jax/compilation_cache/cache_hits":
@@ -196,6 +209,7 @@ class WatchedJit:
                     cap = {}
             self.last_compile = dict(cap, fn=self.name, wall_s=wall,
                                      compiles=self.compiles)
+            _EVENT_COUNTS[event] += 1
             tl.logger.log(event, _echo=False, **self.last_compile)
             if self.compiles > self.storm_threshold:
                 tl.event(

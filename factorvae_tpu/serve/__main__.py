@@ -78,8 +78,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="response JSONL path for --batch (default "
                         "stdout)")
     p.add_argument("--http", type=int, default=None, metavar="PORT",
-                   help="serve HTTP on 127.0.0.1:PORT (POST /score, "
-                        "GET /stats /models /healthz) instead of stdin")
+                   help="serve HTTP on 127.0.0.1:PORT (POST /score "
+                        "/profile, GET /stats /models /healthz "
+                        "/metrics) instead of stdin")
     p.add_argument("--tick_ms", type=float, default=20.0,
                    help="stdin batching window: single-line requests "
                         "arriving within this window fuse into one "
@@ -99,6 +100,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker_cooldown_s", type=float, default=5.0,
                    help="open-breaker cooldown before one half-open "
                         "probe request is let through")
+    p.add_argument("--drift_threshold", type=float, default=0.5,
+                   help="served-score drift gate (obs/drift.py): a "
+                        "model whose day-over-day rank correlation of "
+                        "served scores lands below this emits a "
+                        "score_drift mark (flagged by obs.report/"
+                        "obs.live, exposed in /metrics); -1 disables "
+                        "(no correlation lands below it)")
     p.add_argument("--metrics_jsonl", type=str, default=None,
                    help="RUN.jsonl stream for request spans + compile "
                         "records (render: python -m "
@@ -248,7 +256,8 @@ def main(argv=None) -> int:
             stochastic=(None if args.stochastic else False),
             seed=args.seed, deadline_ms=args.deadline_ms,
             breaker_k=args.breaker_k,
-            breaker_cooldown_s=args.breaker_cooldown_s)
+            breaker_cooldown_s=args.breaker_cooldown_s,
+            drift_threshold=args.drift_threshold)
         if args.warmup:
             walls = registry.warmup(dataset,
                                     stochastic=daemon.stochastic)

@@ -23,17 +23,26 @@ serial path, so a lone request is always bitwise the offline scan.
 object or an ARRAY of requests — an explicit tick; bursts of single
 lines within `tick_s` coalesce into one tick too), `serve_batch_file`
 (score a request file, write a response file, exit) and `serve_http`
-(stdlib http.server: POST /score, GET /stats /models /healthz) all
-funnel into `ScoringDaemon.handle_batch`. Responses preserve request
-order; malformed lines get `{"ok": false, "error": ...}` instead of
-killing the process.
+(stdlib http.server: POST /score /profile, GET /stats /models /healthz
+/metrics) all funnel into `ScoringDaemon.handle_batch`. Responses
+preserve request order; malformed lines get `{"ok": false, "error":
+...}` instead of killing the process.
 
 **Observability.** With a timeline installed (serve `--metrics_jsonl`)
 every request emits a `serve_request` span and every fused dispatch a
 `serve_dispatch` span into the same RUN.jsonl the scoring jits'
 `compile`/`compile_cached` records land in — `python -m
 factorvae_tpu.obs.timeline RUN.jsonl` renders the request-level Gantt
-with zero extra wiring.
+with zero extra wiring, and `python -m factorvae_tpu.obs.live
+RUN_SERVE.jsonl --follow` raises its flags live. On top, the live
+telemetry plane (ISSUE 10): a request-latency histogram plus
+registry/breaker/health/drift gauges on `GET /metrics` (Prometheus
+text, obs/metrics.py), `run_meta` provenance on `/stats` and
+`/models`, on-demand `jax.profiler` capture via `POST /profile`, and
+per-(model, day) served-score digests with day-over-day rank
+correlation (obs/drift.py) flagged as `score_drift` when the ranking
+collapses — the regime-shift telemetry ROADMAP item 4's walk-forward
+loop consumes.
 """
 
 from __future__ import annotations
@@ -48,12 +57,18 @@ from typing import Optional
 
 import numpy as np
 
+from factorvae_tpu.obs.drift import ScoreDriftMonitor
+from factorvae_tpu.obs.metrics import LatencyHistogram
 from factorvae_tpu.serve.registry import (
     Entry,
     ModelRegistry,
     RegistryError,
 )
-from factorvae_tpu.utils.logging import timeline_event, timeline_span
+from factorvae_tpu.utils.logging import (
+    run_meta,
+    timeline_event,
+    timeline_span,
+)
 
 _CMDS = ("ping", "stats", "models", "shutdown")
 
@@ -108,7 +123,9 @@ class ScoringDaemon:
                  deadline_ms: float = 0.0, breaker_k: int = 3,
                  breaker_cooldown_s: float = 5.0,
                  health_window: int = 64, degraded_at: float = 0.1,
-                 failing_at: float = 0.5):
+                 failing_at: float = 0.5,
+                 drift_threshold: float = 0.5,
+                 drift_min_overlap: int = 8):
         self.registry = registry
         self.dataset = dataset
         self.stochastic = stochastic
@@ -123,6 +140,25 @@ class ScoringDaemon:
         self.fused_requests = 0
         self.deadline_misses = 0
         self.breaker_fast_fails = 0
+        self.ticks = 0
+        # Request-latency histogram for /metrics (obs/metrics.py):
+        # tick arrival -> scores landing, the same clock latency_ms
+        # reports. Host-side counters only — the scoring path and its
+        # outputs are untouched.
+        self.latency = LatencyHistogram()
+        # Served-score drift (obs/drift.py): per-(model, day)
+        # distribution digests + day-over-day rank correlation of what
+        # this daemon actually answered; collapses below
+        # `drift_threshold` emit score_drift marks that obs.report /
+        # obs.live flag and /metrics exposes. Digested once per
+        # (model, day) — repeat requests for a scored day are free.
+        self.drift = ScoreDriftMonitor(threshold=drift_threshold,
+                                       min_overlap=drift_min_overlap)
+        # Provenance header for scraped snapshots (ISSUE 10): the same
+        # run_meta a metrics stream opens with (jax version, platform,
+        # git sha, rig env), so a saved /stats or /models payload is
+        # ledger-attributable without the RUN.jsonl next to it.
+        self.run_meta = run_meta(run_name="serve")
         self._closing = False
         self._draining = False
         # key -> {"fails": consecutive failures, "open_until": t}
@@ -447,6 +483,7 @@ class ScoringDaemon:
                 return {"id": rid, "ok": True, "cmd": "ping"}
             if r.cmd == "models":
                 return {"id": rid, "ok": True, "cmd": "models",
+                        "run_meta": self.run_meta,
                         "models": self.registry.stats()["entries"]}
             return {"id": rid, "ok": True, "cmd": "stats",
                     **self.stats()}
@@ -458,6 +495,10 @@ class ScoringDaemon:
         # and K of those in a row open the entry's breaker so later
         # requests stop queueing behind the stall.
         done_lat_ms = ((r.done_t or time.perf_counter()) - t0) * 1e3
+        # Every scoring request that produced scores lands one latency
+        # sample (ok AND deadline-missed: the stall is the histogram's
+        # most interesting tail).
+        self.latency.observe(done_lat_ms / 1e3)
         # A miss against the SERVER's own deadline is evidence the
         # model is sick no matter whose deadline the RESPONSE used —
         # including a client that RAISED (or disabled) its deadline and
@@ -519,6 +560,11 @@ class ScoringDaemon:
             idx = idx[idx < inst.size]
             names = inst[idx]
             vals = np.asarray(r.scores[i], np.float32)[idx]
+            # Drift feed BEFORE any top-k truncation: the digest and
+            # the day-over-day rank pairing must see the full served
+            # cross-section (idempotent per (model, day)).
+            self.drift.observe(r.entry.key, int(day), names, vals,
+                               alias=r.entry.alias)
             if top:
                 order = np.argsort(-vals)[: int(top)]
                 names, vals = names[order], vals[order]
@@ -548,6 +594,7 @@ class ScoringDaemon:
     def handle_batch(self, requests: list) -> list:
         """Responses (in order) for one tick's worth of requests."""
         t0 = time.perf_counter()
+        self.ticks += 1
         with timeline_span("serve_tick", cat="serve", resource="serve",
                            requests=len(requests)):
             resolved = [self._resolve(r) for r in requests]
@@ -607,13 +654,24 @@ class ScoringDaemon:
             "breaker_fast_fails": self.breaker_fast_fails,
         }
 
+    def breaker_states(self) -> dict:
+        """key -> {"fails", "open"} for every entry the breaker has
+        seen — the /metrics gauge source (open_breakers() lists only
+        the currently-open subset)."""
+        open_b = set(self.open_breakers())
+        return {k: {"fails": b.get("fails", 0), "open": k in open_b}
+                for k, b in self._breakers.items()}
+
     def stats(self) -> dict:
         return {
+            "run_meta": self.run_meta,
             "requests_served": self.requests_served,
             "dispatches": self.dispatches,
             "fused_requests": self.fused_requests,
+            "ticks": self.ticks,
             "health": self.health(),
             "registry": self.registry.stats(),
+            "drift": self.drift.stats(),
         }
 
 
@@ -770,15 +828,22 @@ def serve_batch_file(daemon: ScoringDaemon, path: str, out,
 def serve_http(daemon: ScoringDaemon, port: int,
                host: str = "127.0.0.1"):
     """Minimal stdlib HTTP front: POST /score (object or array body),
-    GET /stats, /models, /healthz. Single-threaded by design — jax
-    dispatch is the bottleneck and wants no concurrency. Blocks until
-    a shutdown request arrives or SIGTERM requests a drain (the
-    in-flight request finishes, then the loop exits so the timeline
-    flushes).
+    GET /stats, /models, /healthz, /metrics, POST /profile.
+    Single-threaded by design — jax dispatch is the bottleneck and
+    wants no concurrency. Blocks until a shutdown request arrives or
+    SIGTERM requests a drain (the in-flight request finishes, then the
+    loop exits so the timeline flushes).
 
     `/healthz` reports the sliding-window health (ScoringDaemon.health):
     200 while ok/degraded, 503 once failing or draining — the signal a
-    load balancer keys eviction on."""
+    load balancer keys eviction on. `/metrics` is Prometheus text
+    exposition (obs/metrics.py: latency histogram, registry/breaker/
+    health gauges, compile taxonomy, score-drift monitors). `/stats`
+    and `/models` carry the daemon's `run_meta` provenance so scraped
+    snapshots are ledger-attributable. `POST /profile`
+    ({"action": "start"|"stop", "log_dir"?}) drives an on-demand
+    `jax.profiler` capture (utils/profiling.py); "stop" answers with
+    the `trace_summary` device-time breakdown."""
     from http.server import BaseHTTPRequestHandler, HTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -790,6 +855,15 @@ def serve_http(daemon: ScoringDaemon, port: int,
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, text: str,
+                       content_type: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             if self.path == "/healthz":
                 health = daemon.health()
@@ -797,18 +871,57 @@ def serve_http(daemon: ScoringDaemon, port: int,
             elif self.path == "/stats":
                 self._send(200, daemon.stats())
             elif self.path == "/models":
-                self._send(200, daemon.registry.stats()["entries"])
+                self._send(200, {
+                    "run_meta": daemon.run_meta,
+                    "models": daemon.registry.stats()["entries"]})
+            elif self.path == "/metrics":
+                from factorvae_tpu.obs.metrics import (
+                    CONTENT_TYPE,
+                    daemon_metrics,
+                )
+
+                self._send_text(200, daemon_metrics(daemon),
+                                CONTENT_TYPE)
             else:
                 self._send(404, {"ok": False,
                                  "error": f"unknown path {self.path}"})
 
+        def _profile(self, req: dict) -> None:
+            from factorvae_tpu.utils.profiling import (
+                ProfilerError,
+                start_profile,
+                stop_profile,
+            )
+
+            action = (req or {}).get("action")
+            try:
+                if action == "start":
+                    log_dir = start_profile((req or {}).get("log_dir"))
+                    self._send(200, {"ok": True, "action": "start",
+                                     "log_dir": log_dir})
+                elif action == "stop":
+                    self._send(200, {"ok": True, "action": "stop",
+                                     **stop_profile()})
+                else:
+                    self._send(400, {
+                        "ok": False,
+                        "error": "POST /profile wants {\"action\": "
+                                 "\"start\"|\"stop\"} (optional "
+                                 "\"log_dir\" on start)"})
+            except ProfilerError as e:
+                self._send(409, {"ok": False, "error": str(e)})
+
         def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
-            if self.path != "/score":
+            if self.path not in ("/score", "/profile"):
                 self._send(404, {"ok": False,
                                  "error": f"unknown path {self.path}"})
                 return
             n = int(self.headers.get("Content-Length") or 0)
             requests = _parse_line(self.rfile.read(n).decode())
+            if self.path == "/profile":
+                req = requests[0] if requests else {}
+                self._profile(req if isinstance(req, dict) else {})
+                return
             responses = _with_parse_errors(daemon, requests)
             # An empty array body gets an empty array back — never an
             # IndexError-dropped connection.

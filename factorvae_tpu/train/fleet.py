@@ -673,18 +673,36 @@ class FleetTrainer:
         ckpt_every = max(1, cfg.train.checkpoint_every or 0)
         history = []
         from factorvae_tpu.utils.logging import current_timeline
+        from factorvae_tpu.utils.profiling import (
+            maybe_profile_epoch,
+            summarize_capture,
+        )
+
+        # On-demand profiling (ISSUE 10): same PROFILE_REQUEST drop-in
+        # contract as the serial Trainer — metric-stream runs only.
+        run_dir = (os.path.dirname(os.path.abspath(
+            self.logger.jsonl_path)) if self.logger.jsonl_path else None)
 
         for epoch in range(start_epoch, epochs):
-            t0 = time.time()
+            t0 = time.perf_counter()
             # Timed spans drain the dispatch (block_until_ready) so the
             # span covers the device work; without a timeline the loop
             # keeps its original async dispatch exactly.
-            with timeline_span(f"train_epoch_{epoch}", cat="train",
-                               resource="device", epoch=epoch,
-                               seeds=self.num_seeds):
+            with maybe_profile_epoch(run_dir, epoch) as (prof, prof_dir), \
+                    timeline_span(f"train_epoch_{epoch}", cat="train",
+                                  resource="device", epoch=epoch,
+                                  seeds=self.num_seeds):
                 run_state, train_m = self._run_train_epoch(run_state, epoch)
-                if current_timeline() is not None:
+                if current_timeline() is not None or prof:
                     jax.block_until_ready(train_m["loss"])
+            if prof:
+                self.logger.log("profile_capture", epoch=epoch,
+                                dir=prof_dir,
+                                **summarize_capture(prof_dir, top=5))
+            elif prof_dir:
+                # request consumed but the capture could not start
+                self.logger.log("profile_capture", epoch=epoch,
+                                error=prof_dir)
             if val_order is not None:
                 with timeline_span(f"val_epoch_{epoch}", cat="eval",
                                    resource="device", epoch=epoch,
@@ -711,7 +729,7 @@ class FleetTrainer:
             else:
                 best_params, best_val = select_best(
                     best_params, best_val, run_state.params, selection)
-            dt = time.time() - t0
+            dt = time.perf_counter() - t0
             step = int(np.asarray(run_state.step).reshape(-1)[0])
             lr = learning_rate_at(cfg.train, self.total_steps, step)
             rec = dict(
@@ -754,6 +772,11 @@ class FleetTrainer:
                                 float(v) for v in np.asarray(val_m[k])]
             history.append(rec)
             self.logger.log("fleet_epoch", **rec)
+            # Prometheus textfile exporter (obs/metrics.py): per-seed
+            # lanes export with a seed_lane label; no-op uninstalled.
+            from factorvae_tpu.obs.metrics import export_epoch_metrics
+
+            export_epoch_metrics(rec)
             # Live allocator watermark (no-op without a timeline or on
             # backends without memory_stats — host CPU).
             from factorvae_tpu.obs.memory import watermark_event

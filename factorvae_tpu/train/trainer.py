@@ -450,23 +450,48 @@ class Trainer:
                     )
                 self.logger.log("resume", epoch=start_epoch, best_val=best_val)
 
-        from factorvae_tpu.utils.profiling import step_annotation
+        import os
+
+        from factorvae_tpu.utils.profiling import (
+            maybe_profile_epoch,
+            step_annotation,
+            summarize_capture,
+        )
+
+        # On-demand profiling (ISSUE 10): a PROFILE_REQUEST drop-in
+        # next to the metrics stream captures the next train epoch.
+        # Only metric-stream runs poll (one exists() per epoch); the
+        # default path never stats the filesystem.
+        run_dir = (os.path.dirname(os.path.abspath(
+            self.logger.jsonl_path)) if self.logger.jsonl_path else None)
 
         val_order = self._val_order()
         history = []
         epoch = start_epoch
         while epoch < epochs:
-            t0 = time.time()
+            t0 = time.perf_counter()
             order = self._epoch_orders(epoch)
             # The timeline span shares its name with the profiler
             # step_annotation so host spans cross-link with --profile
             # device lanes; the float() sync inside the span makes the
             # span cover the device work, not just the dispatch.
-            with step_annotation(f"train_epoch_{epoch}"), \
+            with maybe_profile_epoch(run_dir, epoch) as (prof, prof_dir), \
+                    step_annotation(f"train_epoch_{epoch}"), \
                     timeline_span(f"train_epoch_{epoch}", cat="train",
                                   resource="device", epoch=epoch):
                 state, train_m = self._train_epoch(state, order, epoch)
                 train_loss = float(train_m["loss"])
+            if prof:
+                # summarize the on-demand capture into the same stream
+                # (guarded: telemetry never aborts the epoch loop)
+                self.logger.log("profile_capture", epoch=epoch,
+                                dir=prof_dir,
+                                **summarize_capture(prof_dir, top=5))
+            elif prof_dir:
+                # a request WAS consumed but the capture could not
+                # start (profiler busy, unwritable dir) — say so
+                self.logger.log("profile_capture", epoch=epoch,
+                                error=prof_dir)
             if val_order is not None:
                 eval_key = jax.random.fold_in(
                     jax.random.PRNGKey(cfg.train.seed + 1), epoch
@@ -482,7 +507,7 @@ class Trainer:
                 # so the best-weights export still gets written.
                 val_loss = float("nan")
                 selection_loss = train_loss
-            dt = time.time() - t0
+            dt = time.perf_counter() - t0
             lr = learning_rate_at(cfg.train, self.total_steps,
                                   int(state.step), lr_scale=self._lr_scale)
             rec = dict(
@@ -528,6 +553,12 @@ class Trainer:
                             rec["val_" + k] = float(val_m[k])
             history.append(rec)
             self.logger.log("epoch", **rec)
+            # Prometheus textfile exporter (obs/metrics.py): one atomic
+            # .prom rewrite per epoch when installed; one `is None`
+            # check when not (the default).
+            from factorvae_tpu.obs.metrics import export_epoch_metrics
+
+            export_epoch_metrics(rec)
             # Live-buffer watermark where the backend exposes allocator
             # stats (TPU/GPU; no-op on host CPU or without a timeline) —
             # the measured complement of the compile records' peak

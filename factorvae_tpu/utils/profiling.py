@@ -5,15 +5,33 @@ The reference has no tracing at all — only tqdm progress bars
 around training epochs (viewable in TensorBoard / Perfetto), named step
 annotations, and a NaN-debug mode replacing the reference's scattered
 runtime NaN guards (module.py:149-150) with a framework-level switch.
+
+ISSUE 10 adds ON-DEMAND capture to the long-lived processes:
+
+- `start_profile` / `stop_profile` — explicit start/stop pair behind
+  the scoring daemon's `POST /profile`; `stop_profile` summarizes the
+  captured trace through `utils/trace_summary.py` and returns the
+  device-time breakdown.
+- `maybe_profile_epoch` — the trainer's epoch-boundary hook: dropping a
+  `PROFILE_REQUEST` file (empty, or JSON `{"log_dir": ...}`) into the
+  run directory makes the NEXT epoch run under `jax.profiler`, after
+  which the capture is summarized and logged. The poll is one
+  `os.path.exists` per epoch and only when the run has a metrics
+  stream; without the request file the epoch path is untouched.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import os
-from typing import Iterator, Optional
+import tempfile
+from typing import Iterator, Optional, Tuple
 
 import jax
+
+#: drop this file into a run directory to request an epoch capture
+PROFILE_REQUEST_BASENAME = "PROFILE_REQUEST"
 
 
 @contextlib.contextmanager
@@ -28,6 +46,135 @@ def trace(log_dir: Optional[str]) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# on-demand capture (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+class ProfilerError(RuntimeError):
+    """Capture state/backend failure with a one-line actionable
+    message (the daemon's /profile answers it as {"ok": false})."""
+
+
+# Active on-demand capture dir (one at a time per process — the jax
+# profiler itself is a singleton).
+_ACTIVE: dict = {"dir": None}
+
+
+def start_profile(log_dir: Optional[str] = None) -> str:
+    """Begin an on-demand `jax.profiler` capture; returns the log dir
+    (a fresh temp dir when none given). One capture at a time."""
+    if _ACTIVE["dir"] is not None:
+        raise ProfilerError(
+            f"a profile capture is already running into "
+            f"{_ACTIVE['dir']}; POST {{\"action\": \"stop\"}} first")
+    log_dir = log_dir or tempfile.mkdtemp(prefix="factorvae_profile_")
+    os.makedirs(log_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:
+        raise ProfilerError(f"jax.profiler failed to start: {e}") from e
+    _ACTIVE["dir"] = log_dir
+    return log_dir
+
+
+def stop_profile(top: int = 10) -> dict:
+    """End the active capture and summarize it: {"log_dir", "files",
+    "total_us", "host_us", "top": [[name, us, count], ...]} via the
+    existing trace_summary machinery."""
+    log_dir = _ACTIVE["dir"]
+    if log_dir is None:
+        raise ProfilerError(
+            "no profile capture is running; POST "
+            "{\"action\": \"start\"} first")
+    _ACTIVE["dir"] = None
+    try:
+        jax.profiler.stop_trace()
+    except Exception as e:
+        raise ProfilerError(f"jax.profiler failed to stop: {e}") from e
+    return {"log_dir": log_dir, **summarize_capture(log_dir, top=top)}
+
+
+def summarize_capture(log_dir: str, top: int = 10) -> dict:
+    """Guarded trace_summary digest of a capture dir — profiling is
+    telemetry, so an unreadable trace degrades to an `error` field,
+    never an exception on the serving/training path."""
+    from factorvae_tpu.utils.trace_summary import summarize_trace
+
+    try:
+        s = summarize_trace(log_dir, top=top)
+    except Exception as e:
+        return {"files": 0, "error": str(e)}
+    return {
+        "files": len(s["files"]),
+        "total_us": round(s["total_us"], 3),
+        "host_us": round(s.get("host_us", 0.0), 3),
+        "top": [[name, round(us, 3), count]
+                for name, us, count in s["by_name"]],
+    }
+
+
+def poll_profile_request(run_dir: Optional[str]) -> Optional[dict]:
+    """Consume a PROFILE_REQUEST drop-in from `run_dir`: returns its
+    JSON body ({} for an empty/garbled file — the request still
+    counts) and removes the file, or None when absent."""
+    if not run_dir:
+        return None
+    path = os.path.join(run_dir, PROFILE_REQUEST_BASENAME)
+    if not os.path.exists(path):
+        return None
+    req: dict = {}
+    try:
+        with open(path) as fh:
+            body = fh.read().strip()
+        if body:
+            parsed = json.loads(body)
+            if isinstance(parsed, dict):
+                req = parsed
+    except (OSError, ValueError):
+        req = {}  # an unreadable request is still a request
+    try:
+        os.remove(path)
+    except OSError:
+        pass  # already consumed by a sibling process — capture anyway
+    return req
+
+
+@contextlib.contextmanager
+def maybe_profile_epoch(run_dir: Optional[str],
+                        epoch: int) -> Iterator[Tuple[bool, Optional[str]]]:
+    """The trainer's epoch-boundary hook: when `run_dir` carries a
+    PROFILE_REQUEST file, run the epoch body under a `jax.profiler`
+    capture into the request's `log_dir` (default:
+    `<run_dir>/profile_epoch<e>`) and yield (True, log_dir); otherwise
+    (False, None) with zero added work beyond the existence poll.
+
+    The capture start is GUARDED — telemetry never aborts the epoch
+    loop: a profiler that refuses to start (a `--profile` whole-run
+    trace already active, an unwritable log_dir) yields
+    (False, "<error message>") and the epoch runs unprofiled (the
+    request file is consumed either way; the caller logs the error)."""
+    req = poll_profile_request(run_dir)
+    if req is None:
+        yield False, None
+        return
+    log_dir = str(req.get("log_dir") or os.path.join(
+        run_dir, f"profile_epoch{int(epoch)}"))
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:
+        yield False, f"profile capture failed to start: {e}"
+        return
+    try:
+        yield True, log_dir
+    finally:
+        # a failed stop leaves no trace files — summarize_capture then
+        # reports files=0, which is how the failure surfaces
+        with contextlib.suppress(Exception):
+            jax.profiler.stop_trace()
 
 
 def step_annotation(name: str):

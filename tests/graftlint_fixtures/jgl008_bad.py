@@ -1,0 +1,28 @@
+"""JGL008 seeded violation: durations measured on the wall clock.
+
+Analyzed (tests/test_analysis.py) under a synthetic
+`factorvae_tpu/...` path — the rule keys on the module's location.
+Expected: 2 findings (the epoch-loop delta and the inline delta); the
+timestamp use in `record()` is exempt (no subtraction).
+"""
+
+import time
+
+
+def train_epochs(trainer, epochs, logger):
+    for epoch in range(epochs):
+        t0 = time.time()
+        loss = trainer.step(epoch)
+        # BAD: wall-clock duration — an NTP step mid-epoch corrupts it
+        dt = time.time() - t0
+        logger.log("epoch", epoch=epoch, loss=loss, seconds=dt)
+
+
+def request_wall(handler, request, started):
+    # BAD: inline wall-clock delta on the request path
+    return handler(request), time.time() - started
+
+
+def record(logger, event, **fields):
+    # exempt: a timestamp never subtracts — that IS the wall clock's job
+    logger.log(event, ts=time.time(), **fields)

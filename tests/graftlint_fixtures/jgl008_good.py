@@ -1,0 +1,20 @@
+"""JGL008 corrected twin: durations on the monotonic clock
+(`time.perf_counter`, the Timeline contract); `time.time()` kept only
+where it belongs — record timestamps."""
+
+import time
+
+
+def train_epochs(trainer, epochs, logger):
+    for epoch in range(epochs):
+        t0 = time.perf_counter()
+        loss = trainer.step(epoch)
+        # GOOD: monotonic delta, immune to wall-clock jumps
+        dt = time.perf_counter() - t0
+        logger.log("epoch", epoch=epoch, loss=loss, seconds=dt,
+                   ts=time.time())
+
+
+def request_wall(handler, request, started):
+    # GOOD: the caller measured `started` on perf_counter too
+    return handler(request), time.perf_counter() - started

@@ -456,6 +456,69 @@ class TestJGL007:
         assert [f.rule for f in findings if f.suppressed] == ["JGL007"]
 
 
+class TestJGL008:
+    """Wall-clock duration measurement in library code (ISSUE 10;
+    path-keyed like JGL006/7): `time.time()` participating in a
+    subtraction — directly or through an assigned name — must be
+    monotonic `time.perf_counter` (the Timeline contract); timestamp
+    uses never subtract and stay exempt."""
+
+    def _analyze(self, fixture, path):
+        with open(_fixture(fixture)) as fh:
+            return analyze_source(fh.read(), path)
+
+    def test_fires_on_seeded_violations(self):
+        findings = _active(self._analyze(
+            "jgl008_bad.py", "factorvae_tpu/train/newmod.py"))
+        hits = [f for f in findings if f.rule == "JGL008"]
+        assert len(hits) == 2, [(f.line, f.message) for f in findings]
+        assert _rules(findings) == ["JGL008"]  # no cross-rule noise
+
+    def test_silent_on_corrected_twin(self):
+        assert _active(self._analyze(
+            "jgl008_good.py", "factorvae_tpu/train/newmod.py")) == []
+
+    def test_timestamps_are_exempt(self):
+        # the MetricsLogger `ts` field / checkpoint `created` stamps:
+        # a wall-clock read that never subtracts is what the wall
+        # clock is FOR
+        src = ("import time\n"
+               "def log(logger, event, **fields):\n"
+               "    rec = {'ts': time.time(), 'event': event, **fields}\n"
+               "    logger.write(rec)\n"
+               "    created = round(time.time(), 3)\n"
+               "    return created\n")
+        assert _active(analyze_source(
+            src, "factorvae_tpu/utils/newmod.py")) == []
+
+    def test_tracked_name_subtraction_fires(self):
+        # the deferred form: t0 bound from time.time(), subtracted later
+        src = ("import time\n"
+               "def f(fn):\n"
+               "    t0 = time.time()\n"
+               "    fn()\n"
+               "    return time.perf_counter() - t0\n")
+        findings = _active(analyze_source(
+            src, "factorvae_tpu/train/newmod.py"))
+        assert [f.rule for f in findings] == ["JGL008"]
+
+    def test_outside_library_paths_is_exempt(self):
+        # bench.py / scripts own their clocks
+        assert _active(self._analyze(
+            "jgl008_bad.py", "scripts/some_driver.py")) == []
+        assert _active(analyze_paths([_fixture("jgl008_bad.py")])) == []
+
+    def test_trainer_duration_sites_are_monotonic(self):
+        """The audit half of the satellite: the epoch loops' duration
+        measurements (the sites this rule was written against) now
+        read perf_counter — pinned so a revert re-flags."""
+        for mod in ("train/trainer.py", "train/fleet.py"):
+            with open(os.path.join(REPO, "factorvae_tpu", mod)) as fh:
+                src = fh.read()
+            assert "t0 = time.time()" not in src, mod
+            assert "time.perf_counter() - t0" in src, mod
+
+
 # ---------------------------------------------------------------------------
 # tier-1 gates
 

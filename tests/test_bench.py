@@ -90,6 +90,13 @@ def test_bench_obs_overhead_contract():
     assert isinstance(rec["probe_overhead_frac"], float)
     assert rec["probe_overhead_frac"] < 1.0
     assert rec["probe_overhead_ok"] == (rec["probe_overhead_frac"] <= 0.05)
+    # the live-follower A/B (ISSUE 10): both rates present, the
+    # overhead fraction recorded as measured (sandbox noise and all)
+    assert rec["windows_per_sec_live_off"] > 0
+    assert rec["windows_per_sec_live_on"] > 0
+    assert isinstance(rec["live_overhead_frac"], float)
+    assert rec["live_overhead_frac"] < 1.0
+    assert rec["live_overhead_ok"] == (rec["live_overhead_frac"] <= 0.05)
     assert rec["plan"]["provenance"] in ("measured", "default")
 
 

@@ -1,0 +1,784 @@
+"""Live telemetry plane (ISSUE 10): streaming run monitor, /metrics
+exposition, on-demand profiling, served-score drift.
+
+The contracts pinned here are the PR's acceptance bar:
+
+- **Torn-line follower**: a partially-written final line (writer mid-
+  append) never emits; it emits exactly once when the newline lands.
+- **Consistency pin**: the live monitor's final flag set over an
+  in-flight stream — bytes arriving in arbitrary chunks while the
+  follower reads — is IDENTICAL (same flags, same record identities,
+  same details) to `obs.report` run post-hoc on the completed stream,
+  for train, fleet, and serve streams.
+- **/metrics**: a running `serve_http` daemon scrapes as valid
+  Prometheus text exposition carrying the latency histogram and
+  breaker/health gauges; `/stats` and `/models` carry run_meta
+  provenance.
+- **Drift**: day-over-day rank-correlation collapse emits a
+  `score_drift` mark that `obs.report`/`obs.live` flag.
+- **On-demand profiling**: `POST /profile` start/stop round-trips with
+  a trace summary; the trainer's PROFILE_REQUEST epoch hook captures
+  and logs.
+- **Bitwise discipline**: with no exporter installed and no profile
+  request, the epoch path runs the pre-PR code (the hooks are `is
+  None` checks / one exists() on metric-stream runs only) — covered
+  structurally here and by the standing obs-off neutrality pins in
+  tests/test_obs.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+from factorvae_tpu.obs.live import LiveMonitor, follow_run, iter_lines
+from factorvae_tpu.obs.report import build_report
+from factorvae_tpu.obs.timeline import load_run
+from factorvae_tpu.utils.logging import (
+    MetricsLogger,
+    Timeline,
+    install_timeline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(num_features=6, hidden_size=8, num_factors=4,
+            num_portfolios=8, seq_len=5)
+
+
+def tiny_cfg(seed: int = 0) -> Config:
+    return Config(
+        model=ModelConfig(stochastic_inference=False, **TINY),
+        data=DataConfig(seq_len=TINY["seq_len"], start_time=None,
+                        fit_end_time=None, val_start_time=None,
+                        val_end_time=None),
+        train=TrainConfig(seed=seed),
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_ds():
+    panel = synthetic_panel_dense(num_days=16, num_instruments=12,
+                                  num_features=TINY["num_features"])
+    return PanelDataset(panel, seq_len=TINY["seq_len"])
+
+
+@pytest.fixture(scope="module")
+def registry_two(tiny_ds):
+    from factorvae_tpu.models.factorvae import load_model
+    from factorvae_tpu.serve.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    for s in (0, 1):
+        cfg = tiny_cfg(seed=s)
+        params = load_model(cfg, n_max=tiny_ds.n_max)[1]
+        reg.register_params(params, cfg, alias=f"seed{s}")
+    return reg
+
+
+def epoch(e, train=1.0, val=1.0, dps=10.0, **kw):
+    return {"ts": 0.0, "event": "epoch", "epoch": e, "train_loss": train,
+            "val_loss": val, "lr": 1e-4, "days_per_sec": dps, **kw}
+
+
+def write_run(tmp_path, records, name="RUN.jsonl"):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    return str(p)
+
+
+# ---------------------------------------------------------------------------
+# torn-line / mid-write follower behavior (satellite)
+
+
+class TestTornLines:
+    def _drain(self, path, **kw):
+        return list(iter_lines(path, follow=False, **kw))
+
+    def test_partial_final_line_never_emits(self, tmp_path):
+        p = tmp_path / "RUN.jsonl"
+        rec = json.dumps(epoch(0))
+        torn = json.dumps(epoch(1, train=float("nan")))[:17]
+        p.write_text(rec + "\n" + torn)  # writer killed mid-append
+        got = self._drain(str(p))
+        assert got == [(0, rec)]
+
+    def test_completed_line_emits_exactly_once(self, tmp_path):
+        """Writer appends while the follower reads: the torn tail is
+        buffered across polls and yields once, whole, when its newline
+        lands — never a corrupt alert from the prefix."""
+        p = tmp_path / "RUN.jsonl"
+        first = json.dumps(epoch(0))
+        second = json.dumps(epoch(1, train=float("nan")))
+        with open(p, "w") as fh:
+            fh.write(first + "\n" + second[:11])
+            fh.flush()
+            got = []
+            done = threading.Event()
+
+            def tail():
+                for item in iter_lines(str(p), follow=True, poll_s=0.01,
+                                       stop=done.is_set):
+                    got.append(item)
+
+            t = threading.Thread(target=tail, daemon=True)
+            t.start()
+            deadline = time.time() + 5
+            while not got and time.time() < deadline:
+                time.sleep(0.01)
+            assert got == [(0, first)]  # the torn tail did not emit
+            fh.write(second[11:] + "\n")
+            fh.flush()
+            deadline = time.time() + 5
+            while len(got) < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            done.set()
+            t.join(timeout=5)
+        assert got == [(0, first), (1, second)]
+        assert json.loads(got[1][1])["epoch"] == 1
+
+    def test_mid_write_never_yields_a_corrupt_flag(self, tmp_path):
+        """A monitor fed the torn prefix of a NaN record must not flag
+        it (the prefix isn't a record); completing the line flags it
+        once, with the post-hoc identity."""
+        p = tmp_path / "RUN.jsonl"
+        bad = json.dumps(epoch(1, train=float("nan")))
+        p.write_text(json.dumps(epoch(0)) + "\n" + bad[:25])
+        mon = LiveMonitor()
+        for i, line in iter_lines(str(p), follow=False):
+            mon.add_line(i, line)
+        new, _ = mon.update()
+        assert new == []
+        with open(p, "a") as fh:
+            fh.write(bad[25:] + "\n")
+        # replay the completed stream into the same monitor shape the
+        # follower would have (the torn tail was never consumed)
+        mon2 = follow_run(str(p), follow=False)
+        flags = mon2.current_flags()
+        assert [f["flag"] for f in flags] == ["nonfinite"]
+        assert flags == build_report(load_run(str(p)))["flags"]
+
+    def test_blank_and_garbage_lines_are_skipped_not_fatal(self, tmp_path):
+        p = tmp_path / "RUN.jsonl"
+        p.write_text("\n".join([json.dumps(epoch(0)), "", "not json",
+                                json.dumps(epoch(1))]) + "\n")
+        mon = follow_run(str(p), follow=False)
+        assert mon.acc.records == 2 and mon.acc.bad == 1
+
+
+# ---------------------------------------------------------------------------
+# the consistency pin: live == post-hoc, for train / fleet / serve
+
+
+def replay_inflight(src: str, dst: str, chunk: int = 37,
+                    **report_kw) -> LiveMonitor:
+    """Copy `src` into `dst` a few bytes at a time (torn intermediate
+    states guaranteed) while a follower tails `dst`; return the
+    follower's monitor after the writer finishes."""
+    data = open(src, "rb").read()
+    done = threading.Event()
+
+    def write():
+        try:
+            with open(dst, "wb", buffering=0) as fh:
+                for i in range(0, len(data), chunk):
+                    fh.write(data[i:i + chunk])
+                    time.sleep(0.001)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=write, daemon=True)
+    t.start()
+    mon = follow_run(dst, follow=True, poll_s=0.01, stop=done.is_set,
+                     **report_kw)
+    t.join(timeout=10)
+    return mon
+
+
+def assert_pin(src: str, tmp_path, name: str, **report_kw):
+    dst = str(tmp_path / f"live_{name}.jsonl")
+    mon = replay_inflight(src, dst, **report_kw)
+    post = build_report(load_run(src), **report_kw)
+    assert mon.current_flags() == post["flags"]
+    assert open(dst, "rb").read() == open(src, "rb").read()
+    return mon, post
+
+
+class TestConsistencyPin:
+    def test_train_stream(self, tmp_path):
+        """A real (tiny) training run's stream plus appended hazard
+        records: nonfinite + a recovery mark + a drift mark — the live
+        follower over the in-flight bytes lands exactly the post-hoc
+        report's flags."""
+        from factorvae_tpu.data import synthetic_panel
+        from factorvae_tpu.train import Trainer
+
+        run = str(tmp_path / "TRAIN.jsonl")
+        panel = synthetic_panel(num_days=20, num_instruments=6,
+                                num_features=8, missing_prob=0.2, seed=0)
+        ds = PanelDataset(panel, seq_len=5)
+        cfg = Config(
+            model=ModelConfig(num_features=8, hidden_size=8,
+                              num_factors=4, num_portfolios=6,
+                              seq_len=5),
+            data=DataConfig(seq_len=5, start_time=None,
+                            fit_end_time=str(ds.dates[12].date()),
+                            val_start_time=str(ds.dates[13].date()),
+                            val_end_time=str(ds.dates[-1].date())),
+            train=TrainConfig(num_epochs=2, lr=1e-3, seed=0,
+                              save_dir=str(tmp_path / "m"),
+                              checkpoint_every=0, days_per_step=2,
+                              obs_probes=True),
+        )
+        lg = MetricsLogger(jsonl_path=run, echo=False, run_name="t")
+        prev = install_timeline(Timeline(lg))
+        try:
+            Trainer(cfg, ds, logger=lg).fit()
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        with open(run, "a") as fh:
+            fh.write(json.dumps(epoch(0, train=float("nan"))) + "\n")
+            fh.write(json.dumps(
+                {"event": "mark", "name": "stream_retry", "cat":
+                 "recovery", "resource": "stream", "t": 1.0,
+                 "chunk": 2, "attempt": 1}) + "\n")
+        mon, post = assert_pin(run, tmp_path, "train")
+        kinds = {f["flag"] for f in mon.current_flags()}
+        assert {"nonfinite", "retry"} <= kinds
+
+    def test_fleet_stream(self, tmp_path):
+        """A fleet-shaped stream (per-seed lists, one bad lane, a
+        skip_step record, a compile storm) pins live == post-hoc."""
+        def fleet(e, val1, **kw):
+            return {"event": "fleet_epoch", "epoch": e,
+                    "train_loss": [1.0, 1.0], "val_loss": [0.9, val1],
+                    "seed_days_per_sec": 10.0, **kw}
+
+        recs = [
+            {"event": "run_meta", "platform": "cpu"},
+            fleet(0, 0.9), fleet(1, 0.8),
+            fleet(2, 1.5), fleet(3, 1.5), fleet(4, 1.5),
+            fleet(5, 1.5, skipped_steps=[0.0, 2.0]),
+            {"event": "compile", "fn": "train_epoch", "wall_s": 0.5,
+             "compiles": 5},
+            {"event": "mark", "name": "retrace_storm",
+             "fn": "train_epoch", "compiles": 5, "calls": 6, "t": 2.0},
+        ]
+        src = write_run(tmp_path, recs, name="FLEET.jsonl")
+        mon, post = assert_pin(src, tmp_path, "fleet")
+        kinds = {f["flag"] for f in mon.current_flags()}
+        assert {"val_divergence", "skip_step", "compile_storm"} <= kinds
+        assert any("seed lane 1" in f["detail"]
+                   for f in mon.current_flags())
+
+    def test_serve_stream(self, registry_two, tiny_ds, tmp_path):
+        """A real serving stream — request/dispatch spans, compile
+        records from the scoring jits, and score_drift marks from the
+        drift monitor (threshold 2.0 makes every day-over-day pair
+        'drift') — pins live == post-hoc."""
+        from factorvae_tpu.serve.daemon import ScoringDaemon
+
+        run = str(tmp_path / "SERVE.jsonl")
+        lg = MetricsLogger(jsonl_path=run, echo=False, run_name="serve")
+        prev = install_timeline(Timeline(lg))
+        try:
+            daemon = ScoringDaemon(registry_two, tiny_ds,
+                                   drift_threshold=2.0)
+            for day in (0, 1, 2):
+                out = daemon.handle({"model": "seed0", "day": day})
+                assert out["ok"], out
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        mon, post = assert_pin(run, tmp_path, "serve")
+        kinds = [f["flag"] for f in mon.current_flags()]
+        assert kinds.count("score_drift") == 2  # days 1 and 2
+        run_parsed = load_run(run)
+        assert any(m.get("name") == "score_digest"
+                   for m in run_parsed["marks"])
+
+
+# ---------------------------------------------------------------------------
+# alert-stream semantics
+
+
+class TestAlertStream:
+    def test_new_then_resolved(self, tmp_path):
+        """A retrospective flag can dissolve as the baseline moves: the
+        monitor says so with a `resolved` alert instead of silently
+        disagreeing with the final report."""
+        mon = LiveMonitor()
+        recs = [epoch(e, dps=10.0) for e in range(3)] + [epoch(3, dps=2.0)]
+        for i, r in enumerate(recs):
+            mon.add_line(i, json.dumps(r))
+        new, resolved = mon.update()
+        assert [f["flag"] for f in new] == ["slow_epoch"] and not resolved
+        # three more slow epochs drag the run median down to 2.0 — the
+        # early flag dissolves (and the post-hoc report agrees)
+        more = [epoch(4 + k, dps=2.0) for k in range(3)]
+        for j, r in enumerate(more):
+            mon.add_line(len(recs) + j, json.dumps(r))
+        new, resolved = mon.update()
+        assert [f["flag"] for f in resolved] == ["slow_epoch"]
+        src = write_run(tmp_path, recs + more)
+        assert mon.current_flags() == build_report(load_run(src))["flags"]
+
+    def test_two_same_kind_flags_on_one_record_both_alert(self, tmp_path):
+        """One record can carry several same-kind flags (NaN loss AND
+        a nonfinite probe counter): the alert identity must keep them
+        distinct — the post-hoc report has two, so the live monitor
+        must surface two."""
+        rec = epoch(0, train=float("nan"), nonfinite_grads=3.0)
+        mon = LiveMonitor()
+        mon.add_line(0, json.dumps(rec))
+        new, resolved = mon.update()
+        assert [f["flag"] for f in new] == ["nonfinite", "nonfinite"]
+        assert not resolved
+        src = write_run(tmp_path, [rec])
+        post = build_report(load_run(src))["flags"]
+        assert len(post) == 2 and mon.current_flags() == post
+        # recomputing over the same stream churns nothing
+        assert mon.update() == ([], [])
+
+    def test_cli_json_contract(self, tmp_path, capsys):
+        from factorvae_tpu.obs.live import main
+
+        path = write_run(tmp_path, [epoch(0), epoch(1,
+                                                    train=float("nan"))])
+        assert main([path, "--json"]) == 0
+        lines = [json.loads(x) for x in
+                 capsys.readouterr().out.splitlines()]
+        alerts = [x for x in lines if x["event"] == "alert"]
+        assert alerts and alerts[0]["status"] == "new"
+        assert alerts[0]["flag"] == "nonfinite"
+        summary = lines[-1]
+        assert summary["event"] == "summary"
+        assert summary["flag_counts"] == {"nonfinite": 1}
+
+    def test_cli_stream_sanity(self, tmp_path, capsys):
+        from factorvae_tpu.obs.live import main
+
+        assert main([str(tmp_path / "missing.jsonl")]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert main([str(empty)]) == 2
+        garbage = tmp_path / "garbage.jsonl"
+        garbage.write_text("not json\nstill not\n")
+        assert main([str(garbage)]) == 2
+        err = capsys.readouterr().err
+        assert "error:" in err and "Traceback" not in err
+
+    def test_report_and_timeline_follow_delegate(self, tmp_path, capsys):
+        """The satellite: one CLI for in-flight and finished runs —
+        `--follow` on report/timeline routes through the live
+        follower (idle-timeout bounds the tail on a finished file)."""
+        from factorvae_tpu.obs.report import main as report_main
+        from factorvae_tpu.obs.timeline import main as timeline_main
+
+        path = write_run(tmp_path, [epoch(0),
+                                    epoch(1, train=float("nan"))])
+        rc = report_main([path, "--follow", "--idle-timeout", "0.05"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "ALERT" in out and "nonfinite" in out
+        rc = timeline_main([path, "--follow", "--idle-timeout", "0.05",
+                            "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert any(json.loads(x)["event"] == "alert"
+                   for x in out.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# drift primitives
+
+
+class TestDrift:
+    def test_rank_correlation(self):
+        from factorvae_tpu.obs.drift import rank_correlation
+
+        assert rank_correlation([1, 2, 3, 4], [2, 3, 4, 5]) == 1.0
+        assert rank_correlation([1, 2, 3, 4], [4, 3, 2, 1]) == -1.0
+        # ties get average ranks (no arbitrary argsort tiebreak)
+        assert rank_correlation([1, 1, 2], [1, 1, 2]) == 1.0
+        assert rank_correlation([1, 2], [2, 1]) is None  # too few
+        assert rank_correlation([1, 1, 1], [1, 2, 3]) is None  # const
+        assert rank_correlation(
+            [1, float("nan"), 2, 3, 4], [2, 5, 3, 4, 5]) == 1.0
+
+    def test_digest_shape(self):
+        from factorvae_tpu.obs.drift import score_digest
+
+        d = score_digest(np.array([1.0, 2.0, 3.0, float("nan")]))
+        assert d["n"] == 3 and d["p50"] == 2.0
+        empty = score_digest(np.array([float("nan")]))
+        assert empty["n"] == 0 and empty["mean"] is None
+
+    def test_monitor_emits_marks_and_dedups(self, tmp_path):
+        from factorvae_tpu.obs.drift import ScoreDriftMonitor
+
+        run = str(tmp_path / "RUN.jsonl")
+        names = [f"s{i}" for i in range(10)]
+        up = np.arange(10.0)
+        with MetricsLogger(jsonl_path=run, echo=False) as lg:
+            prev = install_timeline(Timeline(lg))
+            try:
+                m = ScoreDriftMonitor(threshold=0.5)
+                m.observe("k", 0, names, up, alias="a")
+                m.observe("k", 0, names, up)       # repeat day: no-op
+                m.observe("k", 1, names, up)       # corr 1.0: clean
+                m.observe("k", 2, names, -up)      # corr -1.0: drift
+            finally:
+                install_timeline(prev)
+        st = m.stats()["k"]
+        assert st["days_digested"] == 3
+        assert st["last_rank_corr"] == -1.0 and st["drift_events"] == 1
+        run_d = load_run(run)
+        digests = [x for x in run_d["marks"]
+                   if x.get("name") == "score_digest"]
+        drifts = [x for x in run_d["marks"]
+                  if x.get("name") == "score_drift"]
+        assert len(digests) == 3 and len(drifts) == 1
+        assert drifts[0]["rank_corr"] == -1.0
+        rep = build_report(run_d)
+        assert [f["flag"] for f in rep["flags"]] == ["score_drift"]
+        assert "rank corr -1.000" in rep["flags"][0]["detail"]
+
+    def test_min_overlap_gates_the_correlation(self):
+        from factorvae_tpu.obs.drift import ScoreDriftMonitor
+
+        m = ScoreDriftMonitor(threshold=0.5, min_overlap=8)
+        m.observe("k", 0, ["a", "b", "c"], np.arange(3.0))
+        m.observe("k", 1, ["a", "b", "c"], -np.arange(3.0))
+        assert m.stats()["k"]["last_rank_corr"] is None
+        assert m.stats()["k"]["drift_events"] == 0
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition + run_meta provenance + /profile
+
+
+# sample line: name{labels} value  — or  name value
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (NaN|[+-]?Inf|[-+0-9.e]+)$')
+
+
+def assert_valid_exposition(text: str) -> dict:
+    """Minimal format check + sample extraction: every non-comment
+    line is `name{labels} value`, every sample's family has HELP/TYPE
+    headers. Returns {name: [(labels_str, value_str)]}."""
+    seen_type: dict = {}
+    samples: dict = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            if line.startswith("# TYPE "):
+                _, _, name, typ = line.split(" ", 3)
+                seen_type[name] = typ
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"invalid exposition line: {line!r}"
+        base = line.split("{")[0].split(" ")[0]
+        fam = base
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and \
+                    base[: -len(suffix)] in seen_type:
+                fam = base[: -len(suffix)]
+        assert fam in seen_type, f"sample without TYPE header: {line!r}"
+        samples.setdefault(base, []).append(line)
+    return samples
+
+
+class TestMetricsExposition:
+    def test_daemon_metrics_text(self, registry_two, tiny_ds):
+        from factorvae_tpu.obs.metrics import daemon_metrics
+        from factorvae_tpu.serve.daemon import ScoringDaemon
+
+        daemon = ScoringDaemon(registry_two, tiny_ds)
+        for day in (0, 1):
+            assert daemon.handle({"model": "seed0", "day": day})["ok"]
+        text = daemon_metrics(daemon)
+        samples = assert_valid_exposition(text)
+        p = "factorvae"
+        assert metric_value(
+            samples, f"{p}_serve_requests_total") == 2.0
+        # the histogram saw every scoring request
+        assert metric_value(
+            samples,
+            f"{p}_serve_request_latency_seconds_count") == 2.0
+        assert f"{p}_serve_request_latency_seconds_bucket" in samples
+        assert metric_value(samples, f"{p}_serve_health_status") == 0.0
+        assert metric_value(samples, f"{p}_registry_models") == 2.0
+        assert any('alias="seed0"' in s
+                   for s in samples[f"{p}_model_requests_total"])
+        # compile taxonomy lines always present (0 without a timeline)
+        assert any('kind="compile_cached"' in s
+                   for s in samples[f"{p}_compile_total"])
+
+    def test_breaker_gauge_reflects_open_state(self, tiny_ds):
+        from factorvae_tpu.models.factorvae import load_model
+        from factorvae_tpu.obs.metrics import daemon_metrics
+        from factorvae_tpu.serve.daemon import ScoringDaemon
+        from factorvae_tpu.serve.registry import ModelRegistry
+
+        reg = ModelRegistry()
+        cfg = tiny_cfg(seed=3)
+        key = reg.register_params(load_model(cfg, n_max=tiny_ds.n_max)[1],
+                                  cfg, alias="sick")
+        daemon = ScoringDaemon(reg, tiny_ds, breaker_k=1,
+                               breaker_cooldown_s=60.0)
+        entry = reg.get(key)
+        daemon._breaker_record(entry, False)  # opens at k=1
+        samples = assert_valid_exposition(daemon_metrics(daemon))
+        line = samples["factorvae_breaker_open"][0]
+        assert line.endswith(" 1") and key in line
+
+    def test_exporter_writes_atomic_textfile(self, tmp_path):
+        from factorvae_tpu.obs.metrics import (
+            TextfileExporter,
+            export_epoch_metrics,
+            install_exporter,
+        )
+
+        path = tmp_path / "train.prom"
+        prev = install_exporter(TextfileExporter(str(path)))
+        try:
+            export_epoch_metrics(dict(epoch=0, train_loss=1.5,
+                                      val_loss=[2.0, 3.0], step=7,
+                                      days_per_sec=10.0))
+        finally:
+            install_exporter(prev)
+        text = path.read_text()
+        samples = assert_valid_exposition(text)
+        assert metric_value(samples, "factorvae_train_train_loss") == 1.5
+        assert metric_value(samples, "factorvae_train_epoch") == 0.0
+        # fleet lanes carry seed_lane labels
+        lanes = samples["factorvae_train_val_loss"]
+        assert ['seed_lane="0"' in s or 'seed_lane="1"' in s
+                for s in lanes] == [True, True]
+        assert not os.path.exists(str(path) + ".tmp")
+
+    def test_exporter_uninstalled_is_noop(self):
+        from factorvae_tpu.obs.metrics import (
+            current_exporter,
+            export_epoch_metrics,
+        )
+
+        assert current_exporter() is None
+        export_epoch_metrics({"epoch": 0})  # must not raise or write
+
+    def test_trainer_epoch_loop_feeds_exporter(self, tmp_path):
+        from factorvae_tpu.data import synthetic_panel
+        from factorvae_tpu.obs.metrics import (
+            TextfileExporter,
+            install_exporter,
+        )
+        from factorvae_tpu.train import Trainer
+
+        panel = synthetic_panel(num_days=16, num_instruments=6,
+                                num_features=8, missing_prob=0.2,
+                                seed=1)
+        ds = PanelDataset(panel, seq_len=5)
+        cfg = Config(
+            model=ModelConfig(num_features=8, hidden_size=8,
+                              num_factors=4, num_portfolios=6,
+                              seq_len=5),
+            data=DataConfig(seq_len=5, start_time=None,
+                            fit_end_time=None, val_start_time=None,
+                            val_end_time=None),
+            train=TrainConfig(num_epochs=2, seed=0,
+                              save_dir=str(tmp_path / "m"),
+                              checkpoint_every=0, days_per_step=2),
+        )
+        exp = TextfileExporter(str(tmp_path / "train.prom"))
+        prev = install_exporter(exp)
+        try:
+            Trainer(cfg, ds, logger=MetricsLogger(echo=False)).fit()
+        finally:
+            install_exporter(prev)
+        assert exp.epochs == 2
+        samples = assert_valid_exposition(
+            (tmp_path / "train.prom").read_text())
+        assert metric_value(samples, "factorvae_train_epoch") == 1.0
+        assert "factorvae_train_days_per_sec" in samples
+
+
+def metric_value(samples: dict, name: str) -> float:
+    lines = samples[name]
+    assert len(lines) == 1, lines
+    return float(lines[0].rsplit(" ", 1)[1])
+
+
+class TestHTTPLiveSurface:
+    @pytest.fixture()
+    def http_daemon(self, registry_two, tiny_ds):
+        import socket
+
+        from factorvae_tpu.serve.daemon import ScoringDaemon, serve_http
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        daemon = ScoringDaemon(registry_two, tiny_ds,
+                               drift_threshold=2.0)
+        t = threading.Thread(target=serve_http, args=(daemon, port),
+                             daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(base + "/healthz", timeout=1)
+                break
+            except OSError:
+                time.sleep(0.05)
+        yield daemon, base
+        daemon.handle({"cmd": "shutdown"})
+        try:  # one last request unblocks the accept loop promptly
+            urllib.request.urlopen(base + "/healthz", timeout=1)
+        except OSError:
+            pass
+        t.join(timeout=5)
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(), method="POST")
+        try:
+            return json.loads(urllib.request.urlopen(req).read())
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read())
+
+    def test_scrape_during_serving(self, http_daemon):
+        """The acceptance scrape: `curl /metrics` against a RUNNING
+        serve_http daemon returns valid exposition with the latency
+        histogram and breaker/health gauges; /stats and /models carry
+        run_meta provenance."""
+        daemon, base = http_daemon
+        for day in (0, 1):
+            resp = self._post(base + "/score",
+                              {"model": "seed0", "day": day})
+            assert resp["ok"], resp
+        raw = urllib.request.urlopen(base + "/metrics")
+        assert raw.headers["Content-Type"].startswith("text/plain")
+        samples = assert_valid_exposition(raw.read().decode())
+        p = "factorvae"
+        assert metric_value(
+            samples, f"{p}_serve_request_latency_seconds_count") >= 2
+        assert f"{p}_serve_health_status" in samples
+        assert f"{p}_serve_health_error_rate" in samples
+        assert f"{p}_compile_total" in samples
+        # drift rode along (threshold 2.0: day 1 vs 0 always 'drifts')
+        assert f"{p}_score_drift_total" in samples
+        stats = json.loads(
+            urllib.request.urlopen(base + "/stats").read())
+        assert stats["run_meta"]["run_name"] == "serve"
+        assert "env" in stats["run_meta"]
+        assert stats["ticks"] >= 2 and "drift" in stats
+        models = json.loads(
+            urllib.request.urlopen(base + "/models").read())
+        assert "run_meta" in models and len(models["models"]) == 2
+
+    def test_profile_round_trip(self, http_daemon, tmp_path):
+        daemon, base = http_daemon
+        log_dir = str(tmp_path / "cap")
+        r = self._post(base + "/profile",
+                       {"action": "start", "log_dir": log_dir})
+        assert r["ok"] and r["log_dir"] == log_dir
+        # starting twice is a 409-style explicit error, not a crash
+        r2 = self._post(base + "/profile", {"action": "start"})
+        assert not r2["ok"] and "already" in r2["error"]
+        assert self._post(base + "/score",
+                          {"model": "seed1", "day": 3})["ok"]
+        r3 = self._post(base + "/profile", {"action": "stop"})
+        assert r3["ok"] and r3["log_dir"] == log_dir
+        assert r3["files"] >= 1 and r3["total_us"] >= 0
+        r4 = self._post(base + "/profile", {"action": "stop"})
+        assert not r4["ok"] and "no profile capture" in r4["error"]
+        r5 = self._post(base + "/profile", {"bogus": 1})
+        assert not r5["ok"] and "action" in r5["error"]
+
+
+# ---------------------------------------------------------------------------
+# trainer epoch-boundary profiling hook
+
+
+class TestEpochProfileHook:
+    def test_poll_consumes_request_file(self, tmp_path):
+        from factorvae_tpu.utils.profiling import poll_profile_request
+
+        assert poll_profile_request(None) is None
+        assert poll_profile_request(str(tmp_path)) is None
+        req = tmp_path / "PROFILE_REQUEST"
+        req.write_text("")
+        assert poll_profile_request(str(tmp_path)) == {}
+        assert not req.exists()
+        req.write_text(json.dumps({"log_dir": "/x"}))
+        assert poll_profile_request(str(tmp_path)) == {"log_dir": "/x"}
+        req.write_text("garbled {")
+        assert poll_profile_request(str(tmp_path)) == {}
+
+    def test_capture_start_failure_degrades_not_raises(self, tmp_path):
+        """A PROFILE_REQUEST while a whole-run `--profile` trace is
+        already active must not kill the run: the hook yields
+        (False, <error>) — the epoch runs unprofiled and the caller
+        logs the failure — and the request file is still consumed."""
+        from factorvae_tpu.utils.profiling import (
+            maybe_profile_epoch,
+            trace,
+        )
+
+        (tmp_path / "PROFILE_REQUEST").write_text("")
+        with trace(str(tmp_path / "outer")):
+            with maybe_profile_epoch(str(tmp_path), 0) as (prof, info):
+                assert prof is False
+                assert info and "failed to start" in info
+        assert not (tmp_path / "PROFILE_REQUEST").exists()
+
+    def test_trainer_captures_on_request(self, tmp_path):
+        """The epoch-boundary hook end to end: a PROFILE_REQUEST next
+        to the metrics stream makes the next epoch capture, the trace
+        summary lands as a `profile_capture` record, and the request
+        file is consumed (one capture, not one per epoch)."""
+        from factorvae_tpu.data import synthetic_panel
+        from factorvae_tpu.train import Trainer
+
+        panel = synthetic_panel(num_days=16, num_instruments=6,
+                                num_features=8, missing_prob=0.2,
+                                seed=2)
+        ds = PanelDataset(panel, seq_len=5)
+        run = str(tmp_path / "RUN.jsonl")
+        (tmp_path / "PROFILE_REQUEST").write_text("")
+        cfg = Config(
+            model=ModelConfig(num_features=8, hidden_size=8,
+                              num_factors=4, num_portfolios=6,
+                              seq_len=5),
+            data=DataConfig(seq_len=5, start_time=None,
+                            fit_end_time=None, val_start_time=None,
+                            val_end_time=None),
+            train=TrainConfig(num_epochs=2, seed=0,
+                              save_dir=str(tmp_path / "m"),
+                              checkpoint_every=0, days_per_step=2),
+        )
+        with MetricsLogger(jsonl_path=run, echo=False) as lg:
+            prev = install_timeline(Timeline(lg))
+            try:
+                Trainer(cfg, ds, logger=lg).fit()
+            finally:
+                install_timeline(prev)
+        recs = [json.loads(x) for x in open(run)]
+        caps = [r for r in recs if r.get("event") == "profile_capture"]
+        assert len(caps) == 1 and caps[0]["epoch"] == 0
+        assert caps[0]["files"] >= 1
+        assert os.path.isdir(caps[0]["dir"])
+        assert not (tmp_path / "PROFILE_REQUEST").exists()

@@ -490,6 +490,97 @@ class TestWarmRestart:
         assert strip[0] == strip[1]
 
 
+class TestWarmRestartScrape:
+    def test_metrics_scrape_shows_cached_taxonomy(self, tmp_path):
+        """The ISSUE-10 acceptance scrape: run the HTTP daemon twice
+        against one persistent compilation cache and scrape /metrics
+        DURING serving. The cold process's exposition counts real
+        compiles; the warm restart's counts compile==0 and
+        compile_cached>0 — the RUN-stream warm-restart contract, now
+        visible to a scraper."""
+        import socket
+        import time as _time
+        import urllib.request
+
+        models = _make_checkpoints(tmp_path)
+        cache = str(tmp_path / "xla_cache")
+
+        def counts_from(text):
+            out = {}
+            for line in text.splitlines():
+                if line.startswith("factorvae_compile_total{"):
+                    kind = line.split('kind="')[1].split('"')[0]
+                    out[kind] = float(line.rsplit(" ", 1)[1])
+            return out
+
+        def run_once(i):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            cmd = [sys.executable, "-m", "factorvae_tpu.serve"]
+            for m in models:
+                cmd += ["--model", m]
+            cmd += ["--synthetic", "16,12", "--http", str(port),
+                    "--metrics_jsonl", str(tmp_path / f"scrape{i}.jsonl"),
+                    "--compile_cache", cache]
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=REPO + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+            proc = subprocess.Popen(cmd, cwd=str(tmp_path), env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE)
+            base = f"http://127.0.0.1:{port}"
+            try:
+                deadline = _time.time() + 240
+                up = False
+                while _time.time() < deadline:
+                    if proc.poll() is not None:
+                        break
+                    try:
+                        urllib.request.urlopen(base + "/healthz",
+                                               timeout=1)
+                        up = True
+                        break
+                    except OSError:
+                        _time.sleep(0.2)
+                if not up:
+                    # kill BEFORE reading stderr: .read() on the live
+                    # pipe would block until process exit and wedge
+                    # the test past its own failure
+                    rc = proc.poll()
+                    proc.kill()
+                    _, err = proc.communicate(timeout=30)
+                    raise AssertionError(
+                        f"daemon never answered /healthz (rc={rc}): "
+                        f"{err.decode()[-2000:]}")
+                req = urllib.request.Request(
+                    base + "/score",
+                    data=json.dumps({"model": "m0", "day": 0}).encode(),
+                    method="POST")
+                resp = json.loads(urllib.request.urlopen(
+                    req, timeout=120).read())
+                assert resp["ok"], resp
+                text = urllib.request.urlopen(
+                    base + "/metrics", timeout=30).read().decode()
+                down = urllib.request.Request(
+                    base + "/score",
+                    data=json.dumps({"cmd": "shutdown"}).encode(),
+                    method="POST")
+                urllib.request.urlopen(down, timeout=30).read()
+                proc.wait(timeout=60)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+            return counts_from(text)
+
+        cold = run_once(1)
+        assert cold.get("compile", 0) > 0, cold
+        warm = run_once(2)
+        assert warm.get("compile", 0) == 0, warm
+        assert warm.get("compile_cached", 0) > 0, warm
+
+
 class TestFleetInt8Path:
     def test_fleet_int8_matches_serial_int8(self, tiny_ds):
         """The new int8 leg of predict_panel_fleet (the serving
