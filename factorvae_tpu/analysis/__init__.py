@@ -23,6 +23,17 @@ Rule catalog (docs/analysis.md has the long-form version):
 - JGL004  donated-buffer read-after-donation.
 - JGL005  dtype drift: array constructors without an explicit dtype in
           plan-governed hot paths.
+- JGL006  bare print() in library modules (route through the
+          MetricsLogger/timeline stream).
+- JGL007  broad `except Exception` that swallows the error silently.
+- JGL008  wall-clock time.time() measuring a duration (the Timeline
+          contract is monotonic perf_counter).
+- JGL009  whole-program only: shared mutable attribute/global written
+          across the thread/main-line boundary without its owning lock.
+- JGL010  whole-program only: async-signal-unsafe work (logging, I/O,
+          lock acquisition) reachable from a signal handler.
+- JGL011  whole-program only: daemon=True thread performing file
+          writes with no join/flush barrier on any shutdown path.
 - JGL000  meta: unparseable file, or a `graftlint: disable` suppression
           carrying no justification. Never suppressible.
 
@@ -37,6 +48,19 @@ is itself a finding.
 CLI::
 
     python -m factorvae_tpu.analysis factorvae_tpu scripts --format human
+    python -m factorvae_tpu.analysis --project          # whole-program
+
+`--project` builds ONE cross-module index (import-resolved call graph,
+thread/signal/HTTP entry reachability, per-class guarded-attribute
+inference — analysis/project.py) over every path, which enables the
+concurrency rules JGL009-011 and lets jit/scan reachability follow
+calls across module boundaries. Per-path mode is unchanged: each file
+stands alone, and the project rules stay off.
+
+The runtime complement is `analysis/sanitize.py`: a lock-order
+recorder tier-1 drives over the Checkpointer/Timeline/metrics/registry
+/chaos lock set, failing on held-while-acquiring cycles static
+analysis cannot prove (tests/test_sanitize.py).
 
 The engine itself is stdlib-only (ast + tokenize) and never executes or
 imports the code under analysis, so the whole-repo pass takes well
@@ -48,8 +72,10 @@ callers like the tier-1 gate pay nothing extra.)
 from factorvae_tpu.analysis.engine import (
     Finding,
     analyze_paths,
+    analyze_project,
     analyze_source,
     main,
 )
 
-__all__ = ["Finding", "analyze_paths", "analyze_source", "main"]
+__all__ = ["Finding", "analyze_paths", "analyze_project",
+           "analyze_source", "main"]

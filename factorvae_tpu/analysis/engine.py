@@ -109,6 +109,14 @@ class Finding:
     message: str
     suppressed: bool = False
     justification: str = ""
+    # Whole-program fields (ISSUE 11): set by the concurrency rules in
+    # --project mode. `thread_reachable` marks a finding whose flagged
+    # scope runs off the main thread (thread target, executor submit,
+    # HTTP handler, signal handler); `entry_point` names the entry the
+    # reachability walk reached it through. Module-local findings keep
+    # the defaults, so the JSON schema is additive, never breaking.
+    thread_reachable: bool = False
+    entry_point: str = ""
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -336,6 +344,9 @@ class ModuleModel:
             if fn.node in seeds:
                 fn.traced = True
 
+        self._propagate_traced()
+
+    def _propagate_traced(self) -> None:
         # propagate: through local calls by name + nested defs
         changed = True
         while changed:
@@ -355,6 +366,23 @@ class ModuleModel:
                         if not callee.traced:
                             callee.traced = True
                             changed = True
+
+    def seed_traced(self, names: Iterable[str]) -> bool:
+        """Mark the named functions traced and re-propagate. The
+        whole-program index (analysis/project.py) calls this when a
+        traced function in ANOTHER module calls into this one through
+        an import-resolved edge — reachability follows calls across
+        module boundaries instead of stopping at them. Returns whether
+        anything new was marked."""
+        changed = False
+        for name in names:
+            for f in self.funcs_named(name):
+                if not f.traced:
+                    f.traced = True
+                    changed = True
+        if changed:
+            self._propagate_traced()
+        return changed
 
     def traced_entry_names(self) -> Set[str]:
         """Names whose call returns device values fresh off a compiled
@@ -506,23 +534,13 @@ def _innermost_stmt_starts(tree: ast.Module) -> Dict[int, int]:
     return {ln: start for ln, (_, start) in best.items()}
 
 
-def analyze_source(src: str, path: str = "<string>",
-                   hot_path: Optional[bool] = None) -> List[Finding]:
-    """Run every rule over one module's source. Findings covered by a
-    justified suppression come back with suppressed=True; an unjustified
-    suppression is itself a JGL000 finding."""
-    from factorvae_tpu.analysis import rules as _rules
-
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Finding("JGL000", path, e.lineno or 1,
-                        f"unparseable file: {e.msg}")]
-    model = ModuleModel(path, src, tree, hot_path=hot_path)
-    findings: List[Finding] = []
-    for rule_fn in _rules.ALL_RULES:
-        findings.extend(rule_fn(model))
-
+def apply_suppressions(src: str, tree: ast.Module, path: str,
+                       findings: List[Finding]) -> List[Finding]:
+    """Apply the file's `graftlint: disable` comments to `findings`
+    (marking covered ones suppressed) and append the JGL000 meta
+    findings for unjustified suppressions. Shared by the module-local
+    pass (analyze_source) and the whole-program pass (analyze_project),
+    so suppression semantics are identical in both modes."""
     sups = _parse_suppressions(src)
     meta: List[Finding] = []
     for s in sups:
@@ -560,6 +578,32 @@ def analyze_source(src: str, path: str = "<string>",
     return out
 
 
+def run_module_rules(model: ModuleModel) -> List[Finding]:
+    """Every module-local rule over one built model (no suppression
+    application — the caller owns that so project mode can merge
+    module-local and whole-program findings first)."""
+    from factorvae_tpu.analysis import rules as _rules
+
+    findings: List[Finding] = []
+    for rule_fn in _rules.ALL_RULES:
+        findings.extend(rule_fn(model))
+    return findings
+
+
+def analyze_source(src: str, path: str = "<string>",
+                   hot_path: Optional[bool] = None) -> List[Finding]:
+    """Run every rule over one module's source. Findings covered by a
+    justified suppression come back with suppressed=True; an unjustified
+    suppression is itself a JGL000 finding."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("JGL000", path, e.lineno or 1,
+                        f"unparseable file: {e.msg}")]
+    model = ModuleModel(path, src, tree, hot_path=hot_path)
+    return apply_suppressions(src, tree, path, run_module_rules(model))
+
+
 def _walk_py_files(root_dir: str) -> Iterable[str]:
     for root, dirs, files in os.walk(root_dir):
         dirs[:] = sorted(
@@ -571,11 +615,16 @@ def _walk_py_files(root_dir: str) -> Iterable[str]:
                 yield os.path.join(root, name)
 
 
-def analyze_paths(paths: Sequence[str]) -> List[Finding]:
-    """Analyze every .py file under `paths`. A path that is missing, not
-    a Python file, or a directory with no Python files is itself a
-    JGL000 finding — a typo'd path must fail the gate loudly, never turn
-    it into a green no-op."""
+def collect_sources(paths: Sequence[str]
+                    ) -> Tuple[List[Tuple[str, Optional[str], str]],
+                               List[Finding]]:
+    """Resolve CLI paths into [(file_path, package_root_or_None, src)]
+    plus the JGL000 findings for anything missing/unreadable — a typo'd
+    path must fail the gate loudly, never turn it into a green no-op.
+    `package_root` is the directory argument a file was found under
+    (the whole-program index derives dotted module names from it);
+    files passed directly carry None and index as standalone modules."""
+    out: List[Tuple[str, Optional[str], str]] = []
     findings: List[Finding] = []
     for p in paths:
         if os.path.isfile(p):
@@ -583,9 +632,9 @@ def analyze_paths(paths: Sequence[str]) -> List[Finding]:
                 findings.append(Finding(
                     "JGL000", p, 1, "not a Python file — nothing analyzed"))
                 continue
-            files = [p]
+            files = [(p, None)]
         elif os.path.isdir(p):
-            files = list(_walk_py_files(p))
+            files = [(f, p) for f in _walk_py_files(p)]
             if not files:
                 findings.append(Finding(
                     "JGL000", p, 1,
@@ -598,7 +647,7 @@ def analyze_paths(paths: Sequence[str]) -> List[Finding]:
                 "path does not exist — a typo here would silently turn "
                 "the lint gate into a no-op"))
             continue
-        for fp in files:
+        for fp, root in files:
             try:
                 with open(fp, "r", encoding="utf-8") as fh:
                     src = fh.read()
@@ -606,8 +655,67 @@ def analyze_paths(paths: Sequence[str]) -> List[Finding]:
                 findings.append(Finding(
                     "JGL000", fp, 1, f"unreadable file: {e}"))
                 continue
-            findings.extend(analyze_source(src, fp))
+            out.append((fp, root, src))
+    return out, findings
+
+
+def analyze_paths(paths: Sequence[str]) -> List[Finding]:
+    """Analyze every .py file under `paths` with the module-local
+    rules (per-path mode: each file stands alone, reachability stops at
+    its module boundary — see analyze_project for whole-program mode)."""
+    sources, findings = collect_sources(paths)
+    for fp, _, src in sources:
+        findings.extend(analyze_source(src, fp))
     return findings
+
+
+def analyze_project(paths: Sequence[str]) -> List[Finding]:
+    """Whole-program mode: build one cross-module project index over
+    every file, propagate traced (jit/scan/vmap) reachability through
+    import-resolved call edges, run the module-local rules with those
+    extra seeds, then the project-level concurrency rules (JGL009-011)
+    on top. Suppression semantics are identical to per-path mode."""
+    from factorvae_tpu.analysis import concurrency
+    from factorvae_tpu.analysis.project import ProjectIndex
+
+    sources, findings = collect_sources(paths)
+    # One file reachable through two CLI paths (passed directly AND
+    # under a directory argument) must index — and report — once.
+    seen_paths: set = set()
+    deduped = []
+    for fp, root, src in sources:
+        ap = os.path.abspath(fp)
+        if ap in seen_paths:
+            continue
+        seen_paths.add(ap)
+        deduped.append((fp, root, src))
+    index = ProjectIndex(deduped)
+    findings.extend(index.errors)          # unparseable files -> JGL000
+    index.propagate_traced()
+    per_file: Dict[str, List[Finding]] = {}
+    for rec in index.records():
+        per_file.setdefault(rec.path, []).extend(
+            run_module_rules(rec.model))
+    for rule_fn in concurrency.PROJECT_RULES:
+        for f in rule_fn(index):
+            per_file.setdefault(f.path, []).append(f)
+    for rec in index.records():
+        findings.extend(apply_suppressions(
+            rec.src, rec.tree, rec.path, per_file.get(rec.path, [])))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def default_project_paths() -> List[str]:
+    """`--project` with no paths: the installed package plus the repo's
+    scripts/ next to it — the same surface the tier-1 per-path gate
+    lints."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = [pkg]
+    scripts = os.path.join(os.path.dirname(pkg), "scripts")
+    if os.path.isdir(scripts):
+        out.append(scripts)
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -616,8 +724,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description="graftlint: JAX-aware static analysis "
                     "(tracer/host-sync/RNG/donation/dtype discipline)",
     )
-    parser.add_argument("paths", nargs="+",
-                        help="files or directories to analyze")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to analyze (required "
+                             "unless --project, which defaults to the "
+                             "installed package + scripts/)")
+    parser.add_argument("--project", action="store_true",
+                        help="whole-program mode: one cross-module index "
+                             "(import-resolved call graph, thread-entry "
+                             "reachability) over every path, enabling the "
+                             "concurrency rules JGL009-011")
     parser.add_argument("--format", choices=("human", "json"),
                         default="human")
     parser.add_argument("--show-suppressed", action="store_true",
@@ -625,7 +740,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "suppressions")
     args = parser.parse_args(argv)
 
-    findings = analyze_paths(args.paths)
+    paths = list(args.paths)
+    if not paths:
+        if not args.project:
+            parser.error("paths are required without --project")
+        paths = default_project_paths()
+    findings = analyze_project(paths) if args.project \
+        else analyze_paths(paths)
     active = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
 

@@ -116,7 +116,11 @@ class ChaosPlan:
     """A seeded list of faults plus their consumption state. `find` is
     thread-safe (stream workers and the serve dispatch pool query from
     their own threads) and CONSUMES one firing per match, so the plan's
-    injection history (`fired`) is itself a deterministic artifact."""
+    injection history (`fired`) is itself a deterministic artifact.
+    The plan lock is a LEAF in the project's lock order (injection
+    points call `fault()` while holding their subsystem's lock —
+    registry, stream — so `find` must never acquire one back;
+    analysis/sanitize.py verifies the composed graph stays acyclic)."""
 
     def __init__(self, faults: Sequence[Fault], seed: int = 0):
         self.faults: List[Fault] = list(faults)

@@ -26,6 +26,7 @@ transfer gap to hide and the number is reported as-is, not a claim.
 
 from __future__ import annotations
 
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterator
@@ -81,6 +82,12 @@ class ChunkStream:
         self._make_chunk = make_chunk
         self._placement = placement or jax.device_put
         self.n_chunks = int(n_chunks)
+        # Transfer-ledger counters are written by the PREFETCH WORKER
+        # (produce side) and read by the consumer/bench (graftlint
+        # JGL009: `x += 1` on a float is a read-modify-write that can
+        # lose an update across threads); one uncontended lock per
+        # CHUNK guards them — nothing on the per-step hot path.
+        self._lock = threading.Lock()
         self.bytes_put = 0
         self.produce_seconds = 0.0
         self.wait_seconds = 0.0
@@ -106,7 +113,8 @@ class ChunkStream:
                 last = e
                 if attempt == self.MAX_RETRIES:
                     raise
-                self.retries += 1
+                with self._lock:
+                    self.retries += 1
                 timeline_event("stream_retry", cat="recovery",
                                resource="stream", chunk=i,
                                attempt=attempt + 1, error=str(e))
@@ -123,9 +131,10 @@ class ChunkStream:
         # Counted only AFTER the put succeeds: a failed attempt that the
         # bounded retry re-runs must not double-count the chunk in the
         # transfer ledger the stream bench reports.
-        self.bytes_put += nbytes
         t1 = time.perf_counter()
-        self.produce_seconds += t1 - t0
+        with self._lock:
+            self.bytes_put += nbytes
+            self.produce_seconds += t1 - t0
         # The ledger as timeline spans (no-op without an installed
         # timeline): each worker-side gather+put window on the "stream"
         # lane, so `obs.timeline` can show how much of it hid behind
@@ -145,7 +154,8 @@ class ChunkStream:
                 t0 = time.perf_counter()
                 batch = fut.result()
                 t1 = time.perf_counter()
-                self.wait_seconds += t1 - t0
+                with self._lock:
+                    self.wait_seconds += t1 - t0
                 timeline_span_at("chunk_wait", t0, t1, cat="stream",
                                  resource="stream_wait", chunk=i)
                 yield batch
@@ -153,7 +163,8 @@ class ChunkStream:
 
     @property
     def overlap_frac(self) -> float:
-        return overlap_frac(self.wait_seconds, self.produce_seconds)
+        with self._lock:
+            return overlap_frac(self.wait_seconds, self.produce_seconds)
 
 
 def chunk_slices(n_steps: int, steps_per_chunk: int) -> list:

@@ -25,6 +25,7 @@ marks are simply not recorded anywhere.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -113,12 +114,22 @@ class ScoreDriftMonitor:
         # model -> {"days": {day: digest}, "last_day", "last_scores"
         #           (name -> score), "last_corr", "drift_events"}
         self._models: Dict[str, dict] = {}
+        # Guards the per-model state (graftlint JGL009): observe()
+        # runs on whatever thread answers scoring requests while
+        # `GET /metrics` reads stats() — the LatencyHistogram pattern.
+        self._lock = threading.Lock()
 
     def observe(self, model: str, day: int,
                 names: Sequence[str], scores: np.ndarray,
                 alias: Optional[str] = None) -> Optional[dict]:
         """Digest one served (model, day) cross-section; returns the
         digest (cached on repeats, None for empty cross-sections)."""
+        with self._lock:
+            return self._observe(model, day, names, scores, alias)
+
+    def _observe(self, model: str, day: int,
+                 names: Sequence[str], scores: np.ndarray,
+                 alias: Optional[str]) -> Optional[dict]:
         st = self._models.setdefault(
             model, {"days": {}, "last_day": None, "last_scores": None,
                     "last_corr": None, "drift_events": 0})
@@ -160,16 +171,18 @@ class ScoreDriftMonitor:
     # ---- read side -------------------------------------------------------
 
     def models(self) -> List[str]:
-        return sorted(self._models)
+        with self._lock:
+            return sorted(self._models)
 
     def stats(self) -> dict:
         """Per-model drift summary for /stats and /metrics."""
         out = {}
-        for model, st in sorted(self._models.items()):
-            out[model] = {
-                "days_digested": len(st["days"]),
-                "last_day": st["last_day"],
-                "last_rank_corr": st["last_corr"],
-                "drift_events": st["drift_events"],
-            }
+        with self._lock:
+            for model, st in sorted(self._models.items()):
+                out[model] = {
+                    "days_digested": len(st["days"]),
+                    "last_day": st["last_day"],
+                    "last_rank_corr": st["last_corr"],
+                    "drift_events": st["drift_events"],
+                }
         return out

@@ -92,7 +92,8 @@ class LatencyHistogram:
 
     @property
     def count(self) -> int:
-        return self._n
+        with self._lock:
+            return self._n
 
     def render(self, name: str, labels: Optional[dict] = None
                ) -> List[str]:
@@ -138,9 +139,18 @@ _HEALTH_CODE = {"ok": 0, "degraded": 1, "failing": 2, "draining": 3}
 def daemon_metrics(daemon) -> str:
     """The scoring daemon's full /metrics payload (see module
     docstring). Reads daemon/registry/watchdog counters only — one
-    scrape does zero scoring work."""
+    scrape does zero scoring work. Holds the daemon's tick lock for
+    the whole render: every counter in one exposition comes from the
+    same instant, never half-way through a tick (the scrape-vs-tick
+    interleaving graftlint JGL009 exists to catch). Lock order inside
+    matches the tick path's: daemon -> registry/drift -> logger."""
     from factorvae_tpu.obs.watchdog import compile_event_counts
 
+    with daemon._lock:
+        return _render_daemon_metrics(daemon, compile_event_counts)
+
+
+def _render_daemon_metrics(daemon, compile_event_counts) -> str:
     p = PREFIX
     reg = daemon.registry.stats()
     health = daemon.health()

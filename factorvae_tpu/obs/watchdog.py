@@ -38,6 +38,7 @@ working on watched jits.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -96,17 +97,27 @@ _PCACHE_CLASSIFY = False
 # with a timeline installed): the no-timeline path stays untouched.
 _EVENT_COUNTS = {"compile": 0, "compile_cached": 0}
 
+# Guards BOTH module tallies (graftlint JGL009): jits dispatch — and
+# therefore bump these counters — on whatever thread scores (the HTTP
+# handler, the stdin tick loop) while `GET /metrics` snapshots them
+# from its own scrape; `dict[k] += 1` is a read-modify-write that
+# loses updates under that interleaving. One uncontended lock per
+# COMPILE (not per call) costs nothing against a multi-second trace.
+_COUNTS_LOCK = threading.Lock()
+
 
 def compile_event_counts() -> dict:
     """Copy of this process's compile-record tally by taxonomy."""
-    return dict(_EVENT_COUNTS)
+    with _COUNTS_LOCK:
+        return dict(_EVENT_COUNTS)
 
 
 def _pcache_listener(event: str, **kwargs) -> None:
-    if event == "/jax/compilation_cache/cache_hits":
-        _PCACHE["hits"] += 1
-    elif event == "/jax/compilation_cache/cache_misses":
-        _PCACHE["misses"] += 1
+    with _COUNTS_LOCK:
+        if event == "/jax/compilation_cache/cache_hits":
+            _PCACHE["hits"] += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            _PCACHE["misses"] += 1
 
 
 def track_persistent_cache() -> bool:
@@ -137,6 +148,10 @@ class WatchedJit:
         self.total_compile_s = 0.0
         # Most recent `compile` record's fields (tests / provenance).
         self.last_compile: Optional[dict] = None
+        # Guards the per-instance counters (JGL009): a watched jit can
+        # be dispatched from the serving thread while /metrics-style
+        # readers snapshot calls/compiles from another.
+        self._lock = threading.Lock()
 
     def __getattr__(self, attr: str) -> Any:
         # Transparent delegation: jit-surface APIs (.lower(),
@@ -161,20 +176,27 @@ class WatchedJit:
         from factorvae_tpu.obs import compile as compilelib
 
         before = self._cache_size()
-        pc0 = dict(_PCACHE)
+        with _COUNTS_LOCK:
+            pc0 = dict(_PCACHE)
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
         t1 = time.perf_counter()
-        self.calls += 1
-        missed = (self.calls == 1 if before is None
+        with self._lock:
+            self.calls += 1
+            calls = self.calls
+        missed = (calls == 1 if before is None
                   else (self._cache_size() or 0) > before)
         if missed:
-            self.compiles += 1
             wall = round(t1 - t0, 6)
-            self.total_compile_s = round(self.total_compile_s + wall, 6)
+            with self._lock:
+                self.compiles += 1
+                compiles = self.compiles
+                self.total_compile_s = round(
+                    self.total_compile_s + wall, 6)
+                total_compile_s = self.total_compile_s
             tl.span_at(
                 f"jit_compile:{self.name}", t0, t1, cat="compile",
-                resource="compile", compiles=self.compiles)
+                resource="compile", compiles=compiles)
             # The per-compile program bill (null-degrading; ISSUE 7).
             # `wall_s` is the authoritative in-call measurement and is
             # ALWAYS nonnull; the capture fields ride along when the
@@ -195,27 +217,32 @@ class WatchedJit:
             # capture replay below, whose second XLA compile would
             # pollute the counter window.
             event = "compile"
+            with _COUNTS_LOCK:
+                pc1 = dict(_PCACHE)
             if (_PCACHE_CLASSIFY
-                    and _PCACHE["hits"] > pc0["hits"]
-                    and _PCACHE["misses"] == pc0["misses"]):
+                    and pc1["hits"] > pc0["hits"]
+                    and pc1["misses"] == pc0["misses"]):
                 event = "compile_cached"
             cap = {}
-            if self.compiles == 1 and _CAPTURE:
+            if compiles == 1 and _CAPTURE:
                 try:
                     cap = compilelib.capture_compile(
                         self._fn, compilelib.abstractify(args),
                         compilelib.abstractify(kwargs))
                 except Exception:  # graftlint: disable=JGL007 capture is best-effort telemetry; failure degrades to an empty compile record that IS logged unconditionally below
                     cap = {}
-            self.last_compile = dict(cap, fn=self.name, wall_s=wall,
-                                     compiles=self.compiles)
-            _EVENT_COUNTS[event] += 1
-            tl.logger.log(event, _echo=False, **self.last_compile)
-            if self.compiles > self.storm_threshold:
+            last = dict(cap, fn=self.name, wall_s=wall,
+                        compiles=compiles)
+            with self._lock:
+                self.last_compile = last
+            with _COUNTS_LOCK:
+                _EVENT_COUNTS[event] += 1
+            tl.logger.log(event, _echo=False, **last)
+            if compiles > self.storm_threshold:
                 tl.event(
                     "retrace_storm", cat="compile", resource="compile",
-                    fn=self.name, compiles=self.compiles, calls=self.calls,
-                    total_compile_s=self.total_compile_s,
+                    fn=self.name, compiles=compiles, calls=calls,
+                    total_compile_s=total_compile_s,
                     note="cache misses keep accruing — a static arg or "
                          "shape is changing per call")
         return out

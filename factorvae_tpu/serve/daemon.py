@@ -51,6 +51,7 @@ import contextlib
 import dataclasses
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import Optional
@@ -159,6 +160,16 @@ class ScoringDaemon:
         # git sha, rig env), so a saved /stats or /models payload is
         # ledger-attributable without the RUN.jsonl next to it.
         self.run_meta = run_meta(run_name="serve")
+        # The tick lock (graftlint JGL009): one re-entrant lock held
+        # for the whole handle_batch tick and by the health/stats
+        # readers. The daemon's counters, breaker table, outcome
+        # window and fused-dispatch caches are mutated across
+        # _dispatch/_respond while `GET /metrics` and `/healthz` read
+        # them — in a threaded front (or ROADMAP item 3's N-worker
+        # tier) those interleave. Single-tick invariant preserved: the
+        # stdlib HTTP driver is single-threaded, so the lock is
+        # uncontended there and costs one atomic acquire per tick.
+        self._lock = threading.RLock()
         self._closing = False
         self._draining = False
         # key -> {"fails": consecutive failures, "open_until": t}
@@ -592,20 +603,25 @@ class ScoringDaemon:
     # ---- public API ------------------------------------------------------
 
     def handle_batch(self, requests: list) -> list:
-        """Responses (in order) for one tick's worth of requests."""
+        """Responses (in order) for one tick's worth of requests.
+        Runs under the tick lock: every counter/breaker/window
+        mutation below (including the ones inside _dispatch/_respond)
+        is serialized against the health/stats/metrics readers."""
         t0 = time.perf_counter()
-        self.ticks += 1
-        with timeline_span("serve_tick", cat="serve", resource="serve",
-                           requests=len(requests)):
-            resolved = [self._resolve(r) for r in requests]
-            self._dispatch(resolved)
-            out = []
-            for r in resolved:
-                with timeline_span("serve_request", cat="serve",
-                                   resource="serve",
-                                   model=(r.entry.key if r.entry
-                                          else None)):
-                    out.append(self._respond(r, t0))
+        with self._lock:
+            self.ticks += 1
+            with timeline_span("serve_tick", cat="serve",
+                               resource="serve",
+                               requests=len(requests)):
+                resolved = [self._resolve(r) for r in requests]
+                self._dispatch(resolved)
+                out = []
+                for r in resolved:
+                    with timeline_span("serve_request", cat="serve",
+                                       resource="serve",
+                                       model=(r.entry.key if r.entry
+                                              else None)):
+                        out.append(self._respond(r, t0))
         return out
 
     def handle(self, request: dict) -> dict:
@@ -616,16 +632,20 @@ class ScoringDaemon:
         return self._closing
 
     def request_drain(self) -> None:
-        """Graceful-shutdown request (the SIGTERM path): the serving
-        loop finishes its in-flight tick, answers it, and exits — the
-        timeline/metrics stream flushes through the driver's normal
-        teardown instead of being torn mid-record."""
-        if not self._draining:
-            self._draining = True
-            timeline_event("sigterm_drain", cat="recovery",
-                           resource="serve",
-                           requests_served=self.requests_served)
-        self._closing = True
+        """Graceful-shutdown request: the serving loop finishes its
+        in-flight tick, answers it, and exits — the timeline/metrics
+        stream flushes through the driver's normal teardown instead of
+        being torn mid-record. Called from MAIN-LINE code only (the
+        serving loops, after the SIGTERM handler sets its Event): the
+        timeline write below takes the metrics-stream lock, which a
+        signal handler must never do (graftlint JGL010)."""
+        with self._lock:
+            if not self._draining:
+                self._draining = True
+                timeline_event("sigterm_drain", cat="recovery",
+                               resource="serve",
+                               requests_served=self.requests_served)
+            self._closing = True
 
     def health(self) -> dict:
         """Sliding-window health: error rate over the last
@@ -634,45 +654,48 @@ class ScoringDaemon:
         daemon must tell its load balancer to stop sending). Open
         breakers degrade an otherwise-clean window: some models are
         fast-failing even if the overall rate looks fine."""
-        n = len(self._outcomes)
-        errs = sum(1 for ok in self._outcomes if not ok)
-        rate = errs / n if n else 0.0
-        open_b = self.open_breakers()
-        if self._closing or rate >= self.failing_at:
-            status = "failing" if not self._closing else "draining"
-        elif rate >= self.degraded_at or open_b:
-            status = "degraded"
-        else:
-            status = "ok"
-        return {
-            "status": status,
-            "ok": status in ("ok", "degraded"),
-            "error_rate": round(rate, 4),
-            "window": n,
-            "open_breakers": open_b,
-            "deadline_misses": self.deadline_misses,
-            "breaker_fast_fails": self.breaker_fast_fails,
-        }
+        with self._lock:
+            n = len(self._outcomes)
+            errs = sum(1 for ok in self._outcomes if not ok)
+            rate = errs / n if n else 0.0
+            open_b = self.open_breakers()
+            if self._closing or rate >= self.failing_at:
+                status = "failing" if not self._closing else "draining"
+            elif rate >= self.degraded_at or open_b:
+                status = "degraded"
+            else:
+                status = "ok"
+            return {
+                "status": status,
+                "ok": status in ("ok", "degraded"),
+                "error_rate": round(rate, 4),
+                "window": n,
+                "open_breakers": open_b,
+                "deadline_misses": self.deadline_misses,
+                "breaker_fast_fails": self.breaker_fast_fails,
+            }
 
     def breaker_states(self) -> dict:
         """key -> {"fails", "open"} for every entry the breaker has
         seen — the /metrics gauge source (open_breakers() lists only
         the currently-open subset)."""
-        open_b = set(self.open_breakers())
-        return {k: {"fails": b.get("fails", 0), "open": k in open_b}
-                for k, b in self._breakers.items()}
+        with self._lock:
+            open_b = set(self.open_breakers())
+            return {k: {"fails": b.get("fails", 0), "open": k in open_b}
+                    for k, b in self._breakers.items()}
 
     def stats(self) -> dict:
-        return {
-            "run_meta": self.run_meta,
-            "requests_served": self.requests_served,
-            "dispatches": self.dispatches,
-            "fused_requests": self.fused_requests,
-            "ticks": self.ticks,
-            "health": self.health(),
-            "registry": self.registry.stats(),
-            "drift": self.drift.stats(),
-        }
+        with self._lock:
+            return {
+                "run_meta": self.run_meta,
+                "requests_served": self.requests_served,
+                "dispatches": self.dispatches,
+                "fused_requests": self.fused_requests,
+                "ticks": self.ticks,
+                "health": self.health(),
+                "registry": self.registry.stats(),
+                "drift": self.drift.stats(),
+            }
 
 
 # ---------------------------------------------------------------------------
@@ -707,24 +730,31 @@ def _with_parse_errors(daemon: ScoringDaemon, requests: list) -> list:
 
 @contextlib.contextmanager
 def _drain_on_sigterm(daemon: ScoringDaemon):
-    """Install a SIGTERM handler that requests a graceful drain (the
-    serving loop finishes the in-flight tick, then exits normally so
-    the metrics/timeline stream flushes). Restores the previous handler
-    on exit; a non-main thread (HTTP tests drive the server from a
-    worker) cannot install handlers and serves without one."""
+    """Install a SIGTERM handler in the SET-FLAG-AND-RETURN shape
+    (graftlint JGL010) and yield the flag: the handler only sets a
+    `threading.Event`; the serving loop polls it and performs the
+    actual drain (`daemon.request_drain()` — a timeline write that
+    takes the metrics-stream lock) in main-line code. CPython runs
+    signal handlers between bytecodes of the interrupted frame, so a
+    handler that logged directly could re-enter the very lock the
+    interrupted `MetricsLogger.log` call holds and deadlock the
+    process on its way down. Restores the previous handler on exit; a
+    non-main thread (HTTP tests drive the server from a worker) cannot
+    install handlers and serves with an Event nothing ever sets."""
     import signal
 
-    def on_term(signum, frame):
-        daemon.request_drain()
+    term = threading.Event()
 
-    prev = None
+    def on_term(signum, frame):
+        term.set()  # nothing else: no logging, no locks, no I/O
+
     try:
         prev = signal.signal(signal.SIGTERM, on_term)
     except ValueError:  # not the main thread — no handler, no drain
-        yield
+        yield term
         return
     try:
-        yield
+        yield term
     finally:
         signal.signal(signal.SIGTERM, prev)
 
@@ -795,9 +825,19 @@ def serve_stdin(daemon: ScoringDaemon, inp, out,
     SIGTERM drain (the in-flight tick is finished and answered first).
     Returns the number of requests answered."""
     answered = 0
-    with _drain_on_sigterm(daemon):
-        for lines in _stdin_ticks(inp, tick_s, max_batch,
-                                  stop=lambda: daemon.closing):
+    with _drain_on_sigterm(daemon) as term:
+
+        def stop() -> bool:
+            # Polled on idle by the tick loop: the SIGTERM flag is
+            # promoted to a real drain HERE, in main-line code, where
+            # taking the timeline lock is safe.
+            if term.is_set():
+                daemon.request_drain()
+            return daemon.closing
+
+        for lines in _stdin_ticks(inp, tick_s, max_batch, stop=stop):
+            if term.is_set():
+                daemon.request_drain()
             requests = [r for line in lines for r in _parse_line(line)]
             for resp in _with_parse_errors(daemon, requests):
                 out.write(json.dumps(resp) + "\n")
@@ -939,9 +979,15 @@ def serve_http(daemon: ScoringDaemon, port: int,
     # no connection, so a SIGTERM drain ends the loop within one tick
     # instead of blocking in accept forever.
     server.timeout = 0.25
-    with _drain_on_sigterm(daemon):
+    with _drain_on_sigterm(daemon) as term:
         try:
             while not daemon.closing:
+                if term.is_set():
+                    # main-line promotion of the handler's flag: the
+                    # in-flight request already finished (we are
+                    # between handle_request calls), so drain and exit
+                    daemon.request_drain()
+                    break
                 server.handle_request()
         finally:
             server.server_close()

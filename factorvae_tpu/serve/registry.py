@@ -52,6 +52,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from collections import OrderedDict
 from typing import Optional
@@ -195,6 +196,18 @@ class ModelRegistry:
     def __init__(self, budget_bytes: int = 0, plan_table=None):
         self.budget_bytes = int(budget_bytes)
         self._plan_table = plan_table
+        # One re-entrant lock over admission/lookup/eviction and the
+        # hit/miss/eviction tallies (graftlint JGL009): requests mutate
+        # this state from whatever thread serves them (the stdin tick
+        # loop, an HTTP handler) while `GET /metrics` reads stats() —
+        # the LRU OrderedDict and `hits += 1` are not atomic. RLock
+        # because a cold-start's register_checkpoint re-enters through
+        # _admit. Cold-start RELOADS (disk I/O + bounded backoff
+        # sleeps) run OUTSIDE the lock so a retrying model never
+        # stalls /metrics, /healthz or other models' lookups; reloads
+        # are idempotent re-admissions (freshest wins), so two racing
+        # cold-starts of one key are safe.
+        self._lock = threading.RLock()
         self._entries: "OrderedDict[str, Entry]" = OrderedDict()
         self._aliases: dict = {}
         # Evicted entries with a reload origin on disk leave a
@@ -214,13 +227,14 @@ class ModelRegistry:
     # ---- admission -------------------------------------------------------
 
     def _admit(self, entry: Entry) -> str:
-        self.version += 1
-        self._entries[entry.key] = entry
-        self._entries.move_to_end(entry.key)
-        if entry.alias:
-            self._aliases[entry.alias] = entry.key
-        self._evict_to_budget()
-        return entry.key
+        with self._lock:
+            self.version += 1
+            self._entries[entry.key] = entry
+            self._entries.move_to_end(entry.key)
+            if entry.alias:
+                self._aliases[entry.alias] = entry.key
+            self._evict_to_budget()
+            return entry.key
 
     def _resolve_precision(self, config: Config,
                            precision: Optional[str],
@@ -357,12 +371,13 @@ class ModelRegistry:
     # ---- lookup / eviction ----------------------------------------------
 
     def resolve_key(self, name: str) -> str:
-        if name in self._entries or name in self._tombstones:
-            return name
-        if name in self._aliases:
-            return self._aliases[name]
-        known = sorted(set(self._entries) | set(self._aliases)
-                       | set(self._tombstones))
+        with self._lock:
+            if name in self._entries or name in self._tombstones:
+                return name
+            if name in self._aliases:
+                return self._aliases[name]
+            known = sorted(set(self._entries) | set(self._aliases)
+                           | set(self._tombstones))
         raise RegistryError(
             f"unknown model {name!r} (known: {', '.join(known) or 'none'})")
 
@@ -371,61 +386,79 @@ class ModelRegistry:
         EVICTED but has a reloadable source cold-starts back in
         transparently (checkpoint reload / artifact round-trip; counted
         as a miss, not a hit); a truly unknown key is a miss+error."""
-        try:
-            key = self.resolve_key(name)
-        except RegistryError:
-            self.misses += 1
-            raise
-        entry = self._entries.get(key)
-        if entry is None:
+        with self._lock:
+            try:
+                key = self.resolve_key(name)
+            except RegistryError:
+                self.misses += 1
+                raise
+            entry = self._entries.get(key)
+            if entry is not None:
+                self.hits += 1
+                self._entries.move_to_end(key)
+                return entry
             # Tombstone stays until the reload SUCCEEDS: a failed
-            # cold-start (deleted/corrupt source) must answer this and
-            # every later request with an actionable error, never
-            # KeyError the daemon on the retry.
+            # cold-start (deleted/corrupt source) must answer this
+            # and every later request with an actionable error,
+            # never KeyError the daemon on the retry.
             stone = self._tombstones[key]
             self.misses += 1
-            for attempt in range(self.COLD_RETRIES + 1):
-                try:
-                    # Chaos hook (factorvae_tpu/chaos): a transient
-                    # cold-start failure — the recovery exercised is
-                    # exactly this retry loop. A None check when off.
-                    if chaos_fault("serve_cold_fail") is not None:
-                        raise RuntimeError(
-                            "chaos: injected cold-start reload failure")
-                    if stone["source"] == "artifact":
-                        self.register_artifact(stone["source_path"],
-                                               alias=stone.get("alias"))
-                    else:
-                        self.register_checkpoint(
-                            stone["source_path"],
-                            config=stone.get("config"),
-                            precision=stone.get("precision"),
-                            alias=stone.get("alias"))
-                    break
-                except RegistryError:
-                    # Deterministic admission failure (missing config,
-                    # manifest mismatch): a retry cannot heal it, and
-                    # the message is already actionable.
-                    raise
-                except Exception as e:
-                    # orbax/OSError/... from a vanished or flaky
-                    # source: bounded exponential-backoff retry, then
-                    # the request path speaks RegistryError only.
-                    if attempt == self.COLD_RETRIES:
-                        raise RegistryError(
-                            f"cold-start of evicted model {name!r} from "
-                            f"{stone['source']} {stone['source_path']} "
-                            f"failed after {attempt + 1} attempts: "
-                            f"{e}") from e
-                    timeline_event("cold_start_retry", cat="recovery",
-                                   resource="serve", model=key,
-                                   attempt=attempt + 1, error=str(e))
-                    time.sleep(self.COLD_BACKOFF_S * (2 ** attempt))
+        # The reload itself — disk I/O, manifest verification, and the
+        # bounded backoff sleeps — runs WITHOUT the lock: one model
+        # retrying a flaky source must not stall /metrics, /healthz or
+        # every other model's lookups for the whole backoff window.
+        # register_* re-take the lock for the admission proper, and a
+        # racing cold-start of the same key just re-admits (freshest
+        # wins, the documented re-admission semantics).
+        for attempt in range(self.COLD_RETRIES + 1):
+            try:
+                # Chaos hook (factorvae_tpu/chaos): a transient
+                # cold-start failure — the recovery exercised is
+                # exactly this retry loop. A None check when off.
+                if chaos_fault("serve_cold_fail") is not None:
+                    raise RuntimeError(
+                        "chaos: injected cold-start reload failure")
+                if stone["source"] == "artifact":
+                    self.register_artifact(stone["source_path"],
+                                           alias=stone.get("alias"))
+                else:
+                    self.register_checkpoint(
+                        stone["source_path"],
+                        config=stone.get("config"),
+                        precision=stone.get("precision"),
+                        alias=stone.get("alias"))
+                break
+            except RegistryError:
+                # Deterministic admission failure (missing config,
+                # manifest mismatch): a retry cannot heal it, and the
+                # message is already actionable.
+                raise
+            except Exception as e:
+                # orbax/OSError/... from a vanished or flaky source:
+                # bounded exponential-backoff retry, then the request
+                # path speaks RegistryError only.
+                if attempt == self.COLD_RETRIES:
+                    raise RegistryError(
+                        f"cold-start of evicted model {name!r} from "
+                        f"{stone['source']} {stone['source_path']} "
+                        f"failed after {attempt + 1} attempts: "
+                        f"{e}") from e
+                timeline_event("cold_start_retry", cat="recovery",
+                               resource="serve", model=key,
+                               attempt=attempt + 1, error=str(e))
+                time.sleep(self.COLD_BACKOFF_S * (2 ** attempt))
+        with self._lock:
             self.cold_starts += 1
             self._tombstones.pop(key, None)
-            return self._entries[key]
-        self.hits += 1
-        self._entries.move_to_end(key)
+            entry = self._entries.get(key)
+        if entry is None:
+            # Admitted and immediately evicted by a concurrent
+            # admission racing the bytes budget: answer actionably —
+            # the next request cold-starts through the re-laid
+            # tombstone.
+            raise RegistryError(
+                f"cold-started model {name!r} was evicted by a "
+                f"concurrent admission before it could serve; retry")
         return entry
 
     def _evict_to_budget(self) -> None:
@@ -452,22 +485,26 @@ class ModelRegistry:
                 del self._aliases[entry.alias]
 
     def total_bytes(self) -> int:
-        return sum(e.nbytes for e in self._entries.values())
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
 
     def keys(self) -> list:
-        return list(self._entries)
+        with self._lock:
+            return list(self._entries)
 
     def stats(self) -> dict:
-        return {
-            "models": len(self._entries),
-            "bytes": self.total_bytes(),
-            "budget_bytes": self.budget_bytes,
-            "hits": self.hits,
-            "misses": self.misses,
-            "evictions": self.evictions,
-            "cold_starts": self.cold_starts,
-            "entries": [e.describe() for e in self._entries.values()],
-        }
+        with self._lock:
+            return {
+                "models": len(self._entries),
+                "bytes": self.total_bytes(),
+                "budget_bytes": self.budget_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "cold_starts": self.cold_starts,
+                "entries": [e.describe()
+                            for e in self._entries.values()],
+            }
 
     # ---- scoring ---------------------------------------------------------
 
@@ -542,7 +579,11 @@ class ModelRegistry:
         {key: compile_seconds}."""
         days = dataset.split_days(None, None)[:1]
         walls = {}
-        for key in list(names or self._entries):
+        with self._lock:
+            # snapshot the key list only: the scoring passes below
+            # must NOT hold the registry lock through their compiles
+            keys = list(names or self._entries)
+        for key in keys:
             entry = self.get(key)
             if entry.compiled:
                 continue

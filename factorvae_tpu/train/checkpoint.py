@@ -354,6 +354,7 @@ class Checkpointer:
                     return
                 time.sleep(0.02)
 
+        # graftlint: disable=JGL011 telemetry-only writer: the span it emits lands on the RUN.jsonl stream, whose consumers (obs.timeline/report/live) tolerate a torn final line by contract — a mid-write kill loses one span, never an artifact
         threading.Thread(target=poll, daemon=True,
                          name=f"ckpt-commit-watch-{step}").start()
 

@@ -12,7 +12,12 @@ context manager that closes its file handle on error paths.
 (factorvae_tpu/obs): monotonic-clock spans (`time.perf_counter`, immune
 to wall-clock jumps), thread-safe by construction (the underlying
 logger serializes writes), emitted as `span` / `mark` records into the
-SAME JSONL stream as the metrics — one RUN.jsonl carries epochs, health
+SAME JSONL stream as the metrics. The logger's write lock is a LEAF in
+the project's lock order (the lock-order sanitizer's graph,
+analysis/sanitize.py): every subsystem may log while holding its own
+lock (daemon tick lock, registry lock, drift lock, ...), so `log()`
+itself must never acquire another subsystem's lock — and signal
+handlers must never log at all (graftlint JGL010) — one RUN.jsonl carries epochs, health
 probes, stream-prefetch spans, checkpoint spans and compile-watchdog
 events, which `python -m factorvae_tpu.obs.timeline` renders as a text
 Gantt with per-resource overlap fractions. Span names are chosen to
@@ -155,8 +160,11 @@ class MetricsLogger:
             if self._fh:
                 self._fh.write(json.dumps(rec) + "\n")
                 self._fh.flush()
-        if self._wandb is not None and event == "epoch":
-            self._wandb.log({k: v for k, v in fields.items() if isinstance(v, (int, float))})
+        # One read of the handle: finish() (main thread) may null it
+        # between a check and a call from a worker-thread log.
+        wandb = self._wandb
+        if wandb is not None and event == "epoch":
+            wandb.log({k: v for k, v in fields.items() if isinstance(v, (int, float))})
         if self.echo if _echo is None else _echo:
             shown = ", ".join(
                 f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"

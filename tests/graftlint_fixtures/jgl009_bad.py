@@ -1,0 +1,57 @@
+"""Seeded JGL009 violations: shared mutable state crossing the
+thread/main-line boundary without its lock.
+
+Four findings:
+  1. `Worker.errors` — written in the thread body with NO lock while
+     main-line `failures()` reads it.
+  2. `Worker.done` — lock-guarded in the thread body (which INFERS the
+     owning lock) but mutated lock-free from main-line `bump_main()`.
+  3. `Worker.done` again — READ lock-free by main-line `peek()` while
+     the owning lock guards the thread-side writes (the composite-
+     reader half of the rule).
+  4. module-global `COUNTS` — mutated by an executor-submitted
+     function, read by the main-line scraper.
+"""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.done = 0
+        self.errors = 0
+
+    def _run(self):
+        with self._lock:
+            self.done += 1
+        self.errors += 1
+
+    def start(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        return t
+
+    def bump_main(self):
+        self.done += 1
+
+    def peek(self):
+        return self.done
+
+    def failures(self):
+        return self.errors
+
+
+COUNTS = {"ticks": 0}
+
+
+def _tick():
+    COUNTS["ticks"] += 1
+
+
+def launch(executor):
+    return executor.submit(_tick)
+
+
+def scrape():
+    return dict(COUNTS)

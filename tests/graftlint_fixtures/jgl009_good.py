@@ -1,0 +1,47 @@
+"""Corrected twin of jgl009_bad.py: every cross-thread mutation holds
+the owning lock — the obs/metrics.LatencyHistogram shape."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.done = 0
+        self.errors = 0
+
+    def _run(self):
+        with self._lock:
+            self.done += 1
+            self.errors += 1
+
+    def start(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        return t
+
+    def bump_main(self):
+        with self._lock:
+            self.done += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"done": self.done, "errors": self.errors}
+
+
+_COUNTS_LOCK = threading.Lock()
+COUNTS = {"ticks": 0}
+
+
+def _tick():
+    with _COUNTS_LOCK:
+        COUNTS["ticks"] += 1
+
+
+def launch(executor):
+    return executor.submit(_tick)
+
+
+def scrape():
+    with _COUNTS_LOCK:
+        return dict(COUNTS)

@@ -1,0 +1,21 @@
+"""Seeded JGL010 violations: a SIGTERM handler whose closure logs and
+takes a lock. Two findings in `_log` (the `with LOG_LOCK` acquisition
+and the print I/O), both attributed to the handler."""
+
+import signal
+import threading
+
+LOG_LOCK = threading.Lock()
+
+
+def _log(msg):
+    with LOG_LOCK:
+        print(msg)
+
+
+def on_term(signum, frame):
+    _log("draining")
+
+
+def install():
+    signal.signal(signal.SIGTERM, on_term)

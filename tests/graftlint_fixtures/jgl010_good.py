@@ -1,0 +1,28 @@
+"""Corrected twin of jgl010_bad.py: the handler sets an Event and
+returns; the serving loop promotes the flag to the real (locking,
+logging) drain work in main-line code — serve/daemon.py's shape."""
+
+import signal
+import threading
+
+STOP = threading.Event()
+LOG_LOCK = threading.Lock()
+
+
+def _log(msg):
+    with LOG_LOCK:
+        print(msg)
+
+
+def on_term(signum, frame):
+    STOP.set()
+
+
+def install():
+    signal.signal(signal.SIGTERM, on_term)
+
+
+def serve_loop(step):
+    while not STOP.is_set():
+        step()
+    _log("draining")

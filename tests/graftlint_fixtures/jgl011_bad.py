@@ -1,0 +1,16 @@
+"""Seeded JGL011 violation: a fire-and-forget daemon=True thread whose
+target writes a JSON artifact — interpreter exit kills it mid-write
+and leaves a torn file. One finding at the Thread() spawn."""
+
+import json
+import threading
+
+
+def _flush(path, stats):
+    with open(path, "w") as fh:
+        json.dump(stats, fh)
+
+
+def schedule_flush(path, stats):
+    threading.Thread(target=_flush, args=(path, stats),
+                     daemon=True).start()

@@ -1,0 +1,28 @@
+"""Corrected twin of jgl011_bad.py: the writer thread is HELD and
+joined at close — every shutdown path drains the write before the
+interpreter can kill it. (The other sanctioned shape is re-running the
+same target synchronously at a read-side barrier, as the
+Checkpointer's manifest flush does.)"""
+
+import json
+import threading
+
+
+def _flush(path, stats):
+    with open(path, "w") as fh:
+        json.dump(stats, fh)
+
+
+class Flusher:
+    def __init__(self):
+        self._t = None
+
+    def schedule(self, path, stats):
+        self._t = threading.Thread(target=_flush, args=(path, stats),
+                                   daemon=True)
+        self._t.start()
+
+    def close(self):
+        if self._t is not None:
+            self._t.join()
+            self._t = None

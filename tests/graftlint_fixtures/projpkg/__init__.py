@@ -1,0 +1,4 @@
+"""Cross-module reachability fixture: a.launch spawns a thread whose
+target calls through b into c, where a module-global counter is
+mutated — the JGL009 finding in c.py is only derivable with the
+whole-program index (each module alone is clean)."""

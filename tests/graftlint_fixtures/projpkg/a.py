@@ -1,0 +1,13 @@
+import threading
+
+from projpkg.b import step
+
+
+def worker():
+    step(3)
+
+
+def launch():
+    t = threading.Thread(target=worker)
+    t.start()
+    return t

@@ -1,0 +1,5 @@
+from projpkg.c import record
+
+
+def step(n):
+    record(n)

@@ -1,0 +1,9 @@
+TALLY = {"n": 0}
+
+
+def record(n):
+    TALLY["n"] += n
+
+
+def snapshot():
+    return dict(TALLY)

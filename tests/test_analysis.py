@@ -1,7 +1,9 @@
 """graftlint tests: every JGL rule demonstrated live on a seeded-violation
 fixture and its corrected twin, suppression semantics, the tier-1
-self-lint gate over factorvae_tpu/ + scripts/, the ruff gate (when ruff
-is installed), and the bitwise pin for the eval/factors.py host-sync fix.
+self-lint gate over factorvae_tpu/ + scripts/ (per-path AND whole-program
+--project mode), the whole-program concurrency rules JGL009-011 with
+their cross-module reachability engine, the ruff gate (when ruff is
+installed), and the bitwise pin for the eval/factors.py host-sync fix.
 """
 
 import json
@@ -13,7 +15,11 @@ import sys
 import numpy as np
 import pytest
 
-from factorvae_tpu.analysis import analyze_paths, analyze_source
+from factorvae_tpu.analysis import (
+    analyze_paths,
+    analyze_project,
+    analyze_source,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "graftlint_fixtures")
@@ -520,6 +526,300 @@ class TestJGL008:
 
 
 # ---------------------------------------------------------------------------
+# whole-program concurrency rules (JGL009-011) — ISSUE 11
+
+
+CONCURRENCY_FIXTURES = [
+    # (rule, bad file, expected findings of that rule, good file)
+    ("JGL009", "jgl009_bad.py", 4, "jgl009_good.py"),
+    ("JGL010", "jgl010_bad.py", 2, "jgl010_good.py"),
+    ("JGL011", "jgl011_bad.py", 1, "jgl011_good.py"),
+]
+
+
+class TestConcurrencyFixtures:
+    """Seeded-violation + corrected-twin pairs, analyzed in --project
+    mode (the rules need the index; per-path mode must stay silent on
+    them by construction)."""
+
+    @pytest.mark.parametrize("rule,bad,count,good", CONCURRENCY_FIXTURES)
+    def test_fires_on_seeded_violation(self, rule, bad, count, good):
+        findings = _active(analyze_project([_fixture(bad)]))
+        hits = [f for f in findings if f.rule == rule]
+        assert len(hits) == count, (
+            f"{rule}: expected {count} findings in {bad}, got "
+            f"{[(f.line, f.message) for f in findings]}"
+        )
+        assert _rules(findings) == [rule]  # no cross-rule noise
+        for f in hits:
+            assert f.thread_reachable is True
+            assert f.entry_point, f
+
+    @pytest.mark.parametrize("rule,bad,count,good", CONCURRENCY_FIXTURES)
+    def test_silent_on_corrected_twin(self, rule, bad, count, good):
+        findings = _active(analyze_project([_fixture(good)]))
+        assert findings == [], (
+            f"corrected twin {good} must be clean, got "
+            f"{[(f.rule, f.line, f.message) for f in findings]}"
+        )
+
+    @pytest.mark.parametrize("rule,bad,count,good", CONCURRENCY_FIXTURES)
+    def test_per_path_mode_does_not_run_project_rules(self, rule, bad,
+                                                      count, good):
+        # the module-local gate has no index: JGL009-011 need --project
+        assert _active(analyze_paths([_fixture(bad)])) == []
+
+    def test_jgl009_infers_owning_lock(self):
+        findings = _active(analyze_project([_fixture("jgl009_bad.py")]))
+        done = [f for f in findings if f.line == 36]  # bump_main
+        assert len(done) == 1
+        assert "self._lock" in done[0].message  # the inferred guard
+        # ...and the composite-reader half: peek()'s lock-free read of
+        # the same guarded attribute is its own finding
+        peek = [f for f in findings if f.line == 39]
+        assert len(peek) == 1
+        assert "read here without its owning lock" in peek[0].message
+
+    def test_jgl009_reader_not_double_reported_at_write_sites(self,
+                                                              tmp_path):
+        # `self.d[k] = v` LOADS self.d as part of the store: the read
+        # must dedup against the write finding at the same site
+        src = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.d = {}\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self.d[\"k\"] = 1\n"
+            "    def poke(self):\n"
+            "        self.d[\"k\"] = 2\n"
+            "    def spawn(self):\n"
+            "        threading.Thread(target=self._run).start()\n"
+        )
+        p = tmp_path / "box.py"
+        p.write_text(src)
+        findings = _active(analyze_project([str(p)]))
+        # exactly ONE finding: the unguarded write in poke (line 10) —
+        # not a second "read" finding for the same subscript store
+        assert [(f.rule, f.line) for f in findings] == [("JGL009", 10)]
+
+    def test_module_name_collision_fails_loudly(self, tmp_path):
+        # two inputs deriving the same module name: JGL000 (the gate
+        # must never silently shadow a file) AND both files still
+        # analyzed for module-local + project findings
+        src = (
+            "import threading\n"
+            "COUNTS = {\"n\": 0}\n"
+            "def _tick():\n"
+            "    COUNTS[\"n\"] += 1\n"
+            "def launch(ex):\n"
+            "    return ex.submit(_tick)\n"
+            "def scrape():\n"
+            "    return dict(COUNTS)\n"
+        )
+        a = tmp_path / "a"
+        b = tmp_path / "b"
+        a.mkdir()
+        b.mkdir()
+        (a / "mod.py").write_text(src)
+        (b / "mod.py").write_text(src)
+        findings = _active(analyze_project(
+            [str(a / "mod.py"), str(b / "mod.py")]))
+        assert [f.rule for f in findings if f.rule == "JGL000"] \
+            == ["JGL000"]
+        hit_paths = {os.path.dirname(f.path) for f in findings
+                     if f.rule == "JGL009"}
+        assert hit_paths == {str(a), str(b)}  # neither file dropped
+
+    def test_suppressible_with_justification(self):
+        src = (
+            "import threading\n"
+            "COUNTS = {\"n\": 0}\n"
+            "def _tick():\n"
+            "    COUNTS[\"n\"] += 1  # graftlint: disable=JGL009 "
+            "fixture: single-writer invariant documented here\n"
+            "def launch(ex):\n"
+            "    return ex.submit(_tick)\n"
+            "def scrape():\n"
+            "    return dict(COUNTS)\n"
+        )
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "mod.py")
+            with open(p, "w") as fh:
+                fh.write(src)
+            findings = analyze_project([p])
+        assert _active(findings) == []
+        assert [f.rule for f in findings if f.suppressed] == ["JGL009"]
+
+
+class TestProjectEngine:
+    """Cross-module reachability + inference regressions for the
+    whole-program index."""
+
+    def test_three_module_chain_reaches_thread_entry(self):
+        # a.launch -> Thread(target=worker); worker -> b.step ->
+        # c.record: the JGL009 in c.py is only derivable whole-program
+        findings = _active(analyze_project([_fixture("projpkg")]))
+        assert [(f.rule, os.path.basename(f.path), f.line)
+                for f in findings] == [("JGL009", "c.py", 5)]
+        assert findings[0].entry_point == "thread:projpkg.a.worker"
+        assert findings[0].thread_reachable is True
+        # each module ALONE is clean — the chain is the point
+        for mod in ("a.py", "b.py", "c.py"):
+            assert _active(analyze_project(
+                [os.path.join(_fixture("projpkg"), mod)])) == []
+
+    def test_parent_root_anchors_names_at_the_package(self, tmp_path):
+        # `--project <repo-checkout>`: module names must anchor at the
+        # outermost PACKAGE (__init__.py chain), not at the CLI root —
+        # a `container.pkg.mod` name would never match `from pkg.mod
+        # import ...` and silently degrade every cross-module edge
+        container = tmp_path / "container"
+        pkg = container / "pkg"
+        pkg.mkdir(parents=True)
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text(
+            "import threading\n"
+            "from pkg.c import record\n"
+            "def worker():\n"
+            "    record(1)\n"
+            "def launch():\n"
+            "    threading.Thread(target=worker).start()\n"
+        )
+        (pkg / "c.py").write_text(
+            "TALLY = {\"n\": 0}\n"
+            "def record(n):\n"
+            "    TALLY[\"n\"] += n\n"
+            "def snapshot():\n"
+            "    return dict(TALLY)\n"
+        )
+        for root in (str(pkg), str(container)):
+            findings = _active(analyze_project([root]))
+            assert [(f.rule, os.path.basename(f.path), f.line)
+                    for f in findings] == [("JGL009", "c.py", 3)], root
+            assert findings[0].entry_point == "thread:pkg.a.worker"
+
+    def test_file_reachable_twice_reports_once(self):
+        # passed directly AND under its directory: one analysis, not
+        # a 4x merge of duplicated module records
+        once = _active(analyze_project([_fixture("jgl010_bad.py")]))
+        twice = _active(analyze_project(
+            [FIXTURES, _fixture("jgl010_bad.py")]))
+        mine = [f for f in twice
+                if os.path.basename(f.path) == "jgl010_bad.py"]
+        assert len(mine) == len(once) == 2
+
+    def test_traced_reachability_crosses_modules(self, tmp_path):
+        # a traced (jit) body calls an imported helper whose np.asarray
+        # is a JGL001 only the cross-module propagation can see
+        pkg = tmp_path / "pkgx"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "runner.py").write_text(
+            "import jax\n"
+            "from pkgx.helper import pull\n"
+            "@jax.jit\n"
+            "def run(x):\n"
+            "    return pull(x)\n"
+        )
+        (pkg / "helper.py").write_text(
+            "import numpy as np\n"
+            "def pull(x):\n"
+            "    return np.asarray(x)\n"
+        )
+        project = _active(analyze_project([str(pkg)]))
+        assert [(f.rule, os.path.basename(f.path)) for f in project] \
+            == [("JGL001", "helper.py")]
+        # per-path mode stops at the module boundary — silent
+        assert _active(analyze_paths([str(pkg)])) == []
+
+    def test_held_lock_propagates_through_call_graph(self, tmp_path):
+        # _bump's write is guarded only by its CALLER's `with` — the
+        # fixpoint must credit it when every call site holds the lock,
+        # and collapse (flag) when one lock-free site appears
+        common = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def _bump(self):\n"
+            "        self.n += 1\n"
+            "    def tick(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+            "    def run(self):\n"
+            "        self.tick()\n"
+            "    def snapshot(self):\n"
+            "        with self._lock:\n"
+            "            return self.n\n"
+            "def spawn(box):\n"
+            "    t = threading.Thread(target=box.run)\n"
+            "    t.start()\n"
+            "    return t\n"
+        )
+        clean = tmp_path / "clean.py"
+        clean.write_text(common)
+        assert _active(analyze_project([str(clean)])) == []
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(common + "\n"
+                         "def poke(box):\n"
+                         "    box._bump()\n")
+        findings = _active(analyze_project([str(dirty)]))
+        assert [f.rule for f in findings] == ["JGL009"]
+        assert findings[0].line == 7  # the write inside _bump
+        # one lock-free path collapses the intersection: the write is
+        # no longer guaranteed guarded anywhere, so the finding reports
+        # it unlocked rather than naming the tick path's lock
+        assert "NO lock" in findings[0].message
+
+    def test_http_handler_attrs_are_request_confined(self, tmp_path):
+        src = (
+            "from http.server import BaseHTTPRequestHandler\n"
+            "class Handler(BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        self._send()\n"
+            "    def _send(self):\n"
+            "        self.wfile.write(b'ok')\n"
+        )
+        p = tmp_path / "h.py"
+        p.write_text(src)
+        assert _active(analyze_project([str(p)])) == []
+
+    @pytest.mark.parametrize("call_form", [
+        "import subprocess\ndef probe():\n"
+        "    return subprocess.run([\"true\"])\n",   # attribute form
+        "from subprocess import run\ndef probe():\n"
+        "    return run([\"true\"])\n",              # bare-name form
+    ])
+    def test_external_library_calls_do_not_name_match(self, tmp_path,
+                                                      call_form):
+        # subprocess.run must NOT link to a local `def run` — in either
+        # import form, that edge would drag unrelated classes into
+        # thread reachability (and taint traced propagation)
+        src = (
+            "import threading\n"
+            + call_form +
+            "class Flow:\n"
+            "    def __init__(self):\n"
+            "        self.state = {}\n"
+            "    def run(self):\n"
+            "        self.state[\"k\"] = 1\n"
+            "def worker():\n"
+            "    probe()\n"
+            "def launch():\n"
+            "    threading.Thread(target=worker).start()\n"
+        )
+        p = tmp_path / "m.py"
+        p.write_text(src)
+        assert _active(analyze_project([str(p)])) == []
+
+
+# ---------------------------------------------------------------------------
 # tier-1 gates
 
 
@@ -538,6 +838,66 @@ class TestTier1Gates:
         for f in findings:
             if f.suppressed:
                 assert f.justification, f
+
+    def test_repo_is_clean_in_project_mode(self):
+        """The whole-program gate (ISSUE 11): zero unsuppressed
+        findings with the cross-module index and the concurrency rules
+        enabled — the same paths the per-path gate lints, plus
+        JGL009-011 and cross-module traced reachability on top."""
+        findings = analyze_project([
+            os.path.join(REPO, "factorvae_tpu"),
+            os.path.join(REPO, "scripts"),
+        ])
+        active = _active(findings)
+        assert active == [], \
+            "unsuppressed --project findings:\n" + "\n".join(
+                f"  {f.path}:{f.line}: {f.rule} {f.message}"
+                for f in active)
+        for f in findings:
+            if f.suppressed:
+                assert f.justification, f
+
+    def test_project_cli_json_contract(self):
+        """`--project --format json` extends the finding schema with
+        thread_reachable/entry_point (additive: module-local findings
+        carry the defaults)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "factorvae_tpu.analysis",
+             "--project", _fixture("jgl009_bad.py"),
+             "--format", "json"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload["counts"]["active"] == 4
+        for f in payload["findings"]:
+            assert f["rule"] == "JGL009"
+            assert f["thread_reachable"] is True
+            assert f["entry_point"].startswith(("thread:", "executor:"))
+        # module-local findings carry the new keys with defaults
+        proc = subprocess.run(
+            [sys.executable, "-m", "factorvae_tpu.analysis",
+             _fixture("jgl002_bad.py"), "--format", "json"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        payload = json.loads(proc.stdout)
+        for f in payload["findings"]:
+            assert f["thread_reachable"] is False
+            assert f["entry_point"] == ""
+
+    def test_project_cli_defaults_to_package_and_scripts(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "factorvae_tpu.analysis",
+             "--project"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stdout
+        # ...and bare invocation without --project still demands paths
+        proc = subprocess.run(
+            [sys.executable, "-m", "factorvae_tpu.analysis"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert proc.returncode == 2
 
     def test_ruff_gate(self):
         """Run ruff under the [tool.ruff] baseline when it is installed;
