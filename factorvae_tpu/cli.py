@@ -68,6 +68,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "seed's best-val weights. With --auto_plan the "
                         "planner's raced seeds_per_program knob sizes "
                         "the programs; otherwise all N share one")
+    p.add_argument("--hyper_grid", type=str, default=None,
+                   metavar="LR:KLW,LR:KLW,...",
+                   help="race a hyperparameter grid through hyper-fleet "
+                        "programs (ISSUE 12, train/fleet.py): each "
+                        "lr:kl_weight point trains as one LANE of a "
+                        "stacked program (per-lane runtime scalars — one "
+                        "compile for the whole grid), every point scores "
+                        "its best-val snapshot, and the rest of the "
+                        "pipeline (score/backtest/export) runs on the "
+                        "best point's weights. Composes with --mesh "
+                        "(lanes ride the 'data' axis; an indivisible "
+                        "lane count is the documented CompositionError, "
+                        "exit 2) and with --auto_plan "
+                        "(Plan.lanes_per_program sizes the programs)")
     p.add_argument("--kl_weight", type=float, default=None,
                    help="scale on the summed-over-K KL term (default 1.0 "
                         "= reference-faithful loss). Measured null for "
@@ -493,6 +507,92 @@ def main(argv=None) -> int:
                 print(f"error: no checkpoint at {path}; train first", file=sys.stderr)
                 return 2
             _, params = load_model(cfg, checkpoint_path=path, n_max=dataset.n_max)
+        elif args.hyper_grid:
+            # Hyper-fleet config grid (ISSUE 12): the whole lr:kl_weight
+            # grid rides ONE compiled program per shape bucket
+            # (eval/sweep.grid_sweep -> train/fleet.py lane_configs);
+            # downstream scoring/backtest/export runs on the winning
+            # point's best-val weights under its own tagged names.
+            import contextlib
+
+            import numpy as np
+
+            from factorvae_tpu.eval.sweep import (
+                _point_config,
+                grid_sweep,
+                parse_hyper_grid,
+                point_label,
+            )
+            from factorvae_tpu.models.factorvae import load_model
+            from factorvae_tpu.parallel.compose import CompositionError
+            from factorvae_tpu.utils.profiling import debug_nans, trace
+
+            points = parse_hyper_grid(args.hyper_grid)
+            if not points:
+                print("error: --hyper_grid parsed to zero points "
+                      "(format: LR:KLW,LR:KLW,...)", file=sys.stderr)
+                return 2
+            lpp = None
+            if auto_plan is not None:
+                # measured hyper row > measured fleet row (>1 only:
+                # seeds_per_program's default IS 1, which is "no
+                # signal", not "serialize the grid" — one single-lane
+                # program per point would fold every lane to the serial
+                # trace and pay the per-config compile this mode
+                # exists to amortize) > whole grid in one program
+                lpp = auto_plan.lanes_per_program or None
+                if lpp is None and auto_plan.seeds_per_program > 1:
+                    lpp = auto_plan.seeds_per_program
+            nan_ctx = (debug_nans() if args.debug_nans
+                       else contextlib.nullcontext())
+            try:
+                with trace(args.profile), nan_ctx:
+                    df = grid_sweep(
+                        cfg, dataset, points,
+                        score_start=args.score_start,
+                        score_end=args.score_end,
+                        logger=logger, lanes_per_program=lpp,
+                        mesh=run_mesh)
+            except CompositionError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            except ValueError as e:
+                if "empty training split" in str(e):
+                    print(
+                        f"error: no trading days in [{cfg.data.start_time}, "
+                        f"{cfg.data.fit_end_time}]; adjust --start_time/"
+                        f"--fit_end_time", file=sys.stderr)
+                    return 2
+                raise
+
+            by_label = {point_label(p): p for p in points}
+
+            def _point_ckpt(lbl):
+                pcfg = _point_config(cfg, by_label[lbl], lbl)
+                return pcfg, os.path.join(pcfg.train.save_dir,
+                                          pcfg.checkpoint_name())
+
+            ranked = df["rank_ic"].dropna()
+            ranked = ranked[np.isfinite(df.loc[ranked.index, "best_val"])]
+            ranked = ranked[[os.path.isdir(_point_ckpt(lbl)[1])
+                             for lbl in ranked.index]]
+            if ranked.empty:
+                print("error: no grid point with finite rank_ic and a "
+                      "best-val checkpoint; nothing to score/export "
+                      "(check the grid / data ranges)", file=sys.stderr)
+                return 2
+            best_label = str(ranked.idxmax())
+            # best_label here is the CHECKPOINT-FILTERED winner (a point
+            # whose weights survived on disk); the summary's own
+            # best_label is the raw rank_ic argmax — keep the filtered
+            # one, it is what ships downstream.
+            logger.log("hyper_grid", best_label=best_label,
+                       points=[point_label(p) for p in points],
+                       **{k: v for k, v in df.attrs["summary"].items()
+                          if k != "best_label"})
+            cfg, best_path = _point_ckpt(best_label)
+            _, params = load_model(cfg, checkpoint_path=best_path,
+                                   n_max=dataset.n_max)
         elif args.fleet_seeds and args.fleet_seeds > 1:
             # Seed-parallel fleet (train/fleet.py): one program trains the
             # whole seed range [seed, seed+N), the sweep frame picks the

@@ -36,6 +36,15 @@ from factorvae_tpu.train.trainer import Trainer
 from factorvae_tpu.utils.logging import MetricsLogger
 
 
+def _float_or_nan(v) -> float:
+    """JSON round-trips our own NaN placeholders as null (strict-JSON
+    flushes serialize non-finite as null); a resume of a resume must
+    not crash on float(None) — and a legitimate 0.0 must survive (a
+    falsy-`or` fallback would turn it into NaN and silently drop the
+    point from winner selection)."""
+    return float("nan") if v is None else float(v)
+
+
 def _adopted_record(seed: int, prev, logger: MetricsLogger,
                     on_seed) -> dict:
     """Record for a seed adopted from ``prior_records`` without
@@ -43,11 +52,7 @@ def _adopted_record(seed: int, prev, logger: MetricsLogger,
     if not isinstance(prev, dict):
         prev = {"rank_ic": prev}
 
-    def _f(v):
-        # JSON round-trips our own NaN placeholders as null
-        # (strict-JSON flushes serialize non-finite as null);
-        # a resume of a resume must not crash on float(None).
-        return float("nan") if v is None else float(v)
+    _f = _float_or_nan
 
     rec = {
         "seed": int(seed),
@@ -246,4 +251,227 @@ def seed_sweep(
         "num_seeds": len(df),
     }
     logger.log("sweep_summary", **df.attrs["summary"])
+    return df
+
+
+# ---------------------------------------------------------------------------
+# Hyper-fleet config-grid sweep (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+#: grid-point keys that change PARAMETER SHAPES — points sharing these
+#: values share one compiled program; points differing in them bucket
+#: into separate programs (the serve daemon's (arch, dtype, days)
+#: bucketing rule, applied to training).
+SHAPE_KEYS = ("num_factors", "hidden_size", "num_portfolios")
+#: grid-point keys that ride the lane axis as runtime scalars (lr,
+#: kl_weight — train/fleet.py hyper trace) or as the established
+#: per-lane seed axis.
+LANE_KEYS = ("lr", "kl_weight", "seed")
+
+
+def parse_hyper_grid(spec: str) -> list:
+    """'1e-4:1.0,3e-4:0.1' -> [{"lr": 1e-4, "kl_weight": 1.0}, ...] —
+    the lr:kl_weight token format scripts/parity_k60_sweep.py always
+    used, shared by `cli.py --hyper_grid`."""
+    points = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        lr, klw = tok.split(":")
+        points.append({"lr": float(lr), "kl_weight": float(klw)})
+    return points
+
+
+def point_label(point: dict) -> str:
+    """Deterministic compact label for one grid point (the frame index
+    and the resume key — prior_records match on it)."""
+    parts = []
+    for key, tag in (("lr", "lr"), ("kl_weight", "kl"),
+                     ("num_factors", "K"), ("hidden_size", "H"),
+                     ("num_portfolios", "M"), ("seed", "s")):
+        if key in point:
+            v = point[key]
+            parts.append(f"{tag}{v:g}" if isinstance(v, float)
+                         else f"{tag}{v}")
+    return "_".join(parts) or "base"
+
+
+def shape_bucket_key(point: dict) -> tuple:
+    """The shape coordinates of a grid point (None = inherit the base
+    config). Pure and total: the bucket partition is a deterministic
+    function of the point list alone (pinned in tests/test_hyper.py)."""
+    return tuple(point.get(k) for k in SHAPE_KEYS)
+
+
+def shape_buckets(points: Sequence[dict]) -> list:
+    """[(bucket_key, [(index, point), ...]), ...] — buckets ordered by
+    first occurrence, points kept in caller order within a bucket."""
+    order: list = []
+    buckets: dict = {}
+    for i, p in enumerate(points):
+        k = shape_bucket_key(p)
+        if k not in buckets:
+            buckets[k] = []
+            order.append(k)
+        buckets[k].append((i, p))
+    return [(k, buckets[k]) for k in order]
+
+
+def _point_config(config: Config, point: dict, label: str) -> Config:
+    """Full per-lane Config for one grid point: shape keys land on the
+    model, lane scalars on train/model, and the run_name is tagged with
+    the point label so same-seed lanes write distinct artifacts
+    (train/fleet.validate_lane_configs requires it)."""
+    bad = sorted(set(point) - set(SHAPE_KEYS) - set(LANE_KEYS))
+    if bad:
+        raise ValueError(
+            f"unknown grid-point key(s) {bad}: shape keys are "
+            f"{list(SHAPE_KEYS)}, lane keys are {list(LANE_KEYS)}")
+    model_kw = {k: point[k] for k in SHAPE_KEYS if k in point}
+    if "kl_weight" in point:
+        model_kw["kl_weight"] = float(point["kl_weight"])
+    train_kw: dict = {"run_name": f"{config.train.run_name}_{label}"}
+    if "lr" in point:
+        train_kw["lr"] = float(point["lr"])
+    if "seed" in point:
+        train_kw["seed"] = int(point["seed"])
+    return dataclasses.replace(
+        config,
+        model=dataclasses.replace(config.model, **model_kw),
+        train=dataclasses.replace(config.train, **train_kw),
+    )
+
+
+def grid_sweep(
+    config: Config,
+    dataset: PanelDataset,
+    points: Sequence[dict],
+    score_start: Optional[str] = None,
+    score_end: Optional[str] = None,
+    logger: Optional[MetricsLogger] = None,
+    on_point=None,
+    prior_records: Optional[dict] = None,
+    lanes_per_program: Optional[int] = None,
+    mesh=None,
+) -> pd.DataFrame:
+    """Race a hyperparameter-config grid through hyper-fleet programs
+    (ISSUE 12): each point is a dict over SHAPE_KEYS (num_factors /
+    hidden_size / num_portfolios — per-shape programs) and LANE_KEYS
+    (lr / kl_weight / seed — per-lane runtime scalars on the stacked
+    TrainState, train/fleet.py). Points bucket by shape, each bucket
+    trains in hyper-fleet programs of ``lanes_per_program`` lanes
+    (None/0 = the whole bucket in one program), and every lane scores
+    with its best-validation snapshot through the seed-batched scan.
+
+    Returns a frame indexed by `point_label` with the point's fields
+    plus [rank_ic, rank_ic_ir, best_val]; ``.attrs["summary"]`` carries
+    the winner. The `seed_sweep` resume/callback contract is preserved:
+    ``on_point(rec)`` fires per finished point (adopted points
+    included), and ``prior_records`` (label -> record) adopts finished
+    points from a prior partial file without retraining them.
+
+    ``mesh`` composes the lane axis with the device mesh exactly like
+    the seed fleet (lanes over 'data'; compose.validate rejects an
+    indivisible lane count with the documented one-line
+    CompositionError at construction, not mid-fit)."""
+    import jax
+    import numpy as np
+
+    from factorvae_tpu.eval.predict import fleet_prediction_scores
+    from factorvae_tpu.train.fleet import FleetTrainer
+
+    logger = logger or MetricsLogger(echo=False)
+    prior_records = prior_records or {}
+    labels = [point_label(p) for p in points]
+    dup = {v for v in labels if labels.count(v) > 1}
+    if dup:
+        raise ValueError(f"duplicate grid points: {sorted(dup)}")
+    records: dict = {}
+
+    for label, point in zip(labels, points):
+        if label in prior_records:
+            prev = dict(prior_records[label])
+            rec = {"label": label, **point,
+                   "rank_ic": _float_or_nan(prev.get("rank_ic")),
+                   "rank_ic_ir": _float_or_nan(prev.get("rank_ic_ir")),
+                   "best_val": _float_or_nan(prev.get("best_val"))}
+            records[label] = rec
+            logger.log("grid_point_resumed", **rec)
+            if on_point is not None:
+                on_point(rec)
+
+    pending = [(lbl, p) for lbl, p in zip(labels, points)
+               if lbl not in records]
+    lpp = (len(pending) if not lanes_per_program
+           else max(1, int(lanes_per_program)))
+    for bucket_key, members in shape_buckets([p for _, p in pending]):
+        mem_labels = [pending[i][0] for i, _ in members]
+        bucket_points = [p for _, p in members]
+        # Bucket base config: the shape overrides applied to the base —
+        # ONE FleetTrainer (one compiled program per group) per shape.
+        shape_kw = {k: v for k, v in zip(SHAPE_KEYS, bucket_key)
+                    if v is not None}
+        bucket_cfg = dataclasses.replace(
+            config, model=dataclasses.replace(config.model, **shape_kw))
+        logger.log("grid_bucket", shape={k: v for k, v in
+                                         zip(SHAPE_KEYS, bucket_key)
+                                         if v is not None},
+                   points=mem_labels,
+                   lanes_per_program=lpp)
+        for g0 in range(0, len(bucket_points), lpp):
+            group = bucket_points[g0:g0 + lpp]
+            group_labels = mem_labels[g0:g0 + lpp]
+            # _point_config already applied each point's shape keys,
+            # and every point in this bucket carries the bucket's exact
+            # shape by construction of shape_buckets — the lane cfgs
+            # match bucket_cfg's model shape without a second pass.
+            lane_cfgs = [_point_config(config, p, lbl)
+                         for p, lbl in zip(group, group_labels)]
+            trainer = FleetTrainer(bucket_cfg, dataset,
+                                   lane_configs=lane_cfgs,
+                                   logger=logger, mesh=mesh)
+            state, out = trainer.fit()
+            best_val = np.asarray(out["best_val"])
+            scoring = out["best_params"]
+            for i, lbl in enumerate(group_labels):
+                if not np.isfinite(best_val[i]):
+                    logger.log(
+                        "sweep_warning", label=lbl,
+                        note="best-val selection never improved; "
+                             "scoring FINAL-epoch params")
+                    scoring = jax.tree.map(
+                        lambda b, p: b.at[i].set(p[i]), scoring,
+                        state.params)
+            from factorvae_tpu.utils.profiling import debug_nans
+
+            with debug_nans(False):
+                frames = fleet_prediction_scores(
+                    scoring, bucket_cfg, dataset, start=score_start,
+                    end=score_end, stochastic=False, with_labels=True,
+                    mesh=mesh)
+            for i, (lbl, point) in enumerate(zip(group_labels, group)):
+                ic = rank_ic_frame(frames[i].dropna(), "LABEL0", "score")
+                rec = {
+                    "label": lbl, **point,
+                    "rank_ic": float(ic["RankIC"].iloc[0]),
+                    "rank_ic_ir": float(ic["RankIC_IR"].iloc[0]),
+                    "best_val": float(best_val[i]),
+                }
+                records[lbl] = rec
+                logger.log("grid_point", **rec)
+                if on_point is not None:
+                    on_point(rec)
+
+    # caller's point order, exactly like seed_sweep's seed order
+    df = pd.DataFrame([records[lbl] for lbl in labels]).set_index("label")
+    finite = df["rank_ic"].dropna()
+    df.attrs["summary"] = {
+        "num_points": len(df),
+        "num_buckets": len(shape_buckets(list(points))),
+        "best_label": (str(finite.idxmax()) if len(finite) else None),
+        "best_rank_ic": (float(finite.max()) if len(finite)
+                         else float("nan")),
+    }
+    logger.log("grid_summary", **df.attrs["summary"])
     return df

@@ -325,6 +325,24 @@ class TextfileExporter:
             fam.append((f"{p}_train_step", "gauge",
                         "optimizer step after the epoch",
                         [metric_line(f"{p}_train_step", rec["step"])]))
+        # Fleet lane-config labels (ISSUE 12): hyper lanes race
+        # DIFFERENT configs, so every per-lane gauge carries the config
+        # that produced it (lr/kl_weight/config hash) next to its
+        # seed_lane index — the scrape-side twin of the obs.report flag
+        # labels. Absent on serial runs and pre-ISSUE-12 streams.
+        lane_names = rec.get("lane_labels")
+        if not (isinstance(lane_names, list)
+                and all(isinstance(x, str) for x in lane_names)):
+            lane_names = None
+
+        def _labels(lane):
+            if lane is None:
+                return None
+            lab = {"seed_lane": str(lane)}
+            if lane_names and lane < len(lane_names):
+                lab["lane_config"] = lane_names[lane]
+            return lab
+
         for key in sorted(rec):
             if key in _EPOCH_SKIP or key.startswith("_"):
                 continue
@@ -332,10 +350,8 @@ class TextfileExporter:
             if not lanes:
                 continue
             name = f"{p}_train_{key}"
-            lines = [metric_line(
-                name, v,
-                None if lane is None else {"seed_lane": str(lane)})
-                for lane, v in lanes]
+            lines = [metric_line(name, v, _labels(lane))
+                     for lane, v in lanes]
             fam.append((name, "gauge",
                         f"epoch-record metric '{key}'", lines))
         text = render_families(fam)

@@ -216,6 +216,33 @@ def _lane(rec: dict, key: str, s: int) -> Optional[float]:
     return lanes[s] if s < len(lanes) else None
 
 
+def _lane_name(recs, s: int) -> Optional[str]:
+    """The lane-CONFIG label for seed lane `s`, from the newest record
+    carrying `lane_labels` (fleet epoch records since ISSUE 12: the
+    hyper fleet races DIFFERENT configs per lane, so an alert must name
+    the config that diverged — lr/kl_weight/config hash — not just the
+    lane index). None on pre-ISSUE-12 streams."""
+    if isinstance(recs, dict):
+        recs = [recs]
+    for rec in reversed(list(recs)):
+        labels = rec.get("lane_labels")
+        if isinstance(labels, list) and s < len(labels) \
+                and isinstance(labels[s], str):
+            return labels[s]
+    return None
+
+
+def _seed_tag(recs, s: int, width: int) -> str:
+    """' (seed lane N)' / ' (seed lane N: <config label>)' / '' — ONE
+    formatter for every per-lane flag detail, so obs.report, obs.live
+    and the skip_step recovery flags name lanes identically."""
+    if width <= 1:
+        return ""
+    name = _lane_name(recs, s)
+    return (f" (seed lane {s}: {name})" if name
+            else f" (seed lane {s})")
+
+
 def health_flags(epochs: List[dict], events: List[dict],
                  spike_mult: float = 10.0, slow_frac: float = 0.5,
                  diverge_frac: float = 0.2,
@@ -229,8 +256,8 @@ def health_flags(epochs: List[dict], events: List[dict],
         flags.append({"epoch": rec.get("epoch"), "line": rec.get("_line"),
                       "flag": kind, "detail": detail})
 
-    def seed_tag(s: int, width: int) -> str:
-        return f" (seed lane {s})" if width > 1 else ""
+    def seed_tag(rec, s: int, width: int) -> str:
+        return _seed_tag(rec, s, width)
 
     # Every stateful check runs PER SEGMENT (per run): baselines,
     # medians, exemptions and the plan envelope from one grid point or
@@ -271,7 +298,7 @@ def health_flags(epochs: List[dict], events: List[dict],
                     flag(rec, "grad_spike",
                          f"grad_norm_max={gmax:.4g} > {spike_mult:g}x "
                          f"median grad_norm_mean ({base:.4g})"
-                         + seed_tag(s, s_grad))
+                         + seed_tag(rec, s, s_grad))
 
         # val divergence, per seed lane: >= diverge_epochs consecutive
         # epochs sitting diverge_frac above that seed's best in this run
@@ -290,7 +317,8 @@ def health_flags(epochs: List[dict], events: List[dict],
                              f"val loss >= {1 + diverge_frac:g}x its "
                              f"best ({best:.6g}) for {diverge_epochs} "
                              "consecutive epochs (through epoch "
-                             f"{rec.get('epoch')})" + seed_tag(s, s_val))
+                             f"{rec.get('epoch')})"
+                             + seed_tag(streak[0], s, s_val))
                 else:
                     streak = []
                 best = min(best, v)
@@ -414,8 +442,7 @@ def recovery_flags(run: dict) -> List[dict]:
             continue
         width = len(lanes)
         detail = ", ".join(
-            f"{n:g} update(s) skipped"
-            + (f" (seed lane {s})" if width > 1 else "")
+            f"{n:g} update(s) skipped" + _seed_tag(rec, s, width)
             for s, n in hit)
         flags.append({"epoch": rec.get("epoch"), "line": rec.get("_line"),
                       "flag": "skip_step",

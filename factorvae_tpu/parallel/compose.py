@@ -77,10 +77,17 @@ def validate(
     residency: str = "hbm",
     days_per_step: int = 1,
     stream_chunk_days: int = 32,
+    hyper: bool = False,
 ) -> None:
     """Raise CompositionError if the requested axis composition cannot
     ship; a silent pass means Trainer/FleetTrainer/ChunkStream will
-    compose these axes in one program."""
+    compose these axes in one program.
+
+    ``hyper=True`` labels the lane axis as a hyper-fleet CONFIG axis
+    (ISSUE 12): the constraint is the same — lanes ride '{SEED_AXIS}'
+    — but the one-line error names the hyper grid, so a grid whose lane
+    count doesn't divide the mesh fails at construction (CLI exit 2)
+    instead of as a mid-fit stacking error."""
     if residency not in ("hbm", "stream"):
         _fail("stream", f"panel_residency must be 'hbm' or 'stream'; "
                         f"got {residency!r}")
@@ -104,15 +111,18 @@ def validate(
                 f"the '{DATA_AXIS}' axis",
             )
         return
-    # Fleet runs: seed lanes ride SEED_AXIS ('data'); day-batches shard
-    # over the 'host' axis when the mesh has one.
+    # Fleet runs: seed (or hyper-config) lanes ride SEED_AXIS ('data');
+    # day-batches shard over the 'host' axis when the mesh has one.
+    axes = "mesh x hyper" if hyper else "mesh x fleet"
+    lanes = "config lanes" if hyper else "seeds"
     seed_ways = seed_parallel_size(mesh)
     if num_seeds % seed_ways:
         _fail(
-            "mesh x fleet",
-            f"fleet of {num_seeds} seeds not divisible by the "
+            axes,
+            f"{'hyper grid' if hyper else 'fleet'} of {num_seeds} "
+            f"{lanes} not divisible by the "
             f"'{SEED_AXIS}' mesh axis ({seed_ways} lanes; mesh "
-            f"{dict(mesh.shape)}); pick a seed count that is a "
+            f"{dict(mesh.shape)}); pick a lane count that is a "
             f"multiple of {seed_ways} or reshape the mesh",
         )
     day = day_batch_axes(mesh, stacked=True)
@@ -120,7 +130,7 @@ def validate(
         dp = int(mesh.shape[day[0]])
         if days_per_step % dp:
             _fail(
-                "mesh x fleet",
+                axes,
                 f"days_per_step={days_per_step} not divisible by the "
                 f"'{day[0]}' axis ({dp}) that day-batches shard over "
                 f"on a hierarchical mesh (mesh {dict(mesh.shape)})",

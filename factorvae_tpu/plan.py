@@ -143,6 +143,17 @@ class Plan:
     the row — absent on pre-fleet rows, which keep resolving exactly as
     before).
 
+    `lanes_per_program` is the HYPER-fleet knob (ISSUE 12,
+    train/fleet.py lane_configs + eval/sweep.grid_sweep): how many
+    heterogeneous (lr, kl_weight) config lanes one training program
+    should batch when a caller sweeps a hyperparameter grid. 0 means
+    "no measured hyper row" — grid callers then fall back to
+    `seeds_per_program` (the lane axis is the same stacked axis), and
+    1 means serial. Raced by `scripts/autotune_plan.py --hyper` (a
+    `"hyper"` block: `{"lanes_per_program": n}`; absent on every
+    pre-ISSUE-12 row, which resolves to 0 — the established
+    fleet/stream/obs/mesh backward-compatibility rule).
+
     `panel_residency` / `stream_chunk_days` are the out-of-core knobs
     (data/stream.py, docs/streaming.md): "hbm" keeps the whole panel on
     device (today's path), "stream" keeps it host-resident and
@@ -210,6 +221,7 @@ class Plan:
     use_pallas_attention: Union[bool, str] = "auto"
     use_pallas_gru: Union[bool, str] = "auto"
     seeds_per_program: int = 1
+    lanes_per_program: int = 0
     panel_residency: str = "hbm"
     stream_chunk_days: int = 32
     obs_probes: bool = False
@@ -445,6 +457,11 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 # serial default (no schema break for existing tables).
                 seeds_per_program=int(
                     (row.get("fleet") or {}).get("seeds_per_program") or 1),
+                # Pre-ISSUE-12 rows have no "hyper" block: 0 = no
+                # measured lane width (grid callers fall back to
+                # seeds_per_program; same no-schema-break rule).
+                lanes_per_program=int(
+                    (row.get("hyper") or {}).get("lanes_per_program") or 0),
                 # Pre-stream rows have no "stream" block: resolve to the
                 # HBM residency (same backward-compatibility rule).
                 panel_residency=str(

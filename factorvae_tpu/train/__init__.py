@@ -1,10 +1,12 @@
 from factorvae_tpu.train.checkpoint import Checkpointer, load_params, save_params
 from factorvae_tpu.train.fleet import FleetTrainer, stack_states, unstack_state
 from factorvae_tpu.train.loop import StepFns, make_step_fns
+from factorvae_tpu.train.pbt import pbt_fit
 from factorvae_tpu.train.state import (
     TrainState,
     create_train_state,
     learning_rate_at,
+    make_hyper_optimizer,
     make_optimizer,
 )
 from factorvae_tpu.train.trainer import Trainer
@@ -18,8 +20,10 @@ __all__ = [
     "create_train_state",
     "learning_rate_at",
     "load_params",
+    "make_hyper_optimizer",
     "make_optimizer",
     "make_step_fns",
+    "pbt_fit",
     "save_params",
     "stack_states",
     "unstack_state",

@@ -1,4 +1,5 @@
-"""Seed-parallel fleet training: S independent models in one program.
+"""Seed- and CONFIG-parallel fleet training: S independent models in one
+program.
 
 The round-2 trace shows the chip ~93% idle at MFU 7.1%: every FactorVAE
 matmul is launch/tile-bound because the contraction dims (158/64/96)
@@ -32,6 +33,29 @@ Semantics contract (tests/test_fleet.py):
   `seed_sweep`'s best-val selection rule and resume semantics are
   preserved: a fleet-trained sweep leaves artifacts a serial run (or a
   serial resume) can consume.
+
+Hyper-fleet (ISSUE 12): the seed axis generalizes to a CONFIG axis.
+``lane_configs`` hands each lane its own Config, where per-lane SCALAR
+hyperparameters — ``train.lr`` and ``model.kl_weight`` — become f32
+runtime inputs of one compiled program ((S,) vectors riding the stacked
+state's axis; train/loop.py `hyper_step_size`, state.py
+`make_hyper_optimizer`), so a whole (lr x kl_weight) sweep shares ONE
+compile and every lane's artifacts land under its own lane-config names.
+Shape-changing variants (K/H) do NOT ride this axis — `eval/sweep.py
+grid_sweep` buckets them into per-shape programs, the same way the serve
+daemon buckets requests by (arch, dtype, days).
+
+Hyper bitwise discipline (tests/test_hyper.py):
+- Lanes whose scalars are ALL IDENTICAL fold to the exact pre-hyper
+  trace: the scalars are rebaked into the base config and the PR-2 path
+  compiles, so a homogeneous "hyper" fleet IS the seed fleet (and S=1 IS
+  the serial Trainer) — bitwise by construction, pinned.
+- A heterogeneous lane is BITWISE lane i of a same-width homogeneous
+  hyper fleet pinned at that lane's config: the runtime-scalar threading
+  adds ZERO numeric drift on top of the established vmap semantics.
+  Against the serial Trainer at that config it inherits the PR-2 fleet's
+  f32 tolerance (vmap batches the matmuls; reassociation, not hyper, is
+  the gap — S>1 seed lanes have never been bitwise vs solo).
 """
 
 from __future__ import annotations
@@ -67,6 +91,7 @@ from factorvae_tpu.train.state import (
     TrainState,
     create_train_state,
     learning_rate_at,
+    make_hyper_optimizer,
     make_optimizer,
 )
 from factorvae_tpu.utils.logging import (
@@ -74,6 +99,64 @@ from factorvae_tpu.utils.logging import (
     timeline_event,
     timeline_span,
 )
+
+
+#: per-lane Config fields a hyper fleet may vary — lr/kl_weight ride the
+#: stacked program as runtime scalars; seed is the established lane axis;
+#: run_name/save_dir only rename the per-lane artifacts (grid_sweep tags
+#: each point's run_name so same-seed lanes can't collide on disk).
+LANE_TRAIN_FIELDS = frozenset({"lr", "seed", "run_name", "save_dir"})
+LANE_MODEL_FIELDS = frozenset({"kl_weight"})
+
+
+def validate_lane_configs(base: Config, lane_configs: Sequence[Config]):
+    """Reject a lane set one compiled program cannot carry: every field
+    OUTSIDE the lane-varying sets must equal the base config's — a K/H
+    (shape) variant belongs in a different shape bucket (grid_sweep),
+    not on the lane axis — and every lane must write distinct artifacts
+    (`checkpoint_name()` collision = same run_name+seed racing two
+    scalar configs into one directory)."""
+    for i, c in enumerate(lane_configs):
+        for f in dataclasses.fields(c.model):
+            if f.name in LANE_MODEL_FIELDS:
+                continue
+            if getattr(c.model, f.name) != getattr(base.model, f.name):
+                raise ValueError(
+                    f"lane {i} varies model.{f.name}: shape/arch fields "
+                    "cannot ride the lane axis of one compiled program — "
+                    "bucket per shape (eval.sweep.grid_sweep) instead")
+        for f in dataclasses.fields(c.train):
+            if f.name in LANE_TRAIN_FIELDS:
+                continue
+            if getattr(c.train, f.name) != getattr(base.train, f.name):
+                raise ValueError(
+                    f"lane {i} varies train.{f.name}: only "
+                    f"{sorted(LANE_TRAIN_FIELDS)} may differ per lane")
+        if c.data != base.data:
+            raise ValueError(
+                f"lane {i} varies the data config: lanes share one "
+                "panel/splits by construction")
+    names = [(c.train.save_dir, c.checkpoint_name()) for c in lane_configs]
+    dup = {n for n in names if names.count(n) > 1}
+    if dup:
+        raise ValueError(
+            "lane checkpoint paths collide (same save_dir+run_name+seed "
+            f"across different lane configs): {sorted(dup)}; tag each "
+            "lane's run_name or save_dir (grid_sweep tags run_name per "
+            "point)")
+
+
+def lane_label(cfg: Config, hyper: bool) -> str:
+    """Short human label for one lane, used by obs.report/obs.live flag
+    details and the Prometheus `lane_config` label: the config that
+    diverged, not just the lane index."""
+    if not hyper:
+        return f"seed={cfg.train.seed}"
+    from factorvae_tpu.utils.logging import config_hash
+
+    return (f"seed={cfg.train.seed} lr={cfg.train.lr:g} "
+            f"klw={cfg.model.kl_weight:g} "
+            f"cfg={config_hash(cfg.to_dict())[:8]}")
 
 
 def stack_states(states: Sequence[TrainState]) -> TrainState:
@@ -123,17 +206,61 @@ class FleetTrainer:
         self,
         config: Config,
         dataset: PanelDataset,
-        seeds: Sequence[int],
+        seeds: Optional[Sequence[int]] = None,
         logger: Optional[MetricsLogger] = None,
         mesh: Optional[object] = None,
+        lane_configs: Optional[Sequence[Config]] = None,
+        force_hyper: bool = False,
     ):
-        if len(seeds) == 0:
-            raise ValueError("empty fleet: need at least one seed")
-        if len(set(int(s) for s in seeds)) != len(seeds):
-            raise ValueError(f"duplicate seeds in fleet: {list(seeds)}")
+        """``seeds`` names a classic seed fleet (every lane = `config`
+        at that seed). ``lane_configs`` (mutually exclusive) names a
+        HYPER fleet: one Config per lane, varying only the lane fields
+        (`validate_lane_configs`). Lanes whose (lr, kl_weight) are all
+        identical FOLD: the scalars are rebaked into the base config and
+        the pre-hyper trace compiles — a homogeneous hyper fleet is
+        bitwise the seed fleet (and S=1 bitwise the serial Trainer) by
+        construction. ``force_hyper=True`` keeps the runtime-scalar
+        trace even for (S>1) homogeneous lanes — the PBT loop
+        (train/pbt.py) perturbs scalars BETWEEN generations of one
+        compiled program, and the bitwise-oracle tests pin the hyper
+        trace against the folded one."""
+        if lane_configs is not None:
+            if seeds is not None:
+                raise ValueError(
+                    "pass seeds OR lane_configs, not both (lane configs "
+                    "carry their own train.seed)")
+            lane_cfgs = list(lane_configs)
+            if not lane_cfgs:
+                raise ValueError("empty fleet: need at least one lane")
+            validate_lane_configs(config, lane_cfgs)
+        else:
+            if seeds is None or len(seeds) == 0:
+                raise ValueError("empty fleet: need at least one seed")
+            if len(set(int(s) for s in seeds)) != len(seeds):
+                raise ValueError(f"duplicate seeds in fleet: {list(seeds)}")
+            lane_cfgs = [
+                dataclasses.replace(
+                    config,
+                    train=dataclasses.replace(config.train, seed=int(s)))
+                for s in seeds
+            ]
+        scalars = {(c.train.lr, c.model.kl_weight) for c in lane_cfgs}
+        self.hyper = len(lane_cfgs) > 1 and (len(scalars) > 1
+                                             or bool(force_hyper))
+        if not self.hyper and lane_configs is not None:
+            # Homogeneous fold: rebake the single scalar pair into the
+            # base config so the compiled trace is EXACTLY the pre-hyper
+            # seed-fleet (or, at S=1, serial-Trainer) program.
+            lr, klw = next(iter(scalars))
+            config = dataclasses.replace(
+                config,
+                model=dataclasses.replace(config.model, kl_weight=klw),
+                train=dataclasses.replace(config.train, lr=lr),
+            )
         self.cfg = config
         self.ds = dataset
-        self.seeds = [int(s) for s in seeds]
+        self.lane_cfgs = lane_cfgs
+        self.seeds = [int(c.train.seed) for c in lane_cfgs]
         self.num_seeds = len(self.seeds)
         self.logger = logger or MetricsLogger(echo=False)
         self.mesh = mesh
@@ -143,6 +270,7 @@ class FleetTrainer:
             residency=getattr(dataset, "residency", "hbm"),
             days_per_step=max(1, config.train.days_per_step),
             stream_chunk_days=config.data.stream_chunk_days,
+            hyper=self.hyper,
         )
         if mesh is not None:
             # HBM panels re-place onto the mesh once; stream datasets
@@ -177,6 +305,8 @@ class FleetTrainer:
             "fleet_execution_layout",
             seeds=self.seeds,
             seeds_per_program=self.num_seeds,
+            hyper=self.hyper,
+            lane_labels=self.lane_labels(),
             flatten_days=config.model.flatten_days,
             days_per_step=self.batch_days,
             compute_dtype=config.model.compute_dtype,
@@ -222,7 +352,17 @@ class FleetTrainer:
 
         cfg = self.cfg
         mesh = self.mesh
-        self.tx = make_optimizer(cfg.train, self.total_steps)
+        # Hyper trace: Adam with the lr multiply deferred to a runtime
+        # per-lane scalar (state.make_hyper_optimizer — same opt-state
+        # tree as make_optimizer, so per-lane checkpoints stay
+        # serial-compatible). The folded/classic paths keep the exact
+        # pre-hyper optimizer.
+        self._hyper_step_size = None
+        if self.hyper:
+            self.tx, self._hyper_step_size = make_hyper_optimizer(
+                cfg.train, self.total_steps)
+        else:
+            self.tx = make_optimizer(cfg.train, self.total_steps)
         # Trace-time chaos gate (same rule as the serial Trainer): the
         # poison argument exists only on builds made under an installed
         # nan_grads fault plan; per-LANE on the vmapped path, so one bad
@@ -240,6 +380,7 @@ class FleetTrainer:
             self.model, self.model_eval, self.tx, cfg.data.seq_len,
             shard_batch=shard_batch, obs=cfg.train.obs_probes,
             guard=cfg.train.finite_guard, inject_nan=self._inject,
+            hyper_step_size=self._hyper_step_size,
         )
         from factorvae_tpu.obs.watchdog import watch_jit
 
@@ -331,28 +472,36 @@ class FleetTrainer:
             # seed axis like the state/orders ((S,) sharded seed_pref
             # under a mesh).
             inject = self._inject
+            hyper = self.hyper
             if mesh is not None:
                 seed_pref = partition.named(
                     mesh, jax.sharding.PartitionSpec(partition.SEED_AXIS))
+                # Trailing trace-gated args, hp FIRST (loop._split_extras):
+                # the hp dict's (S,) lr/kl_weight vectors ride the seed
+                # axis like every other per-lane leaf (seed_pref as a
+                # prefix pytree), poison likewise.
+                hyper_extra = (seed_pref,) if hyper else ()
                 stacked_extra = (seed_pref,) if inject else ()
                 jit_kw = dict(in_shardings=(state_sh, ord_sh, pan_s)
-                              + stacked_extra,
+                              + hyper_extra + stacked_extra,
                               out_shardings=(state_sh, seed_pref))
                 eval_kw = dict(in_shardings=(state_sh.params, val_ord_sh,
-                                             keys_sh, pan_s),
+                                             keys_sh, pan_s) + hyper_extra,
                                out_shardings=seed_pref)
                 pan_stacked = tuple(
                     partition.named(mesh, s)
                     for s in partition.panel_partition_specs(stacked=True))
                 chunk_kw = dict(
                     in_shardings=(state_sh, ord_sh, pan_stacked)
-                    + stacked_extra,
+                    + hyper_extra + stacked_extra,
                     out_shardings=(state_sh, seed_pref))
                 eval_chunk_kw = dict(
                     in_shardings=(state_sh.params, val_ord_sh, keys_sh,
-                                  pan_s),
+                                  pan_s) + hyper_extra,
                     out_shardings=seed_pref)
-            train_axes = (0, 0, None, 0) if inject else (0, 0, None)
+            hyp_ax = (0,) if hyper else ()
+            inj_ax = (0,) if inject else ()
+            train_axes = (0, 0, None) + hyp_ax + inj_ax
             self._train_epoch_jit = watch_jit(jax.jit(
                 jax.vmap(self.fns.train_epoch, in_axes=train_axes),
                 donate_argnums=(0,), **jit_kw,
@@ -360,7 +509,8 @@ class FleetTrainer:
             # params/key are per-seed; the validation order is shared
             # (shuffle=False, seed 0 — identical across seeds).
             self._eval_epoch_jit = watch_jit(jax.jit(
-                jax.vmap(self.fns.eval_epoch, in_axes=(0, None, 0, None)),
+                jax.vmap(self.fns.eval_epoch,
+                         in_axes=(0, None, 0, None) + hyp_ax),
                 **eval_kw,
             ), "fleet_eval_epoch")
             if self.stream:
@@ -376,14 +526,14 @@ class FleetTrainer:
                     self._eval_chunk_placement = chunk_placement(
                         mesh, order_spec=partition.
                         eval_order_partition_spec(mesh, stacked=True))
-                chunk_axes = (0, 0, 0, 0) if inject else (0, 0, 0)
+                chunk_axes = (0, 0, 0) + hyp_ax + inj_ax
                 self._train_chunk_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.train_chunk, in_axes=chunk_axes),
                     donate_argnums=(0,), **chunk_kw,
                 ), "fleet_train_chunk")
                 self._eval_chunk_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.eval_chunk,
-                             in_axes=(0, None, 0, None)),
+                             in_axes=(0, None, 0, None) + hyp_ax),
                     **eval_chunk_kw,
                 ), "fleet_eval_chunk")
                 self._finalize_train_jit = watch_jit(jax.jit(
@@ -491,17 +641,60 @@ class FleetTrainer:
             return (jnp.float32(vals[0]),)
         return (jnp.asarray(vals, jnp.float32),)
 
+    def _hp_args(self) -> tuple:
+        """() on non-hyper traces; one (S,)-vector hp dict on hyper
+        traces — rebuilt from `self.lane_cfgs` at every call so PBT's
+        between-generation perturbations (set_lane_scalars) reach the
+        SAME compiled program as fresh runtime values."""
+        if not self.hyper:
+            return ()
+        return ({
+            "lr": jnp.asarray([c.train.lr for c in self.lane_cfgs],
+                              jnp.float32),
+            "kl_weight": jnp.asarray(
+                [c.model.kl_weight for c in self.lane_cfgs], jnp.float32),
+        },)
+
+    def lane_labels(self) -> list:
+        """Per-lane config labels (obs satellite, ISSUE 12): alerts and
+        Prometheus lanes name the CONFIG that diverged, not just the
+        lane index."""
+        return [lane_label(c, self.hyper) for c in self.lane_cfgs]
+
+    def set_lane_scalars(self, lane: int, lr: Optional[float] = None,
+                         kl_weight: Optional[float] = None) -> None:
+        """PBT explore step: replace one lane's runtime scalars. Values
+        are runtime inputs of the compiled hyper program (`_hp_args`),
+        so the next epoch call picks them up with ZERO retrace; lane
+        artifacts keep their names (checkpoint_name encodes run_name +
+        seed, not the scalars)."""
+        if not self.hyper:
+            raise ValueError(
+                "set_lane_scalars needs the hyper trace (construct with "
+                "lane_configs and force_hyper=True for an initially "
+                "homogeneous population)")
+        c = self.lane_cfgs[lane]
+        self.lane_cfgs[lane] = dataclasses.replace(
+            c,
+            model=dataclasses.replace(
+                c.model, kl_weight=(c.model.kl_weight if kl_weight is None
+                                    else float(kl_weight))),
+            train=dataclasses.replace(
+                c.train, lr=(c.train.lr if lr is None else float(lr))),
+        )
+
     def _run_train_epoch(self, run_state, epoch):
         orders = self._epoch_orders(epoch)
+        hp = self._hp_args()
         poison = self._poison(epoch)
         if self.stream:
-            return self._stream_train_epoch(run_state, orders, poison)
+            return self._stream_train_epoch(run_state, orders, hp + poison)
         if self.num_seeds == 1:
             st, m = self._train_epoch_jit(
                 run_state, orders[0], self.panel_args(), *poison)
             return st, {k: v[None] for k, v in m.items()}
         return self._train_epoch_jit(run_state, orders, self.panel_args(),
-                                     *poison)
+                                     *hp, *poison)
 
     def _run_eval_epoch(self, run_params, val_order, epoch):
         keys = self._eval_keys(epoch)
@@ -512,15 +705,19 @@ class FleetTrainer:
                 run_params, val_order, keys[0], self.panel_args())
             return {k: v[None] for k, v in m.items()}
         return self._eval_epoch_jit(run_params, val_order, keys,
-                                    self.panel_args())
+                                    self.panel_args(), *self._hp_args())
 
     # ---- streaming residency -----------------------------------------
 
-    def _stream_train_epoch(self, run_state, orders, poison: tuple = ()):
+    def _stream_train_epoch(self, run_state, orders, extras: tuple = ()):
         """Chunked stream fleet epoch: per-seed mini-panels (each seed's
         shuffled order gathers different slabs) stacked into one
         prefetched chunk, consumed by the vmapped chunk scan. S=1 runs
-        the serial chunk fns on the raw state — the bitwise oracle."""
+        the serial chunk fns on the raw state — the bitwise oracle.
+        `extras` is the trace-gated trailing-arg tuple (hp on hyper
+        builds first, then poison on chaos builds; at S=1 only poison
+        can exist — single-lane fleets always fold to the serial
+        trace)."""
         from factorvae_tpu.data.stream import (
             ChunkStream,
             chunk_slices,
@@ -535,7 +732,7 @@ class FleetTrainer:
                 placement=self._chunk_placement)
             for order_local, panel_chunk in chunks:
                 run_state, aux = self._train_chunk_jit(
-                    run_state, order_local, panel_chunk, *poison)
+                    run_state, order_local, panel_chunk, *extras)
                 parts.append(aux)
             self.last_stream_stats = chunks
             m = self._finalize_train_jit(concat_auxes(parts))
@@ -561,7 +758,7 @@ class FleetTrainer:
                              placement=self._chunk_placement)
         for order_local, panel_chunk in chunks:
             run_state, aux = self._train_chunk_jit(
-                run_state, order_local, panel_chunk, *poison)
+                run_state, order_local, panel_chunk, *extras)
             parts.append(aux)
         self.last_stream_stats = chunks
         return run_state, self._finalize_train_jit(
@@ -579,10 +776,14 @@ class FleetTrainer:
             placement=(self._chunk_placement if serial
                        else self._eval_chunk_placement))
         key = keys[0] if serial else keys
+        # hyper traces take the per-lane hp dict on the eval chunk too
+        # (the selection loss recomposes with the lane kl_weight); at
+        # S=1 the fold guarantees a non-hyper trace, so hp is ().
+        hp = () if serial else self._hp_args()
         parts = []
         for order_local, panel_chunk in chunks:
             key, aux = self._eval_chunk_jit(
-                run_params, order_local, key, panel_chunk)
+                run_params, order_local, key, panel_chunk, *hp)
             parts.append(aux)
         if serial:
             m = self._finalize_eval_jit(concat_auxes(parts))
@@ -731,7 +932,13 @@ class FleetTrainer:
                     best_params, best_val, run_state.params, selection)
             dt = time.perf_counter() - t0
             step = int(np.asarray(run_state.step).reshape(-1)[0])
-            lr = learning_rate_at(cfg.train, self.total_steps, step)
+            # Hyper lanes each ride their own cosine (peak = lane lr):
+            # the logged lr is per-lane, like every other lane metric.
+            lr = (
+                [learning_rate_at(c.train, self.total_steps, step)
+                 for c in self.lane_cfgs]
+                if self.hyper
+                else learning_rate_at(cfg.train, self.total_steps, step))
             rec = dict(
                 epoch=epoch,
                 train_loss=[float(v) for v in np.asarray(train_m["loss"])],
@@ -748,6 +955,11 @@ class FleetTrainer:
                 seed_days_per_sec=(
                     self.num_seeds * float(np.asarray(train_m["days"])[0])
                     / max(dt, 1e-9)),
+                # Per-lane config labels (ISSUE 12 obs satellite):
+                # obs.report/obs.live flag details and the Prometheus
+                # exporter's lane_config label name the config that
+                # diverged, not just the lane index.
+                lane_labels=self.lane_labels(),
             )
             if "skipped_steps" in train_m:
                 # Per-lane finite-guard skip counts (train/loop.py) —
@@ -881,7 +1093,7 @@ class FleetTrainer:
         stacked = self.num_seeds > 1
         for i in lanes:
             seed = self.seeds[i]
-            ckpt = self._seed_checkpointer(seed)
+            ckpt = self._lane_checkpointer(i)
             template = (unstack_state(run_state, i) if stacked
                         else run_state)
             restored_step = lane_anchor[i]
@@ -914,7 +1126,10 @@ class FleetTrainer:
 
     def seed_config(self, seed: int) -> Config:
         """The per-seed Config a solo run of this fleet member would use
-        (what `checkpoint_name()` keys on)."""
+        (what `checkpoint_name()` keys on). On hyper fleets the LANE
+        config is the member's identity (`self.lane_cfgs[i]` — lanes may
+        share a seed); this seed-keyed view stays for the classic
+        seed-fleet callers (tests, chaos harnesses)."""
         return dataclasses.replace(
             self.cfg,
             train=dataclasses.replace(self.cfg.train, seed=int(seed)),
@@ -971,7 +1186,7 @@ class FleetTrainer:
         # unstack rows — per-seed artifacts never carry mesh layout.
         best_params = self._gather_host(best_params, stacked_params=True)
         for i in rows:
-            cfg_s = self.seed_config(self.seeds[i])
+            cfg_s = self.lane_cfgs[i]
             save_params(
                 cfg_s.train.save_dir, cfg_s.checkpoint_name(),
                 unstack_state(best_params, i),
@@ -990,8 +1205,7 @@ class FleetTrainer:
         the cosine schedule."""
         ckpt_dirs = []
         common = None
-        for seed in self.seeds:
-            cfg_s = self.seed_config(seed)
+        for cfg_s in self.lane_cfgs:
             d = f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt"
             if not os.path.isdir(d):
                 return None
@@ -1016,7 +1230,7 @@ class FleetTrainer:
         epoch = max(common)
         states, best_vals, cleans = [], [], []
         for i, seed in enumerate(self.seeds):
-            cfg_s = self.seed_config(seed)
+            cfg_s = self.lane_cfgs[i]
             ckpt = Checkpointer(ckpt_dirs[i],
                                 keep=cfg_s.train.keep_checkpoints)
             try:
@@ -1061,7 +1275,7 @@ class FleetTrainer:
         rows = []
         for i, seed in enumerate(self.seeds):
             template = unstack_state(params_template, i)
-            cfg_s = self.seed_config(seed)
+            cfg_s = self.lane_cfgs[i]
             path = os.path.join(cfg_s.train.save_dir,
                                 cfg_s.checkpoint_name())
             if np.isfinite(best_val[i]) and os.path.isdir(path):
@@ -1070,20 +1284,27 @@ class FleetTrainer:
                 rows.append(jax.tree.map(jnp.copy, template))
         return stack_states(rows)
 
-    def _seed_checkpointer(self, seed: int) -> Checkpointer:
-        """Per-seed Checkpointer, cached for the life of this trainer so
+    def _lane_checkpointer(self, i: int) -> Checkpointer:
+        """Per-LANE Checkpointer, cached for the life of this trainer so
         ASYNC saves (checkpoint.py) actually overlap the next epoch —
-        open/close per save would re-impose the barrier at close()."""
+        open/close per save would re-impose the barrier at close().
+        Keyed by lane index, not seed: hyper lanes may share a seed
+        while writing distinct (run_name-tagged) directories."""
         if not hasattr(self, "_ckpts"):
             self._ckpts = {}
-        if seed not in self._ckpts:
-            cfg_s = self.seed_config(seed)
-            self._ckpts[seed] = Checkpointer(
+        if i not in self._ckpts:
+            cfg_s = self.lane_cfgs[i]
+            self._ckpts[i] = Checkpointer(
                 f"{cfg_s.train.save_dir}/{cfg_s.checkpoint_name()}_ckpt",
                 keep=cfg_s.train.keep_checkpoints,
                 async_save=cfg_s.train.async_checkpointing,
             )
-        return self._ckpts[seed]
+        return self._ckpts[i]
+
+    def _seed_checkpointer(self, seed: int) -> Checkpointer:
+        """Seed-keyed view of `_lane_checkpointer` for classic fleets
+        (first lane carrying that seed)."""
+        return self._lane_checkpointer(self.seeds.index(int(seed)))
 
     def _close_checkpointers(self) -> None:
         for ckpt in getattr(self, "_ckpts", {}).values():
@@ -1106,12 +1327,12 @@ class FleetTrainer:
         shape."""
         fleet_state = self._gather_host(fleet_state)
         for i, seed in enumerate(self.seeds):
-            cfg_s = self.seed_config(seed)
+            cfg_s = self.lane_cfgs[i]
             # 0-d ndarrays, not numpy scalars: indexing a gathered host
             # (S,) leaf yields np.int32-style scalars, which orbax's
             # sync StandardSave rejects ("Unsupported type").
             row = jax.tree.map(np.asarray, unstack_state(fleet_state, i))
-            self._seed_checkpointer(seed).save(
+            self._lane_checkpointer(i).save(
                 epoch,
                 row,
                 {"epoch": epoch, "best_val": float(best_val[i]),

@@ -83,6 +83,7 @@ def make_step_fns(
     obs: bool = False,
     guard: bool = False,
     inject_nan: bool = False,
+    hyper_step_size: Any = None,
 ) -> StepFns:
     """`model_train` / `model_eval` are the day-batched forward variants
     (models.day_forward with train=True/False; they share one param tree).
@@ -109,6 +110,20 @@ def make_step_fns(
     identical (pinned in tests/test_obs.py, the `panel_residency`
     discipline).
 
+    `hyper_step_size` (the hyper-fleet trace, train/fleet.py +
+    state.make_hyper_optimizer) switches the per-LANE hyperparameter
+    mode on: every train/eval entry point takes one extra `hp` argument
+    — a dict ``{"lr": scalar, "kl_weight": scalar}`` of f32 runtime
+    scalars ((S,) vectors once vmapped over the fleet axis) — the
+    per-day loss is recomposed as ``recon + hp.kl_weight * kl`` (the
+    model's own expression with the trace constant replaced by the
+    runtime scalar; the model still computes its baked ``out.loss``,
+    which this path simply ignores), and the optimizer's deferred lr
+    multiply is applied as ``u * hyper_step_size(step, hp.lr)``. Gated
+    at TRACE TIME like `obs`: `hyper_step_size=None` (every pre-hyper
+    caller) compiles the exact pre-hyper graph — signatures, arithmetic
+    and all.
+
     `guard=True` (TrainConfig.finite_guard, the self-healing default)
     compiles the in-graph all-finite gate: the optimizer update is
     applied through a `jnp.where` select on "all gradient elements
@@ -123,6 +138,22 @@ def make_step_fns(
     epochs/lanes a fault targets, 1.0 elsewhere — applied between the
     backward pass and the gate."""
 
+    hyper = hyper_step_size is not None
+
+    def _split_extras(extras: tuple) -> tuple:
+        """(hp, poison) from a train entry point's trailing positional
+        args. Both exist only on the traces that compiled them in (hp on
+        hyper builds — FIRST, so mesh in_shardings stay positional;
+        poison on chaos builds), so every pre-hyper caller's positional
+        `*poison` keeps binding exactly where it always did."""
+        if hyper and inject_nan:
+            return extras[0], extras[1]
+        if hyper:
+            return extras[0], None
+        if inject_nan:
+            return None, extras[0]
+        return None, None
+
     def batch_for(days: jnp.ndarray, panel):
         values, last_valid, next_valid = panel
         safe = jnp.maximum(days, 0)
@@ -134,7 +165,7 @@ def make_step_fns(
             x, y, mask = shard_batch(x, y, mask)
         return x, y, mask
 
-    def weighted_day_loss(params, days, key, panel, train: bool):
+    def weighted_day_loss(params, days, key, panel, train: bool, hp=None):
         x, y, mask = batch_for(days, panel)
         day_w = (days >= 0).astype(jnp.float32)
         k_sample, k_drop = jax.random.split(key)
@@ -142,7 +173,17 @@ def make_step_fns(
         out = model.apply(
             params, x, y, mask, rngs={"sample": k_sample, "dropout": k_drop}
         )
-        loss_sum = jnp.sum(out.loss * day_w)
+        if hyper:
+            # Per-lane loss recomposition: the model's own expression
+            # (models/factorvae.py `recon + cfg.kl_weight * kl`) with
+            # the trace constant replaced by the runtime lane scalar —
+            # the same single multiply+add on the same operands, so a
+            # lane whose kl_weight bit-equals the baked constant takes
+            # the same loss (and loss gradient) value-for-value.
+            day_loss = out.recon_loss + hp["kl_weight"] * out.kl
+        else:
+            day_loss = out.loss
+        loss_sum = jnp.sum(day_loss * day_w)
         count = jnp.sum(day_w)
         # mean over real days this step; padded days carry zero weight
         loss = loss_sum / jnp.maximum(count, 1.0)
@@ -156,7 +197,7 @@ def make_step_fns(
             # the reference's dead `test` loop (train_model.py:62-82 weights
             # by batch size but divides by batch count — we divide by the
             # sample count)
-            "wloss_sum": jnp.sum(out.loss * n_valid),
+            "wloss_sum": jnp.sum(day_loss * n_valid),
             "samples": jnp.sum(n_valid),
         }
         if obs:
@@ -166,10 +207,11 @@ def make_step_fns(
         return loss, aux
 
     def train_step(state: TrainState, days: jnp.ndarray, panel,
-                   poison=None):
+                   *extras):
+        hp, poison = _split_extras(extras)
         state, key = state.advance_rng()
         (_, aux), grads = jax.value_and_grad(weighted_day_loss, has_aux=True)(
-            state.params, days, key, panel, True
+            state.params, days, key, panel, True, hp
         )
         if inject_nan:
             # Chaos-only trace (factorvae_tpu/chaos): poison is 1.0 on
@@ -177,6 +219,16 @@ def make_step_fns(
             # NaN where a nan_grads fault targets.
             grads = jax.tree.map(lambda g: g * poison, grads)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        if hyper:
+            # The deferred lr multiply (state.make_hyper_optimizer):
+            # optax's scale_by_schedule arithmetic with the Python-float
+            # init replaced by the runtime lane lr. `state.step` equals
+            # the schedule count at update time (both advance once per
+            # update; the identity transform in the chain carries the
+            # count the serial opt-state tree has).
+            s = hyper_step_size(state.step, hp["lr"])
+            updates = jax.tree.map(
+                lambda u: jnp.asarray(s, dtype=u.dtype) * u, updates)
         new_params = optax.apply_updates(state.params, updates)
         if guard:
             # The all-finite gate: a poisoned step KEEPS the previous
@@ -240,7 +292,7 @@ def make_step_fns(
         return m
 
     def train_chunk(state: TrainState, order: jnp.ndarray, panel,
-                    poison=None):
+                    *extras):
         """One epoch SEGMENT: the epoch scan body over a (k, B) slice of
         the step order, returning the UN-reduced per-step aux so the
         caller can finalize over the whole epoch. The stream path runs
@@ -250,36 +302,43 @@ def make_step_fns(
         per-step updates stay bitwise (pre-gathered batches as jit
         inputs were measured to perturb XLA's backward fusion by ~1 ulp;
         keeping the gather in-graph is what makes stream == hbm exact).
-        `poison` exists only on chaos traces (`inject_nan`; see
-        make_step_fns) and is threaded to every step of the segment.
+        `extras` carries the trace-gated trailing args — `hp` on hyper
+        builds, `poison` on chaos builds (see make_step_fns) — threaded
+        to every step of the segment.
         """
         def body(st, days):
-            st, aux = train_step(st, days, panel, poison)
+            st, aux = train_step(st, days, panel, *extras)
             return st, aux
 
         return jax.lax.scan(body, state, order)
 
     def train_epoch(state: TrainState, order: jnp.ndarray, panel,
-                    poison=None):
+                    *extras):
         """order: (S, B) int32 day indices (-1 = pad)."""
-        state, auxes = train_chunk(state, order, panel, poison)
+        state, auxes = train_chunk(state, order, panel, *extras)
         return state, finalize_train(auxes)
 
-    def eval_chunk(params, order: jnp.ndarray, key: jax.Array, panel):
+    def eval_chunk(params, order: jnp.ndarray, key: jax.Array, panel,
+                   *extras):
         """Eval epoch segment. The key threads ACROSS chunks (returned
         with the aux), so the concatenated per-step key stream is
-        exactly the whole-epoch scan's."""
+        exactly the whole-epoch scan's. On hyper builds `extras` is
+        `(hp,)` — the per-lane kl_weight recomposes the selection
+        loss."""
+        hp = extras[0] if hyper else None
+
         def body(k, days):
             k, sub = jax.random.split(k)
-            _, aux = weighted_day_loss(params, days, sub, panel, False)
+            _, aux = weighted_day_loss(params, days, sub, panel, False, hp)
             return k, aux
 
         return jax.lax.scan(body, key, order)
 
-    def eval_epoch(params, order: jnp.ndarray, key: jax.Array, panel):
+    def eval_epoch(params, order: jnp.ndarray, key: jax.Array, panel,
+                   *extras):
         """Validation mean loss (reference validate(), train_model.py:40-60:
         dropout off, reconstruction still sampled)."""
-        _, auxes = eval_chunk(params, order, key, panel)
+        _, auxes = eval_chunk(params, order, key, panel, *extras)
         return finalize_eval(auxes)
 
     return StepFns(

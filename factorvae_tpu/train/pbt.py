@@ -1,0 +1,261 @@
+"""On-device population-based training over the hyper-fleet (ISSUE 12).
+
+One FleetTrainer, one compiled program, G generations: every lane races
+its own (lr, kl_weight) as RUNTIME scalars of the stacked hyper trace
+(train/fleet.py), so the exploit/explore loop perturbs hyperparameters
+between generations with ZERO recompiles — the per-lane scalars are just
+fresh (S,) inputs at the next epoch dispatch.
+
+The three PBT steps reuse machinery that already exists:
+
+- **Fitness** — the per-lane validation loss the fleet epoch loop
+  already finalizes on device (the same `jnp.where` best-val selection
+  signal; obs probes ride the same record as telemetry).
+- **Exploit** — a losing lane is restored from a WINNER's per-lane
+  checkpoint: the winner's last lockstep full-state row is copied into
+  the loser's checkpoint directory (Checkpointer.save overwrites the
+  step — the PR 9 rollback discipline), and the next generation's
+  `fit(resume=True)` splices it in through the ordinary group-resume
+  path. No new restore code; the per-lane rollback machinery carries it.
+- **Explore** — DETERMINISTIC per-lane perturbation: the loser's lane
+  scalars are multiplied by `perturb_factors[(generation + lane) % n]`
+  (no host RNG — a resumed run replays the same walk), clipped to the
+  configured bounds.
+
+Resume discipline (tests/test_hyper.py TestPBT): the controller
+persists `{generation, per-lane scalars}` to `<save_dir>/<run>_pbt.json`
+after every generation (atomic rename). A killed run resumed with
+``pbt_fit(..., resume=True)`` reconstructs the lane scalars, restores
+every lane from its lockstep checkpoints and continues BITWISE the
+unbroken run — generations are just `fit(resume=True)` windows over the
+same per-lane checkpoint layout an unbroken run writes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from factorvae_tpu.config import Config
+from factorvae_tpu.data.loader import PanelDataset
+from factorvae_tpu.train.fleet import FleetTrainer, unstack_state
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+def perturb_factor(generation: int, lane: int,
+                   factors: Sequence[float]) -> float:
+    """The deterministic explore rule: which factor multiplies a losing
+    lane's scalars at generation `generation`. Pure — the resume path
+    replays the identical walk."""
+    return float(factors[(int(generation) + int(lane)) % len(factors)])
+
+
+def _pbt_state_path(config: Config) -> str:
+    return os.path.join(config.train.save_dir,
+                        f"{config.train.run_name}_pbt.json")
+
+
+def _write_pbt_state(path: str, generation: int, lanes: list) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"generation": generation, "lanes": lanes}, f, indent=1)
+    os.replace(tmp, path)
+
+
+def pbt_fit(
+    config: Config,
+    dataset: PanelDataset,
+    lane_configs: Sequence[Config],
+    generations: int,
+    epochs_per_generation: int,
+    exploit_frac: float = 0.25,
+    perturb_factors: Sequence[float] = (0.8, 1.25),
+    lr_bounds: tuple = (1e-6, 1e-1),
+    kl_weight_bounds: tuple = (1e-4, 10.0),
+    logger: Optional[MetricsLogger] = None,
+    mesh=None,
+    resume: bool = False,
+    stop_after: Optional[int] = None,
+):
+    """Run G generations of PBT over one hyper-fleet program.
+
+    ``lane_configs`` seeds the population (per-lane lr/kl_weight/seed;
+    `train/fleet.validate_lane_configs` rules apply — distinct run_names
+    for same-seed lanes). ``config.train.num_epochs`` is overridden to
+    ``generations * epochs_per_generation`` (the cosine horizon of the
+    whole run) and ``checkpoint_every`` must be >= 1: the lockstep
+    per-lane checkpoints ARE the exploit transport and the resume
+    substrate.
+
+    Returns ``(trainer, result)`` where result has per-generation
+    records (fitness, winners, exploited lanes, the scalar walk) and
+    the final ``lane_configs`` / ``state`` / ``best_val``.
+
+    ``stop_after=g`` ends the run after generation ``g`` completes
+    (exploit/explore/persist included) — the deterministic "killed at a
+    generation boundary" the bitwise-resume tests and chaos harnesses
+    drive; a later ``resume=True`` call continues exactly where the
+    stopped run would have.
+    """
+    logger = logger or MetricsLogger(echo=False)
+    generations = int(generations)
+    epg = int(epochs_per_generation)
+    if generations < 1 or epg < 1:
+        raise ValueError("need generations >= 1 and "
+                         "epochs_per_generation >= 1")
+    total_epochs = generations * epg
+    config = dataclasses.replace(
+        config, train=dataclasses.replace(config.train,
+                                          num_epochs=total_epochs))
+    if not config.train.checkpoint_every:
+        raise ValueError(
+            "PBT needs checkpoint_every >= 1: the lockstep per-lane "
+            "checkpoints carry the exploit step and the resume path")
+    lane_cfgs = [
+        dataclasses.replace(
+            c, train=dataclasses.replace(c.train,
+                                         num_epochs=total_epochs))
+        for c in lane_configs
+    ]
+    state_path = _pbt_state_path(config)
+    start_gen = 0
+    if resume and os.path.exists(state_path):
+        with open(state_path) as f:
+            saved = json.load(f)
+        if len(saved.get("lanes", [])) != len(lane_cfgs):
+            raise ValueError(
+                f"PBT state at {state_path} has "
+                f"{len(saved.get('lanes', []))} lanes; this run has "
+                f"{len(lane_cfgs)} — population size cannot change "
+                "across a resume")
+        start_gen = int(saved["generation"])
+        lane_cfgs = [
+            dataclasses.replace(
+                c,
+                model=dataclasses.replace(
+                    c.model, kl_weight=float(s["kl_weight"])),
+                train=dataclasses.replace(c.train, lr=float(s["lr"])),
+            )
+            for c, s in zip(lane_cfgs, saved["lanes"])
+        ]
+        logger.log("pbt_resume", generation=start_gen,
+                   lanes=saved["lanes"])
+
+    # force_hyper: an initially homogeneous population would otherwise
+    # fold to the constant-baked trace, and the first explore step
+    # would have no runtime scalar input to move.
+    trainer = FleetTrainer(config, dataset, lane_configs=lane_cfgs,
+                           logger=logger, mesh=mesh, force_hyper=True)
+    num_lanes = trainer.num_seeds
+    n_exploit = (max(1, int(round(num_lanes * float(exploit_frac))))
+                 if num_lanes > 1 else 0)
+    n_exploit = min(n_exploit, num_lanes // 2)
+
+    gen_records = []
+    state = out = None
+    for gen in range(start_gen, generations):
+        state, out = trainer.fit(num_epochs=(gen + 1) * epg,
+                                 resume=(gen > 0 or resume))
+        last = out["history"][-1] if out["history"] else None
+        if last is not None:
+            fitness = np.asarray(
+                last["val_loss"]
+                if np.isfinite(np.asarray(last["val_loss"])).any()
+                else last["train_loss"], np.float64)
+        else:
+            # Killed between this generation's final checkpoint commit
+            # and the PBT-state write: the resumed fit() restored at
+            # the generation's last epoch and had nothing to train, so
+            # there is no history to read fitness from. Recompute it
+            # from the RESTORED params with the SAME eval key/order the
+            # unbroken run's last epoch used — the select (and the
+            # whole exploit/explore step) then replays bitwise instead
+            # of ranking on garbage.
+            val_order = trainer._val_order()
+            if val_order is not None:
+                m = trainer._run_eval_epoch(state.params, val_order,
+                                            (gen + 1) * epg - 1)
+                fitness = np.asarray(m["loss"], np.float64)
+            else:
+                fitness = np.asarray(out["best_val"], np.float64)
+        # NaN lanes rank LAST (a diverged lane is the exploit target,
+        # never a winner).
+        order = np.argsort(np.where(np.isfinite(fitness), fitness,
+                                    np.inf), kind="stable")
+        winners = [int(i) for i in order[:max(1, n_exploit)]]
+        losers = ([int(i) for i in order[-n_exploit:]]
+                  if n_exploit else [])
+        rec = {
+            "generation": gen,
+            "epochs": [gen * epg, (gen + 1) * epg],
+            "fitness": [float(v) for v in fitness],
+            "lane_labels": trainer.lane_labels(),
+            "winners": winners,
+            "exploited": [],
+        }
+        if gen < generations - 1 and losers:
+            gather_epoch = (gen + 1) * epg - 1
+            for j, loser in enumerate(losers):
+                winner = winners[j % len(winners)]
+                if loser == winner:
+                    continue
+                # ---- explore: deterministic scalar perturbation ------
+                f = perturb_factor(gen, loser, perturb_factors)
+                w_cfg = trainer.lane_cfgs[winner]
+                new_lr = float(np.clip(w_cfg.train.lr * f,
+                                       *lr_bounds))
+                new_klw = float(np.clip(w_cfg.model.kl_weight * f,
+                                        *kl_weight_bounds))
+                trainer.set_lane_scalars(loser, lr=new_lr,
+                                         kl_weight=new_klw)
+                # ---- exploit: winner's checkpoint row -> loser's dir -
+                # (PR 9's per-lane rollback transport: restore from the
+                # winner's Checkpointer, overwrite-save into the
+                # loser's; the next fit(resume=True) group-restore
+                # splices it in.)
+                template = unstack_state(trainer._stacked(state), loser)
+                w_ckpt = trainer._lane_checkpointer(winner)
+                row, w_meta = w_ckpt.restore(template, step=gather_epoch)
+                l_ckpt = trainer._lane_checkpointer(loser)
+                l_ckpt.save(
+                    gather_epoch,
+                    row,
+                    {"epoch": gather_epoch,
+                     "best_val": float(out["best_val"][loser]),
+                     "config": trainer.lane_cfgs[loser].to_dict(),
+                     "clean": True},
+                )
+                rec["exploited"].append(
+                    {"lane": loser, "from": winner,
+                     "perturb_factor": f, "lr": new_lr,
+                     "kl_weight": new_klw})
+            # Drain the exploit overwrites before the next generation's
+            # group-restore opens fresh readers on the same dirs: an
+            # async save still in flight would be invisible to them.
+            trainer._close_checkpointers()
+        gen_records.append(rec)
+        logger.log("pbt_generation", **{
+            k: v for k, v in rec.items() if k != "fitness"},
+            best_fitness=float(np.nanmin(np.where(
+                np.isfinite(fitness), fitness, np.nan)))
+            if np.isfinite(fitness).any() else float("nan"))
+        _write_pbt_state(
+            state_path, gen + 1,
+            [{"lr": c.train.lr, "kl_weight": c.model.kl_weight}
+             for c in trainer.lane_cfgs])
+        if stop_after is not None and gen >= stop_after:
+            logger.log("pbt_stopped", after_generation=gen)
+            break
+
+    return trainer, {
+        "generations": gen_records,
+        "lane_configs": list(trainer.lane_cfgs),
+        "state": state,
+        "best_val": out["best_val"] if out is not None else None,
+        "best_params": out["best_params"] if out is not None else None,
+    }

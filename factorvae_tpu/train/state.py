@@ -55,6 +55,58 @@ def make_optimizer(
     return optax.adam(schedule)
 
 
+def make_hyper_optimizer(
+    cfg: TrainConfig, total_steps: Optional[int] = None,
+):
+    """Optimizer for the hyper-fleet's per-lane learning rates: the same
+    Adam as `make_optimizer`, but with the final ``-(lr * decay)``
+    multiply DEFERRED to the caller, so the lr can be a runtime per-lane
+    scalar riding the vmapped step instead of a trace-baked constant.
+
+    Returns ``(tx, step_size)``:
+
+    - ``tx`` = ``chain(scale_by_adam(), scale_by_schedule(1.0))`` (or
+      ``scale(1.0)`` when the cosine schedule is off) — the identity
+      multiply keeps the opt-state TREE identical to ``make_optimizer``'s
+      (``ScaleByAdamState`` + ``ScaleByScheduleState``/``ScaleState``
+      with the same advancing count), so per-lane checkpoint rows stay
+      restorable by a serial `Trainer` built at that lane's config, and a
+      serial checkpoint drops into a hyper lane unchanged.
+    - ``step_size(step, lane_lr)`` reproduces optax's own arithmetic
+      exactly — ``-1 * (lane_lr * cosine_decay_schedule(1.0)(step))``,
+      the same multiply order ``scale_by_learning_rate`` applies with
+      its Python-float init — so a lane whose ``lane_lr`` bit-equals the
+      serial run's ``cfg.lr`` takes bit-identical update steps
+      (tests/test_hyper.py pins the whole chain).
+
+    The caller applies ``u * step_size`` itself (train/loop.py's hyper
+    path), mirroring ``scale_by_schedule``'s
+    ``jnp.array(step_size, g.dtype) * g``.
+    """
+    if cfg.cosine_schedule and total_steps:
+        tx = optax.chain(
+            optax.scale_by_adam(),
+            # identity multiply; exists only to carry the schedule COUNT
+            # state the serial optimizer's tree has
+            optax.scale_by_schedule(lambda count: 1.0),
+        )
+        decay = optax.cosine_decay_schedule(
+            init_value=1.0, decay_steps=total_steps, alpha=0.0)
+
+        def step_size(step, lane_lr):
+            # same expression shape as scale_by_learning_rate's
+            # `-1 * schedule(count)` with schedule = init * decayed:
+            # one (lane_lr * decayed) rounding, one exact negation
+            return -1 * (lane_lr * decay(step))
+    else:
+        tx = optax.chain(optax.scale_by_adam(), optax.scale(1.0))
+
+        def step_size(step, lane_lr):
+            return -1 * lane_lr
+
+    return tx, step_size
+
+
 def create_train_state(params, tx: optax.GradientTransformation, seed: int) -> TrainState:
     return TrainState(
         step=jnp.zeros((), jnp.int32),

@@ -104,6 +104,13 @@ SCORE_CANDIDATES = [{"flatten_days": f} for f in (False, True)]
 # train knobs (train/fleet.py). S=1 is the serial path itself, so the
 # persisted winner can never be slower than what the fallback runs.
 FLEET_CANDIDATES = [1, 2, 4, 8]
+# --hyper: heterogeneous-lane program widths (ISSUE 12) raced on the
+# winning train knobs — each candidate trains S DISTINCT (lr, kl_weight)
+# configs in one hyper-fleet program (train/fleet.py lane_configs; the
+# lane scalars are deterministic spreads around the config's defaults,
+# so the race is reproducible). S=1 folds to the serial trace, so the
+# persisted winner can never regress a grid below the serial sweep.
+HYPER_CANDIDATES = [1, 2, 4, 8]
 # --stream: panel-residency race on the winning train knobs — HBM vs
 # the out-of-core stream path at several chunk sizes (days per
 # host->device transfer, data/stream.py). HBM is always in the raced
@@ -257,6 +264,78 @@ def time_fleet(shape: dict, train_knobs: dict, num_seeds: int,
     jax.block_until_ready(m["loss"])
     dt = time.time() - t0
     return reps * days * shape["stocks"] * num_seeds / dt
+
+
+def hyper_lane_spread(cfg, num_lanes: int) -> list:
+    """Deterministic heterogeneous lane configs around a base Config:
+    lane i races (lr * 1.25**i, kl_weight * 0.5**i) at seed i with a
+    tagged run_name — a reproducible stand-in for a real grid, wide
+    enough that XLA cannot constant-fold the lanes back together."""
+    import dataclasses
+
+    return [
+        dataclasses.replace(
+            cfg,
+            model=dataclasses.replace(
+                cfg.model, kl_weight=cfg.model.kl_weight * (0.5 ** i)),
+            train=dataclasses.replace(
+                cfg.train, seed=i, lr=cfg.train.lr * (1.25 ** i),
+                run_name=f"{cfg.train.run_name}_hl{i}"),
+        )
+        for i in range(num_lanes)
+    ]
+
+
+def time_hyper(shape: dict, train_knobs: dict, num_lanes: int,
+               days: int, reps: int) -> float:
+    """Aggregate config-throughput (windows/sec·config summed over the
+    lanes) for one hyper-fleet program width on the winning train knobs
+    (compile excluded — compile AMORTIZATION is bench.py --hyper's
+    story; this race sizes the steady-state program width)."""
+    import jax
+
+    from factorvae_tpu.train.fleet import FleetTrainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, train_knobs["compute_dtype"],
+                     train_knobs["flatten_days"],
+                     train_knobs["days_per_step"], days)
+    trainer = FleetTrainer(cfg, ds,
+                           lane_configs=hyper_lane_spread(cfg, num_lanes),
+                           logger=MetricsLogger(echo=False))
+    state = trainer.init_run_state()
+    state, m = trainer._run_train_epoch(state, 0)  # warmup/compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    for e in range(1, 1 + reps):
+        state, m = trainer._run_train_epoch(state, e)
+    jax.block_until_ready(m["loss"])
+    dt = time.time() - t0
+    return reps * days * shape["stocks"] * num_lanes / dt
+
+
+def race_hyper(name: str, shape: dict, train_knobs: dict,
+               days: int, reps: int, logger=None) -> dict:
+    """Race `lanes_per_program` over HYPER_CANDIDATES (heterogeneous
+    (lr, kl_weight) lanes, train/fleet.py hyper trace); return the
+    row's `hyper` block (winner + every candidate timing for audit)."""
+    measured = {}
+    best_s, best_wps = 1, None
+    for s in HYPER_CANDIDATES:
+        wps = time_hyper(shape, train_knobs, s, days, reps)
+        measured[f"S={s}"] = round(wps, 1)
+        _log(logger, "autotune_hyper_candidate", shape=name, lanes=s,
+             aggregate_windows_per_sec_config=round(wps, 1))
+        if best_wps is None or wps > best_wps:
+            best_s, best_wps = s, wps
+    return {
+        "lanes_per_program": best_s,
+        "measured": measured,
+        "source": f"hyper race on {train_knobs['compute_dtype']} "
+                  f"flat={int(train_knobs['flatten_days'])} "
+                  f"dps{train_knobs['days_per_step']}: best S={best_s} "
+                  f"at {best_wps:,.0f} w/s·config",
+    }
 
 
 def time_stream(shape: dict, train_knobs: dict, residency: str,
@@ -525,7 +604,7 @@ def _existing_measured_row(shape: dict, platform: str):
 def race_shape(name: str, shape: dict, days: int, reps: int,
                fleet: bool = False, stream: bool = False,
                mesh: bool = False, serve: bool = False,
-               logger=None) -> dict:
+               hyper: bool = False, logger=None) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
     plan-table row.
@@ -607,6 +686,10 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
     if fleet:
         fleet_block = race_fleet(name, shape, best_train_key, days,
                                  reps, logger=logger)
+    hyper_block = None
+    if hyper:
+        hyper_block = race_hyper(name, shape, best_train_key, days,
+                                 reps, logger=logger)
     stream_block = None
     if stream:
         stream_block = race_stream(name, shape, best_train_key, days,
@@ -626,6 +709,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         num_portfolios=shape["portfolios"], n_stocks=shape["stocks"])
     if fleet_block is not None:
         measured["fleet"] = fleet_block.pop("measured")
+    if hyper_block is not None:
+        measured["hyper"] = hyper_block.pop("measured")
     if stream_block is not None:
         measured["stream"] = stream_block.pop("measured")
     if serve_block is not None:
@@ -653,6 +738,10 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         row["fleet"] = {"seeds_per_program":
                         fleet_block["seeds_per_program"]}
         row["source"] += f"; {fleet_block['source']}"
+    if hyper_block is not None:
+        row["hyper"] = {"lanes_per_program":
+                        hyper_block["lanes_per_program"]}
+        row["source"] += f"; {hyper_block['source']}"
     if stream_block is not None:
         row["stream"] = {"panel_residency": stream_block["panel_residency"],
                          "chunk_days": stream_block["chunk_days"]}
@@ -681,7 +770,7 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
 def race_widths(name: str, shape: dict, days: int, reps: int,
                 fleet: bool = False, stream: bool = False,
                 mesh: bool = False, serve: bool = False,
-                logger=None) -> list:
+                hyper: bool = False, logger=None) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
     envelope row — both bounds measured, no extrapolation beyond them
@@ -692,15 +781,15 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
         widths = [widths]
     rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
                        fleet=fleet, stream=stream, mesh=mesh,
-                       serve=serve, logger=logger)
+                       serve=serve, hyper=hyper, logger=logger)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
         p = merged[-1]
         if (r["train"], r["score"], r.get("fleet"), r.get("stream"),
-                r.get("mesh"), r.get("serve")) != (
+                r.get("mesh"), r.get("serve"), r.get("hyper")) != (
                 p["train"], p["score"], p.get("fleet"), p.get("stream"),
-                p.get("mesh"), p.get("serve")):
+                p.get("mesh"), p.get("serve"), p.get("hyper")):
             merged.append(r)
             continue
         if not any(k.startswith("n=") for k in p["measured"]):
@@ -736,6 +825,15 @@ def main() -> int:
                         "persisted on the row's 'fleet' block "
                         "(plan_for -> Plan.seeds_per_program; rows "
                         "without the block resolve to serial)")
+    p.add_argument("--hyper", action="store_true",
+                   help="also race the heterogeneous-lane hyper-fleet "
+                        "knob (lanes_per_program in {1, 2, 4, 8}, "
+                        "train/fleet.py lane_configs; ISSUE 12) on each "
+                        "shape's winning train knobs; the aggregate "
+                        "config-throughput winner is persisted on the "
+                        "row's 'hyper' block (plan_for -> "
+                        "Plan.lanes_per_program; rows without the block "
+                        "resolve to 0 = fall back to seeds_per_program)")
     p.add_argument("--stream", action="store_true",
                    help="also race the panel residency (hbm vs the "
                         "out-of-core stream path at chunk sizes "
@@ -826,7 +924,8 @@ def main() -> int:
                                              args.reps, fleet=args.fleet,
                                              stream=args.stream,
                                              mesh=args.mesh,
-                                             serve=args.serve, logger=lg)]
+                                             serve=args.serve,
+                                             hyper=args.hyper, logger=lg)]
             print(json.dumps({"rows": rows}, indent=1))
             if args.dry_run:
                 lg.log("autotune_dry_run", rows=len(rows),

@@ -88,10 +88,29 @@ def _cfg_for(cfg0, prefix_dates, window_dates, epochs,
     )
 
 
-def _run_one(cfg, ds, ref_scores, labels, score_start, score_end,
-             logger=None):
+def _compare_point(cfg, ds, params, ref_scores, labels,
+                   score_start, score_end) -> dict:
+    """Score one trained config over the proxy window and compare to
+    the reference scores — the protocol half of `_run_one`, shared by
+    the serial grid and the hyper-fleet grid so both phases report the
+    SAME statistic."""
     from factorvae_tpu.eval.compare import compare_scores
     from factorvae_tpu.eval.predict import generate_prediction_scores
+
+    scores = generate_prediction_scores(
+        params, cfg, ds, start=score_start, end=score_end,
+        stochastic=False, with_labels=True)
+    cmp = compare_scores(ref_scores, scores[["score"]], labels,
+                         tolerance=0.002)
+    return {
+        "rank_ic": cmp["ours_rank_ic"],
+        "rank_ic_ir": cmp["ours_rank_ic_ir"],
+        "reference_rank_ic": cmp["reference_rank_ic"],
+    }
+
+
+def _run_one(cfg, ds, ref_scores, labels, score_start, score_end,
+             logger=None):
     from factorvae_tpu.train.checkpoint import load_params
     from factorvae_tpu.train.trainer import Trainer
     from factorvae_tpu.utils.logging import MetricsLogger
@@ -103,18 +122,108 @@ def _run_one(cfg, ds, ref_scores, labels, score_start, score_end,
     best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
     params = load_params(best, state.params) if os.path.isdir(best) \
         else state.params
-    scores = generate_prediction_scores(
-        params, cfg, ds, start=score_start, end=score_end,
-        stochastic=False, with_labels=True)
-    cmp = compare_scores(ref_scores, scores[["score"]], labels,
-                         tolerance=0.002)
-    return {
-        "rank_ic": cmp["ours_rank_ic"],
-        "rank_ic_ir": cmp["ours_rank_ic_ir"],
-        "reference_rank_ic": cmp["reference_rank_ic"],
-        "best_val": float(out["best_val"]),
-        "train_seconds": round(time.time() - t0, 1),
+    rec = _compare_point(cfg, ds, params, ref_scores, labels,
+                         score_start, score_end)
+    rec.update(best_val=float(out["best_val"]),
+               train_seconds=round(time.time() - t0, 1))
+    return rec
+
+
+def _run_grid_hyper(cfg0, ds, grid, prefix_dates, window_dates, epochs,
+                    ref_scores, labels, score_start, score_end, logger,
+                    lanes_per_program=None):
+    """The whole (lr x kl_weight) grid phase as hyper-fleet programs
+    (ISSUE 12): every pending grid point is one LANE of a stacked
+    program — its (lr, kl_weight) ride the vmapped trace as runtime
+    scalars (train/fleet.py lane_configs), so the grid pays ONE compile
+    instead of one per point. Scoring and the reference comparison run
+    per lane through the SAME `_compare_point` protocol as the serial
+    grid, and records keep the serial grid's keys (resume files stay
+    format-compatible; `hyper_fleet`/`train_seconds` annotate the
+    shared program wall)."""
+    import jax
+
+    from factorvae_tpu.train.checkpoint import load_params
+    from factorvae_tpu.train.fleet import FleetTrainer, unstack_state
+
+    lanes = []
+    for lr, klw in grid:
+        cfg = _cfg_for(cfg0, prefix_dates, window_dates, epochs, lr, klw,
+                       f"lr{lr:g}_kl{klw:g}")
+        shutil.rmtree(cfg.train.save_dir, ignore_errors=True)
+        lanes.append(cfg)
+    base = _cfg_for(cfg0, prefix_dates, window_dates, epochs,
+                    grid[0][0], grid[0][1], "hyper_base")
+    recs = []
+    spp = (len(lanes) if not lanes_per_program
+           else max(1, int(lanes_per_program)))
+    for g0 in range(0, len(lanes), spp):
+        group = lanes[g0:g0 + spp]
+        group_points = list(grid)[g0:g0 + spp]
+        t0 = time.time()
+        trainer = FleetTrainer(base, ds, lane_configs=group,
+                               logger=logger)
+        state, out = trainer.fit()
+        wall = round(time.time() - t0, 1)
+        for i, cfg in enumerate(group):
+            best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
+            params = (load_params(best,
+                                  unstack_state(state.params, i))
+                      if os.path.isdir(best)
+                      else unstack_state(state.params, i))
+            rec = _compare_point(cfg, ds, params, ref_scores, labels,
+                                 score_start, score_end)
+            rec.update(
+                lr=group_points[i][0], kl_weight=group_points[i][1],
+                best_val=float(out["best_val"][i]),
+                # the program wall is SHARED by the whole group — that
+                # amortization is the point; recorded per rec so the
+                # serial-resume reader finds the key it always had
+                train_seconds=wall,
+                hyper_fleet=True,
+                lanes_per_program=len(group),
+            )
+            recs.append(rec)
+    return recs
+
+
+def _refresh_diagnosis(path, results, logger) -> None:
+    """Merge a `hyper_fleet` provenance block into K60_DIAGNOSIS.json:
+    the (kl_weight x lr) loss-balance grid re-raced as ONE program per
+    shape bucket. Purely ADDITIVE — every existing key of the diagnosis
+    artifact is preserved (resume/readers stay format-compatible)."""
+    try:
+        with open(path) as f:
+            diag = json.load(f)
+        if not isinstance(diag, dict):
+            raise ValueError("not a JSON object")
+    except FileNotFoundError:
+        diag = {}
+    except (OSError, ValueError) as e:
+        logger.log("k60_diag_refresh_skipped", path=path, error=str(e),
+                   note="existing diagnosis unreadable; NOT overwriting")
+        return
+    diag["hyper_fleet"] = {
+        "refreshed_by": "parity_k60_sweep.py --hyper",
+        "platform": results.get("platform"),
+        "epochs": results.get("epochs"),
+        "execution": "one hyper-fleet program per shape bucket "
+                     "(per-lane (lr, kl_weight) as runtime scalars; "
+                     "train/fleet.py lane_configs)",
+        "grid": [
+            {"lr": r["lr"], "kl_weight": r["kl_weight"],
+             "rank_ic": r.get("rank_ic"), "best_val": r.get("best_val"),
+             "train_seconds": r.get("train_seconds"),
+             "hyper_fleet": bool(r.get("hyper_fleet", False))}
+            for r in results.get("grid", [])
+        ],
     }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(diag, f, indent=1)
+    os.replace(tmp, path)
+    logger.log("k60_diag_refreshed", path=path,
+               grid_points=len(diag["hyper_fleet"]["grid"]))
 
 
 def _parse_points(spec):
@@ -145,6 +254,25 @@ def main(argv=None) -> int:
                          "seeds_per_program for this shape (serial when "
                          "the plan says 1); on = one program for all "
                          "seeds; off = serial")
+    ap.add_argument("--hyper", action="store_true",
+                    help="race the grid phase through hyper-fleet "
+                         "programs (ISSUE 12): every pending "
+                         "(lr, kl_weight) point becomes one LANE of a "
+                         "stacked program (train/fleet.py lane_configs) "
+                         "— one compile for the whole grid instead of "
+                         "one per point. Records keep the serial grid's "
+                         "format (resume-compatible), and a completed "
+                         "non-quick grid refreshes K60_DIAGNOSIS.json "
+                         "with hyper-fleet provenance (--diag)")
+    ap.add_argument("--diag", default="K60_DIAGNOSIS.json",
+                    help="diagnosis artifact to refresh under --hyper "
+                         "(additive `hyper_fleet` block; existing keys "
+                         "preserved)")
+    ap.add_argument("--lanes_per_program", type=int, default=None,
+                    help="lanes per hyper-fleet program under --hyper "
+                         "(default: the whole grid in one program, or "
+                         "the planner's raced Plan.lanes_per_program "
+                         "when a measured hyper row exists)")
     ap.add_argument("--grid", default=DEFAULT_GRID,
                     help="comma-separated lr:kl_weight grid points; "
                          "'' skips the grid phase")
@@ -384,18 +512,42 @@ def main(argv=None) -> int:
                            seeds=n_seeds)
                 sweep(lr, klw, f"lr{lr:g}_kl{klw:g}")
 
-        logger.log("k60_grid_start", points=len(grid), epochs=epochs)
+        logger.log("k60_grid_start", points=len(grid), epochs=epochs,
+                   hyper=args.hyper)
         done_points = {(r["lr"], r["kl_weight"]) for r in results["grid"]}
+        pending_grid = [p for p in grid if p not in done_points]
         for lr, klw in grid:
             if (lr, klw) in done_points:
                 logger.log("k60_grid_skipped", lr=lr, kl_weight=klw)
-                continue
-            rec = run_point(lr, klw, f"lr{lr:g}_kl{klw:g}")
-            results["grid"].append(rec)
-            flush()
-            logger.log("k60_grid_point", lr=lr, kl_weight=klw,
-                       rank_ic=rec["rank_ic"],
-                       train_seconds=rec["train_seconds"])
+        if args.hyper and pending_grid:
+            # ONE compiled program for the whole pending grid (bounded
+            # by --lanes_per_program / the planner's raced lane width).
+            lpp = args.lanes_per_program
+            if lpp is None and plan.lanes_per_program > 0:
+                lpp = plan.lanes_per_program
+            for rec in _run_grid_hyper(
+                    cfg0, ds, pending_grid, prefix_dates, window_dates,
+                    epochs, ref[PRESET], labels, score_start, score_end,
+                    logger, lanes_per_program=lpp):
+                results["grid"].append(rec)
+                flush()
+                logger.log("k60_grid_point", lr=rec["lr"],
+                           kl_weight=rec["kl_weight"],
+                           rank_ic=rec["rank_ic"],
+                           train_seconds=rec["train_seconds"],
+                           hyper_fleet=True)
+        else:
+            for lr, klw in pending_grid:
+                rec = run_point(lr, klw, f"lr{lr:g}_kl{klw:g}")
+                results["grid"].append(rec)
+                flush()
+                logger.log("k60_grid_point", lr=lr, kl_weight=klw,
+                           rank_ic=rec["rank_ic"],
+                           train_seconds=rec["train_seconds"])
+        if args.hyper and not args.quick and results["grid"]:
+            # Refresh the K-scaling diagnosis artifact with hyper-fleet
+            # provenance (additive block; format-compatible).
+            _refresh_diagnosis(args.diag, results, logger)
 
         if not explicit_sweeps and results["grid"]:
             best = max(results["grid"], key=lambda r: r["rank_ic"])

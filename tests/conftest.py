@@ -69,6 +69,11 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # registry/chaos lock set must be proven acyclic on every tier-1 run —
 # an inversion lands with whichever PR composes two subsystems, and
 # only a standing gate catches it THAT run.
+# The ISSUE-12 hyper-fleet classes are quick BY DESIGN: tier-1 must
+# exercise the heterogeneous-lane oracle chain (hetero lane bitwise the
+# same-width homogeneous hyper fleet; fold bitwise the PR-2/serial
+# traces), the shape-bucket partition, the PBT generation resume and
+# the mesh x hyper composition rejection on every run.
 _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestLockOrderRecorder", "TestLockOrderTier1",
                   "TestComposeValidate", "TestComposedOracles",
@@ -79,7 +84,11 @@ _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestCheckpointIntegrity", "TestKillMidSave",
                   "TestNaNRecovery", "TestGuardBitwise",
                   "TestStreamChaos", "TestRecoveryObs",
-                  "TestServeChaos"}
+                  "TestServeChaos",
+                  "TestHyperOptimizerArithmetic", "TestHyperFold",
+                  "TestHyperOracle", "TestShapeBuckets",
+                  "TestGridSweep", "TestPBT", "TestHyperCompose",
+                  "TestHyperObsLabels"}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,620 @@
+"""Hyper-fleet contract (ISSUE 12: train/fleet.py lane_configs,
+train/loop.py hyper trace, eval/sweep.grid_sweep, train/pbt.py).
+
+The bitwise discipline, in oracle-chain order:
+
+- FOLD: lanes whose (lr, kl_weight) are all identical rebake the
+  scalars into the base config and compile the exact pre-hyper trace —
+  a homogeneous "hyper" fleet IS the PR-2 seed fleet (and a 1-lane
+  hyper fleet IS the serial Trainer), bitwise by construction AND
+  pinned against real runs here.
+- HETERO ORACLE: lane i of a mixed-(lr, kl_weight) fleet is BITWISE
+  lane i of a same-width homogeneous hyper fleet pinned at that lane's
+  config (force_hyper) — the runtime-scalar threading adds ZERO numeric
+  drift. Against the serial Trainer at that config a lane inherits the
+  PR-2 fleet's established f32 tolerance (vmap batches the matmuls and
+  reassociates the reductions — S>1 seed lanes have never been bitwise
+  vs solo; tests/test_fleet.py TestFleetIndependence pins the same).
+- The un-vmapped hyper ARITHMETIC is bitwise the serial optax path
+  (state.make_hyper_optimizer: same opt-state tree, same multiply
+  order) — pinned at the optimizer level below.
+- PBT: a generation step (winner select + per-lane checkpoint exploit +
+  deterministic perturb) resumed from its checkpoints continues BITWISE
+  the unbroken run.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.train import FleetTrainer, Trainer
+from factorvae_tpu.train.fleet import unstack_state, validate_lane_configs
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+@pytest.fixture(scope="module")
+def hyper_ds():
+    panel = synthetic_panel(
+        num_days=20, num_instruments=6, num_features=8, missing_prob=0.1,
+        seed=0,
+    )
+    return PanelDataset(panel, seq_len=5)
+
+
+def base_config(save_dir, ds, **train_kw) -> Config:
+    defaults = dict(num_epochs=3, lr=1e-3, seed=3, save_dir=str(save_dir),
+                    checkpoint_every=0)
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5),
+        data=DataConfig(seq_len=5, start_time=None,
+                        fit_end_time=str(ds.dates[12].date()),
+                        val_start_time=str(ds.dates[13].date()),
+                        val_end_time=str(ds.dates[-1].date())),
+        train=TrainConfig(**defaults),
+    )
+
+
+def lane_cfg(cfg: Config, seed: int, lr: float, klw: float,
+             tag: str) -> Config:
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, kl_weight=klw),
+        train=dataclasses.replace(
+            cfg.train, seed=seed, lr=lr,
+            run_name=f"{cfg.train.run_name}_{tag}"),
+    )
+
+
+#: the mixed grid every class here races: two lanes, both scalars differ
+LANES = [(3, 1e-3, 1.0), (7, 3e-3, 0.1)]
+
+
+def assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def assert_trees_close(a, b, rtol=5e-3, atol=5e-3):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+class TestHyperOptimizerArithmetic:
+    """state.make_hyper_optimizer: the deferred-lr Adam is bitwise the
+    serial optax.adam at matched values, with the SAME opt-state tree
+    (per-lane checkpoints stay serial-restorable)."""
+
+    def _steps(self, tx, scale, p, n=8):
+        import optax
+
+        o = tx.init(p)
+        g = {"w": jnp.linspace(-1.0, 1.0, 12).reshape(3, 4)}
+        for i in range(n):
+            u, o = tx.update(g, o, p)
+            if scale is not None:
+                s = scale(jnp.int32(i))
+                u = jax.tree.map(
+                    lambda t: jnp.asarray(s, dtype=t.dtype) * t, u)
+            p = optax.apply_updates(p, u)
+        return p, o
+
+    @pytest.mark.parametrize("cosine", [True, False])
+    def test_bitwise_vs_serial_adam(self, cosine):
+        import optax
+
+        from factorvae_tpu.train.state import (
+            make_hyper_optimizer,
+            make_optimizer,
+        )
+
+        cfg = TrainConfig(lr=3e-4, cosine_schedule=cosine)
+        total = 30
+        tx_s = make_optimizer(cfg, total)
+        tx_h, step_size = make_hyper_optimizer(cfg, total)
+        p0 = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4)}
+        lane_lr = jnp.float32(cfg.lr)
+        ps, os_ = self._steps(tx_s, None, p0)
+        ph, oh = self._steps(
+            tx_h, lambda i: step_size(i, lane_lr), p0)
+        assert_trees_bitwise(ps, ph)
+        assert (jax.tree_util.tree_structure(os_)
+                == jax.tree_util.tree_structure(oh))
+        # the COUNT leaves advanced identically (the serial horizon a
+        # restored checkpoint resumes on)
+        cs = [x for x in jax.tree.leaves(os_) if x.dtype == jnp.int32]
+        ch = [x for x in jax.tree.leaves(oh) if x.dtype == jnp.int32]
+        for a, b in zip(cs, ch):
+            assert int(a) == int(b)
+
+
+class TestHyperFold:
+    """Homogeneous lanes fold to the exact pre-hyper traces."""
+
+    def test_homogeneous_lanes_fold_to_seed_fleet(self, hyper_ds,
+                                                  tmp_path):
+        cfg = base_config(tmp_path / "plain", hyper_ds)
+        plain = FleetTrainer(cfg, hyper_ds, seeds=[3, 7],
+                             logger=MetricsLogger(echo=False))
+        assert not plain.hyper
+        sp, op = plain.fit()
+
+        cfg_f = base_config(tmp_path / "fold", hyper_ds)
+        lanes = [
+            dataclasses.replace(
+                cfg_f, train=dataclasses.replace(cfg_f.train, seed=s))
+            for s in (3, 7)
+        ]
+        fold = FleetTrainer(cfg_f, hyper_ds, lane_configs=lanes,
+                            logger=MetricsLogger(echo=False))
+        assert not fold.hyper, "identical-scalar lanes must fold"
+        sf, of = fold.fit()
+        assert_trees_bitwise(sp.params, sf.params)
+        np.testing.assert_array_equal(op["best_val"], of["best_val"])
+
+    def test_single_lane_folds_to_serial_trainer(self, hyper_ds,
+                                                 tmp_path):
+        """S=1 with a lane override rebakes the scalars and runs the
+        serial-bitwise un-vmapped trace."""
+        cfg = base_config(tmp_path / "serial", hyper_ds)
+        lane = lane_cfg(cfg, 5, 3e-3, 0.1, "solo")
+        ft = FleetTrainer(cfg, hyper_ds, lane_configs=[lane],
+                          logger=MetricsLogger(echo=False))
+        assert not ft.hyper
+        # the fold rebaked the lane's scalars into the compiled config
+        assert ft.cfg.train.lr == 3e-3
+        assert ft.cfg.model.kl_weight == 0.1
+        sf, of = ft.fit()
+
+        tr = Trainer(lane, hyper_ds, logger=MetricsLogger(echo=False))
+        ss, os_ = tr.fit()
+        assert_trees_bitwise(ss.params, unstack_state(sf, 0).params)
+        assert float(of["best_val"][0]) == os_["best_val"]
+
+    def test_lane_validation_rejects_shape_and_schedule_variants(
+            self, hyper_ds, tmp_path):
+        cfg = base_config(tmp_path, hyper_ds)
+        k_variant = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, num_factors=2))
+        with pytest.raises(ValueError, match="shape/arch"):
+            validate_lane_configs(cfg, [cfg, k_variant])
+        epoch_variant = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, num_epochs=5))
+        with pytest.raises(ValueError, match="train.num_epochs"):
+            validate_lane_configs(cfg, [cfg, epoch_variant])
+        # same save_dir+run_name+seed with different scalars: the two
+        # lanes would race into one checkpoint directory
+        a = lane_cfg(cfg, 3, 1e-3, 1.0, "x")
+        b = lane_cfg(cfg, 3, 3e-3, 0.1, "x")
+        with pytest.raises(ValueError, match="collide"):
+            validate_lane_configs(cfg, [a, b])
+
+    def test_seeds_and_lane_configs_mutually_exclusive(self, hyper_ds,
+                                                       tmp_path):
+        cfg = base_config(tmp_path, hyper_ds)
+        with pytest.raises(ValueError, match="not both"):
+            FleetTrainer(cfg, hyper_ds, seeds=[3],
+                         lane_configs=[cfg])
+
+
+class TestHyperOracle:
+    """The heterogeneous-lane oracle chain (f32, fixed seeds)."""
+
+    @pytest.fixture(scope="class")
+    def runs(self, hyper_ds, tmp_path_factory):
+        d = tmp_path_factory.mktemp("hyper")
+        cfg = base_config(d / "mixed", hyper_ds)
+        lanes = [lane_cfg(cfg, s, lr, klw, f"l{i}")
+                 for i, (s, lr, klw) in enumerate(LANES)]
+        mixed = FleetTrainer(cfg, hyper_ds, lane_configs=lanes,
+                             logger=MetricsLogger(echo=False))
+        assert mixed.hyper
+        sm, om = mixed.fit()
+
+        homog, serial = [], []
+        for i, (seed, lr, klw) in enumerate(LANES):
+            cfg_h = base_config(d / f"homog{i}", hyper_ds)
+            lanes_h = [lane_cfg(cfg_h, s, lr, klw, f"l{j}")
+                       for j, (s, _, _) in enumerate(LANES)]
+            ft = FleetTrainer(cfg_h, hyper_ds, lane_configs=lanes_h,
+                              force_hyper=True,
+                              logger=MetricsLogger(echo=False))
+            assert ft.hyper, "force_hyper must keep the runtime trace"
+            homog.append(ft.fit())
+
+            cfg_s = lane_cfg(base_config(d / f"serial{i}", hyper_ds),
+                             seed, lr, klw, "solo")
+            tr = Trainer(cfg_s, hyper_ds, logger=MetricsLogger(echo=False))
+            serial.append(tr.fit())
+        return sm, om, homog, serial
+
+    def test_hetero_lane_bitwise_vs_homogeneous_hyper_fleet(self, runs):
+        """The hyper mechanism adds ZERO drift: lane i of the mixed
+        fleet == lane i of the same-width fleet pinned at config i,
+        bit for bit (params, best-val, metric history)."""
+        sm, om, homog, _ = runs
+        for i in range(len(LANES)):
+            so, oo = homog[i]
+            assert_trees_bitwise(unstack_state(sm, i).params,
+                                 unstack_state(so, i).params)
+            assert float(om["best_val"][i]) == float(oo["best_val"][i])
+            for hm, ho in zip(om["history"], oo["history"]):
+                assert hm["train_loss"][i] == ho["train_loss"][i]
+                assert hm["val_loss"][i] == ho["val_loss"][i]
+                assert hm["train_kl"][i] == ho["train_kl"][i]
+
+    def test_hetero_lane_close_to_serial_run(self, runs):
+        """Against the serial Trainer at its config a lane inherits the
+        PR-2 fleet tolerance (vmap reassociation — not the hyper
+        threading — is the gap; same rtol as TestFleetIndependence)."""
+        sm, om, _, serial = runs
+        for i in range(len(LANES)):
+            ss, os_ = serial[i]
+            assert_trees_close(ss.params, unstack_state(sm, i).params)
+            np.testing.assert_allclose(
+                os_["best_val"], float(om["best_val"][i]), rtol=5e-3)
+            for hs, hm in zip(os_["history"], om["history"]):
+                np.testing.assert_allclose(
+                    hs["train_loss"], hm["train_loss"][i], rtol=5e-3)
+                np.testing.assert_allclose(
+                    hs["val_loss"], hm["val_loss"][i], rtol=5e-3)
+
+    def test_hetero_lane_scores_close_to_serial(self, runs, hyper_ds):
+        """Final-epoch params score through the seed-batched scan to
+        the serial run's scores at the fleet tolerance."""
+        from factorvae_tpu.eval.predict import (
+            predict_panel,
+            predict_panel_fleet,
+        )
+
+        sm, om, _, serial = runs
+        cfg = base_config("/tmp/unused", hyper_ds)
+        days = hyper_ds.split_days(cfg.data.val_start_time, None)
+        batched = predict_panel_fleet(sm.params, cfg, hyper_ds,
+                                      days, stochastic=False)
+        for i, (ss, _) in enumerate(serial):
+            solo = predict_panel(ss.params, cfg, hyper_ds, days,
+                                 stochastic=False)
+            np.testing.assert_allclose(
+                np.asarray(solo), np.asarray(batched[i]),
+                rtol=5e-3, atol=5e-3)
+
+    def test_stream_residency_bitwise_hbm(self, runs, tmp_path):
+        """Hyper x stream: the mixed-lane fleet on a stream-resident
+        panel (chunked prefetch, per-lane mini-panels) reproduces the
+        HBM run bit for bit — the established stream == hbm discipline
+        extends to the hyper trace (hp threads through the chunk jits,
+        eval included)."""
+        panel = synthetic_panel(
+            num_days=20, num_instruments=6, num_features=8,
+            missing_prob=0.1, seed=0,
+        )
+        ds_stream = PanelDataset(panel, seq_len=5, residency="stream")
+        sm, om, _, _ = runs
+        cfg = base_config(tmp_path / "stream", ds_stream)
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data,
+                                          panel_residency="stream",
+                                          stream_chunk_days=8))
+        lanes = [lane_cfg(cfg, s, lr, klw, f"l{i}")
+                 for i, (s, lr, klw) in enumerate(LANES)]
+        ft = FleetTrainer(cfg, ds_stream, lane_configs=lanes,
+                          logger=MetricsLogger(echo=False))
+        assert ft.hyper and ft.stream
+        ss, os_ = ft.fit()
+        assert_trees_bitwise(sm.params, ss.params)
+        np.testing.assert_array_equal(om["best_val"], os_["best_val"])
+
+    def test_per_lane_lr_logged(self, runs):
+        """Hyper epoch records carry per-lane lr lists and lane-config
+        labels (the obs satellite's data source)."""
+        _, om, _, _ = runs
+        rec = om["history"][0]
+        assert isinstance(rec["lr"], list) and len(rec["lr"]) == 2
+        assert rec["lr"][0] != rec["lr"][1]
+        labels = rec["lane_labels"]
+        assert "lr=0.001" in labels[0] and "klw=1" in labels[0]
+        assert "lr=0.003" in labels[1] and "klw=0.1" in labels[1]
+        assert "cfg=" in labels[0]
+
+
+class TestShapeBuckets:
+    """grid_sweep's partition + labeling are pure, deterministic
+    functions of the point list."""
+
+    POINTS = [
+        {"lr": 1e-4, "kl_weight": 1.0},
+        {"lr": 3e-4, "kl_weight": 0.1},
+        {"lr": 1e-4, "kl_weight": 1.0, "num_factors": 60},
+        {"lr": 1e-4, "kl_weight": 1.0, "num_factors": 60,
+         "hidden_size": 60},
+        {"lr": 3e-4, "kl_weight": 0.1, "num_factors": 60},
+    ]
+
+    def test_partition_deterministic(self):
+        from factorvae_tpu.eval.sweep import shape_buckets
+
+        a = shape_buckets(self.POINTS)
+        b = shape_buckets(list(self.POINTS))
+        assert [(k, [i for i, _ in m]) for k, m in a] \
+            == [(k, [i for i, _ in m]) for k, m in b]
+        # three distinct shapes, ordered by first occurrence; lane order
+        # preserved within each bucket
+        assert [k for k, _ in a] == [
+            (None, None, None), (60, None, None), (60, 60, None)]
+        assert [i for i, _ in a[0][1]] == [0, 1]
+        assert [i for i, _ in a[1][1]] == [2, 4]
+
+    def test_point_labels_unique_and_stable(self):
+        from factorvae_tpu.eval.sweep import point_label
+
+        labels = [point_label(p) for p in self.POINTS]
+        assert len(set(labels)) == len(labels)
+        assert labels[0] == "lr0.0001_kl1"
+        assert labels[3] == "lr0.0001_kl1_K60_H60"
+
+    def test_parse_hyper_grid(self):
+        from factorvae_tpu.eval.sweep import parse_hyper_grid
+
+        assert parse_hyper_grid("1e-4:1.0, 3e-4:0.1") == [
+            {"lr": 1e-4, "kl_weight": 1.0},
+            {"lr": 3e-4, "kl_weight": 0.1},
+        ]
+
+    def test_unknown_point_key_rejected(self, hyper_ds, tmp_path):
+        from factorvae_tpu.eval.sweep import _point_config
+
+        cfg = base_config(tmp_path, hyper_ds)
+        with pytest.raises(ValueError, match="unknown grid-point key"):
+            _point_config(cfg, {"lr": 1e-4, "dropout_rate": 0.5}, "x")
+
+
+class TestGridSweep:
+    """grid_sweep end to end: shape buckets -> hyper-fleet programs ->
+    per-point scores, with the seed_sweep resume/callback contract."""
+
+    def test_grid_trains_buckets_and_adopts_priors(self, hyper_ds,
+                                                   tmp_path):
+        from factorvae_tpu.eval.sweep import grid_sweep
+
+        cfg = base_config(tmp_path, hyper_ds, num_epochs=2)
+        points = [
+            {"lr": 1e-3, "kl_weight": 1.0},
+            {"lr": 3e-3, "kl_weight": 0.1},
+            {"lr": 1e-3, "kl_weight": 1.0, "num_factors": 2},
+        ]
+        fired = []
+        df = grid_sweep(cfg, hyper_ds, points,
+                        score_start=str(hyper_ds.dates[13].date()),
+                        logger=MetricsLogger(echo=False),
+                        on_point=lambda r: fired.append(r["label"]))
+        assert list(df.index) == [
+            "lr0.001_kl1", "lr0.003_kl0.1", "lr0.001_kl1_K2"]
+        assert fired == list(df.index)
+        assert np.isfinite(df["rank_ic"]).all()
+        assert np.isfinite(df["best_val"]).all()
+        assert df.attrs["summary"]["num_buckets"] == 2
+
+        # resume: adopted point keeps its record verbatim, still fires
+        prior = {"lr0.001_kl1": df.loc["lr0.001_kl1"].to_dict()}
+        fired2 = []
+        df2 = grid_sweep(cfg, hyper_ds, points,
+                         score_start=str(hyper_ds.dates[13].date()),
+                         logger=MetricsLogger(echo=False),
+                         prior_records=prior,
+                         on_point=lambda r: fired2.append(r["label"]))
+        assert df2.loc["lr0.001_kl1", "rank_ic"] \
+            == df.loc["lr0.001_kl1", "rank_ic"]
+        assert sorted(fired2) == sorted(fired)
+
+
+class TestPBT:
+    """train/pbt.py: deterministic explore, checkpoint-copy exploit,
+    bitwise generation resume."""
+
+    def _lanes(self, cfg):
+        return [lane_cfg(cfg, s, lr, klw, f"lane{i}")
+                for i, (s, lr, klw) in enumerate(LANES)]
+
+    def test_perturb_rule_is_deterministic(self):
+        from factorvae_tpu.train.pbt import perturb_factor
+
+        f = [perturb_factor(g, ln, (0.8, 1.25))
+             for g in range(3) for ln in range(2)]
+        assert f == [perturb_factor(g, ln, (0.8, 1.25))
+                     for g in range(3) for ln in range(2)]
+        assert set(f) == {0.8, 1.25}
+
+    def test_generation_resume_bitwise(self, hyper_ds, tmp_path):
+        """Unbroken 2-generation run == stop-after-generation-0 run +
+        resume: params, best-val and the scalar walk all match exactly
+        (the winner-select + exploit + perturb step replays from the
+        lockstep checkpoints)."""
+        from factorvae_tpu.train.pbt import pbt_fit
+
+        kw = dict(generations=2, epochs_per_generation=2,
+                  logger=MetricsLogger(echo=False))
+        cfg_a = base_config(tmp_path / "a", hyper_ds, num_epochs=4,
+                            checkpoint_every=1)
+        _, res_a = pbt_fit(cfg_a, hyper_ds, self._lanes(cfg_a), **kw)
+        assert [r["generation"] for r in res_a["generations"]] == [0, 1]
+        assert res_a["generations"][0]["exploited"], \
+            "generation 0 must exploit at least one lane"
+
+        cfg_b = base_config(tmp_path / "b", hyper_ds, num_epochs=4,
+                            checkpoint_every=1)
+        pbt_fit(cfg_b, hyper_ds, self._lanes(cfg_b), stop_after=0, **kw)
+        _, res_b = pbt_fit(cfg_b, hyper_ds, self._lanes(cfg_b),
+                           resume=True, **kw)
+        assert [r["generation"] for r in res_b["generations"]] == [1]
+        assert_trees_bitwise(res_a["state"].params,
+                             res_b["state"].params)
+        np.testing.assert_array_equal(res_a["best_val"],
+                                      res_b["best_val"])
+        assert [(c.train.lr, c.model.kl_weight)
+                for c in res_a["lane_configs"]] == \
+            [(c.train.lr, c.model.kl_weight)
+             for c in res_b["lane_configs"]]
+        # the persisted walk matches the in-memory one
+        with open(os.path.join(
+                cfg_b.train.save_dir,
+                f"{cfg_b.train.run_name}_pbt.json")) as f:
+            saved = json.load(f)
+        assert saved["generation"] == 2
+        assert saved["lanes"] == [
+            {"lr": c.train.lr, "kl_weight": c.model.kl_weight}
+            for c in res_b["lane_configs"]]
+
+    def test_resume_after_kill_before_pbt_state_write(self, hyper_ds,
+                                                      tmp_path):
+        """The narrowest kill window: generation 0's fit completed (its
+        lockstep checkpoints committed) but the process died BEFORE the
+        exploit step and the _pbt.json write. The resumed run's gen-0
+        fit restores with nothing left to train (empty history), so the
+        controller must RECOMPUTE fitness from the restored params with
+        the unbroken run's eval key — never rank lanes on a garbage
+        all-inf fallback — and the whole run still finishes bitwise the
+        unbroken one."""
+        import dataclasses as dc
+
+        from factorvae_tpu.train.pbt import pbt_fit
+
+        kw = dict(generations=2, epochs_per_generation=2,
+                  logger=MetricsLogger(echo=False))
+        cfg_a = base_config(tmp_path / "a", hyper_ds, num_epochs=4,
+                            checkpoint_every=1)
+        _, res_a = pbt_fit(cfg_a, hyper_ds, self._lanes(cfg_a), **kw)
+
+        cfg_b = base_config(tmp_path / "b", hyper_ds, num_epochs=4,
+                            checkpoint_every=1)
+        lanes_b = self._lanes(cfg_b)
+        # simulate the kill: run gen 0's fit by hand (exactly what
+        # pbt_fit's first generation runs), write NO pbt state
+        ft = FleetTrainer(cfg_b, hyper_ds,
+                          lane_configs=[
+                              dc.replace(c, train=dc.replace(
+                                  c.train, num_epochs=4))
+                              for c in lanes_b],
+                          force_hyper=True,
+                          logger=MetricsLogger(echo=False))
+        ft.fit(num_epochs=2)
+        _, res_b = pbt_fit(cfg_b, hyper_ds, lanes_b, resume=True, **kw)
+        assert [r["generation"] for r in res_b["generations"]] == [0, 1]
+        # the recomputed gen-0 fitness equals the unbroken run's
+        np.testing.assert_array_equal(
+            res_a["generations"][0]["fitness"],
+            res_b["generations"][0]["fitness"])
+        assert_trees_bitwise(res_a["state"].params,
+                             res_b["state"].params)
+        np.testing.assert_array_equal(res_a["best_val"],
+                                      res_b["best_val"])
+
+    def test_pbt_requires_checkpointing(self, hyper_ds, tmp_path):
+        from factorvae_tpu.train.pbt import pbt_fit
+
+        cfg = base_config(tmp_path, hyper_ds, checkpoint_every=0)
+        with pytest.raises(ValueError, match="checkpoint_every"):
+            pbt_fit(cfg, hyper_ds, self._lanes(cfg), generations=1,
+                    epochs_per_generation=1)
+
+
+class TestHyperCompose:
+    """mesh x hyper: an indivisible lane count fails at construction
+    with the documented one-line CompositionError (the CLI's exit-2
+    path), never as a mid-fit stacking error."""
+
+    def test_indivisible_hyper_grid_rejected(self, hyper_ds, tmp_path):
+        from jax.sharding import Mesh
+
+        from factorvae_tpu.parallel.compose import CompositionError
+
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stock"))
+        cfg = base_config(tmp_path, hyper_ds)
+        lanes = [lane_cfg(cfg, s, lr, 1.0, f"l{i}")
+                 for i, (s, lr) in enumerate(
+                     [(3, 1e-3), (7, 3e-3), (11, 1e-2)])]
+        with pytest.raises(CompositionError,
+                           match=r"\[mesh x hyper\].*3 config lanes"):
+            FleetTrainer(cfg, hyper_ds, lane_configs=lanes, mesh=mesh,
+                         logger=MetricsLogger(echo=False))
+
+    def test_compose_validate_hyper_message(self):
+        from factorvae_tpu.parallel.compose import (
+            CompositionError,
+            validate,
+        )
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "stock"))
+        with pytest.raises(CompositionError, match="hyper grid of 5"):
+            validate(mesh=mesh, num_seeds=5, hyper=True)
+        # the classic fleet message is untouched
+        with pytest.raises(CompositionError, match="fleet of 5 seeds"):
+            validate(mesh=mesh, num_seeds=5)
+
+
+class TestHyperObsLabels:
+    """Per-lane flag details and Prometheus lanes carry the lane CONFIG
+    (the obs satellite) — pure record-level checks, no training."""
+
+    def _epochs(self):
+        labels = ["seed=3 lr=0.001 klw=1 cfg=aaaaaaaa",
+                  "seed=7 lr=0.003 klw=0.1 cfg=bbbbbbbb"]
+        return [
+            {"event": "fleet_epoch", "epoch": e, "_line": e,
+             "train_loss": [0.5, 0.5], "val_loss": [1.0, v],
+             "seconds": 1.0, "lane_labels": labels}
+            for e, v in enumerate([1.0, 1.0, 2.0, 2.0, 2.0])
+        ]
+
+    def test_report_flags_name_the_lane_config(self):
+        from factorvae_tpu.obs.report import health_flags
+
+        flags = health_flags(self._epochs(), [])
+        div = [f for f in flags if f["flag"] == "val_divergence"]
+        assert div, "expected a val_divergence flag"
+        assert "seed lane 1: seed=7 lr=0.003 klw=0.1" in div[0]["detail"]
+
+    def test_live_monitor_matches_report(self, tmp_path):
+        """The streaming monitor reuses build_report, so the labeled
+        detail is identical live and post-hoc (the ISSUE-10 pin)."""
+        from factorvae_tpu.obs.live import follow_run
+        from factorvae_tpu.obs.report import build_report
+        from factorvae_tpu.obs.timeline import load_run
+
+        path = tmp_path / "RUN.jsonl"
+        with open(path, "w") as f:
+            for rec in self._epochs():
+                f.write(json.dumps(rec) + "\n")
+        mon = follow_run(str(path), follow=False, update_interval_s=0)
+        post = build_report(load_run(str(path)))
+        assert sorted(f["detail"] for f in mon.current_flags()) \
+            == sorted(f["detail"] for f in post["flags"])
+
+    def test_exporter_carries_lane_config_label(self, tmp_path):
+        from factorvae_tpu.obs.metrics import TextfileExporter
+
+        exp = TextfileExporter(str(tmp_path / "train.prom"))
+        exp.export_epoch(self._epochs()[0])
+        text = open(tmp_path / "train.prom").read()
+        assert ('factorvae_train_val_loss{seed_lane="1",'
+                'lane_config="seed=7 lr=0.003 klw=0.1 cfg=bbbbbbbb"}'
+                in text)
+        # serial records (no labels) keep the bare seed_lane-less form
+        exp.export_epoch({"epoch": 0, "train_loss": 0.5})
+        text = open(tmp_path / "train.prom").read()
+        assert "factorvae_train_train_loss 0.5" in text
