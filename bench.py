@@ -80,6 +80,16 @@ verified recovery. Every class must recover or the payload becomes the
 per-class MTTR; `--track` adds one history row per fault class. Same
 robustness contract.
 
+Walk-forward mode (`python bench.py --walkforward`, or
+BENCH_WALKFORWARD=1): the closed-loop nightly-cycle bench (ISSUE 14,
+factorvae_tpu/wf) — one forced append->judge->refit->promote->verify
+cycle on a tiny in-process rig with a client hammering the daemon
+throughout. Reports refit-to-first-served-score (headline: rollovers/
+sec), warm-vs-cold refit Rank-IC A/B, and promotion downtime (any
+dropped request fails the payload). BENCH_WALKFORWARD.json + a
+`walkforward_serve_continuity` history row under --track. Same
+robustness contract.
+
 Stream mode (`python bench.py --stream`, or BENCH_STREAM=1 with
 BENCH_STREAM_CHUNK=n): A/B the panel residency — HBM-resident
 whole-epoch scan vs the out-of-core stream path (data/stream.py,
@@ -242,6 +252,23 @@ SERVE_MODELS = int(os.environ.get("BENCH_SERVE_MODELS", 2))
 # `chaos_recovery_rate_<class>` history row per fault class
 # (BENCH_CHAOS.json carries the full detail).
 USE_CHAOS = os.environ.get("BENCH_CHAOS", "0") == "1"
+# Walk-forward mode (`python bench.py --walkforward` or
+# BENCH_WALKFORWARD=1): one drift-triggered nightly cycle (ISSUE 14,
+# factorvae_tpu/wf) on a tiny in-process rig with a client hammering
+# the scoring daemon THROUGHOUT — measures refit-to-first-served-score
+# wall, the warm-vs-cold refit Rank-IC A/B, and promotion downtime
+# (requests dropped during rollover MUST be zero or the payload becomes
+# the *_failed metric the ledger refuses). Headline value is
+# 1/refit-to-serve (rollovers/sec: higher is better, the ledger's
+# direction); a second `walkforward_serve_continuity` history row
+# tracks the served-ok fraction during the cycle. Detail lands in
+# BENCH_WALKFORWARD.json. Shapes are env-overridable
+# (BENCH_WF_STOCKS/BENCH_WF_DAYS/BENCH_WF_EPOCHS/BENCH_WF_FEATURES).
+USE_WALKFORWARD = os.environ.get("BENCH_WALKFORWARD", "0") == "1"
+WF_STOCKS = int(os.environ.get("BENCH_WF_STOCKS", 16))
+WF_DAYS = int(os.environ.get("BENCH_WF_DAYS", 24))
+WF_EPOCHS = int(os.environ.get("BENCH_WF_EPOCHS", 2))
+WF_FEATURES = int(os.environ.get("BENCH_WF_FEATURES", 8))
 # Track mode (`--track` or BENCH_TRACK=1): append the emitted headline
 # row to BENCH_HISTORY.jsonl (obs/ledger.py) so every bench run extends
 # the longitudinal perf trajectory instead of producing a one-off
@@ -363,6 +390,8 @@ def fail_metric() -> str:
         return "serve_qps_failed"
     if USE_CHAOS or os.environ.get("BENCH_CHAOS", "0") == "1":
         return "chaos_recovery_rate_failed"
+    if USE_WALKFORWARD or os.environ.get("BENCH_WALKFORWARD", "0") == "1":
+        return "walkforward_rollover_rate_failed"
     return "train_throughput_flagship_K96_H64_Alpha158_failed"
 
 
@@ -377,6 +406,8 @@ def fail_unit() -> str:
         return "req/sec"
     if USE_CHAOS or os.environ.get("BENCH_CHAOS", "0") == "1":
         return "recoveries/sec"
+    if USE_WALKFORWARD or os.environ.get("BENCH_WALKFORWARD", "0") == "1":
+        return "rollovers/sec"
     return "windows/sec*seed" if fleet else "windows/sec/chip"
 
 
@@ -1511,6 +1542,152 @@ for s in range(3):
     if recovered["serve_cold_fail"]:
         mttr["serve_cold_fail"] = max(time.perf_counter() - t0, 1e-4)
 
+    # ---- walk-forward cycle-stage classes (ISSUE 14) ------------------
+    # The nightly loop's crash windows (docs/walkforward.md fault
+    # catalog): slab corruption + kills at the append / refit / promote
+    # boundaries, and a forced fidelity-gate reject. The kill classes
+    # drive the REAL driver (`python -m factorvae_tpu.wf`) in
+    # subprocesses so the recovery measured is the journal resume a
+    # production operator actually performs.
+    from factorvae_tpu.data.append import AppendError, PanelStore
+    from factorvae_tpu.data.synthetic import (
+        continuation_panel,
+        synthetic_panel_dense,
+    )
+
+    # --- corrupt_append_slab: slab bytes flipped between write and
+    # manifest commit; recovered = validation aborts the append with
+    # the manifest untouched AND the retry (fault consumed) lands the
+    # slab verified. MTTR = failed attempt + clean retry.
+    wf_store_dir = os.path.join(work, "wf_store")
+    wf_panel = synthetic_panel_dense(num_days=12, num_instruments=8,
+                                     num_features=6, seed=0)
+    wf_store = PanelStore.create(wf_store_dir, wf_panel)
+    piece = continuation_panel(wf_store.instruments, wf_store.end_date,
+                               2, 6, seed=1)
+    plan = ChaosPlan([Fault("corrupt_append_slab")])
+    t0 = time.perf_counter()
+    with chaos.active(plan):
+        aborted = False
+        try:
+            wf_store.append_panel(piece)
+        except AppendError:
+            aborted = wf_store.generation == 1
+        try:
+            wf_store.append_panel(piece)
+        except AppendError:
+            pass
+    recovered["corrupt_append_slab"] = bool(
+        aborted and wf_store.generation == 2
+        and wf_store.verify() is None)
+    if recovered["corrupt_append_slab"]:
+        mttr["corrupt_append_slab"] = max(
+            time.perf_counter() - t0, 1e-4)
+
+    # --- kill_mid_append: a child SIGKILLed between slab commit and
+    # manifest commit (the orphan-slab window); recovered = the parent
+    # re-appends the same days idempotently and the store verifies.
+    # MTTR = the recovery append wall.
+    piece2 = continuation_panel(wf_store.instruments,
+                                wf_store.end_date, 2, 6, seed=2)
+    append_child = f"""
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+from factorvae_tpu.data.append import PanelStore
+from factorvae_tpu.data.synthetic import continuation_panel
+st = PanelStore({wf_store_dir!r})
+piece = continuation_panel(st.instruments, st.end_date, 2, 6, seed=2)
+st.append_panel(piece)
+"""
+    plan = ChaosPlan([Fault("kill_mid_append", step=1)])
+    r = subprocess.run(
+        [sys.executable, "-c", append_child], capture_output=True,
+        text=True, timeout=300,
+        env=chaos.child_env(plan, env={**os.environ,
+                                       "JAX_PLATFORMS": "cpu"}))
+    t0 = time.perf_counter()
+    try:
+        st2 = PanelStore(wf_store_dir)
+        orphan_before = st2.generation == 2
+        st2.append_panel(piece2)
+        recovered["kill_mid_append"] = (
+            r.returncode == -_signal.SIGKILL and orphan_before
+            and st2.generation == 3 and st2.verify() is None)
+    except Exception:
+        recovered["kill_mid_append"] = False
+    if recovered["kill_mid_append"]:
+        mttr["kill_mid_append"] = max(time.perf_counter() - t0, 1e-4)
+
+    # --- kill_mid_refit / kill_between_admit_and_drain /
+    # fidelity_gate_reject: the real driver. One clean bootstrap run
+    # (cycle 1) warms the rig; each kill class then runs one cycle
+    # under its fault (SIGKILL mid-stage), and the UNfaulted re-run is
+    # the timed recovery: the journal resumes the open cycle and
+    # completes it.
+    wf_run = os.path.join(work, "wf_run")
+    wf_cmd = [sys.executable, "-m", "factorvae_tpu.wf",
+              "--run_dir", wf_run, "--cycles", "1", "--force_refit",
+              "--epochs", "2", "--init_days", "16", "--new_days", "2",
+              "--stocks", "8", "--features", "6", "--hidden", "8",
+              "--factors", "4", "--portfolios", "6", "--seq_len", "5"]
+    wf_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+              "FACTORVAE_COMPILE_CACHE": os.path.join(work, "wf_cache")}
+    wf_env.pop(chaos.ENV_VAR, None)
+
+    def _wf_run(fault=None, timeout=600):
+        env = wf_env if fault is None else chaos.child_env(
+            ChaosPlan([fault]), env=wf_env)
+        r = subprocess.run(wf_cmd, capture_output=True, text=True,
+                           timeout=timeout, env=env)
+        summary = None
+        for ln in (r.stdout or "").strip().splitlines():
+            if ln.startswith("{"):
+                summary = json.loads(ln)
+        return r.returncode, summary
+
+    rc0, _ = _wf_run()   # clean bootstrap + cycle (not timed)
+    wf_boot_ok = rc0 == 0
+
+    for cls, fault in (
+            ("kill_mid_refit", Fault("kill_mid_refit", step=1)),
+            ("kill_between_admit_and_drain",
+             Fault("kill_between_admit_and_drain", request=2))):
+        try:
+            rc_kill, _ = _wf_run(fault=fault)
+            t0 = time.perf_counter()
+            rc_res, summary = _wf_run()
+            recovered[cls] = bool(
+                wf_boot_ok and rc_kill == -_signal.SIGKILL
+                and rc_res == 0 and summary
+                and summary.get("promoted")
+                # the journal replayed the committed prefix instead of
+                # re-running it (idempotent resume, not a restart)
+                and summary.get("ran", {}).get("append") is False)
+            if recovered[cls]:
+                mttr[cls] = max(time.perf_counter() - t0, 1e-4)
+        except Exception:
+            recovered[cls] = False
+
+    # --- fidelity_gate_reject: the gate rejects the candidate;
+    # recovered = the cycle still CLOSES with the incumbent serving
+    # (promoted=False, verify answered). MTTR = the promote+verify
+    # walls from the cycle summary.
+    try:
+        rc_rej, summary = _wf_run(
+            fault=Fault("fidelity_gate_reject", request=2))
+        recovered["fidelity_gate_reject"] = bool(
+            wf_boot_ok and rc_rej == 0 and summary
+            and summary.get("triggered")
+            and summary.get("promoted") is False
+            and summary["stages"]["verify"].get("n"))
+        if recovered["fidelity_gate_reject"]:
+            walls = summary.get("walls", {})
+            mttr["fidelity_gate_reject"] = max(
+                float(walls.get("promote", 0.0))
+                + float(walls.get("verify", 0.0)), 1e-4)
+    except Exception:
+        recovered["fidelity_gate_reject"] = False
+
     shutil.rmtree(work, ignore_errors=True)
     all_recovered = all(recovered.values()) and len(mttr) == len(recovered)
     mean_mttr = (sum(mttr.values()) / len(mttr)) if mttr else 0.0
@@ -1559,6 +1736,169 @@ for s in range(3):
         except Exception as e:
             print(f"[bench] --chaos per-class track failed: {e}",
                   file=sys.stderr)
+    return payload
+
+
+def run_walkforward_bench() -> dict:
+    """Walk-forward bench (BENCH_WALKFORWARD): one forced nightly cycle
+    (append -> judge -> warm refit raced against a cold A/B -> fidelity
+    gate -> zero-downtime rollover -> first served score) on a tiny
+    in-process rig, with a client thread hammering the daemon the
+    WHOLE time. Reports refit-to-first-served-score wall (headline:
+    1/wall as rollovers/sec), the warm-vs-cold Rank-IC A/B, and
+    promotion downtime — any dropped request flips the payload to the
+    *_failed metric the ledger refuses. BENCH_WALKFORWARD.json carries
+    the full detail; --track also appends a
+    `walkforward_serve_continuity` row."""
+    import shutil
+    import tempfile
+    import threading
+
+    from factorvae_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, PanelStore
+    from factorvae_tpu.data.synthetic import (
+        continuation_panel,
+        synthetic_panel_dense,
+    )
+    from factorvae_tpu.serve.daemon import ScoringDaemon
+    from factorvae_tpu.serve.registry import ModelRegistry
+    from factorvae_tpu.utils.logging import MetricsLogger
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+    from factorvae_tpu.wf.operator import WalkForwardOperator
+
+    enable_persistent_compile_cache()
+    platform, _ = detect_platform()
+    work = tempfile.mkdtemp(prefix="bench_wf_")
+    seq_len = 5
+    cfg = Config(
+        model=ModelConfig(num_features=WF_FEATURES, hidden_size=8,
+                          num_factors=4, num_portfolios=8,
+                          seq_len=seq_len, stochastic_inference=False),
+        data=DataConfig(seq_len=seq_len, start_time=None,
+                        fit_end_time=None, val_start_time=None,
+                        val_end_time=None, panel_residency="stream"),
+        train=TrainConfig(seed=0, run_name="walkforward",
+                          num_epochs=WF_EPOCHS))
+    store = PanelStore.create(
+        os.path.join(work, "store"),
+        synthetic_panel_dense(num_days=WF_DAYS,
+                              num_instruments=WF_STOCKS,
+                              num_features=WF_FEATURES, seed=0))
+    dataset = PanelDataset(store.load_panel(), seq_len=seq_len,
+                           residency="stream")
+    registry = ModelRegistry()
+    daemon = ScoringDaemon(registry, dataset, stochastic=False)
+    logger = MetricsLogger(echo=False)
+    op = WalkForwardOperator(
+        store, dataset, daemon, cfg, os.path.join(work, "run"),
+        force_refit=True, cold_ab=True, refit_epochs=WF_EPOCHS,
+        logger=logger)
+
+    t0 = time.perf_counter()
+    op.ensure_incumbent(epochs=WF_EPOCHS)
+    bootstrap_s = time.perf_counter() - t0
+
+    # Client hammer: requests for a pre-append day flow through the
+    # daemon for the entire cycle — append, refit, promotion and drain
+    # included. The tick lock is the zero-downtime mechanism; this
+    # thread is the measurement of it.
+    probe_day = int(dataset.split_days(None, None)[-1])
+    stop = threading.Event()
+    outcomes: list = []   # (perf_counter, ok) tuples, hammer-owned
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                resp = daemon.handle({"model": "prod",
+                                      "day": probe_day})
+                ok = bool(resp.get("ok"))
+            except Exception as e:
+                # A serving plane that RAISES is a dropped request —
+                # record the failure so the zero-downtime verdict
+                # fails loudly instead of passing vacuously on a dead
+                # hammer thread.
+                print(f"[bench] walkforward hammer error: {e}",
+                      file=sys.stderr)
+                ok = False
+            outcomes.append((time.perf_counter(), ok))
+            time.sleep(0.005)
+
+    client = threading.Thread(target=hammer, name="wf-bench-client")
+    client.start()
+    try:
+        piece = continuation_panel(store.instruments, store.end_date,
+                                   2, WF_FEATURES, seed=11)
+        summary = op.run_cycle(piece)
+    finally:
+        stop.set()
+        client.join(timeout=30)
+
+    refit = summary["stages"]["refit"]
+    dropped = sum(1 for _, ok in outcomes if not ok)
+    ok_times = [t for t, ok in outcomes if ok]
+    max_gap_s = max(
+        (b - a for a, b in zip(ok_times, ok_times[1:])), default=0.0)
+    refit_to_serve = float(summary.get("refit_to_serve_s") or 0.0)
+    # A cycle whose gate REJECTED the candidate performed no rollover:
+    # a rollovers/sec headline for it would be a lie the ledger then
+    # tracks — require the promotion itself.
+    ok_all = bool(summary.get("promoted") is True
+                  and refit_to_serve > 0 and dropped == 0
+                  and len(outcomes) > 0)
+    rate = (1.0 / refit_to_serve) if refit_to_serve > 0 else 0.0
+    payload = {
+        "metric": ("walkforward_rollover_rate" if ok_all
+                   else "walkforward_rollover_rate_failed"),
+        "value": round(rate, 4),
+        "unit": "rollovers/sec",
+        "vs_baseline": None,   # no reference walk-forward baseline
+        "platform": platform,
+        "shapes": {"stocks": WF_STOCKS, "days": WF_DAYS,
+                   "epochs": WF_EPOCHS, "features": WF_FEATURES},
+        "bootstrap_s": round(bootstrap_s, 4),
+        "refit_to_first_served_s": round(refit_to_serve, 4),
+        "walls": summary.get("walls"),
+        "promoted": summary.get("promoted"),
+        "warm_rank_ic": (refit.get("warm") or {}).get("rank_ic"),
+        "cold_rank_ic": (refit.get("cold") or {}).get("rank_ic"),
+        "ab_winner": refit.get("winner"),
+        "promotion_downtime": {
+            "requests": len(outcomes),
+            "dropped": dropped,
+            "max_gap_s": round(max_gap_s, 4),
+        },
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_WALKFORWARD.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    if USE_TRACK and not ACCEL_CHILD and ok_all:
+        try:
+            from factorvae_tpu.obs.ledger import append_row
+            from factorvae_tpu.utils.logging import run_meta
+
+            append_row({
+                "metric": "walkforward_serve_continuity",
+                "value": round(1.0 - dropped / max(1, len(outcomes)),
+                               6),
+                "unit": "served_ok_frac",
+                "platform": platform,
+                "vs_baseline": None,
+                "run_meta": run_meta(),
+            })
+        except Exception as e:
+            print(f"[bench] --walkforward continuity track failed: {e}",
+                  file=sys.stderr)
+    shutil.rmtree(work, ignore_errors=True)
     return payload
 
 
@@ -1766,6 +2106,8 @@ def bench_payload() -> dict:
         payload = run_serve_bench()
     elif USE_CHAOS:
         payload = run_chaos_bench()
+    elif USE_WALKFORWARD:
+        payload = run_walkforward_bench()
     else:
         payload = run_bench()
     try:
@@ -1921,7 +2263,7 @@ def run_accel_child() -> tuple[bool, str]:
 
 def main() -> None:
     global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_SERVE, \
-        USE_CHAOS, USE_TRACK, USE_HYPER
+        USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -1948,6 +2290,9 @@ def main() -> None:
     if "--chaos" in sys.argv:
         USE_CHAOS = True
         os.environ["BENCH_CHAOS"] = "1"
+    if "--walkforward" in sys.argv:
+        USE_WALKFORWARD = True
+        os.environ["BENCH_WALKFORWARD"] = "1"
 
     if ACCEL_CHILD:
         # Child: backend already validated by the parent's probe; any crash

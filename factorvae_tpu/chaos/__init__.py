@@ -33,6 +33,32 @@ and act only on a match:
     serve_malformed      (no hook needed: the       {"ok": false}
                          bench/tests feed garbage)  responses
 
+Walk-forward cycle-stage coordinates (factorvae_tpu/wf, ISSUE 14) —
+each stage of the nightly append->judge->refit->promote->verify loop
+gets its own fault class, timed by the MTTR harness (bench --chaos):
+
+    kill_mid_append      data/append.py, step=0     orphan-slab overwrite
+                         before the slab write or   + idempotent append
+                         step=1 between slab and    re-run off the cycle
+                         manifest commit (SIGKILL)  journal
+    corrupt_append_slab  data/append.py, after the  sha256 validation
+                         slab lands, before the     BEFORE manifest
+                         manifest commit            commit: append aborts,
+                                                    store untouched, retry
+    kill_mid_refit       wf/operator.py, step=0     journaled refit stage
+                         before the refit fit or    re-runs; candidate
+                         step=1 after it, before    checkpoints resume the
+                         the journal commit         fit bitwise
+    kill_between_admit_  serve/daemon.admit, after  promote stage re-runs:
+    and_drain            candidate admission +      re-admission is
+                         gate verdict, before the   idempotent, the alias
+                         alias flip / incumbent     still points at the
+                         drain                      incumbent (serving
+                                                    never stopped)
+    fidelity_gate_reject serve/daemon.admit forces  candidate retired +
+                         the gate verdict to        logged; incumbent
+                         reject                     keeps serving
+
 Opt-in and zero-cost when off: with no plan installed and no env var,
 `fault()` is a None check — no allocation, no locking, no jax import —
 and every in-graph injection is gated at TRACE time (`has_fault`), so
@@ -68,6 +94,12 @@ KINDS = (
     "serve_cold_fail",
     "serve_stall",
     "serve_malformed",
+    # walk-forward cycle-stage classes (factorvae_tpu/wf)
+    "kill_mid_append",
+    "corrupt_append_slab",
+    "kill_mid_refit",
+    "kill_between_admit_and_drain",
+    "fidelity_gate_reject",
 )
 
 # Coordinate fields a Fault can pin (-1 / "" = wildcard, matches any).

@@ -11,6 +11,10 @@ Usage:
     python -m factorvae_tpu.cli --num_epochs 30 --dataset ./data/csi_data.pkl
     python -m factorvae_tpu.cli --score_only --resume ...
     python -m factorvae_tpu.cli --fleet_seeds 8 --auto_plan ...  # seed fleet
+
+The nightly closed loop (append -> drift judge -> warm refit ->
+zero-downtime rollover) lives in its own driver:
+`python -m factorvae_tpu.wf` (docs/walkforward.md).
 """
 
 from __future__ import annotations
@@ -701,6 +705,15 @@ def main(argv=None) -> int:
             # backtest loads, backtest.ipynb cell 2), not the final step.
             best = os.path.join(cfg.train.save_dir, cfg.checkpoint_name())
             params = load_params(best, state.params) if os.path.isdir(best) else state.params
+            if os.path.isdir(best):
+                # Serving/walk-forward admission drop-in: with it, the
+                # weights directory resolves its Config standalone
+                # (serve.registry.checkpoint_config) — admission no
+                # longer depends on the sibling full-state _ckpt
+                # manager surviving retention.
+                with open(os.path.join(best, "serve_config.json"),
+                          "w") as fh:
+                    fh.write(cfg.to_json())
 
         from factorvae_tpu.eval import RankIC, export_scores, generate_prediction_scores
 

@@ -1,7 +1,9 @@
+from factorvae_tpu.data.append import AppendError, PanelStore
 from factorvae_tpu.data.loader import PanelDataset
 from factorvae_tpu.data.panel import Panel, build_panel, load_frame, panel_to_frame
 from factorvae_tpu.data.stream import ChunkStream, chunk_slices, stream_epoch_batches
 from factorvae_tpu.data.synthetic import (
+    continuation_panel,
     synthetic_frame,
     synthetic_panel,
     synthetic_panel_dense,
@@ -16,12 +18,15 @@ from factorvae_tpu.data.windows import (
 )
 
 __all__ = [
+    "AppendError",
     "ChunkStream",
     "Panel",
     "PanelDataset",
+    "PanelStore",
     "build_panel",
     "chunk_slices",
     "compute_fill_maps",
+    "continuation_panel",
     "fill_indices_host",
     "gather_day",
     "gather_days_host",

@@ -1,0 +1,353 @@
+"""Incremental panel store: append-only day slabs + a sha256 manifest.
+
+The walk-forward loop (factorvae_tpu/wf, ROADMAP item 2) ingests one
+new trading day per cycle. Re-pickling the whole history to add a day
+— the only path the pickle loader offers — is both slow and a crash
+hazard (a kill mid-write corrupts the single file the entire run
+depends on). This module stores the panel as an APPEND-ONLY sequence
+of day slabs instead:
+
+    <dir>/MANIFEST.json          instruments + ordered slab records
+    <dir>/slabs/slab_00001.npz   values (I, D_s, C+1) f32,
+                                 valid (D_s, I) bool, dates int64[ns]
+
+Crash discipline (the chaos classes `kill_mid_append` /
+`corrupt_append_slab` exercise exactly these windows):
+
+- A slab lands via tmp-write + atomic rename, then is RE-READ and
+  sha256-verified against the digest of the bytes we meant to write
+  BEFORE the manifest commit — torn or corrupted slab bytes abort the
+  append (`AppendError`) with the manifest untouched, so the store
+  never references data it cannot vouch for.
+- The manifest itself commits by tmp-write + atomic rename. A kill
+  between slab rename and manifest commit leaves an ORPHAN slab file
+  the next append of the same days simply overwrites — re-running a
+  killed append is idempotent.
+- Appending days the manifest already ends with is a verified no-op
+  returning the existing slab record (the resume path of a cycle whose
+  journal commit raced a crash); any other overlap is a loud error.
+
+Readers get the whole history as one dense `Panel` via `load_panel`
+(optionally verifying every slab), while an in-memory consumer that
+already holds the previous panel only needs the NEW slab —
+`PanelDataset.extend_days` (data/loader.py) is that consumer: the
+stream-residency serving path picks up appended days with no full
+reload and no device transfer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+from factorvae_tpu.data.panel import Panel
+from factorvae_tpu.utils.logging import timeline_event
+
+MANIFEST_NAME = "MANIFEST.json"
+SLAB_DIRNAME = "slabs"
+
+
+class AppendError(RuntimeError):
+    """Append/validation failure with a one-line actionable message."""
+
+
+def _sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _slab_bytes(values: np.ndarray, valid: np.ndarray,
+                dates: pd.DatetimeIndex) -> bytes:
+    """Serialize one slab to npz bytes (deterministic for fixed
+    inputs: uncompressed, fixed key order)."""
+    buf = io.BytesIO()
+    np.savez(buf,
+             values=np.asarray(values, np.float32),
+             valid=np.asarray(valid, bool),
+             dates=np.asarray(pd.DatetimeIndex(dates).asi8, np.int64))
+    return buf.getvalue()
+
+
+def _read_slab(path: str):
+    with np.load(path) as z:
+        return (z["values"], z["valid"],
+                pd.DatetimeIndex(z["dates"].astype("datetime64[ns]")))
+
+
+def align_to_instruments(piece: Panel, instruments: np.ndarray) -> Panel:
+    """Reindex a panel piece onto the store's instrument axis: missing
+    instruments become invalid NaN rows; instruments the store has
+    never seen are rejected (cross-section growth means a new n_max,
+    new padding and a retrain — not an append)."""
+    store_inst = np.asarray(instruments)
+    piece_inst = np.asarray(piece.instruments)
+    unknown = sorted(set(piece_inst) - set(store_inst))
+    if unknown:
+        raise AppendError(
+            f"appended panel brings {len(unknown)} instrument(s) the "
+            f"store has never seen (first: {unknown[0]!r}); the "
+            f"cross-section axis is fixed at store creation — rebuild "
+            f"the store to widen it")
+    if piece_inst.shape == store_inst.shape and (
+            piece_inst == store_inst).all():
+        return piece
+    pos = {str(n): i for i, n in enumerate(piece_inst)}
+    d = piece.num_days
+    c = piece.values.shape[-1]
+    values = np.full((len(store_inst), d, c), np.nan, np.float32)
+    valid = np.zeros((d, len(store_inst)), bool)
+    for j, name in enumerate(store_inst):
+        i = pos.get(str(name))
+        if i is not None:
+            values[j] = piece.values[i]
+            valid[:, j] = piece.valid[:, i]
+    return Panel(values=values, valid=valid, dates=piece.dates,
+                 instruments=store_inst)
+
+
+class PanelStore:
+    """Append-only slab store over one panel history (module docstring
+    has the layout and crash discipline)."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        path = os.path.join(self.directory, MANIFEST_NAME)
+        try:
+            with open(path) as fh:
+                self._manifest = json.load(fh)
+        except FileNotFoundError:
+            raise AppendError(
+                f"no panel store at {self.directory} (missing "
+                f"{MANIFEST_NAME}); create one with "
+                f"PanelStore.create(dir, panel)") from None
+        except ValueError as e:
+            raise AppendError(
+                f"panel store manifest {path} is corrupt ({e}); the "
+                f"slabs are intact — rebuild the manifest or restore "
+                f"it from backup") from None
+
+    # ---- creation --------------------------------------------------------
+
+    @classmethod
+    def create(cls, directory: str, panel: Panel) -> "PanelStore":
+        """Initialize a store from a seed panel (slab 1 = its full
+        history). Refuses to clobber a store that already holds data —
+        but an EMPTY store (manifest committed, zero slabs: the crash
+        window between the manifest commit and the seed-slab append)
+        is adopted and seeded, so a killed create() re-runs instead of
+        wedging the directory forever."""
+        directory = os.path.abspath(directory)
+        if os.path.exists(os.path.join(directory, MANIFEST_NAME)):
+            existing = cls(directory)
+            if existing.generation > 0:
+                raise AppendError(
+                    f"panel store already exists at {directory}; open "
+                    f"it with PanelStore(dir) and append instead")
+            existing.append_panel(panel)
+            return existing
+        os.makedirs(os.path.join(directory, SLAB_DIRNAME), exist_ok=True)
+        manifest = {
+            "version": 1,
+            "instruments": [str(n) for n in panel.instruments],
+            "num_columns": int(panel.values.shape[-1]),
+            "slabs": [],
+        }
+        tmp = os.path.join(directory, MANIFEST_NAME + ".tmp")
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh)
+        os.replace(tmp, os.path.join(directory, MANIFEST_NAME))
+        store = cls(directory)
+        store.append_panel(panel)
+        return store
+
+    # ---- facts -----------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        """Number of committed slabs (the walk-forward cycle anchor)."""
+        return len(self._manifest["slabs"])
+
+    @property
+    def instruments(self) -> np.ndarray:
+        return np.asarray(self._manifest["instruments"])
+
+    @property
+    def num_columns(self) -> int:
+        """Feature columns + 1 label column (fixed at creation)."""
+        return int(self._manifest["num_columns"])
+
+    @property
+    def slabs(self) -> List[dict]:
+        return list(self._manifest["slabs"])
+
+    @property
+    def num_days(self) -> int:
+        return sum(int(s["num_days"]) for s in self._manifest["slabs"])
+
+    @property
+    def end_date(self) -> Optional[pd.Timestamp]:
+        if not self._manifest["slabs"]:
+            return None
+        return pd.Timestamp(self._manifest["slabs"][-1]["end"])
+
+    def _slab_path(self, name: str) -> str:
+        return os.path.join(self.directory, SLAB_DIRNAME, name)
+
+    # ---- append ----------------------------------------------------------
+
+    def _commit_manifest(self) -> None:
+        path = os.path.join(self.directory, MANIFEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._manifest, fh, indent=1)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def append_panel(self, piece: Panel) -> dict:
+        """Append a panel piece as one new slab; returns its manifest
+        record. Validated before commit (module docstring); idempotent
+        when `piece` is exactly the days the store already ends with."""
+        from factorvae_tpu import chaos
+
+        piece = align_to_instruments(piece, self.instruments)
+        if int(piece.values.shape[-1]) != self._manifest["num_columns"]:
+            raise AppendError(
+                f"appended panel has {piece.values.shape[-1]} columns; "
+                f"the store was created with "
+                f"{self._manifest['num_columns']} — feature schema is "
+                f"fixed at store creation")
+        if piece.num_days == 0:
+            raise AppendError("appended panel has zero days")
+        end = self.end_date
+        if end is not None and piece.dates[0] <= end:
+            last = self._manifest["slabs"][-1]
+            if (str(piece.dates[0].date()) == last["start"]
+                    and str(piece.dates[-1].date()) == last["end"]
+                    and piece.num_days == last["num_days"]):
+                # Idempotent re-append (a resumed cycle whose journal
+                # commit raced a crash): verify the committed slab
+                # carries these exact bytes and return its record.
+                data = _slab_bytes(piece.values, piece.valid, piece.dates)
+                if _sha256_file(self._slab_path(last["name"])) \
+                        != _sha256_bytes(data):
+                    raise AppendError(
+                        f"re-appended days [{last['start']}, "
+                        f"{last['end']}] differ from the committed slab "
+                        f"{last['name']} — same dates, different bytes; "
+                        f"the incoming feed is not deterministic")
+                return dict(last)
+            raise AppendError(
+                f"appended days start at {piece.dates[0].date()} but "
+                f"the store already ends at {end.date()}; appends must "
+                f"be strictly newer (or exactly the final slab, for "
+                f"idempotent resume)")
+
+        name = f"slab_{self.generation + 1:05d}.npz"
+        record = {
+            "name": name,
+            "num_days": int(piece.num_days),
+            "start": str(piece.dates[0].date()),
+            "end": str(piece.dates[-1].date()),
+            "sha256": None,
+        }
+        # Chaos window 0: killed before any bytes land — re-running the
+        # append is a plain rerun.
+        if chaos.fault("kill_mid_append", step=0) is not None:
+            chaos.ops.kill_now()
+        data = _slab_bytes(piece.values, piece.valid, piece.dates)
+        record["sha256"] = _sha256_bytes(data)
+        final = self._slab_path(name)
+        os.makedirs(os.path.dirname(final), exist_ok=True)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, final)
+        # Chaos window 1: slab committed, manifest not — the orphan a
+        # re-run overwrites.
+        if chaos.fault("kill_mid_append", step=1) is not None:
+            chaos.ops.kill_now()
+        corrupt = chaos.fault("corrupt_append_slab")
+        if corrupt is not None:
+            chaos.ops.corrupt_file(final, rng_seed=corrupt.rng_seed)
+        # Validation BEFORE commit: re-read the committed file and
+        # compare against the digest of the bytes we intended. Torn or
+        # corrupted slabs abort with the manifest untouched.
+        on_disk = _sha256_file(final)
+        if on_disk != record["sha256"]:
+            os.remove(final)
+            timeline_event("append_slab_rejected", cat="recovery",
+                           resource="data", slab=name,
+                           expected=record["sha256"], actual=on_disk)
+            raise AppendError(
+                f"slab {name} failed sha256 validation before commit "
+                f"(wrote {record['sha256'][:12]}…, read back "
+                f"{on_disk[:12]}…); the slab was removed and the "
+                f"manifest is untouched — retry the append")
+        self._manifest["slabs"].append(record)
+        self._commit_manifest()
+        timeline_event("append_slab", cat="data", resource="data",
+                       slab=name, days=record["num_days"],
+                       start=record["start"], end=record["end"])
+        return dict(record)
+
+    # ---- read ------------------------------------------------------------
+
+    def verify(self) -> Optional[str]:
+        """None when every committed slab's bytes match its manifest
+        sha256; otherwise a one-line reason naming the first mismatch."""
+        for rec in self._manifest["slabs"]:
+            path = self._slab_path(rec["name"])
+            if not os.path.exists(path):
+                return f"slab missing: {rec['name']}"
+            if _sha256_file(path) != rec["sha256"]:
+                return f"sha256 mismatch: {rec['name']}"
+        return None
+
+    def load_slab(self, record: dict, verify: bool = True) -> Panel:
+        """One slab as a Panel on the store's instrument axis."""
+        path = self._slab_path(record["name"])
+        if verify and _sha256_file(path) != record["sha256"]:
+            raise AppendError(
+                f"slab {record['name']} failed sha256 verification; "
+                f"the store is damaged — restore the slab or rebuild "
+                f"from the source feed")
+        values, valid, dates = _read_slab(path)
+        return Panel(values=values, valid=valid, dates=dates,
+                     instruments=self.instruments)
+
+    def load_panel(self, verify: bool = False) -> Panel:
+        """The whole history as one dense Panel (slabs concatenated on
+        the day axis). `verify=True` sha256-checks every slab first."""
+        if not self._manifest["slabs"]:
+            raise AppendError(f"panel store {self.directory} is empty")
+        if verify:
+            bad = self.verify()
+            if bad is not None:
+                raise AppendError(
+                    f"panel store {self.directory} failed verification "
+                    f"({bad}); restore the slab or rebuild the store")
+        pieces = [_read_slab(self._slab_path(r["name"]))
+                  for r in self._manifest["slabs"]]
+        values = np.concatenate([p[0] for p in pieces], axis=1)
+        valid = np.concatenate([p[1] for p in pieces], axis=0)
+        dates = pd.DatetimeIndex(
+            np.concatenate([np.asarray(p[2].asi8) for p in pieces])
+            .astype("datetime64[ns]"))
+        return Panel(values=values, valid=valid, dates=dates,
+                     instruments=self.instruments)

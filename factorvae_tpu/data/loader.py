@@ -137,6 +137,79 @@ class PanelDataset:
         """Fraction of cross-section rows that are permanent padding."""
         return 1.0 - self.n_real / self.n_max
 
+    # ---- incremental append (walk-forward; data/append.py) ---------------
+
+    def extend_days(self, piece: Panel) -> bool:
+        """Append new trading days in place; returns True when days
+        were added, False for the idempotent no-op (every incoming day
+        already present — the resumed-cycle path).
+
+        The walk-forward loop's serving-side pickup (ROADMAP item 2):
+        under ``residency='stream'`` the panel is host numpy, so this
+        is a concatenate + fill-map recompute — NO device transfer, no
+        re-pickling, and the per-chunk batch shapes the scoring jits
+        trace are unchanged (zero recompiles on append). Under ``hbm``
+        the grown panel re-ships to the device once and the day axis
+        D changes, so the whole-panel scoring jits retrace — stream
+        residency is the serving mode the nightly loop wants.
+
+        The instrument axis is fixed: incoming instruments must be a
+        subset of this dataset's (aligned by data/append.py's rule).
+        Fill maps are recomputed over the FULL valid matrix — bfill
+        reaches forward, so trailing gaps before the append may now
+        resolve to the new days, exactly as a fresh dataset built on
+        the appended panel would resolve them (pinned bitwise in
+        tests/test_wf.py). Callers sharing this dataset with a serving
+        thread serialize through the daemon's tick lock
+        (ScoringDaemon.extend_dataset)."""
+        from factorvae_tpu.data.append import align_to_instruments
+
+        piece = align_to_instruments(piece, self.instruments)
+        if piece.dates[0] <= self.dates[-1]:
+            if (piece.dates[-1] <= self.dates[-1]
+                    and piece.dates.isin(self.dates).all()):
+                return False
+            raise ValueError(
+                f"extend_days: incoming days start at "
+                f"{piece.dates[0].date()} but the dataset already ends "
+                f"at {self.dates[-1].date()}; appends must be strictly "
+                f"newer (or fully present, for idempotent resume)")
+        d_new = piece.num_days
+        c = piece.values.shape[-1]
+        add_vals = np.full((self.n_max, d_new, c), np.nan, np.float32)
+        add_vals[: self.n_real] = piece.values
+        add_valid = np.zeros((d_new, self.n_max), bool)
+        add_valid[:, : self.n_real] = piece.valid
+        if self.residency == "stream":
+            values = np.concatenate([self.values_np, add_vals], axis=1)
+        else:
+            values = np.concatenate(
+                [np.asarray(self.values), add_vals], axis=1)
+        valid = np.concatenate([self.valid, add_valid], axis=0)
+        last_valid, next_valid = compute_fill_maps(valid)
+        if self.residency == "stream":
+            self.values_np = values
+            self.last_valid_np = last_valid
+            self.next_valid_np = next_valid
+        else:
+            self.values = jnp.asarray(values)
+            self.last_valid = jnp.asarray(last_valid)
+            self.next_valid = jnp.asarray(next_valid)
+        self.valid = valid
+        self.dates = self.dates.append(piece.dates)
+        # The wrapped Panel grows too: split_days/locate resolve date
+        # ranges through it, and a rebuilt dataset must see the same
+        # underlying history.
+        self.panel = Panel(
+            values=np.concatenate([self.panel.values, piece.values],
+                                  axis=1),
+            valid=np.concatenate([self.panel.valid, piece.valid],
+                                 axis=0),
+            dates=self.dates,
+            instruments=self.panel.instruments,
+        )
+        return True
+
     # ---- splits ----------------------------------------------------------
 
     def split_days(self, start: Optional[str], end: Optional[str]) -> np.ndarray:
@@ -221,7 +294,7 @@ class PanelDataset:
         days = np.asarray(days, dtype=np.intp)
         day_pos, inst_pos = np.nonzero(self.valid[days])
         return pd.MultiIndex.from_arrays(
-            [self.dates[days[day_pos]], self.instruments[inst_pos]],
+            [self.dates[days[day_pos]], self.instruments[inst_pos]],  # graftlint: disable=JGL009 extend_days mutations are serialized by the daemon tick lock that also covers every serving-thread reader; index_frame's callers are main-line score exporters running between cycles, never concurrent with an append
             names=["datetime", "instrument"],
         )
 

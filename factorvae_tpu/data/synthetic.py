@@ -57,6 +57,40 @@ def synthetic_panel(**kw) -> Panel:
     return build_panel(synthetic_frame(**kw))
 
 
+def continuation_panel(
+    instruments: np.ndarray,
+    last_date,
+    num_days: int,
+    num_features: int,
+    signal: float = 0.3,
+    seed: int = 0,
+) -> Panel:
+    """Dense synthetic days CONTINUING an existing panel: same
+    instrument axis, trading days strictly after `last_date`, features
+    and planted-signal label drawn like `synthetic_panel_dense` but
+    from `seed` alone — two calls with the same arguments produce
+    bitwise-identical days (the determinism the walk-forward loop's
+    idempotent append resume rests on, factorvae_tpu/wf)."""
+    rng = np.random.default_rng(seed)
+    instruments = np.asarray(instruments)
+    n = len(instruments)
+    dates = pd.bdate_range(
+        pd.Timestamp(last_date) + pd.tseries.offsets.BDay(1),
+        periods=num_days)
+    feats = rng.normal(size=(n, num_days, num_features)).astype(np.float32)
+    w = (rng.normal(size=(num_features,)) / np.sqrt(num_features)).astype(
+        np.float32)
+    label = signal * feats @ w + (1 - signal) * rng.normal(
+        size=(n, num_days)).astype(np.float32)
+    values = np.concatenate([feats, label[..., None]], axis=-1)
+    return Panel(
+        values=values,
+        valid=np.ones((num_days, n), bool),
+        dates=dates,
+        instruments=instruments,
+    )
+
+
 def synthetic_panel_dense(
     num_days: int,
     num_instruments: int,

@@ -32,6 +32,41 @@ def rank_ic_frame(
 RankIC = rank_ic_frame
 
 
+def labeled_holdout_days(dataset, n: int = 1,
+                         min_labels: int = 3) -> list:
+    """The newest `n` day indices whose cross-sections carry at least
+    `min_labels` finite labels — the ONE definition of the holdout
+    both the walk-forward refit A/B (wf/operator) and the promotion
+    gate (serve/daemon.admit) judge Rank-IC on; a drifted copy in
+    either would silently desynchronize what the two sides compare.
+    Possibly empty (the callers own the error message)."""
+    days = dataset.split_days(None, None)
+    labels = dataset.day_labels(days)
+    ok = (np.isfinite(labels)
+          & dataset.valid[days]).sum(axis=1) >= int(min_labels)
+    idx = np.nonzero(ok)[0]
+    return [int(days[i]) for i in idx[-max(1, int(n)):]]
+
+
+def panel_rank_ic(scores: np.ndarray, labels: np.ndarray,
+                  valid: np.ndarray) -> float:
+    """Mean per-day Rank-IC over padded (D, N_max) score/label panels,
+    judged by `masked_spearman` (average-rank scipy semantics) with
+    non-finite entries masked out. NaN when no day has a defined
+    correlation — the walk-forward fidelity gate's judge
+    (serve/daemon.admit, wf/operator)."""
+    scores = np.asarray(scores, np.float32)
+    labels = np.asarray(labels, np.float32)
+    mask = (np.asarray(valid, bool) & np.isfinite(scores)
+            & np.isfinite(labels))
+    ic = np.asarray(masked_spearman(
+        jnp.nan_to_num(jnp.asarray(scores)),
+        jnp.nan_to_num(jnp.asarray(labels)),
+        jnp.asarray(mask)))
+    return float(np.nanmean(ic)) if np.isfinite(ic).any() \
+        else float("nan")
+
+
 def daily_rank_ic(
     df: pd.DataFrame, column1: str = "LABEL0", column2: str = "score"
 ) -> pd.Series:

@@ -114,10 +114,32 @@ class ScoreDriftMonitor:
         # model -> {"days": {day: digest}, "last_day", "last_scores"
         #           (name -> score), "last_corr", "drift_events"}
         self._models: Dict[str, dict] = {}
+        # Per-model threshold overrides (walk-forward promotion policy,
+        # ISSUE 14): `threshold` above is the daemon-wide default
+        # (--drift_threshold); a model admitted with its own gate —
+        # POST /admit's drift_threshold, or set_threshold — judges its
+        # day-over-day correlation against that instead. The active
+        # value is exposed per model on /stats and /metrics.
+        self._thresholds: Dict[str, float] = {}
         # Guards the per-model state (graftlint JGL009): observe()
         # runs on whatever thread answers scoring requests while
         # `GET /metrics` reads stats() — the LatencyHistogram pattern.
         self._lock = threading.Lock()
+
+    def set_threshold(self, model: str,
+                      threshold: Optional[float]) -> None:
+        """Per-model drift threshold (None clears the override back to
+        the monitor-wide default)."""
+        with self._lock:
+            if threshold is None:
+                self._thresholds.pop(str(model), None)
+            else:
+                self._thresholds[str(model)] = float(threshold)
+
+    def threshold_for(self, model: str) -> float:
+        """The ACTIVE threshold for one model (override or default)."""
+        with self._lock:
+            return self._thresholds.get(str(model), self.threshold)
 
     def observe(self, model: str, day: int,
                 names: Sequence[str], scores: np.ndarray,
@@ -154,13 +176,15 @@ class ScoreDriftMonitor:
                     np.array([prev[n] for n in common]))
                 if corr is not None:
                     st["last_corr"] = corr
-                    if corr < self.threshold:
+                    threshold = self._thresholds.get(model,
+                                                     self.threshold)
+                    if corr < threshold:
                         st["drift_events"] += 1
                         timeline_event(
                             DRIFT_MARK, cat="serve", resource="serve",
                             model=model, alias=alias, day=day,
                             prev_day=prev_day, rank_corr=corr,
-                            threshold=self.threshold,
+                            threshold=threshold,
                             n_common=len(common))
         # days can arrive out of order (backtest replays): the chain
         # follows ARRIVAL order — yesterday is "the day this model
@@ -174,15 +198,33 @@ class ScoreDriftMonitor:
         with self._lock:
             return sorted(self._models)
 
+    def drifting(self, model: str) -> bool:
+        """Current drift state: the model's latest day-over-day rank
+        correlation landed below its ACTIVE threshold (False until a
+        correlation exists). The walk-forward judge stage promotes this
+        from alert to refit trigger (factorvae_tpu/wf)."""
+        with self._lock:
+            st = self._models.get(str(model))
+            if st is None or st["last_corr"] is None:
+                return False
+            threshold = self._thresholds.get(str(model), self.threshold)
+            return st["last_corr"] < threshold
+
     def stats(self) -> dict:
-        """Per-model drift summary for /stats and /metrics."""
+        """Per-model drift summary for /stats and /metrics: digests,
+        last correlation, drift-event count, the ACTIVE threshold and
+        the current drift state."""
         out = {}
         with self._lock:
             for model, st in sorted(self._models.items()):
+                threshold = self._thresholds.get(model, self.threshold)
                 out[model] = {
                     "days_digested": len(st["days"]),
                     "last_day": st["last_day"],
                     "last_rank_corr": st["last_corr"],
                     "drift_events": st["drift_events"],
+                    "threshold": threshold,
+                    "drifting": bool(st["last_corr"] is not None
+                                     and st["last_corr"] < threshold),
                 }
         return out

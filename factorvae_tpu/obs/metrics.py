@@ -254,8 +254,10 @@ def _render_daemon_metrics(daemon, compile_event_counts) -> str:
                  metric_line(f"{p}_compile_total", cc["compile_cached"],
                              {"kind": "compile_cached"})]))
 
-    # served-score drift
+    # served-score drift (+ per-model thresholds and drift state —
+    # walk-forward promotion policy, ISSUE 14)
     corr_lines, drift_lines, day_lines = [], [], []
+    thr_lines, drifting_lines = [], []
     for model, st in daemon.drift.stats().items():
         lab = {"model": model}
         if st["last_rank_corr"] is not None:
@@ -266,6 +268,10 @@ def _render_daemon_metrics(daemon, compile_event_counts) -> str:
             f"{p}_score_drift_total", st["drift_events"], lab))
         day_lines.append(metric_line(
             f"{p}_score_days_digested", st["days_digested"], lab))
+        thr_lines.append(metric_line(
+            f"{p}_score_drift_threshold", st["threshold"], lab))
+        drifting_lines.append(metric_line(
+            f"{p}_score_drifting", int(bool(st["drifting"])), lab))
     fam.append((f"{p}_score_rank_corr_prev_day", "gauge",
                 "rank correlation of the served cross-section vs the "
                 "model's previously served day", corr_lines))
@@ -274,6 +280,13 @@ def _render_daemon_metrics(daemon, compile_event_counts) -> str:
                 "drift threshold", drift_lines))
     fam.append((f"{p}_score_days_digested", "gauge",
                 "distinct days with a served-score digest", day_lines))
+    fam.append((f"{p}_score_drift_threshold", "gauge",
+                "ACTIVE drift threshold per model (per-model override "
+                "or the daemon-wide default)", thr_lines))
+    fam.append((f"{p}_score_drifting", "gauge",
+                "1 while the model's latest day-over-day rank "
+                "correlation sits below its active threshold",
+                drifting_lines))
     return render_families(fam)
 
 

@@ -23,8 +23,8 @@ serial path, so a lone request is always bitwise the offline scan.
 object or an ARRAY of requests — an explicit tick; bursts of single
 lines within `tick_s` coalesce into one tick too), `serve_batch_file`
 (score a request file, write a response file, exit) and `serve_http`
-(stdlib http.server: POST /score /profile, GET /stats /models /healthz
-/metrics) all funnel into `ScoringDaemon.handle_batch`. Responses
+(stdlib http.server: POST /score /profile /admit, GET /stats /models
+/healthz /metrics) all funnel into `ScoringDaemon.handle_batch`. Responses
 preserve request order; malformed lines get `{"ok": false, "error":
 ...}` instead of killing the process.
 
@@ -71,7 +71,7 @@ from factorvae_tpu.utils.logging import (
     timeline_span,
 )
 
-_CMDS = ("ping", "stats", "models", "shutdown")
+_CMDS = ("ping", "stats", "models", "shutdown", "admit")
 
 
 @dataclasses.dataclass
@@ -142,6 +142,9 @@ class ScoringDaemon:
         self.deadline_misses = 0
         self.breaker_fast_fails = 0
         self.ticks = 0
+        # Walk-forward rollover surface (POST /admit, ISSUE 14)
+        self.admits = 0
+        self.promotions = 0
         # Request-latency histogram for /metrics (obs/metrics.py):
         # tick arrival -> scores landing, the same clock latency_ms
         # reports. Host-side counters only — the scoring path and its
@@ -496,6 +499,11 @@ class ScoringDaemon:
                 return {"id": rid, "ok": True, "cmd": "models",
                         "run_meta": self.run_meta,
                         "models": self.registry.stats()["entries"]}
+            if r.cmd == "admit":
+                # Never reached: handle_batch defers admit cmds OUT of
+                # the tick lock (the gate scoring must not stall the
+                # tick) and answers them via _cmd_admit below.
+                return self._cmd_admit(r)
             return {"id": rid, "ok": True, "cmd": "stats",
                     **self.stats()}
         # Per-request deadline: judged from tick arrival to THIS
@@ -600,14 +608,216 @@ class ScoringDaemon:
             "latency_ms": round(done_lat_ms, 3),
         }
 
+    # ---- walk-forward rollover (ISSUE 14) --------------------------------
+
+    def extend_dataset(self, piece) -> bool:
+        """Append new trading days to the serving panel in place
+        (PanelDataset.extend_days) under the tick lock, so the in-flight
+        tick finishes on the old day axis and the next one sees the new
+        days — the walk-forward append stage's serving-side pickup.
+        Returns True when days were added (False = idempotent no-op)."""
+        with self._lock:
+            added = bool(self.dataset.extend_days(piece))
+        if added:
+            timeline_event("serve_extend", cat="serve", resource="serve",
+                           n_days=len(self.dataset.dates))
+        return added
+
+    def _holdout_days(self, holdout_days) -> np.ndarray:
+        """Resolve the fidelity gate's holdout days: an explicit list
+        resolves like a request's 'days' field; default = the newest
+        rankably-labeled day per the SHARED holdout rule
+        (`eval.metrics.labeled_holdout_days` — the same days the
+        walk-forward refit A/B judges on)."""
+        if holdout_days:
+            return self._resolve_days({"days": list(holdout_days)})
+        from factorvae_tpu.eval.metrics import labeled_holdout_days
+
+        days = labeled_holdout_days(self.dataset, 1)
+        if not days:
+            raise ValueError(
+                "no holdout day with >=3 finite labels in the serving "
+                "panel; pass explicit holdout_days")
+        return np.asarray(days, np.int64)
+
+    def _gate_rank_ic(self, key: str, days: np.ndarray) -> float:
+        """Mean holdout Rank-IC of one registry entry, judged by
+        ops.stats.masked_spearman (average-rank scipy semantics — the
+        same judge the serve precision ladder uses)."""
+        from factorvae_tpu.eval.metrics import panel_rank_ic
+
+        ds = self.dataset
+        scores = self.registry.score(key, ds, days,
+                                     stochastic=self.stochastic,
+                                     seed=self.seed)
+        return panel_rank_ic(scores, ds.day_labels(days), ds.valid[days])
+
+    def admit(self, path: str, alias: str,
+              holdout_days=None, min_margin: float = 0.0,
+              drift_threshold: Optional[float] = None,
+              precision: Optional[str] = None) -> dict:
+        """The rollover control surface (`POST /admit` / cmd "admit"):
+        admit a candidate checkpoint into the live registry under its
+        config hash, judge it against the incumbent behind `alias`
+        with a fidelity gate — candidate Rank-IC vs incumbent Rank-IC
+        on the holdout day(s), by `masked_spearman` — and on a win flip
+        the alias and DRAIN the incumbent (the flip happens under the
+        tick lock, so every in-flight request completes on the model
+        that was serving when it arrived; zero requests drop). Losers
+        are retired from the registry and logged. With no incumbent
+        behind `alias` the candidate is promoted unconditionally (the
+        bootstrap admission).
+
+        The gate SCORING runs outside the tick lock — a slow gate must
+        not stall /healthz or the request path; only the promotion
+        mutation itself serializes with ticks. Crash-idempotent: a kill
+        between admission and drain (the `kill_between_admit_and_drain`
+        chaos class) leaves the incumbent serving; re-running admit
+        re-admits the same bytes (a refresh, not a generation bump) and
+        completes the flip."""
+        from factorvae_tpu import chaos
+
+        alias = str(alias)
+        with self._lock:
+            self.admits += 1
+            admit_no = self.admits   # chaos coordinate: Nth admission
+            try:
+                # Resolve the incumbent's KEY only — resolve_key
+                # touches no disk. A tombstoned incumbent must not
+                # cold-start (checkpoint reload + sha256 verify) under
+                # the tick lock; the gate scoring below runs outside
+                # it and cold-starts on demand.
+                inc_key = self.registry.resolve_key(alias)
+            except RegistryError as e:
+                # Nothing behind the alias: bootstrap admission.
+                inc_key = None
+                timeline_event("admit_no_incumbent", cat="serve",
+                               resource="serve", alias=alias,
+                               error=str(e))
+        cand_key = self.registry.register_checkpoint(
+            str(path), precision=precision,
+            n_stocks=self.dataset.n_max)
+        out = {"ok": True, "alias": alias, "model": cand_key,
+               "incumbent": inc_key}
+        cand_ic = inc_ic = None
+        reason = "no incumbent behind alias (bootstrap admission)"
+        promote = True
+        if inc_key is not None and inc_key != cand_key:
+            try:
+                days = self._holdout_days(holdout_days)
+                cand_ic = self._gate_rank_ic(cand_key, days)
+                inc_ic = self._gate_rank_ic(inc_key, days)
+            except Exception:
+                # A gate that cannot judge (no labeled holdout day,
+                # scoring failure, a dead incumbent cold-start) must
+                # not leave the never-gated candidate resident —
+                # retire it before surfacing the error; whatever was
+                # serving keeps serving.
+                self.registry.retire(cand_key)
+                raise
+            out["holdout_days"] = [int(d) for d in days]
+            if np.isnan(cand_ic):
+                # An unrankable candidate never ships — even against an
+                # equally unrankable incumbent (known beats unknown).
+                promote, reason = False, "candidate Rank-IC undefined"
+            elif np.isnan(inc_ic):
+                promote, reason = True, "incumbent Rank-IC undefined"
+            else:
+                promote = cand_ic >= inc_ic - float(min_margin)
+                reason = (f"candidate {cand_ic:+.4f} vs incumbent "
+                          f"{inc_ic:+.4f} (margin {min_margin:g})")
+        elif inc_key is not None:
+            # Same config hash: the admission above already refreshed
+            # the serving entry in place (version-bump semantics live
+            # in the registry); there is no second model to gate.
+            reason = "same config hash as incumbent (in-place refresh)"
+        if chaos.fault("fidelity_gate_reject",
+                       request=admit_no) is not None:
+            promote, reason = False, "chaos: forced fidelity-gate reject"
+        out.update(candidate_rank_ic=cand_ic, incumbent_rank_ic=inc_ic,
+                   reason=reason)
+        if not promote:
+            if inc_key is not None and inc_key != cand_key:
+                self.registry.retire(cand_key)
+            timeline_event("admit_rejected", cat="serve",
+                           resource="serve", model=cand_key,
+                           alias=alias, reason=reason,
+                           candidate_rank_ic=cand_ic,
+                           incumbent_rank_ic=inc_ic)
+            out["promoted"] = False
+            return out
+        # Chaos window: candidate admitted + verdict in, alias not yet
+        # flipped — a kill here leaves the incumbent serving and the
+        # promote stage re-runs idempotently. `request` pins the Nth
+        # admission of the process (the wf rig's bootstrap re-admit is
+        # #1, the cycle's promote #2).
+        if chaos.fault("kill_between_admit_and_drain",
+                       request=admit_no) is not None:
+            chaos.ops.kill_now()
+        with self._lock:
+            # The flip + drain, serialized with ticks: in-flight
+            # requests finished on the incumbent; the next tick
+            # resolves the alias to the candidate.
+            self.registry.set_alias(alias, cand_key)
+            if inc_key is not None and inc_key != cand_key:
+                self.registry.retire(inc_key)
+                # The retired incumbent's per-model threshold override
+                # goes with it — a long-lived nightly daemon must not
+                # accumulate one stale entry per promoted cycle.
+                self.drift.set_threshold(inc_key, None)
+            if drift_threshold is not None:
+                self.drift.set_threshold(cand_key,
+                                         float(drift_threshold))
+            self.promotions += 1
+        timeline_event("admit_promoted", cat="serve", resource="serve",
+                       model=cand_key, alias=alias,
+                       incumbent=out["incumbent"], reason=reason,
+                       candidate_rank_ic=cand_ic,
+                       incumbent_rank_ic=inc_ic)
+        entry = self.registry.get(cand_key)
+        out.update(promoted=True, generation=entry.generation,
+                   precision=entry.precision)
+        return out
+
+    def _cmd_admit(self, r: _Resolved) -> dict:
+        """The {"cmd": "admit"} surface, executed OUTSIDE the tick
+        lock (handle_batch defers it past the locked section): the
+        admission's checkpoint load + gate scoring must not stall the
+        tick, /healthz or the operator thread — the same contract the
+        HTTP /admit route keeps. Consequence (documented in
+        docs/serving.md): the flip takes effect from the NEXT tick."""
+        rid = (r.request or {}).get("id")
+        req = r.request or {}
+        if not isinstance(req.get("path"), str):
+            return {"id": rid, "ok": False,
+                    "error": "admit wants a 'path' (candidate "
+                             "checkpoint directory) and an 'alias'"}
+        try:
+            return {"id": rid, "cmd": "admit", **self.admit(
+                req["path"], req.get("alias", "prod"),
+                holdout_days=req.get("holdout_days"),
+                min_margin=float(req.get("min_margin", 0.0) or 0),
+                drift_threshold=req.get("drift_threshold"),
+                precision=req.get("precision"))}
+        except Exception as e:
+            # Admission failures (bad path, manifest mismatch,
+            # unresolvable config) answer THIS request — the
+            # incumbent keeps serving, the daemon keeps living.
+            return {"id": rid, "ok": False, "error": str(e)}
+
     # ---- public API ------------------------------------------------------
 
     def handle_batch(self, requests: list) -> list:
         """Responses (in order) for one tick's worth of requests.
         Runs under the tick lock: every counter/breaker/window
         mutation below (including the ones inside _dispatch/_respond)
-        is serialized against the health/stats/metrics readers."""
+        is serialized against the health/stats/metrics readers.
+        Admit cmds are the exception: they are answered AFTER the
+        locked section (slot order preserved) so their checkpoint load
+        + gate scoring never stalls the tick — scoring requests in the
+        same tick resolve against tick-start state either way."""
         t0 = time.perf_counter()
+        admits: list = []
         with self._lock:
             self.ticks += 1
             with timeline_span("serve_tick", cat="serve",
@@ -617,11 +827,17 @@ class ScoringDaemon:
                 self._dispatch(resolved)
                 out = []
                 for r in resolved:
+                    if r.cmd == "admit":
+                        admits.append((len(out), r))
+                        out.append(None)
+                        continue
                     with timeline_span("serve_request", cat="serve",
                                        resource="serve",
                                        model=(r.entry.key if r.entry
                                               else None)):
                         out.append(self._respond(r, t0))
+        for i, r in admits:
+            out[i] = self._cmd_admit(r)
         return out
 
     def handle(self, request: dict) -> dict:
@@ -692,6 +908,8 @@ class ScoringDaemon:
                 "dispatches": self.dispatches,
                 "fused_requests": self.fused_requests,
                 "ticks": self.ticks,
+                "admits": self.admits,
+                "promotions": self.promotions,
                 "health": self.health(),
                 "registry": self.registry.stats(),
                 "drift": self.drift.stats(),
@@ -868,7 +1086,9 @@ def serve_batch_file(daemon: ScoringDaemon, path: str, out,
 def serve_http(daemon: ScoringDaemon, port: int,
                host: str = "127.0.0.1"):
     """Minimal stdlib HTTP front: POST /score (object or array body),
-    GET /stats, /models, /healthz, /metrics, POST /profile.
+    GET /stats, /models, /healthz, /metrics, POST /profile, POST
+    /admit (walk-forward rollover: candidate admission + fidelity gate
+    + zero-downtime alias flip — see ScoringDaemon.admit).
     Single-threaded by design — jax dispatch is the bottleneck and
     wants no concurrency. Blocks until a shutdown request arrives or
     SIGTERM requests a drain (the in-flight request finishes, then the
@@ -952,7 +1172,7 @@ def serve_http(daemon: ScoringDaemon, port: int,
                 self._send(409, {"ok": False, "error": str(e)})
 
         def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
-            if self.path not in ("/score", "/profile"):
+            if self.path not in ("/score", "/profile", "/admit"):
                 self._send(404, {"ok": False,
                                  "error": f"unknown path {self.path}"})
                 return
@@ -961,6 +1181,34 @@ def serve_http(daemon: ScoringDaemon, port: int,
             if self.path == "/profile":
                 req = requests[0] if requests else {}
                 self._profile(req if isinstance(req, dict) else {})
+                return
+            if self.path == "/admit":
+                # Rollover control surface (ISSUE 14): gate scoring
+                # runs outside the tick lock inside admit(); only the
+                # alias flip serializes with ticks.
+                req = requests[0] if requests else {}
+                if not (isinstance(req, dict)
+                        and isinstance(req.get("path"), str)):
+                    self._send(400, {
+                        "ok": False,
+                        "error": "POST /admit wants {\"path\": "
+                                 "\"<checkpoint dir>\", \"alias\": "
+                                 "\"<serving alias>\"} (optional "
+                                 "holdout_days, min_margin, "
+                                 "drift_threshold, precision)"})
+                    return
+                try:
+                    self._send(200, daemon.admit(
+                        req["path"], req.get("alias", "prod"),
+                        holdout_days=req.get("holdout_days"),
+                        min_margin=float(req.get("min_margin", 0.0) or 0),
+                        drift_threshold=req.get("drift_threshold"),
+                        precision=req.get("precision")))
+                except Exception as e:
+                    # A failed admission never kills the daemon — the
+                    # incumbent keeps serving and the caller gets the
+                    # actionable message.
+                    self._send(200, {"ok": False, "error": str(e)})
                 return
             responses = _with_parse_errors(daemon, requests)
             # An empty array body gets an empty array back — never an

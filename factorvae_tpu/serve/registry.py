@@ -94,6 +94,28 @@ def _params_nbytes(tree) -> int:
     return int(tree_nbytes(tree))
 
 
+def _params_digest(tree) -> str:
+    """sha256 over the parameter leaves in tree-path order — the
+    weights identity a re-admission is judged by (two trees with the
+    same config hash but different bytes are DIFFERENT models; the
+    registry must version-bump, never silently refresh-in-place and
+    keep stale sibling executables serving)."""
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for path, leaf in sorted(
+            jax.tree_util.tree_flatten_with_path(tree)[0],
+            key=lambda kv: jax.tree_util.keystr(kv[0])):
+        h.update(jax.tree_util.keystr(path).encode())
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
 @dataclasses.dataclass
 class Entry:
     """One resident model. `params` is the SERVING tree (pre-quantized
@@ -113,6 +135,12 @@ class Entry:
     compiled: bool = False
     compile_s: Optional[float] = None
     requests: int = 0
+    #: weights identity (sha256 of the serving tree / artifact blob)
+    digest: Optional[str] = None
+    #: bumped when a re-admission under the SAME key carries DIFFERENT
+    #: weights (the walk-forward rollover path) — stats/describe carry
+    #: it so "which weights is this key serving" is answerable
+    generation: int = 1
 
     @property
     def int8(self) -> bool:
@@ -141,6 +169,7 @@ class Entry:
             "precision": self.precision, "source": self.source,
             "nbytes": self.nbytes, "compiled": self.compiled,
             "compile_s": self.compile_s, "requests": self.requests,
+            "generation": self.generation,
             "arch": arch,
         }
 
@@ -219,6 +248,10 @@ class ModelRegistry:
         self.misses = 0
         self.evictions = 0
         self.cold_starts = 0
+        # Changed-weights re-admissions under an existing key (the
+        # rollover path; each one version-bumps the entry's generation
+        # and tombstones its stale sibling rungs).
+        self.readmissions = 0
         # Bumped on every admission/eviction (weights may have
         # changed): consumers caching derived state — the daemon's
         # stacked fused-dispatch param trees — invalidate on it.
@@ -229,12 +262,74 @@ class ModelRegistry:
     def _admit(self, entry: Entry) -> str:
         with self._lock:
             self.version += 1
+            prev = self._entries.get(entry.key)
+            if prev is not None:
+                if (prev.digest is not None and entry.digest is not None
+                        and prev.digest != entry.digest):
+                    # Re-admission under the SAME key with DIFFERENT
+                    # weights — the walk-forward rollover: version-bump
+                    # the entry and TOMBSTONE every sibling precision
+                    # rung derived from the same base hash. Their
+                    # executables (int8-quantized trees, serialized
+                    # artifact programs) were built from the OLD bytes;
+                    # a tombstoned sibling cold-starts from its source
+                    # on the next request and picks the fresh weights
+                    # up, where the pre-fix behavior silently kept
+                    # serving the stale ones.
+                    entry.generation = prev.generation + 1
+                    self.readmissions += 1
+                    stale = self._retire_siblings_locked(entry.key)
+                    timeline_event(
+                        "registry_readmit", cat="serve",
+                        resource="serve", model=entry.key,
+                        generation=entry.generation,
+                        stale_siblings=stale)
+                else:
+                    # Same bytes (or an unverifiable side): refresh in
+                    # place — the idempotent resume path must not burn
+                    # generations or evict healthy siblings.
+                    entry.generation = prev.generation
             self._entries[entry.key] = entry
             self._entries.move_to_end(entry.key)
             if entry.alias:
                 self._aliases[entry.alias] = entry.key
             self._evict_to_budget()
             return entry.key
+
+    def _base_hash(self, key: str) -> str:
+        return key.split(":", 1)[0]
+
+    def _retire_siblings_locked(self, key: str) -> list:
+        """Drop every OTHER precision rung of `key`'s base config hash
+        (tombstoning reloadable ones). Caller holds the lock."""
+        base = self._base_hash(key)
+        stale = [k for k in self._entries
+                 if k != key and self._base_hash(k) == base]
+        for k in stale:
+            entry = self._entries.pop(k)
+            self.version += 1
+            self._tombstone_or_drop(k, entry)
+        return stale
+
+    def _tombstone_or_drop(self, key: str, entry: Entry) -> None:
+        """Post-removal bookkeeping for an entry already popped from
+        `_entries`: a reloadable source leaves a tombstone (the next
+        request cold-starts it back in — from the CURRENT bytes on
+        disk); otherwise its aliases are unhooked so they cannot
+        resolve to a key with nothing behind it. Caller holds the
+        lock."""
+        if entry.source_path:
+            self._tombstones[key] = {
+                "source": entry.source,
+                "source_path": entry.source_path,
+                "precision": entry.precision,
+                "config": entry.config,
+                "alias": entry.alias,
+            }
+        else:
+            for alias, k in list(self._aliases.items()):
+                if k == key:
+                    del self._aliases[alias]
 
     def _resolve_precision(self, config: Config,
                            precision: Optional[str],
@@ -281,7 +376,8 @@ class ModelRegistry:
             key=key, config=config, precision=precision, params=params,
             score_config=precision_config(config, precision),
             nbytes=_params_nbytes(params), source=source,
-            source_path=source_path, alias=alias)
+            source_path=source_path, alias=alias,
+            digest=_params_digest(params))
         return self._admit(entry)
 
     def register_checkpoint(self, path: str,
@@ -358,6 +454,8 @@ class ModelRegistry:
             # Same suffix rule as register_params: an f32 and an int8
             # export of one checkpoint are distinct registry entries.
             key = f"{key}:{precision}"
+        import hashlib
+
         entry = Entry(
             key=key,
             config=Config(),  # arch facts live in the header
@@ -365,7 +463,8 @@ class ModelRegistry:
             artifact=art, nbytes=len(blob), source="artifact",
             source_path=path,
             alias=alias or (os.path.basename(path) if path else None),
-            compiled=True)  # nothing left to trace — the program IS the blob
+            compiled=True,  # nothing left to trace — the program IS the blob
+            digest=hashlib.sha256(blob).hexdigest())
         return self._admit(entry)
 
     # ---- lookup / eviction ----------------------------------------------
@@ -470,19 +569,42 @@ class ModelRegistry:
             key, entry = self._entries.popitem(last=False)
             self.version += 1
             self.evictions += 1
-            if entry.source_path:
-                # Reloadable source: leave a tombstone so the next
-                # request cold-starts the model back in instead of 404.
-                self._tombstones[key] = {
-                    "source": entry.source,
-                    "source_path": entry.source_path,
-                    "precision": entry.precision,
-                    "config": entry.config,
-                    "alias": entry.alias,
-                }
-            elif (entry.alias
-                  and self._aliases.get(entry.alias) == key):
-                del self._aliases[entry.alias]
+            # Reloadable sources leave a tombstone so the next request
+            # cold-starts the model back in instead of 404.
+            self._tombstone_or_drop(key, entry)
+
+    def set_alias(self, alias: str, name: str) -> str:
+        """(Re)point an alias at an entry — the rollover's atomic
+        serving flip: requests by alias resolve to the new key from the
+        next lookup on. Returns the resolved key."""
+        with self._lock:
+            key = self.resolve_key(name)
+            self._aliases[str(alias)] = key
+            self.version += 1
+            return key
+
+    def retire(self, name: str) -> bool:
+        """Remove an entry from the warm set — the incumbent-drain leg
+        of a promotion (serve/daemon.admit drains in-flight requests
+        first via the tick lock). Reloadable sources leave a tombstone
+        (an old alias or key still resolves by cold-starting the
+        CURRENT bytes from disk); in-memory entries drop with their
+        aliases. Returns True when something was removed; a name that
+        is already gone is a no-op, so a crashed-and-resumed promotion
+        retires idempotently."""
+        with self._lock:
+            try:
+                key = self.resolve_key(name)
+            except RegistryError:
+                return False
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return False
+            self.version += 1
+            self._tombstone_or_drop(key, entry)
+        timeline_event("registry_retire", cat="serve", resource="serve",
+                       model=key)
+        return True
 
     def total_bytes(self) -> int:
         with self._lock:
@@ -502,6 +624,8 @@ class ModelRegistry:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "cold_starts": self.cold_starts,
+                "readmissions": self.readmissions,
+                "aliases": dict(sorted(self._aliases.items())),
                 "entries": [e.describe()
                             for e in self._entries.values()],
             }

@@ -1,0 +1,28 @@
+"""Closed-loop walk-forward production (ROADMAP item 2, ISSUE 14).
+
+`wf.operator.WalkForwardOperator` runs the nightly
+append -> judge -> refit -> promote -> verify cycle as an idempotent
+journaled state machine over the repo's existing subsystems;
+`python -m factorvae_tpu.wf` is the self-contained driver
+(docs/walkforward.md).
+"""
+
+from factorvae_tpu.wf.journal import STAGES, CycleJournal, JournalError
+from factorvae_tpu.wf.operator import (
+    WalkForwardError,
+    WalkForwardOperator,
+    holdout_day_indices,
+    refit_rank_ic,
+    warm_refit,
+)
+
+__all__ = [
+    "STAGES",
+    "CycleJournal",
+    "JournalError",
+    "WalkForwardError",
+    "WalkForwardOperator",
+    "holdout_day_indices",
+    "refit_rank_ic",
+    "warm_refit",
+]

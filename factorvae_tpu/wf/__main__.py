@@ -1,0 +1,275 @@
+"""Walk-forward driver: the nightly loop as one resumable command.
+
+    # bootstrap a synthetic rig and run 3 crash-safe nightly cycles,
+    # serving HTTP throughout (zero-downtime rollover)
+    python -m factorvae_tpu.wf --run_dir ./wf_run --cycles 3 \
+        --force_refit --epochs 4 --http 8787 --metrics_jsonl RUN_WF.jsonl
+
+    # killed at ANY stage? the same command resumes the open cycle
+    # idempotently off the cycle journal (<run>_wf.json)
+    python -m factorvae_tpu.wf --run_dir ./wf_run --cycles 3 ...
+
+The driver owns the full triple: a `PanelStore` (bootstrapped from
+--dataset or a synthetic panel), a STREAM-residency `PanelDataset`
+(appended days are picked up in place — no reload, no retrace), a
+`ModelRegistry` + `ScoringDaemon` (optionally fronted by HTTP on
+--http while cycles run), and a `WalkForwardOperator` journaling every
+stage. Incoming days come from --incoming PICKLE files (one per cycle,
+reference schema) or are synthesized deterministically per target
+generation — determinism is what makes a killed append resumable.
+
+Startup chatter goes to STDERR; the JSON cycle summaries go to STDOUT
+(one line per cycle).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m factorvae_tpu.wf",
+        description="closed-loop walk-forward operator: drift-triggered "
+                    "retrain + zero-downtime rollover "
+                    "(docs/walkforward.md)")
+    p.add_argument("--run_dir", required=True,
+                   help="operator workspace: journal, incumbent/"
+                        "candidate checkpoints, default store location")
+    p.add_argument("--store", default=None,
+                   help="panel store directory (default: "
+                        "<run_dir>/store)")
+    p.add_argument("--dataset", default=None,
+                   help="bootstrap the store from this reference-schema "
+                        "pickle when the store does not exist yet")
+    p.add_argument("--incoming", action="append", default=[],
+                   metavar="PICKLE",
+                   help="per-cycle incoming panel pickle (repeatable, "
+                        "consumed in order); without it, incoming days "
+                        "are synthesized deterministically")
+    p.add_argument("--cycles", type=int, default=1,
+                   help="nightly cycles to run (resuming an open cycle "
+                        "counts as its own cycle)")
+    p.add_argument("--new_days", type=int, default=2,
+                   help="synthetic incoming days per cycle")
+    p.add_argument("--alias", default="prod",
+                   help="serving alias the rollover flips")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="bootstrap + refit epochs (default: the config "
+                        "schedule)")
+    p.add_argument("--force_refit", action="store_true",
+                   help="retrain every cycle (the nightly cadence) "
+                        "instead of only on drift triggers")
+    p.add_argument("--cold_ab", action="store_true",
+                   help="race a cold-start fit against the warm start "
+                        "each refit (holdout Rank-IC decides the "
+                        "candidate)")
+    p.add_argument("--min_margin", type=float, default=0.0,
+                   help="fidelity gate slack: promote when candidate "
+                        "Rank-IC >= incumbent - margin")
+    p.add_argument("--drift_threshold", type=float, default=0.5,
+                   help="day-over-day rank-correlation floor; served "
+                        "correlations below it trigger a refit (set "
+                        "per model at each promotion)")
+    p.add_argument("--holdout_days", type=int, default=1,
+                   help="newest labeled days held out for the fidelity "
+                        "gate and the warm/cold A/B")
+    p.add_argument("--window_days", type=int, default=0,
+                   help="rolling train window in days (0 = expanding)")
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="serve scoring HTTP on 127.0.0.1:PORT on a "
+                        "background thread while cycles run (the "
+                        "zero-downtime demonstration)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="model seed + synthetic feed seed base")
+    # synthetic rig shapes (bootstrap only; a real --dataset wins)
+    p.add_argument("--init_days", type=int, default=32)
+    p.add_argument("--stocks", type=int, default=12)
+    p.add_argument("--features", type=int, default=6)
+    p.add_argument("--hidden", type=int, default=8)
+    p.add_argument("--factors", type=int, default=4)
+    p.add_argument("--portfolios", type=int, default=8)
+    p.add_argument("--seq_len", type=int, default=5)
+    p.add_argument("--metrics_jsonl", default=None,
+                   help="RUN.jsonl stream for wf stage spans + train "
+                        "epochs + serve spans (render: python -m "
+                        "factorvae_tpu.obs.timeline)")
+    p.add_argument("--compile_cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache dir (default: "
+                        "$FACTORVAE_COMPILE_CACHE; 'off' disables) — a "
+                        "resumed nightly run deserializes its programs "
+                        "instead of recompiling")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # Persistent compile cache BEFORE jax warms up: a nightly resume
+    # (the crash-recovery path) deserializes yesterday's programs.
+    from factorvae_tpu import plan as planlib
+
+    planlib.setup_compilation_cache(args.compile_cache)
+
+    import os
+    import threading
+
+    from factorvae_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, PanelStore
+    from factorvae_tpu.data.append import AppendError
+    from factorvae_tpu.data.synthetic import (
+        continuation_panel,
+        synthetic_panel_dense,
+    )
+    from factorvae_tpu.serve.daemon import ScoringDaemon, serve_http
+    from factorvae_tpu.serve.registry import ModelRegistry
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
+    from factorvae_tpu.wf.journal import CycleJournal, JournalError
+    from factorvae_tpu.wf.operator import (
+        WalkForwardError,
+        WalkForwardOperator,
+    )
+
+    run_dir = os.path.abspath(args.run_dir)
+    os.makedirs(run_dir, exist_ok=True)
+    store_dir = os.path.abspath(args.store or
+                                os.path.join(run_dir, "store"))
+
+    logger = MetricsLogger(jsonl_path=args.metrics_jsonl, echo=False,
+                           run_name="walkforward")
+    prev_tl = install_timeline(Timeline(logger)) \
+        if args.metrics_jsonl else None
+    http_thread = None
+    daemon = None
+    try:
+        # ---- store -------------------------------------------------------
+        try:
+            store = PanelStore(store_dir)
+        except AppendError:
+            store = None
+        if store is None or store.generation == 0:
+            # Missing, or EMPTY (a create() killed between its manifest
+            # commit and the seed-slab append): (re)seed it — create
+            # adopts the empty store, so the crash window resumes.
+            if args.dataset:
+                from factorvae_tpu.data import build_panel, load_frame
+
+                seed_panel = build_panel(load_frame(args.dataset))
+            else:
+                seed_panel = synthetic_panel_dense(
+                    num_days=args.init_days,
+                    num_instruments=args.stocks,
+                    num_features=args.features, seed=args.seed)
+            store = PanelStore.create(store_dir, seed_panel)
+            print(f"[wf] created store {store_dir}: "
+                  f"{store.num_days}d x {len(store.instruments)} "
+                  f"instruments", file=sys.stderr)
+
+        dataset = PanelDataset(store.load_panel(),
+                               seq_len=args.seq_len,
+                               residency="stream")
+
+        # ---- config ------------------------------------------------------
+        cfg = Config(
+            model=ModelConfig(
+                num_features=dataset.panel.num_features,
+                hidden_size=args.hidden, num_factors=args.factors,
+                num_portfolios=args.portfolios, seq_len=args.seq_len,
+                stochastic_inference=False),
+            data=DataConfig(seq_len=args.seq_len, start_time=None,
+                            fit_end_time=None, val_start_time=None,
+                            val_end_time=None,
+                            panel_residency="stream"),
+            train=TrainConfig(
+                seed=args.seed, run_name="walkforward",
+                **({"num_epochs": args.epochs} if args.epochs else {})))
+
+        # ---- serving plane ----------------------------------------------
+        registry = ModelRegistry()
+        daemon = ScoringDaemon(registry, dataset, stochastic=False,
+                               seed=args.seed,
+                               drift_threshold=args.drift_threshold)
+        if args.http is not None:
+            # Non-daemon thread + join on exit: the serving loop owns
+            # timeline writes, and the drain below ends it within one
+            # accept tick.
+            http_thread = threading.Thread(
+                target=serve_http, args=(daemon, args.http),
+                name="wf-http")
+            http_thread.start()
+            print(f"[wf] serving http://127.0.0.1:{args.http}/score "
+                  f"during cycles", file=sys.stderr)
+
+        journal = CycleJournal(os.path.join(
+            run_dir, f"{cfg.train.run_name}_wf.json"))
+        if journal.recovered_from_backup:
+            print("[wf] journal main document was damaged; resumed "
+                  "from .bak (one stage may re-run)", file=sys.stderr)
+        op = WalkForwardOperator(
+            store, dataset, daemon, cfg, run_dir, alias=args.alias,
+            journal=journal, refit_epochs=args.epochs,
+            cold_ab=args.cold_ab, force_refit=args.force_refit,
+            min_margin=args.min_margin,
+            drift_threshold=args.drift_threshold,
+            holdout_days=args.holdout_days,
+            window_days=args.window_days, logger=logger)
+
+        key = op.ensure_incumbent(epochs=args.epochs)
+        print(f"[wf] incumbent {key[:12]} behind alias "
+              f"{args.alias!r}", file=sys.stderr)
+
+        # ---- cycles ------------------------------------------------------
+        incoming_files = list(args.incoming)
+        for _ in range(max(1, args.cycles)):
+            cycle_id = op.next_cycle_id()
+            gen = int(cycle_id[1:])
+            if incoming_files:
+                from factorvae_tpu.data import build_panel, load_frame
+
+                piece = build_panel(load_frame(incoming_files.pop(0)))
+            else:
+                # Deterministic per target generation: a resumed run
+                # regenerates the exact bytes the killed run appended
+                # (the idempotent-append contract). Generation g's
+                # days start after slab g-1's end — whether or not
+                # slab g already committed before the crash.
+                import pandas as pd
+
+                if store.generation >= gen:
+                    prev_end = pd.Timestamp(
+                        store.slabs[gen - 2]["end"])
+                else:
+                    prev_end = store.end_date
+                piece = continuation_panel(
+                    store.instruments, prev_end, args.new_days,
+                    store.num_columns - 1,
+                    seed=args.seed * 100003 + gen)
+            summary = op.run_cycle(piece)
+            print(json.dumps(summary))
+            sys.stdout.flush()
+        return 0
+    except (AppendError, JournalError, WalkForwardError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        if daemon is not None and http_thread is not None:
+            daemon.request_drain()
+            http_thread.join(timeout=10)
+        if prev_tl is not None or args.metrics_jsonl:
+            install_timeline(prev_tl)
+        logger.finish()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,512 @@
+"""The closed-loop walk-forward operator (ROADMAP item 2, ISSUE 14).
+
+One nightly cycle, run as an idempotent journaled state machine over
+subsystems the repo already has:
+
+    append   incremental panel append (data/append.py PanelStore;
+             slab sha256-validated before commit) + in-place serving
+             pickup (ScoringDaemon.extend_dataset -> stream-residency
+             PanelDataset.extend_days: no full reload, no device
+             transfer, no scoring retrace)
+    judge    the incumbent scores the new day(s) THROUGH the daemon,
+             feeding obs/drift.py's day-over-day rank-correlation
+             chain; drift past the model's ACTIVE threshold is
+             promoted from alert to *trigger* (scheduled refit) —
+             `force_refit` makes every cycle retrain (the nightly
+             cadence), and a serving failure on the new day triggers
+             too (a sick incumbent is its own reason to refit)
+    refit    warm-started from the incumbent's checkpoint via the
+             existing Checkpointer (params into a fresh optimizer +
+             schedule), trained on the appended panel's rolling
+             window; a cold-start fit is raced as an A/B when
+             `cold_ab` is on, judged by holdout Rank-IC
+    promote  `ScoringDaemon.admit` (POST /admit): candidate admitted
+             into the live registry under its config hash, fidelity
+             gate (candidate vs incumbent Rank-IC on the holdout day,
+             by masked_spearman) decides; losers are retired and
+             logged, winners flip the serving alias under the tick
+             lock — in-flight requests complete on the incumbent,
+             zero requests drop
+    verify   the first served score from the promoted model closes
+             the cycle
+
+Every stage transition persists to the torn-write-tolerant cycle
+journal (wf/journal.py, `<run>_wf.json`, atomic rename), so a SIGKILL
+at ANY boundary resumes idempotently: committed stages replay from
+their recorded results, the uncommitted stage re-runs — the append is
+slab-idempotent, the refit resumes bitwise from the candidate's own
+checkpoints, the promotion re-admits the same bytes (a refresh, not a
+generation bump) and re-derives the same deterministic verdict. The
+chaos classes `kill_mid_append` / `corrupt_append_slab` /
+`kill_mid_refit` / `kill_between_admit_and_drain` /
+`fidelity_gate_reject` pin exactly these windows (bench.py --chaos
+times their MTTR).
+
+Bitwise discipline: a no-fault cycle's refit parameters are BITWISE a
+plain `warm_refit` call on the appended panel — the operator adds
+journaling around the fit, never arithmetic inside it
+(tests/test_wf.py pins it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from factorvae_tpu.config import Config
+from factorvae_tpu.data.append import PanelStore
+from factorvae_tpu.data.panel import Panel
+from factorvae_tpu.utils.logging import MetricsLogger, timeline_span
+from factorvae_tpu.wf.journal import CycleJournal
+
+
+class WalkForwardError(RuntimeError):
+    """Operator-level failure with a one-line actionable message."""
+
+
+# ---------------------------------------------------------------------------
+# refit primitives (module-level so tests can pin the operator's refit
+# bitwise against a plain call)
+# ---------------------------------------------------------------------------
+
+
+def holdout_day_indices(dataset, n: int = 1) -> List[int]:
+    """The newest `n` day indices with rankable labels — the SHARED
+    holdout definition (`eval.metrics.labeled_holdout_days`) the
+    fidelity gate also judges on, with the operator's error."""
+    from factorvae_tpu.eval.metrics import labeled_holdout_days
+
+    days = labeled_holdout_days(dataset, n)
+    if not days:
+        raise WalkForwardError(
+            "no day with >=3 finite labels in the panel; the fidelity "
+            "gate cannot judge Rank-IC — check the label column")
+    return days
+
+
+def warm_refit(config: Config, dataset, warm_params=None,
+               resume: bool = False,
+               logger: Optional[MetricsLogger] = None):
+    """One refit fit: a fresh Trainer over `dataset`, started from
+    `warm_params` (fresh optimizer state + schedule — the params are
+    yesterday's, the optimization is today's), or cold when None.
+
+    `resume=True` continues from the config's OWN checkpoints when any
+    exist (the killed-mid-refit path: the per-epoch full-state
+    checkpoints the fit writes make the continuation bitwise — the
+    established PR-4 resume contract); with none on disk it falls back
+    to the warm/cold start, so a kill before the first checkpoint is a
+    plain re-run.
+
+    Returns (state, fit_info, best_weights_dir)."""
+    from factorvae_tpu.train.checkpoint import Checkpointer
+    from factorvae_tpu.train.trainer import Trainer
+
+    trainer = Trainer(config, dataset, logger=logger)
+    has_ckpt = False
+    if resume and config.train.checkpoint_every:
+        ck_dir = os.path.join(
+            config.train.save_dir, config.checkpoint_name() + "_ckpt")
+        ck = Checkpointer(ck_dir, keep=config.train.keep_checkpoints,
+                          async_save=config.train.async_checkpointing)
+        try:
+            has_ckpt = ck.latest_step() is not None
+        finally:
+            ck.close()
+    if has_ckpt:
+        state, info = trainer.fit(resume=True)
+    else:
+        start = trainer.init_state()
+        if warm_params is not None:
+            start = start.replace(params=warm_params)
+        state, info = trainer.fit(state=start)
+    weights = os.path.join(config.train.save_dir,
+                           config.checkpoint_name())
+    return state, info, weights
+
+
+def refit_rank_ic(params, config: Config, dataset,
+                  days: List[int], seed: int = 0) -> float:
+    """Holdout Rank-IC of a refit candidate's params (deterministic
+    scores; the same masked_spearman judge the promotion gate uses)."""
+    from factorvae_tpu.eval.metrics import panel_rank_ic
+    from factorvae_tpu.eval.predict import predict_panel
+
+    days = np.asarray(days, np.int64)
+    scores = predict_panel(params, config, dataset, days,
+                           stochastic=False, seed=seed)
+    return panel_rank_ic(scores, dataset.day_labels(days),
+                         dataset.valid[days])
+
+
+# ---------------------------------------------------------------------------
+# the operator
+# ---------------------------------------------------------------------------
+
+
+class WalkForwardOperator:
+    """Runs nightly cycles against a live (store, dataset, daemon)
+    triple. The daemon may be serving traffic from another thread the
+    whole time — every mutation the operator performs on shared state
+    goes through the daemon's tick lock (extend_dataset, admit)."""
+
+    def __init__(self, store: PanelStore, dataset, daemon,
+                 config: Config, run_dir: str,
+                 alias: str = "prod",
+                 journal: Optional[CycleJournal] = None,
+                 refit_epochs: Optional[int] = None,
+                 cold_ab: bool = False,
+                 force_refit: bool = False,
+                 min_margin: float = 0.0,
+                 drift_threshold: Optional[float] = None,
+                 holdout_days: int = 1,
+                 window_days: int = 0,
+                 keep_cycles: int = 2,
+                 logger: Optional[MetricsLogger] = None):
+        self.store = store
+        self.dataset = dataset
+        self.daemon = daemon
+        self.config = config
+        self.run_dir = os.path.abspath(run_dir)
+        self.alias = alias
+        self.journal = journal or CycleJournal(os.path.join(
+            self.run_dir, f"{config.train.run_name}_wf.json"))
+        self.refit_epochs = refit_epochs
+        self.cold_ab = bool(cold_ab)
+        self.force_refit = bool(force_refit)
+        self.min_margin = float(min_margin)
+        self.drift_threshold = drift_threshold
+        self.holdout_days = max(1, int(holdout_days))
+        self.window_days = max(0, int(window_days))
+        self.keep_cycles = max(1, int(keep_cycles))
+        self.logger = logger or MetricsLogger(echo=False)
+
+    # ---- cycle identity / configs ----------------------------------------
+
+    def next_cycle_id(self) -> str:
+        """The resume target's id, else the next generation's. Cycle N
+        appends the store's Nth slab, so the id is derivable before
+        AND after the append committed (the driver regenerates the
+        same deterministic incoming either way)."""
+        cur = self.journal.open_cycle()
+        if cur is not None:
+            return cur["id"]
+        return f"c{self.store.generation + 1:05d}"
+
+    def cycle_dir(self, cycle_id: str) -> str:
+        return os.path.join(self.run_dir, "cycles", cycle_id)
+
+    def _candidate_config(self, cycle_id: str,
+                          cold: bool = False) -> Config:
+        """The refit Config: same architecture, per-cycle save_dir (its
+        own config hash — candidate and incumbent coexist in the
+        registry for the gate), splits re-anchored on the APPENDED
+        panel: train up to the holdout, validate on the holdout tail,
+        optional rolling `window_days` lower bound."""
+        ds = self.dataset
+        hold = holdout_day_indices(ds, self.holdout_days)
+        fit_end = str(ds.dates[hold[0] - 1].date()) if hold[0] > 0 \
+            else None
+        start = self.config.data.start_time
+        if self.window_days:
+            lo = max(0, hold[0] - self.window_days)
+            start = str(ds.dates[lo].date())
+        save_dir = self.cycle_dir(cycle_id)
+        if cold:
+            save_dir = os.path.join(save_dir, "cold")
+        train_kw = dict(save_dir=save_dir, checkpoint_every=1)
+        if self.refit_epochs is not None:
+            train_kw["num_epochs"] = int(self.refit_epochs)
+        return dataclasses.replace(
+            self.config,
+            data=dataclasses.replace(
+                self.config.data, start_time=start,
+                fit_end_time=fit_end,
+                val_start_time=str(ds.dates[hold[0]].date()),
+                val_end_time=None),
+            train=dataclasses.replace(self.config.train, **train_kw))
+
+    # ---- bootstrap -------------------------------------------------------
+
+    def ensure_incumbent(self, epochs: Optional[int] = None) -> str:
+        """Make sure a model serves behind the alias: re-admit the
+        journaled incumbent (a fresh process after a crash), else
+        bootstrap-train one on the current panel and admit it
+        unconditionally. Returns the serving key."""
+        from factorvae_tpu.serve.registry import RegistryError
+
+        try:
+            return self.daemon.registry.resolve_key(self.alias)
+        except RegistryError:
+            pass  # nothing behind the alias yet: admit or bootstrap
+        path = self.journal.get_meta("incumbent_path")
+        if path and os.path.isdir(path):
+            resp = self.daemon.admit(path, self.alias,
+                                     drift_threshold=self.drift_threshold)
+            return resp["model"]
+        cfg = dataclasses.replace(
+            self.config,
+            train=dataclasses.replace(
+                self.config.train,
+                save_dir=os.path.join(self.run_dir, "incumbent"),
+                checkpoint_every=1,
+                **({"num_epochs": int(epochs)} if epochs else {})))
+        self.logger.log("wf_bootstrap", run=cfg.train.run_name,
+                        epochs=cfg.train.num_epochs)
+        with timeline_span("wf_bootstrap", cat="wf", resource="wf"):
+            _, _, weights = warm_refit(cfg, self.dataset,
+                                       warm_params=None, resume=True,
+                                       logger=self.logger)
+        resp = self.daemon.admit(weights, self.alias,
+                                 drift_threshold=self.drift_threshold)
+        self.journal.set_meta("incumbent_path", weights)
+        return resp["model"]
+
+    # ---- stages ----------------------------------------------------------
+
+    def _stage_append(self, incoming: Panel) -> dict:
+        rec = self.store.append_panel(incoming)
+        # Serving-side pickup, serialized with ticks; idempotent when
+        # the resumed dataset (rebuilt from the post-append store)
+        # already holds the days.
+        self.daemon.extend_dataset(incoming)
+        return dict(rec, n_days_total=int(len(self.dataset.dates)))
+
+    def _stage_judge(self, incoming: Panel) -> dict:
+        """Serve the day BEFORE the append plus each appended day with
+        the incumbent (through the daemon — the drift monitor's
+        day-over-day chain advances exactly as production traffic
+        would advance it), then read the drift verdict. Deterministic
+        on re-run: the same days served in the same order rebuild the
+        same chain even in a fresh post-crash process."""
+        ds = self.dataset
+        dates = ds.dates
+        first_new = int(dates.get_indexer([incoming.dates[0]])[0])
+        if first_new < 0:
+            raise WalkForwardError(
+                f"judge: appended day {incoming.dates[0].date()} is "
+                f"not in the serving panel — the append stage did not "
+                f"commit; resume the cycle")
+        days = [d for d in range(first_new - 1, len(dates))
+                if d >= 0]
+        inc_key = self.daemon.registry.resolve_key(self.alias)
+        failures = 0
+        for day in days:
+            resp = self.daemon.handle({"model": self.alias, "day": day})
+            if not resp.get("ok"):
+                failures += 1
+        drift = self.daemon.drift.stats().get(inc_key, {})
+        corr = drift.get("last_rank_corr")
+        threshold = self.daemon.drift.threshold_for(inc_key)
+        drifting = bool(self.daemon.drift.drifting(inc_key))
+        trigger = bool(self.force_refit or drifting or failures)
+        reasons = [r for r, hit in (
+            ("force_refit", self.force_refit),
+            ("score_drift", drifting),
+            ("serving_failures", failures > 0)) if hit]
+        return {"trigger": trigger,
+                "reason": "+".join(reasons) or "no_drift",
+                "rank_corr": corr, "threshold": threshold,
+                "incumbent": inc_key, "days_served": len(days),
+                "failures": failures}
+
+    def _warm_params(self, template_state):
+        """The incumbent's params as the warm start, restored from its
+        full-state checkpoint via the existing Checkpointer when one
+        exists (the documented warm-start source), else the serving
+        entry's in-memory tree."""
+        from factorvae_tpu.train.checkpoint import Checkpointer
+
+        entry = self.daemon.registry.get(self.alias)
+        ck_dir = (entry.source_path or "") + "_ckpt"
+        if entry.source_path and os.path.isdir(ck_dir):
+            ck = Checkpointer(ck_dir, async_save=False)
+            try:
+                state, _ = ck.restore(template_state)
+                return state.params
+            finally:
+                ck.close()
+        if entry.params is None:
+            raise WalkForwardError(
+                f"incumbent {entry.key} has neither a full-state "
+                f"checkpoint at {ck_dir} nor in-memory params to "
+                f"warm-start from")
+        return entry.params
+
+    def _stage_refit(self, cycle_id: str) -> dict:
+        from factorvae_tpu import chaos
+        from factorvae_tpu.train.trainer import Trainer
+
+        cand_cfg = self._candidate_config(cycle_id)
+        fresh = not self.journal.marked("refit_started")
+        if fresh:
+            # Wipe-then-mark: a kill between the two re-wipes (no-op);
+            # the mark only ever covers THIS cycle's artifacts, so a
+            # marked resume never adopts a previous cycle's
+            # checkpoints.
+            shutil.rmtree(self.cycle_dir(cycle_id), ignore_errors=True)
+            self.journal.mark("refit_started")
+        if chaos.fault("kill_mid_refit", step=0) is not None:
+            chaos.ops.kill_now()
+        template = Trainer(cand_cfg, self.dataset,
+                           logger=self.logger).init_state()
+        warm_params = self._warm_params(template)
+        hold = holdout_day_indices(self.dataset, self.holdout_days)
+        with timeline_span("wf_refit_warm", cat="wf", resource="wf"):
+            state, info, weights = warm_refit(
+                cand_cfg, self.dataset, warm_params=warm_params,
+                resume=not fresh, logger=self.logger)
+        result = {
+            "holdout_days": hold,
+            "warm": {
+                "best_val": float(info["best_val"]),
+                "rank_ic": refit_rank_ic(state.params, cand_cfg,
+                                         self.dataset, hold),
+                "path": weights,
+                "epochs": len(info["history"]),
+            },
+            "cold": None, "winner": "warm",
+        }
+        if self.cold_ab:
+            cold_cfg = self._candidate_config(cycle_id, cold=True)
+            with timeline_span("wf_refit_cold", cat="wf",
+                               resource="wf"):
+                cstate, cinfo, cweights = warm_refit(
+                    cold_cfg, self.dataset, warm_params=None,
+                    resume=not fresh, logger=self.logger)
+            result["cold"] = {
+                "best_val": float(cinfo["best_val"]),
+                "rank_ic": refit_rank_ic(cstate.params, cold_cfg,
+                                         self.dataset, hold),
+                "path": cweights,
+                "epochs": len(cinfo["history"]),
+            }
+            warm_ic = result["warm"]["rank_ic"]
+            cold_ic = result["cold"]["rank_ic"]
+            # Cold must STRICTLY beat warm on finite ICs to take the
+            # candidacy — warm is the walk-forward default.
+            if (np.isfinite(cold_ic)
+                    and (not np.isfinite(warm_ic)
+                         or cold_ic > warm_ic)):
+                result["winner"] = "cold"
+        result["path"] = result[result["winner"]]["path"]
+        if chaos.fault("kill_mid_refit", step=1) is not None:
+            chaos.ops.kill_now()
+        return result
+
+    def _stage_promote(self, refit: dict) -> dict:
+        resp = self.daemon.admit(
+            refit["path"], self.alias,
+            holdout_days=refit.get("holdout_days"),
+            min_margin=self.min_margin,
+            drift_threshold=self.drift_threshold)
+        if resp.get("promoted"):
+            self.journal.set_meta("incumbent_path", refit["path"])
+        keep = ("promoted", "model", "incumbent", "reason",
+                "candidate_rank_ic", "incumbent_rank_ic", "alias",
+                "generation")
+        return {k: resp[k] for k in keep if k in resp}
+
+    def _stage_verify(self) -> dict:
+        """First served score from whatever now stands behind the
+        alias — the cycle is closed by the SERVING plane answering,
+        not by the operator believing its own bookkeeping."""
+        day = int(self.dataset.split_days(None, None)[-1])
+        resp = self.daemon.handle({"model": self.alias, "day": day})
+        if not resp.get("ok"):
+            raise WalkForwardError(
+                f"verify: serving the newest day failed "
+                f"({resp.get('error')}); the cycle stays open — fix "
+                f"the daemon and resume")
+        return {"day": day,
+                "date": str(self.dataset.dates[day].date()),
+                "model": resp["model"], "n": resp["n"],
+                "latency_ms": resp.get("latency_ms")}
+
+    # ---- the cycle -------------------------------------------------------
+
+    def run_cycle(self, incoming: Panel) -> dict:
+        """Run (or resume) one cycle over `incoming` (the new days).
+        Returns a summary with per-stage results and walls; committed
+        stages replay their journaled results without re-running."""
+        cycle_id = self.next_cycle_id()
+        self.journal.begin_cycle(
+            cycle_id, start=str(incoming.dates[0].date()),
+            end=str(incoming.dates[-1].date()),
+            days=int(incoming.num_days))
+        walls = {}
+        ran = {}
+
+        def stage(name, fn, *args):
+            done = self.journal.committed(name)
+            if done is not None:
+                ran[name] = False
+                return done
+            t0 = time.perf_counter()
+            with timeline_span(f"wf_{name}", cat="wf", resource="wf",
+                               cycle=cycle_id):
+                result = fn(*args)
+            walls[name] = round(time.perf_counter() - t0, 4)
+            ran[name] = True
+            self.logger.log("wf_stage", cycle=cycle_id, stage=name,
+                            wall_s=walls[name], **{
+                                k: v for k, v in result.items()
+                                if isinstance(v, (int, float, str,
+                                                  bool, type(None)))})
+            return self.journal.commit(name, dict(result,
+                                                  wall_s=walls[name]))
+
+        append = stage("append", self._stage_append, incoming)
+        judge = stage("judge", self._stage_judge, incoming)
+        if judge["trigger"]:
+            refit = stage("refit", self._stage_refit, cycle_id)
+            promote = stage("promote", self._stage_promote, refit)
+        else:
+            refit = stage("refit", lambda: {"skipped": True})
+            promote = stage("promote", lambda: {"skipped": True,
+                                                "promoted": False})
+        verify = stage("verify", self._stage_verify)
+        self.journal.finish_cycle()
+        self._cleanup_cycles()
+        summary = {
+            "cycle": cycle_id,
+            "triggered": bool(judge["trigger"]),
+            "promoted": bool(promote.get("promoted")),
+            "stages": {"append": append, "judge": judge,
+                       "refit": refit, "promote": promote,
+                       "verify": verify},
+            "walls": walls, "ran": ran,
+        }
+        if ran.get("refit") and ran.get("verify") \
+                and not refit.get("skipped"):
+            # refit start -> first served score from the rolled-over
+            # model, the bench.py --walkforward headline
+            summary["refit_to_serve_s"] = round(
+                sum(walls.get(s, 0.0)
+                    for s in ("refit", "promote", "verify")), 4)
+        self.logger.log("wf_cycle", **{
+            k: v for k, v in summary.items()
+            if isinstance(v, (int, float, str, bool, type(None)))})
+        return summary
+
+    def _cleanup_cycles(self) -> None:
+        """Opportunistically drop old per-cycle candidate workspaces,
+        keeping the newest `keep_cycles` plus anything the journaled
+        incumbent path still lives in."""
+        root = os.path.join(self.run_dir, "cycles")
+        try:
+            dirs = sorted(d for d in os.listdir(root)
+                          if os.path.isdir(os.path.join(root, d)))
+        except OSError:
+            return
+        incumbent = self.journal.get_meta("incumbent_path") or ""
+        for d in dirs[:-self.keep_cycles]:
+            full = os.path.join(root, d)
+            if incumbent.startswith(full + os.sep):
+                continue
+            shutil.rmtree(full, ignore_errors=True)

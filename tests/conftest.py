@@ -43,6 +43,7 @@ _SLOW_FILES = {
     "test_quant.py",             # trained-model fixture
     "test_reference_oracle.py",  # flagship-shape torch+jax compiles
     "test_chaos.py",             # fleet recovery + subprocess harnesses
+    "test_wf.py",                # walk-forward subprocess resume rigs
 }
 # Heavy classes inside otherwise-quick files (full-model jit compiles).
 _SLOW_CLASSES = {
@@ -74,6 +75,13 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # same-width homogeneous hyper fleet; fold bitwise the PR-2/serial
 # traces), the shape-bucket partition, the PBT generation resume and
 # the mesh x hyper composition rejection on every run.
+# The ISSUE-14 walk-forward classes are quick BY DESIGN: tier-1 must
+# drive the cycle journal, the sha256-validated incremental append,
+# the in-place serving pickup, the /admit fidelity gate and ONE full
+# in-process cycle (zero dropped requests through rollover + the
+# refit-bitwise-plain-warm-start pin) plus the registry re-admission
+# version-bump; the subprocess SIGKILL-at-each-boundary resume rigs
+# stay slow (test_wf.py in _SLOW_FILES).
 _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestLockOrderRecorder", "TestLockOrderTier1",
                   "TestComposeValidate", "TestComposedOracles",
@@ -88,7 +96,10 @@ _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestHyperOptimizerArithmetic", "TestHyperFold",
                   "TestHyperOracle", "TestShapeBuckets",
                   "TestGridSweep", "TestPBT", "TestHyperCompose",
-                  "TestHyperObsLabels"}
+                  "TestHyperObsLabels",
+                  "TestCycleJournal", "TestPanelStore",
+                  "TestExtendDays", "TestAdmitGate",
+                  "TestWalkForwardCycle", "TestReadmission"}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -702,3 +702,96 @@ class TestArtifactHeader:
         payload = blob.split(b"\n", 2)[2]  # strip the header: legacy
         with pytest.raises(RegistryError, match="re-export"):
             ModelRegistry().register_artifact(payload)
+
+
+class TestReadmission:
+    """ISSUE 14 satellite: admitting a checkpoint with the SAME config
+    hash but CHANGED weights must version-bump the entry and tombstone
+    its stale sibling executables — never silently keep serving the
+    old bytes. Pinned by scoring before/after the re-admission."""
+
+    def _ckpt(self, base, cfg, params):
+        from factorvae_tpu.train.checkpoint import save_params
+
+        path = save_params(str(base), "w", params)
+        with open(os.path.join(path, "serve_config.json"), "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        return path
+
+    def test_readmit_changed_weights_scores_fresh(self, tiny_ds,
+                                                  tmp_path):
+        import jax
+
+        from factorvae_tpu.eval.predict import predict_panel
+
+        cfg = tiny_cfg(seed=11)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        path = self._ckpt(tmp_path, cfg, params)
+        reg = ModelRegistry()
+        key = reg.register_checkpoint(path, alias="prod")
+        days = tiny_ds.split_days(None, None)[:2]
+        before = reg.score("prod", tiny_ds, days)
+        assert reg.get(key).generation == 1
+        # the walk-forward refit overwrites the same dir with new bytes
+        new_params = jax.tree.map(lambda x: x * 1.25, params)
+        self._ckpt(tmp_path, cfg, new_params)
+        key2 = reg.register_checkpoint(path, alias="prod")
+        assert key2 == key           # same config hash, same key
+        entry = reg.get(key)
+        assert entry.generation == 2
+        assert reg.readmissions == 1
+        after = reg.score("prod", tiny_ds, days)
+        ref = predict_panel(new_params, cfg, tiny_ds, days,
+                            stochastic=False)
+        v = np.isfinite(ref)
+        # fresh weights serve — bitwise the f32 scan on the NEW tree
+        np.testing.assert_array_equal(after[v], ref[v])
+        assert not np.array_equal(before[v], after[v])
+
+    def test_readmit_same_bytes_is_refresh_not_bump(self, tiny_ds,
+                                                    tmp_path):
+        """The crash-resume path re-admits identical bytes: no
+        generation burn, no sibling eviction."""
+        cfg = tiny_cfg(seed=12)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        path = self._ckpt(tmp_path, cfg, params)
+        reg = ModelRegistry()
+        key = reg.register_checkpoint(path)
+        ki = reg.register_checkpoint(path, precision="int8")
+        reg.register_checkpoint(path)   # same bytes again
+        assert reg.get(key).generation == 1
+        assert reg.readmissions == 0
+        assert ki in reg.keys()         # sibling untouched
+
+    def test_stale_sibling_rung_tombstoned_and_refreshed(self, tiny_ds,
+                                                         tmp_path):
+        """An int8 sibling quantized from the OLD bytes must not keep
+        serving after the f32 re-admission: it is tombstoned and the
+        next request cold-starts it from the UPDATED source."""
+        import jax
+
+        from factorvae_tpu.eval.predict import predict_panel
+
+        cfg = tiny_cfg(seed=13)
+        params = tiny_params(cfg, tiny_ds.n_max)
+        path = self._ckpt(tmp_path, cfg, params)
+        reg = ModelRegistry()
+        key = reg.register_checkpoint(path)
+        ki = reg.register_checkpoint(path, precision="int8",
+                                     alias="prod8")
+        days = tiny_ds.split_days(None, None)[:2]
+        stale = reg.score(ki, tiny_ds, days)
+        new_params = jax.tree.map(lambda x: x * 1.25, params)
+        self._ckpt(tmp_path, cfg, new_params)
+        reg.register_checkpoint(path)    # f32 re-admission, new bytes
+        assert ki not in reg.keys()      # stale executable tombstoned
+        fresh = reg.score("prod8", tiny_ds, days)   # cold-starts
+        assert reg.cold_starts == 1
+        from factorvae_tpu.ops.quant import ensure_quantized
+
+        ref = predict_panel(ensure_quantized(new_params),
+                            precision_config(cfg, "int8"), tiny_ds,
+                            days, stochastic=False, int8=True)
+        v = np.isfinite(ref)
+        np.testing.assert_array_equal(fresh[v], ref[v])
+        assert not np.array_equal(stale[v], fresh[v])
