@@ -237,6 +237,34 @@ MESH_RESIDENCY = os.environ.get("BENCH_MESH_RESIDENCY", "hbm")
 USE_SERVE = os.environ.get("BENCH_SERVE", "0") == "1"
 SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", 100))
 SERVE_MODELS = int(os.environ.get("BENCH_SERVE_MODELS", 2))
+# Scale-out curve (`python bench.py --serve --workers 1,2,4` or
+# BENCH_SERVE_WORKERS=1,2,4): the router + worker-fleet tier
+# (ISSUE 15, serve/pool.py + serve/router.py). For each worker count
+# N, stand up a pool of N full daemon subprocesses sharing ONE
+# persistent compile cache + AOT store behind the sticky router
+# (N=1: clients hit the lone worker directly — no router, matching
+# the CLI contract), drive the same-day multi-model closed-loop
+# client load, and report QPS/p50/p99 per N plus the zero-compile
+# cold-start taxonomy of every worker joining a warm fleet
+# (compile==0, compile_cached>0 — the PR-10 warm-restart scrape
+# extended to fleet joins). Workers are pinned to host CPU: the
+# router tier is host-side by construction, and N workers cannot
+# share one accelerator context. Shapes/load are env-overridable
+# (BENCH_SCALE_*).
+SERVE_WORKERS = tuple(
+    int(s) for s in os.environ.get("BENCH_SERVE_WORKERS", "").split(",")
+    if s.strip())
+SCALE_FEATURES = int(os.environ.get("BENCH_SCALE_FEATURES", 32))
+SCALE_SEQ_LEN = int(os.environ.get("BENCH_SCALE_SEQ_LEN", 12))
+SCALE_HIDDEN = int(os.environ.get("BENCH_SCALE_HIDDEN", 16))
+SCALE_FACTORS = int(os.environ.get("BENCH_SCALE_FACTORS", 8))
+SCALE_PORTFOLIOS = int(os.environ.get("BENCH_SCALE_PORTFOLIOS", 16))
+SCALE_STOCKS = int(os.environ.get("BENCH_SCALE_STOCKS", 112))
+SCALE_DAYS = int(os.environ.get("BENCH_SCALE_DAYS", 16))
+SCALE_MODELS = int(os.environ.get("BENCH_SCALE_MODELS", 8))
+SCALE_CLIENTS = int(os.environ.get("BENCH_SCALE_CLIENTS", 8))
+SCALE_REQUESTS = int(os.environ.get("BENCH_SCALE_REQUESTS", 240))
+SCALE_WARMUP = int(os.environ.get("BENCH_SCALE_WARMUP", 160))
 # Chaos mode (`python bench.py --chaos` or BENCH_CHAOS=1): the MTTR
 # bench (ISSUE 9, docs/robustness.md). One representative fault per
 # class from factorvae_tpu/chaos — poisoned gradients, a hard-killed
@@ -1256,6 +1284,257 @@ def run_serve_bench() -> dict:
     return payload
 
 
+def _scale_checkpoints(root: str, n_models: int) -> list:
+    """Weights-only checkpoint dirs + serve_config.json drop-ins for
+    the scale-out rig (distinct seeds -> distinct config hashes)."""
+    import dataclasses
+
+    from factorvae_tpu.config import (
+        Config,
+        DataConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from factorvae_tpu.models.factorvae import load_model
+    from factorvae_tpu.train.checkpoint import save_params
+
+    cfg0 = Config(
+        model=ModelConfig(
+            stochastic_inference=False, num_features=SCALE_FEATURES,
+            hidden_size=SCALE_HIDDEN, num_factors=SCALE_FACTORS,
+            num_portfolios=SCALE_PORTFOLIOS, seq_len=SCALE_SEQ_LEN),
+        data=DataConfig(seq_len=SCALE_SEQ_LEN, start_time=None,
+                        fit_end_time=None, val_start_time=None,
+                        val_end_time=None),
+        train=TrainConfig(seed=0))
+    specs = []
+    for s in range(n_models):
+        cfg = dataclasses.replace(
+            cfg0, train=dataclasses.replace(cfg0.train, seed=s))
+        params = load_model(cfg, n_max=SCALE_STOCKS)[1]
+        save_params(root, f"m{s}", params)
+        with open(os.path.join(root, f"m{s}", "serve_config.json"),
+                  "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        specs.append(os.path.join(root, f"m{s}"))
+    return specs
+
+
+def _scale_load(port: int, clients: int, total: int,
+                day: int, n_models: int) -> dict:
+    """Closed-loop client load: `clients` threads with persistent
+    connections, single-object requests round-robin over the models,
+    all scoring the SAME (newest) day — the paper's serving story, and
+    the shape the fused multi-model dispatch exists for. Returns
+    QPS + latency percentiles."""
+    import http.client
+    import threading
+
+    import numpy as np
+
+    lat: list = []
+    oks: list = []
+    lock = threading.Lock()
+    per_client = max(1, total // clients)
+
+    def client(tid: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=600)
+        for i in range(per_client):
+            req = {"model": f"m{(tid + i) % n_models}", "day": day,
+                   "top": 3}
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", "/score",
+                             body=json.dumps(req).encode(),
+                             headers={"Content-Type":
+                                      "application/json"})
+                out = json.loads(conn.getresponse().read().decode())
+                ok = bool(out.get("ok"))
+            except Exception:
+                ok = False
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=600)
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.append(dt)
+                oks.append(ok)
+        conn.close()
+
+    threads = [threading.Thread(target=client, args=(t,),
+                                name=f"bench-client-{t}")
+               for t in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {
+        "requests": len(lat),
+        "ok": all(oks) and bool(oks),
+        "dropped": sum(1 for ok in oks if not ok),
+        "qps": round(len(lat) / wall, 2),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+    }
+
+
+def _worker_compile_counts(pool) -> dict:
+    """worker_id -> {"compile": n, "compile_cached": n} scraped off
+    each worker's /metrics."""
+    out = {}
+    for w in pool.workers:
+        counts = {"compile": 0.0, "compile_cached": 0.0}
+        try:
+            text = pool.scrape_metrics(w)
+        except Exception:
+            out[w.wid] = None
+            continue
+        for line in text.splitlines():
+            if line.startswith("factorvae_compile_total{"):
+                kind = line.split('kind="')[1].split('"')[0]
+                counts[kind] = float(line.rsplit(" ", 1)[1])
+        out[w.wid] = counts
+    return out
+
+
+def _scale_curve(specs, cache_dir, store_dir, work, env, day) -> list:
+    """One curve point per worker count: pool (+ router past N=1) up,
+    join taxonomy scraped BEFORE traffic, warmup + timed load, torn
+    down. Only the very first worker of the whole curve ever builds a
+    program — every later join must deserialize (compile==0,
+    compile_cached>0)."""
+    from factorvae_tpu.serve.pool import WorkerPool
+    from factorvae_tpu.serve.router import Router
+
+    curve = []
+    first_worker_seen = False
+    for n in sorted(set(SERVE_WORKERS or (1, 2))):
+        pool = WorkerPool(
+            specs, ["--synthetic", f"{SCALE_DAYS},{SCALE_STOCKS}"],
+            n, cache_dir, store_dir,
+            work_dir=os.path.join(work, f"pool_n{n}"), env=env)
+        router = None
+        try:
+            pool.start()
+            joins = _worker_compile_counts(pool)
+            join_ok = True
+            for w in pool.workers:
+                c = joins.get(w.wid) or {}
+                if first_worker_seen:
+                    join_ok &= (c.get("compile", 1) == 0
+                                and c.get("compile_cached", 0) > 0)
+                first_worker_seen = True
+            if n == 1:
+                port = pool.workers[0].port
+            else:
+                router = Router(pool,
+                                max_inflight=max(64, 4 * SCALE_CLIENTS))
+                port = router.start()
+            _scale_load(port, SCALE_CLIENTS, SCALE_WARMUP, day,
+                        SCALE_MODELS)   # fused-program warmup
+            timed = _scale_load(port, SCALE_CLIENTS, SCALE_REQUESTS,
+                                day, SCALE_MODELS)
+            after = _worker_compile_counts(pool)
+            stats = pool.stats()
+            curve.append({
+                "workers": n,
+                **timed,
+                "zero_compile_joins": join_ok,
+                "join_compile_taxonomy": joins,
+                "post_load_compile_taxonomy": after,
+                "respawns": stats["respawns"],
+            })
+        finally:
+            if router is not None:
+                router.stop()          # stops the pool too
+            else:
+                pool.stop()
+    return curve
+
+
+def run_serve_scaleout_bench() -> dict:
+    """Serving scale-out curve (ISSUE 15): QPS/p50/p99 vs worker
+    count through the router + worker-fleet tier, with the
+    zero-compile fleet-join contract asserted per worker. One JSON
+    line, same terminal contract; `value` is the QPS at the largest
+    worker count, and the ACCEPTANCE pin — QPS at N=2 strictly above
+    N=1, plus compile==0/compile_cached>0 for every worker joining a
+    warm fleet — flips the metric to *_failed when broken."""
+    import shutil
+    import tempfile
+
+    platform, _ = detect_platform()
+    work = tempfile.mkdtemp(prefix="bench_scaleout_")
+    cache_dir = os.path.join(work, "xla_cache")
+    store_dir = os.path.join(work, "aot_store")
+    specs = _scale_checkpoints(os.path.join(work, "ckpts"),
+                               SCALE_MODELS)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(chaos_env_var(), None)
+    day = SCALE_DAYS - 1
+    try:
+        curve = _scale_curve(specs, cache_dir, store_dir, work, env,
+                             day)
+    finally:
+        # A pool that failed to start must not leak the checkpoint +
+        # cache + log tree (the surviving exception still reaches the
+        # top-level *_failed terminal contract).
+        shutil.rmtree(work, ignore_errors=True)
+
+    by_n = {c["workers"]: c for c in curve}
+    qps1 = (by_n.get(1) or {}).get("qps")
+    qps2 = (by_n.get(2) or {}).get("qps")
+    scaling_ok = (qps1 is None or qps2 is None) or (qps2 > qps1)
+    joins_ok = all(c["zero_compile_joins"] for c in curve)
+    served_ok = all(c["ok"] for c in curve)
+    best = max(curve, key=lambda c: c["workers"])
+    ok_all = scaling_ok and joins_ok and served_ok
+    payload = {
+        "metric": (
+            f"serve_scaleout_qps_C{SCALE_FEATURES}_T{SCALE_SEQ_LEN}"
+            f"_H{SCALE_HIDDEN}_K{SCALE_FACTORS}_M{SCALE_PORTFOLIOS}"
+            f"_N{SCALE_STOCKS}_models{SCALE_MODELS}"
+            f"_w{best['workers']}"
+            + ("" if ok_all else "_failed")),
+        "value": best["qps"],
+        "unit": "req/sec",
+        "vs_baseline": None,   # no reference multi-worker baseline
+        "platform": platform,
+        "models": SCALE_MODELS,
+        "clients": SCALE_CLIENTS,
+        "requests_per_point": SCALE_REQUESTS,
+        "curve": curve,
+        "qps_n2_over_n1": (round(qps2 / qps1, 3)
+                           if qps1 and qps2 else None),
+        "scaling_ok": scaling_ok,
+        "zero_compile_joins_ok": joins_ok,
+        "workload": "same-day multi-model closed loop (top=3)",
+        "worker_backend": "cpu (single-thread XLA per worker; the "
+                          "fleet divides the host's cores)",
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SERVE.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return payload
+
+
+def chaos_env_var() -> str:
+    from factorvae_tpu import chaos
+
+    return chaos.ENV_VAR
+
+
 def run_chaos_bench() -> dict:
     """MTTR bench (BENCH_CHAOS): one representative fault per chaos
     class, each timed from fault onset to VERIFIED recovery (the
@@ -1541,6 +1820,83 @@ for s in range(3):
         recovered["serve_cold_fail"] = False
     if recovered["serve_cold_fail"]:
         mttr["serve_cold_fail"] = max(time.perf_counter() - t0, 1e-4)
+
+    # --- kill_worker (ISSUE 15): a worker of a 2-worker fleet is
+    # SIGKILLed mid-tick by the pool watcher's chaos hook; recovery =
+    # the router REROUTES the worker's sticky models to the survivor
+    # (a request for every model keeps answering ok) AND the pool
+    # respawns the worker from the shared AOT store + compile cache
+    # back to healthy. MTTR = kill -> respawned worker healthy with a
+    # routed request answering ok.
+    from factorvae_tpu.serve.pool import WorkerPool, http_json
+    from factorvae_tpu.serve.router import Router
+
+    kw_root = os.path.join(work, "kill_worker")
+    save_params(kw_root, "kw0", sparams)
+    with open(os.path.join(kw_root, "kw0", "serve_config.json"),
+              "w") as fh:
+        json.dump(scfg.to_dict(), fh)
+    cfg_kw1 = Config(model=scfg.model, data=scfg.data,
+                     train=TrainConfig(seed=7))
+    save_params(kw_root, "kw1", load_model(cfg_kw1, n_max=sds.n_max)[1])
+    with open(os.path.join(kw_root, "kw1", "serve_config.json"),
+              "w") as fh:
+        json.dump(cfg_kw1.to_dict(), fh)
+    kw_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    kw_env.pop(chaos.ENV_VAR, None)
+    kw_pool = WorkerPool(
+        [os.path.join(kw_root, "kw0"), os.path.join(kw_root, "kw1")],
+        ["--synthetic", "12,10"], 2,
+        cache_dir=os.path.join(kw_root, "cache"),
+        store_dir=os.path.join(kw_root, "store"),
+        work_dir=os.path.join(kw_root, "pool"),
+        health_interval_s=0.2, env=kw_env)
+    kw_router = Router(kw_pool)
+    try:
+        kw_pool.start()
+        kw_port = kw_router.start()
+
+        def kw_score(model):
+            return http_json(
+                f"http://127.0.0.1:{kw_port}/score",
+                {"model": model, "day": 0}, timeout=120)
+
+        warm_ok = all(kw_score(m).get("ok") for m in ("kw0", "kw1"))
+        victim = kw_pool.workers[1]
+        plan = ChaosPlan([Fault("kill_worker", request=victim.index)])
+        t0 = time.perf_counter()
+        with chaos.active(plan):
+            # the watcher's next pass fires the fault (SIGKILL)
+            deadline = t0 + 30
+            while time.perf_counter() < deadline and not plan.fired:
+                time.sleep(0.05)
+        # reroute: every model keeps answering THROUGH the router
+        # while the victim is down
+        reroute_ok = all(
+            kw_score(m).get("ok") for m in ("kw0", "kw1"))
+        respawned = False
+        deadline = time.perf_counter() + 240
+        while time.perf_counter() < deadline:
+            st = kw_pool.stats()
+            vw = next(w for w in st["workers"]
+                      if w["worker_id"] == victim.wid)
+            if vw["state"] == "ok" and vw["restarts"] > 0:
+                respawned = vw["respawn_source"] == "aot_store"
+                break
+            time.sleep(0.1)
+        post_ok = all(kw_score(m).get("ok") for m in ("kw0", "kw1"))
+        t1 = time.perf_counter()
+        recovered["kill_worker"] = bool(
+            warm_ok and plan.fired and reroute_ok and respawned
+            and post_ok)
+        if recovered["kill_worker"]:
+            mttr["kill_worker"] = max(t1 - t0, 1e-4)
+    except Exception as e:
+        print(f"[bench] kill_worker scenario failed: {e}",
+              file=sys.stderr)
+        recovered["kill_worker"] = False
+    finally:
+        kw_router.stop()
 
     # ---- walk-forward cycle-stage classes (ISSUE 14) ------------------
     # The nightly loop's crash windows (docs/walkforward.md fault
@@ -2103,7 +2459,10 @@ def bench_payload() -> dict:
     elif USE_MESH:
         payload = run_mesh_bench()
     elif USE_SERVE:
-        payload = run_serve_bench()
+        # --workers 1,2,4 switches the serve bench to the scale-out
+        # curve through the router + worker-fleet tier (ISSUE 15).
+        payload = (run_serve_scaleout_bench() if SERVE_WORKERS
+                   else run_serve_bench())
     elif USE_CHAOS:
         payload = run_chaos_bench()
     elif USE_WALKFORWARD:
@@ -2263,7 +2622,7 @@ def run_accel_child() -> tuple[bool, str]:
 
 def main() -> None:
     global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_SERVE, \
-        USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD
+        USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD, SERVE_WORKERS
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -2287,6 +2646,18 @@ def main() -> None:
     if "--serve" in sys.argv:
         USE_SERVE = True
         os.environ["BENCH_SERVE"] = "1"
+    if "--workers" in sys.argv:
+        # `--serve --workers 1,2,4`: the scale-out curve. Propagated
+        # via env so the probe/fallback subprocesses keep the mode.
+        try:
+            arg = sys.argv[sys.argv.index("--workers") + 1]
+            SERVE_WORKERS = tuple(int(s) for s in arg.split(",")
+                                  if s.strip())
+            os.environ["BENCH_SERVE_WORKERS"] = arg
+        except (IndexError, ValueError):
+            print("error: --workers wants a comma list (e.g. 1,2,4)",
+                  file=sys.stderr)
+            sys.exit(2)
     if "--chaos" in sys.argv:
         USE_CHAOS = True
         os.environ["BENCH_CHAOS"] = "1"

@@ -59,6 +59,19 @@ gets its own fault class, timed by the MTTR harness (bench --chaos):
                          the gate verdict to        logged; incumbent
                          reject                     keeps serving
 
+Serving scale-out fault class (serve/pool.py, ISSUE 15):
+
+    kill_worker          WorkerPool watcher tick    router reroutes the
+                         (request=worker index):    worker's sticky
+                         the worker process is      models to surviving
+                         SIGKILLed mid-tick         workers; the pool
+                                                    respawns it from the
+                                                    shared AOT store +
+                                                    compile cache (zero
+                                                    recompiles) and
+                                                    replays fan-out
+                                                    admits
+
 Opt-in and zero-cost when off: with no plan installed and no env var,
 `fault()` is a None check — no allocation, no locking, no jax import —
 and every in-graph injection is gated at TRACE time (`has_fault`), so
@@ -100,6 +113,8 @@ KINDS = (
     "kill_mid_refit",
     "kill_between_admit_and_drain",
     "fidelity_gate_reject",
+    # serving scale-out class (serve/pool.py, ISSUE 15)
+    "kill_worker",
 )
 
 # Coordinate fields a Fault can pin (-1 / "" = wildcard, matches any).

@@ -129,6 +129,73 @@ def render_families(
     return "\n".join(out) + "\n"
 
 
+def inject_labels(sample: str, labels: dict) -> str:
+    """One exposition sample line with extra labels spliced in —
+    `name{a="b"} 1` or `name 1` gains every (k, v) of `labels`. The
+    router's fleet scrape uses this to relabel each worker's families
+    with its `worker_id` before merging them into one exposition."""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels.items()
+                     if v is not None)
+    if not inner:
+        return sample
+    brace = sample.find("{")
+    if brace != -1 and brace < sample.rfind("}"):
+        close = sample.rfind("}")
+        existing = sample[brace + 1:close].strip()
+        sep = "," if existing else ""
+        return (sample[:brace + 1] + inner + sep
+                + sample[brace + 1:])
+    name, _, rest = sample.partition(" ")
+    return f"{name}{{{inner}}} {rest}"
+
+
+def merge_expositions(
+        parts: Sequence[Tuple[dict, str]],
+        extra_families: Sequence[Tuple[str, str, str, List[str]]] = (),
+) -> str:
+    """Merge several exposition payloads into ONE valid exposition:
+    `parts` is [(labels, text), ...] — every sample line of `text`
+    gains `labels` (the fleet scrape's `worker_id`), and families that
+    appear in several payloads collapse under one `# HELP`/`# TYPE`
+    header (duplicate headers are invalid exposition). `extra_families`
+    (the router's own counters) render FIRST. Sample order inside a
+    family follows `parts` order, so one worker's histogram buckets
+    stay contiguous."""
+    from collections import OrderedDict
+
+    merged: "OrderedDict[str, List]" = OrderedDict()
+    for name, typ, help_, lines in extra_families:
+        merged[name] = [typ, help_, list(lines)]
+    for labels, text in parts:
+        family = None
+        for line in (text or "").splitlines():
+            if line.startswith("# HELP "):
+                rest = line[len("# HELP "):]
+                name, _, help_ = rest.partition(" ")
+                family = name
+                merged.setdefault(name, ["untyped", help_, []])
+                merged[name][1] = merged[name][1] or help_
+            elif line.startswith("# TYPE "):
+                rest = line[len("# TYPE "):]
+                name, _, typ = rest.partition(" ")
+                family = name
+                merged.setdefault(name, [typ or "untyped", "", []])
+                if typ:
+                    merged[name][0] = typ
+            elif line.startswith("#") or not line.strip():
+                continue
+            else:
+                sample = inject_labels(line, labels)
+                name = line.split("{", 1)[0].split(" ", 1)[0]
+                if family is None or not (
+                        name == family or name.startswith(family + "_")):
+                    family = name
+                    merged.setdefault(name, ["untyped", "", []])
+                merged[family][2].append(sample)
+    return render_families([(n, t, h, ls)
+                            for n, (t, h, ls) in merged.items()])
+
+
 # ---------------------------------------------------------------------------
 # serving-side exposition
 # ---------------------------------------------------------------------------

@@ -197,6 +197,20 @@ class Plan:
     the block resolve to "float32" — the conservative, bitwise default,
     same backward-compatibility rule as fleet/stream/obs/mesh.
 
+    `serve_tick_ms` / `serve_max_tick_batch` are the continuous-batching
+    SCHEDULER knobs (serve/daemon.TickScheduler, ISSUE 15): how long a
+    worker's cross-tick scheduler holds an under-full batch open for
+    late arrivals (trading p50 for fused-dispatch QPS under load), and
+    how many requests one tick may fuse. Raced by
+    `scripts/autotune_plan.py --serve` under a closed-loop concurrent
+    client load (the same `"serve"` block: `{"tick_ms": ...,
+    "max_tick_batch": ...}`). tick_ms = -1 / max_tick_batch = 0 mean
+    "no measured scheduler row" — the serving CLI then falls back to
+    its own defaults; a MEASURED 0ms window (immediate dispatch, a
+    legitimate low-concurrency winner) resolves as exactly 0. Rows
+    without the keys (every pre-ISSUE-15 table) keep resolving exactly
+    as before.
+
     `budget_*` are the OBSERVABILITY envelopes (ISSUE 7): a row's
     optional `"budgets"` block (`{"compile_seconds": s,
     "peak_hbm_bytes": b, "comm_bytes_per_epoch": c}`) states what a
@@ -226,6 +240,8 @@ class Plan:
     stream_chunk_days: int = 32
     obs_probes: bool = False
     serve_precision: str = "float32"
+    serve_tick_ms: float = -1.0
+    serve_max_tick_batch: int = 0
     mesh_data_axis: int = 0
     mesh_stock_axis: int = 0
     mesh_days_per_step: int = 0
@@ -479,6 +495,19 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 serve_precision=str(
                     (row.get("serve") or {}).get("precision")
                     or "float32"),
+                # Pre-ISSUE-15 serve blocks carry no scheduler keys:
+                # -1/0 = no measured scheduler row (the serving CLI
+                # falls back to its own defaults). A PRESENT tick_ms
+                # of 0 is a measured immediate-dispatch winner and
+                # must survive — `or` would collapse it into the
+                # sentinel.
+                serve_tick_ms=(
+                    float((row.get("serve") or {})["tick_ms"])
+                    if (row.get("serve") or {}).get("tick_ms")
+                    is not None else -1.0),
+                serve_max_tick_batch=int(
+                    (row.get("serve") or {}).get("max_tick_batch")
+                    or 0),
                 # Pre-PR-6 rows have no "mesh" block: 0/0 = keep the
                 # run's own MeshConfig (no schema break).
                 mesh_data_axis=int(

@@ -1,19 +1,26 @@
-"""Production scoring service (ISSUE 8): warm AOT model registry +
-long-lived scoring daemon.
+"""Production scoring service (ISSUE 8/15): warm AOT model registry +
+long-lived scoring daemon, scaled horizontally by a router +
+worker-fleet tier.
 
     python -m factorvae_tpu.serve --model best_models/<name> ...
+    python -m factorvae_tpu.serve ... --workers 4 --router_port 8800
 
 See docs/serving.md for the registry keying, the precision ladder's
-guarantees, the request/response schema and the latency envelope;
-`bench.py --serve` measures p50/p99/QPS on this machine.
+guarantees, the request/response schema, the scale-out tier's
+routing/stickiness/shed rules and the latency envelope;
+`bench.py --serve [--workers 1,2,4]` measures p50/p99/QPS (and the
+scaling curve) on this machine.
 """
 
 from factorvae_tpu.serve.daemon import (
     ScoringDaemon,
+    TickScheduler,
     serve_batch_file,
     serve_http,
     serve_stdin,
 )
+from factorvae_tpu.serve.pool import AotStore, PoolError, WorkerPool
+from factorvae_tpu.serve.router import Router, rendezvous_order
 from factorvae_tpu.serve.registry import (
     Entry,
     ModelRegistry,
@@ -23,12 +30,18 @@ from factorvae_tpu.serve.registry import (
 )
 
 __all__ = [
+    "AotStore",
     "Entry",
     "ModelRegistry",
+    "PoolError",
     "RegistryError",
+    "Router",
     "ScoringDaemon",
+    "TickScheduler",
+    "WorkerPool",
     "checkpoint_config",
     "precision_config",
+    "rendezvous_order",
     "serve_batch_file",
     "serve_http",
     "serve_stdin",

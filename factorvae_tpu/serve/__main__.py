@@ -10,6 +10,11 @@
     python -m factorvae_tpu.serve --model m.aot --batch reqs.jsonl
     python -m factorvae_tpu.serve --model m.aot --http 8787
 
+    # scale-out (ISSUE 15): N workers behind the sticky router
+    python -m factorvae_tpu.serve --model ckpt0 --model ckpt1 \
+        --dataset ./data/csi_data.pkl --workers 4 --router_port 8800 \
+        --compile_cache ~/.cache/fvae-xla
+
 Requests (one JSON object per line; an ARRAY line is one explicit
 batch/tick): {"id": 1, "model": "<key|alias>", "day": "2020-01-03"}
 plus optional "days"/"start"/"end", "top": k; commands {"cmd":
@@ -23,6 +28,7 @@ Startup chatter goes to STDERR — stdout is the response stream.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 
@@ -81,12 +87,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve HTTP on 127.0.0.1:PORT (POST /score "
                         "/profile, GET /stats /models /healthz "
                         "/metrics) instead of stdin")
-    p.add_argument("--tick_ms", type=float, default=20.0,
-                   help="stdin batching window: single-line requests "
-                        "arriving within this window fuse into one "
-                        "multi-model dispatch tick")
-    p.add_argument("--max_batch", type=int, default=64,
-                   help="max requests per tick")
+    p.add_argument("--tick_ms", type=float, default=None,
+                   help="batching window: stdin lines (default 20) or "
+                        "— with --scheduler / --workers — how long an "
+                        "under-full HTTP tick holds for late arrivals "
+                        "(default: the plan row's serve block, raced "
+                        "by autotune_plan.py --serve, else 2)")
+    p.add_argument("--max_batch", type=int, default=None,
+                   help="max requests per tick (default: the plan "
+                        "row's serve block with --scheduler, else 64)")
+    p.add_argument("--scheduler", action="store_true",
+                   help="with --http: cross-tick continuous batching "
+                        "(ThreadingHTTPServer + one scheduler thread; "
+                        "concurrent clients' requests fuse into shared "
+                        "dispatch ticks — trades p50 for QPS under "
+                        "load; docs/serving.md). Implied for pool "
+                        "workers")
+    p.add_argument("--workers", type=int, default=1,
+                   help="serving scale-out (docs/serving.md): spawn N "
+                        "full daemon worker processes behind a "
+                        "config-hash-sticky HTTP router. N=1 (default) "
+                        "is exactly today's single daemon — no router "
+                        "process")
+    p.add_argument("--router_port", type=int, default=8800,
+                   help="router listen port with --workers > 1 "
+                        "(/score /admit /stats /metrics /healthz)")
+    p.add_argument("--aot_store", type=str, default=None,
+                   metavar="DIR",
+                   help="AOT artifact store the pool pre-exports "
+                        "admitted models into (respawned workers "
+                        "cold-start from it with zero traces; "
+                        "default: <work dir>/aot_store)")
+    p.add_argument("--max_inflight", type=int, default=64,
+                   help="router load-shed bound: in-flight client "
+                        "requests past this answer 503 with "
+                        "retry_after_s (0 disables)")
     p.add_argument("--deadline_ms", type=float, default=0.0,
                    help="per-request scoring deadline (0 = none; a "
                         "request-level 'deadline_ms' field overrides): "
@@ -121,6 +156,86 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def run_pool(args) -> int:
+    """The scale-out entry (--workers N > 1): spawn the worker fleet
+    behind the sticky router and block until SIGTERM drains it. This
+    process never builds a panel or compiles a model — the workers are
+    full daemons; the router is a thin forwarding tier (its only jax
+    use is the pool's AOT pre-export)."""
+    import tempfile
+
+    from factorvae_tpu.serve.pool import PoolError, WorkerPool
+    from factorvae_tpu.serve.router import Router
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
+
+    work_dir = tempfile.mkdtemp(prefix="serve_pool_")
+    store_dir = args.aot_store or os.path.join(work_dir, "aot_store")
+    cache_dir = args.compile_cache \
+        or os.environ.get("FACTORVAE_COMPILE_CACHE")
+    if not cache_dir or cache_dir == "off":
+        # The shared cache IS the zero-compile cold-start transport:
+        # a pool without one would compile per worker. Make one.
+        cache_dir = os.path.join(work_dir, "xla_cache")
+        print(f"[pool] no --compile_cache given; workers share "
+              f"{cache_dir}", file=sys.stderr)
+    dataset_args = (["--dataset", args.dataset] if args.dataset
+                    else ["--synthetic", args.synthetic])
+    if args.max_stocks is not None:
+        dataset_args += ["--max_stocks", str(args.max_stocks)]
+    extra: list = []
+    if args.precision != "plan":
+        extra += ["--precision", args.precision]
+    if args.budget_mb:
+        extra += ["--budget_mb", str(args.budget_mb)]
+    if args.stochastic:
+        extra += ["--stochastic"]
+    if args.seed:
+        extra += ["--seed", str(args.seed)]
+    if args.deadline_ms:
+        extra += ["--deadline_ms", str(args.deadline_ms)]
+    extra += ["--breaker_k", str(args.breaker_k),
+              "--breaker_cooldown_s", str(args.breaker_cooldown_s),
+              "--drift_threshold", str(args.drift_threshold)]
+    logger = MetricsLogger(jsonl_path=args.metrics_jsonl, echo=False,
+                           run_name="serve_router")
+    prev_tl = install_timeline(Timeline(logger)) \
+        if args.metrics_jsonl else None
+    pool = WorkerPool(
+        args.model, dataset_args, args.workers, cache_dir, store_dir,
+        work_dir=work_dir, warmup=True, extra_args=extra,
+        # Each worker gets its own stream next to the requested one;
+        # two processes appending one JSONL would tear records.
+        metrics_base=args.metrics_jsonl,
+        tick_ms=args.tick_ms, max_tick_batch=args.max_batch)
+    try:
+        print(f"[pool] starting {args.workers} worker(s) "
+              f"(cache {cache_dir}, aot store {store_dir}, logs "
+              f"{work_dir})", file=sys.stderr)
+        pool.start()
+        for w in pool.stats()["workers"]:
+            print(f"[pool] {w['worker_id']} pid={w['pid']} "
+                  f"{w['url']} ({w['state']})", file=sys.stderr)
+        router = Router(pool, max_inflight=args.max_inflight)
+        print(f"[pool] router ready: "
+              f"http://127.0.0.1:{args.router_port}/score "
+              f"({args.workers} workers, sticky rendezvous routing)",
+              file=sys.stderr)
+        router.serve(args.router_port)
+        return 0
+    except PoolError as e:
+        print(f"error: {e}", file=sys.stderr)
+        pool.stop()
+        return 2
+    finally:
+        if args.metrics_jsonl:
+            install_timeline(prev_tl)
+        logger.finish()
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if not args.model:
@@ -130,6 +245,11 @@ def main(argv=None) -> int:
         print("error: pass --dataset PATH or --synthetic DAYS,STOCKS",
               file=sys.stderr)
         return 2
+    if args.workers > 1:
+        # The scale-out tier (ISSUE 15). N=1 falls through to the
+        # single-daemon path below — byte-identical to the pre-pool
+        # CLI, no router process.
+        return run_pool(args)
 
     # Cache + cache-aware compile-record taxonomy BEFORE jax warms up.
     from factorvae_tpu import plan as planlib
@@ -275,20 +395,44 @@ def main(argv=None) -> int:
             out = open(args.out, "w") if args.out else sys.stdout
             try:
                 n = serve_batch_file(daemon, args.batch, out,
-                                     max_batch=args.max_batch)
+                                     max_batch=args.max_batch or 64)
             finally:
                 if args.out:
                     out.close()
             print(f"[serve] answered {n} request(s) from {args.batch}",
                   file=sys.stderr)
         elif args.http is not None:
+            scheduler = None
+            if args.scheduler:
+                # Continuous batching (ISSUE 15): explicit knobs win,
+                # else the measured plan row's serve block
+                # (autotune_plan.py --serve), else the conservative
+                # defaults (2ms window, 64/tick).
+                from factorvae_tpu.serve.daemon import TickScheduler
+
+                pl = planlib.plan_for_config(specs[0][2], dataset.n_max) \
+                    if kind0 == "checkpoint" else None
+                tick_ms = args.tick_ms if args.tick_ms is not None \
+                    else (pl.serve_tick_ms
+                          if pl is not None and pl.serve_tick_ms >= 0
+                          else 2.0)
+                max_tick = args.max_batch if args.max_batch is not None \
+                    else (pl.serve_max_tick_batch
+                          if pl is not None
+                          and pl.serve_max_tick_batch > 0 else 64)
+                scheduler = TickScheduler(daemon, tick_ms=tick_ms,
+                                          max_tick_batch=max_tick)
+                print(f"[serve] continuous batching: tick_ms="
+                      f"{tick_ms:g} max_tick_batch={max_tick}",
+                      file=sys.stderr)
             print(f"[serve] http://127.0.0.1:{args.http}/score",
                   file=sys.stderr)
-            serve_http(daemon, args.http)
+            serve_http(daemon, args.http, scheduler=scheduler)
         else:
             serve_stdin(daemon, sys.stdin, sys.stdout,
-                        tick_s=args.tick_ms / 1e3,
-                        max_batch=args.max_batch)
+                        tick_s=(20.0 if args.tick_ms is None
+                                else args.tick_ms) / 1e3,
+                        max_batch=args.max_batch or 64)
         logger.log("serve_stop", **daemon.stats())
         return 0
     finally:

@@ -1,0 +1,693 @@
+"""Worker-fleet manager: N scoring daemons behind one control plane.
+
+ROADMAP item 3 / ISSUE 15 — the horizontal half of the serving story.
+One `ScoringDaemon` process tops out at one dispatch path; this module
+turns it into a FLEET: the pool spawns N full PR-8 daemons (each with
+its own warm registry, breaker table and `/metrics`), keeps them
+healthy, and gives the router (serve/router.py) a live worker table to
+route over.
+
+**Zero-compile worker cold start.** Every worker shares ONE persistent
+XLA compilation cache directory (`plan.setup_compilation_cache`): the
+first worker builds each scoring program once, and worker N+1
+deserializes — its `/metrics` scrapes `compile == 0,
+compile_cached > 0` (the PR-10 warm-restart contract, extended from
+restarts to fleet joins; pinned in tests/test_pool.py). On top, the
+pool PRE-EXPORTS every admitted checkpoint into a disk **AOT artifact
+store** (`AotStore`: `eval/export_aot.py` container v1, one artifact
+per serving alias, atomic tmp+rename, digest-keyed freshness): a
+respawned worker admits the artifacts instead of re-loading
+checkpoints, a cold start that involves no flax, no orbax and no trace
+at all.
+
+**Lifecycle.** `start()` brings worker 0 up first (it warms the shared
+cache), pre-exports the AOT store at the fleet's measured panel width
+(read off worker 0's `/stats`), then raises the rest of the fleet
+warm. A watcher thread polls each worker: process death -> respawn
+from the AOT store (same port — the router's worker table stays
+stable) and replay of any fan-out admits; `/healthz` scrape ->
+ok/degraded/failing state the router's candidate selection keys on.
+`request_drain()`/`stop()` fan SIGTERM out so every worker performs
+its own graceful drain (the daemon's documented SIGTERM shape), then
+reap. The chaos class `kill_worker` (request = worker index) SIGKILLs
+a worker from the watcher tick — `bench.py --chaos` times the
+router-reroute + respawn MTTR.
+
+**Rolling admit fan-out.** `admit_fanout(payload)` first refreshes the
+AOT store from the candidate checkpoint, then POSTs `/admit` to each
+worker IN SEQUENCE — a walk-forward promotion reaches every worker
+holding the alias, one zero-downtime alias flip at a time, and
+respawned workers replay the same admissions so a crash never
+resurrects yesterday's incumbent (docs/walkforward.md).
+
+Locking: `self._lock` guards the worker table, counters and the admit
+log. Network scrapes, subprocess spawns and AOT exports all run
+OUTSIDE it — a slow worker must not stall the router's
+`healthy_ids()` read. The watcher thread writes no files (spawn log
+handles are opened in `_spawn`, which `start()` also calls
+synchronously) and is joined on every stop path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional, Sequence
+
+from factorvae_tpu.chaos import fault as chaos_fault
+from factorvae_tpu.utils.logging import timeline_event
+
+
+class PoolError(RuntimeError):
+    """Pool-level failure with a one-line actionable message."""
+
+
+def http_json(url: str, payload: Optional[dict] = None,
+              timeout: float = 30.0):
+    """One JSON request/response round trip (POST when `payload` is
+    given, GET otherwise). HTTP error bodies that carry JSON (the
+    daemon's 503 health answer, the router's shed response) parse and
+    return instead of raising — only transport-level failures raise."""
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data, method=(
+        "POST" if data is not None else "GET"))
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode() or "null")
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        try:
+            return json.loads(body)
+        except ValueError:
+            raise PoolError(
+                f"{url} answered HTTP {e.code}: {body[:200]}") from None
+
+
+def http_text(url: str, timeout: float = 30.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# AOT artifact store
+# ---------------------------------------------------------------------------
+
+
+class AotStore:
+    """Disk store of serving artifacts, one per alias: `<root>/<alias>`
+    is a v1 AOT container (eval/export_aot.py) whose basename doubles
+    as the registry alias a worker admits it under — exactly the alias
+    the equivalent checkpoint admission would have produced, so
+    requests route identically to a checkpoint-backed and an
+    artifact-backed fleet. A `<alias>.meta.json` sidecar records the
+    exported weights' digest so an unchanged checkpoint re-exports
+    nothing (the export's one trace per call is the cost being
+    skipped)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def path_for(self, alias: str) -> str:
+        return os.path.join(self.root, alias)
+
+    def has(self, alias: str) -> bool:
+        return os.path.isfile(self.path_for(alias))
+
+    def aliases(self) -> List[str]:
+        return sorted(n for n in os.listdir(self.root)
+                      if not n.endswith(".meta.json")
+                      and os.path.isfile(os.path.join(self.root, n)))
+
+    def export_checkpoint(self, path: str, n_max: int,
+                          alias: Optional[str] = None) -> str:
+        """Export one weights-only checkpoint directory as an f32
+        serving artifact at cross-section width `n_max`; returns the
+        artifact path. Freshness is judged by the params digest — the
+        same identity the registry's re-admission version-bump uses —
+        so the rollover path re-exports exactly when the bytes
+        changed. The write is atomic (tmp + rename): a killed export
+        never leaves a torn artifact a respawn could admit."""
+        from factorvae_tpu.eval.export_aot import export_prediction
+        from factorvae_tpu.models.factorvae import load_model
+        from factorvae_tpu.serve.registry import (
+            _params_digest,
+            checkpoint_config,
+        )
+
+        path = os.path.abspath(path)
+        alias = alias or os.path.basename(path)
+        config = checkpoint_config(path)
+        _, params = load_model(config, checkpoint_path=path, n_max=1)
+        digest = _params_digest(params)
+        meta_path = self.path_for(alias) + ".meta.json"
+        out = self.path_for(alias)
+        try:
+            with open(meta_path) as fh:
+                prior = json.load(fh)
+        except (OSError, ValueError):
+            prior = {}
+        if (prior.get("digest") == digest
+                and prior.get("n_max") == int(n_max)
+                and os.path.isfile(out)):
+            return out
+        blob = export_prediction(params, config, n_max=int(n_max),
+                                 stochastic=False)
+        tmp = out + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+        os.replace(tmp, out)
+        tmp_meta = meta_path + ".tmp"
+        with open(tmp_meta, "w") as fh:
+            json.dump({"digest": digest, "n_max": int(n_max),
+                       "source": path}, fh)
+        os.replace(tmp_meta, meta_path)
+        timeline_event("aot_export", cat="serve", resource="pool",
+                       alias=alias, n_max=int(n_max), bytes=len(blob))
+        return out
+
+    def adopt_artifact(self, path: str,
+                       alias: Optional[str] = None) -> str:
+        """Copy an existing artifact FILE into the store under its
+        alias (the `--model m.aot` admission path needs no export)."""
+        import shutil
+
+        path = os.path.abspath(path)
+        alias = alias or os.path.basename(path)
+        out = self.path_for(alias)
+        if os.path.abspath(out) != path:
+            tmp = out + ".tmp"
+            shutil.copyfile(path, tmp)
+            os.replace(tmp, out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# worker handle + pool
+# ---------------------------------------------------------------------------
+
+
+class Worker:
+    """One worker process slot. Field mutation happens under the
+    pool's lock; the subprocess handle itself is only driven by the
+    pool (spawn/terminate/kill/poll)."""
+
+    def __init__(self, index: int, port: int, log_path: str):
+        self.index = index
+        self.wid = f"w{index}"
+        self.port = port
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = "starting"   # starting|ok|degraded|failing|dead
+        self.restarts = 0
+        self.fails = 0            # consecutive scrape failures
+        self.last_health: Optional[dict] = None
+        self.admits_replayed = 0
+        self.respawn_source = None  # "aot_store" | "specs" on respawn
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def describe(self) -> dict:
+        return {
+            "worker_id": self.wid, "port": self.port, "url": self.url,
+            "state": self.state,
+            "pid": self.proc.pid if self.proc else None,
+            "restarts": self.restarts,
+            "respawn_source": self.respawn_source,
+            "healthz": f"{self.url}/healthz",
+            "metrics": f"{self.url}/metrics",
+            "stats": f"{self.url}/stats",
+            "health": self.last_health,
+            "log": self.log_path,
+        }
+
+
+def free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class WorkerPool:
+    """Spawn/heal/drain N `python -m factorvae_tpu.serve` workers.
+
+    `model_specs` are the daemon's `--model` arguments (checkpoint
+    dirs or artifact files); `dataset_args` the panel arguments
+    (`["--dataset", p]` or `["--synthetic", "D,S"]`); `extra_args`
+    pass through verbatim (precision, deadlines, ...). `cache_dir` is
+    the SHARED persistent XLA compile cache every worker points at —
+    the zero-compile cold-start transport; `store_dir` the AOT
+    artifact store respawns admit from. `tick_ms`/`max_tick_batch`
+    configure each worker's continuous-batching scheduler (None =
+    leave the worker's own plan-governed resolution alone)."""
+
+    #: consecutive health-scrape failures before a live process is
+    #: treated as failing (routing stops; the process may still be
+    #: compiling its warmup — only death triggers a respawn)
+    SCRAPE_FAILS_FAILING = 3
+
+    def __init__(self, model_specs: Sequence[str],
+                 dataset_args: Sequence[str],
+                 n_workers: int,
+                 cache_dir: str,
+                 store_dir: str,
+                 work_dir: Optional[str] = None,
+                 warmup: bool = True,
+                 extra_args: Sequence[str] = (),
+                 tick_ms: Optional[float] = None,
+                 max_tick_batch: Optional[int] = None,
+                 metrics_base: Optional[str] = None,
+                 health_interval_s: float = 0.5,
+                 respawn: bool = True,
+                 start_timeout_s: float = 600.0,
+                 single_thread_xla: bool = True,
+                 env: Optional[dict] = None):
+        if n_workers < 1:
+            raise PoolError("a pool needs at least 1 worker")
+        self.model_specs = [os.path.abspath(m) for m in model_specs]
+        self.dataset_args = list(dataset_args)
+        self.cache_dir = os.path.abspath(cache_dir)
+        self.store = AotStore(store_dir)
+        import tempfile
+
+        self.work_dir = os.path.abspath(
+            work_dir or tempfile.mkdtemp(prefix="serve_pool_"))
+        os.makedirs(self.work_dir, exist_ok=True)
+        self.warmup = bool(warmup)
+        self.extra_args = list(extra_args)
+        self.tick_ms = tick_ms
+        self.max_tick_batch = max_tick_batch
+        # Per-worker RUN streams ON by default (under work_dir): the
+        # compile-record taxonomy a worker's /metrics exposes only
+        # counts LOGGED records (obs/watchdog.py), and the fleet
+        # cold-start contract — worker N+1 scrapes compile==0,
+        # compile_cached>0 — is pinned off exactly that scrape.
+        self.metrics_base = metrics_base or os.path.join(
+            self.work_dir, "RUN.jsonl")
+        self.health_interval_s = float(health_interval_s)
+        self.respawn = bool(respawn)
+        self.start_timeout_s = float(start_timeout_s)
+        worker_env = dict(os.environ if env is None else env)
+        # Workers spawn with cwd=work_dir: make THIS checkout's
+        # package importable regardless of where the pool was started.
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        worker_env["PYTHONPATH"] = repo + os.pathsep + \
+            worker_env.get("PYTHONPATH", "")
+        if single_thread_xla:
+            # One worker per core is the fleet's scaling model on CPU
+            # hosts: each worker's XLA runs single-threaded so N
+            # workers divide the machine instead of thrashing each
+            # other's intra-op thread pools (measured on this rig: the
+            # multi-threaded eigen pool LOSES on serving-sized ops
+            # even at N=1). CPU-backend flags only — a TPU worker
+            # ignores them.
+            flags = worker_env.get("XLA_FLAGS", "")
+            if "xla_cpu_multi_thread_eigen" not in flags:
+                worker_env["XLA_FLAGS"] = (
+                    flags + " --xla_cpu_multi_thread_eigen=false "
+                    "intra_op_parallelism_threads=1").strip()
+        # Built locally, assigned once, read-only afterwards (the
+        # watcher thread's respawn path reads it).
+        self.env = worker_env
+        self._lock = threading.Lock()
+        self.workers: List[Worker] = [
+            Worker(i, free_port(),
+                   os.path.join(self.work_dir, f"w{i}.log"))
+            for i in range(int(n_workers))]
+        self.n_max: Optional[int] = None
+        self.respawns = 0
+        self.kills = 0            # chaos kill_worker firings
+        self._admit_log: List[dict] = []
+        self._draining = False
+        self._watcher: Optional[threading.Thread] = None
+
+    # ---- spawning --------------------------------------------------------
+
+    def _worker_cmd(self, w: Worker, models: Sequence[str]) -> list:
+        cmd = [sys.executable, "-m", "factorvae_tpu.serve"]
+        for m in models:
+            cmd += ["--model", m]
+        cmd += list(self.dataset_args)
+        cmd += ["--http", str(w.port), "--compile_cache", self.cache_dir,
+                "--scheduler"]
+        if self.warmup:
+            cmd += ["--warmup"]
+        if self.tick_ms is not None:
+            cmd += ["--tick_ms", str(float(self.tick_ms))]
+        if self.max_tick_batch is not None:
+            cmd += ["--max_batch", str(int(self.max_tick_batch))]
+        if self.metrics_base:
+            base, ext = os.path.splitext(self.metrics_base)
+            cmd += ["--metrics_jsonl", f"{base}_{w.wid}{ext or '.jsonl'}"]
+        cmd += self.extra_args
+        return cmd
+
+    def _respawn_models(self) -> tuple:
+        """(models, source): the AOT store's artifacts when it covers
+        every alias (the zero-trace cold start), else the original
+        specs (the store may not exist yet on a very early death)."""
+        aliases = [os.path.basename(m) for m in self.model_specs]
+        if all(self.store.has(a) for a in aliases):
+            return [self.store.path_for(a) for a in aliases], "aot_store"
+        return list(self.model_specs), "specs"
+
+    def _spawn(self, w: Worker, models: Sequence[str]) -> None:
+        """Start (or restart) one worker process; the handle and state
+        land under the lock, the spawn itself runs outside it."""
+        cmd = self._worker_cmd(w, models)
+        log = open(w.log_path, "ab")
+        try:
+            proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                    env=self.env, cwd=self.work_dir)
+        finally:
+            log.close()   # the child holds its own descriptor
+        with self._lock:
+            w.proc = proc
+            w.state = "starting"
+            w.fails = 0
+            w.admits_replayed = 0
+
+    def _wait_healthy(self, workers: Sequence[Worker],
+                      timeout_s: Optional[float] = None) -> None:
+        deadline = time.monotonic() + (timeout_s
+                                       or self.start_timeout_s)
+        remaining = list(workers)
+        while remaining and time.monotonic() < deadline:
+            still = []
+            for w in remaining:
+                if w.proc is not None and w.proc.poll() is not None:
+                    tail = self.worker_log_tail(w)
+                    raise PoolError(
+                        f"worker {w.wid} died during startup "
+                        f"(rc={w.proc.returncode}); log tail:\n{tail}")
+                try:
+                    health = http_json(w.url + "/healthz", timeout=2.0)
+                except (OSError, ValueError, PoolError):
+                    # not listening yet (startup compiles): keep polling
+                    still.append(w)
+                    continue
+                with self._lock:
+                    w.last_health = health
+                    w.state = "ok" if health.get("ok") else "failing"
+            remaining = still
+            if remaining:
+                time.sleep(0.2)
+        if remaining:
+            raise PoolError(
+                f"worker(s) {', '.join(w.wid for w in remaining)} "
+                f"never answered /healthz within "
+                f"{timeout_s or self.start_timeout_s:.0f}s "
+                f"(logs under {self.work_dir})")
+
+    def worker_log_tail(self, w: Worker, n: int = 2000) -> str:
+        try:
+            with open(w.log_path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                fh.seek(max(0, fh.tell() - n))
+                return fh.read().decode(errors="replace")
+        except OSError:
+            return "<no log>"
+
+    def start(self) -> None:
+        """Bring the fleet up: worker 0 first (it pays the compiles
+        into the shared cache), then the AOT pre-export at the
+        measured panel width, then the rest of the fleet — warm by
+        construction."""
+        self._spawn(self.workers[0], self.model_specs)
+        self._wait_healthy(self.workers[:1])
+        stats = http_json(self.workers[0].url + "/stats", timeout=30.0)
+        self.n_max = int((stats.get("panel") or {}).get("n_max") or 0)
+        self.pre_export()
+        for w in self.workers[1:]:
+            self._spawn(w, self.model_specs)
+        if len(self.workers) > 1:
+            self._wait_healthy(self.workers[1:])
+        self._watcher = threading.Thread(
+            target=self._watch, name="pool-watcher", daemon=True)
+        self._watcher.start()
+
+    def pre_export(self) -> List[str]:
+        """Populate the AOT store from the admitted model specs (one
+        artifact per alias; checkpoint dirs export, artifact files
+        copy in). Failures are logged, not fatal — the store is a
+        respawn accelerator, the original specs remain the fallback."""
+        done = []
+        for spec in self.model_specs:
+            try:
+                if os.path.isdir(spec):
+                    if not self.n_max:
+                        raise PoolError(
+                            "panel width unknown; start() reads it "
+                            "off worker 0's /stats before exporting")
+                    done.append(self.store.export_checkpoint(
+                        spec, self.n_max))
+                else:
+                    done.append(self.store.adopt_artifact(spec))
+            except Exception as e:
+                timeline_event("aot_export_failed", cat="serve",
+                               resource="pool", spec=spec,
+                               error=str(e))
+        return done
+
+    # ---- health / routing view -------------------------------------------
+
+    def healthy_ids(self) -> List[str]:
+        with self._lock:
+            return [w.wid for w in self.workers
+                    if w.state in ("ok", "degraded")]
+
+    def worker(self, wid: str) -> Worker:
+        with self._lock:
+            for w in self.workers:
+                if w.wid == wid:
+                    return w
+        raise PoolError(f"unknown worker {wid!r}")
+
+    def note_failure(self, wid: str) -> None:
+        """Router-observed forward failure: stop routing to the worker
+        until the watcher's next scrape clears it (or its death is
+        confirmed and the respawn path takes over)."""
+        with self._lock:
+            for w in self.workers:
+                if w.wid == wid:
+                    w.fails += 1
+                    if w.fails >= 1 and w.state in ("ok", "degraded"):
+                        w.state = "failing"
+                    return
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "workers": [w.describe() for w in self.workers],
+                "healthy": sum(1 for w in self.workers
+                               if w.state in ("ok", "degraded")),
+                "respawns": self.respawns,
+                "kills": self.kills,
+                "admits_fanned_out": len(self._admit_log),
+                "aot_store": self.store.root,
+                "compile_cache": self.cache_dir,
+                "n_max": self.n_max,
+                "draining": self._draining,
+            }
+
+    # ---- rolling admit fan-out -------------------------------------------
+
+    def admit_fanout(self, payload: dict,
+                     timeout: float = 600.0) -> dict:
+        """Rolling `/admit` across the fleet: refresh the AOT store
+        from the candidate checkpoint first (a respawn after this
+        promotion must serve the NEW bytes), then admit worker by
+        worker — each performs its own fidelity gate + zero-downtime
+        alias flip. The admission is recorded so respawned workers
+        replay it. Returns per-worker responses; `ok` is the AND."""
+        payload = dict(payload)
+        path = payload.get("path")
+        if isinstance(path, str) and os.path.isdir(path) and self.n_max:
+            try:
+                self.store.export_checkpoint(path, self.n_max)
+            except Exception as e:
+                timeline_event("aot_export_failed", cat="serve",
+                               resource="pool", spec=path,
+                               error=str(e))
+        with self._lock:
+            self._admit_log.append(payload)
+            targets = [(w.wid, w.url) for w in self.workers]
+        results = []
+        for wid, url in targets:
+            try:
+                resp = http_json(url + "/admit", payload,
+                                 timeout=timeout)
+            except Exception as e:
+                resp = {"ok": False, "error": str(e)}
+            results.append({"worker": wid, **(resp or {})})
+        with self._lock:
+            for w in self.workers:
+                # live workers just got it; respawns replay from here
+                w.admits_replayed = len(self._admit_log)
+        ok = all(r.get("ok") for r in results)
+        timeline_event("admit_fanout", cat="serve", resource="pool",
+                       alias=payload.get("alias"), ok=ok,
+                       workers=len(results))
+        return {"ok": ok, "alias": payload.get("alias", "prod"),
+                "workers": results}
+
+    def _replay_admits(self, w: Worker) -> None:
+        """Post-respawn catch-up: the worker restarted from startup
+        specs/artifacts; any fan-out admissions since then replay in
+        order so its aliases land on the same generation as the rest
+        of the fleet."""
+        with self._lock:
+            todo = self._admit_log[w.admits_replayed:]
+            already = w.admits_replayed
+        for i, payload in enumerate(todo):
+            try:
+                http_json(w.url + "/admit", payload, timeout=600.0)
+            except Exception as e:
+                timeline_event("admit_replay_failed", cat="serve",
+                               resource="pool", worker=w.wid,
+                               error=str(e))
+                break
+            with self._lock:
+                w.admits_replayed = already + i + 1
+
+    # ---- the watcher -----------------------------------------------------
+
+    def _watch(self) -> None:
+        """Respawn-on-death + health scraping, one bounded pass per
+        interval. Runs until stop(); joined there (and writes no files
+        itself), so process exit never tears its work."""
+        while True:
+            with self._lock:
+                if self._draining:
+                    return
+                snapshot = list(self.workers)
+            for w in snapshot:
+                self._watch_one(w)
+            time.sleep(self.health_interval_s)
+
+    def _watch_one(self, w: Worker) -> None:
+        with self._lock:
+            proc, state = w.proc, w.state
+            draining = self._draining
+        if proc is None or draining:
+            return
+        # Chaos injection point (request = worker index): SIGKILL the
+        # worker mid-tick; the recovery exercised is the router's
+        # reroute plus THIS watcher's respawn-from-AOT-store.
+        if chaos_fault("kill_worker", request=w.index) is not None:
+            proc.kill()
+            proc.wait(timeout=30)
+            with self._lock:
+                self.kills += 1
+            timeline_event("chaos_kill_worker", cat="recovery",
+                           resource="pool", worker=w.wid)
+        if proc.poll() is not None:
+            with self._lock:
+                w.state = "dead"
+                w.last_health = None
+                do_respawn = self.respawn and not self._draining
+                if do_respawn:
+                    self.respawns += 1
+            timeline_event("worker_dead", cat="recovery",
+                           resource="pool", worker=w.wid,
+                           rc=proc.returncode, respawn=do_respawn)
+            if not do_respawn:
+                return
+            models, source = self._respawn_models()
+            self._spawn(w, models)
+            with self._lock:
+                w.restarts += 1
+                w.respawn_source = source
+            timeline_event("worker_respawn", cat="recovery",
+                           resource="pool", worker=w.wid,
+                           source=source)
+            return
+        try:
+            health = http_json(w.url + "/healthz", timeout=2.0)
+        except (OSError, ValueError, PoolError):
+            # unreachable/slow: strikes accrue toward "failing"
+            with self._lock:
+                w.fails += 1
+                if (w.fails >= self.SCRAPE_FAILS_FAILING
+                        and w.state != "starting"):
+                    w.state = "failing"
+            return
+        status = str(health.get("status", "failing"))
+        with self._lock:
+            w.fails = 0
+            w.last_health = health
+            was_starting = state == "starting"
+            w.state = status if status in (
+                "ok", "degraded", "failing") else "failing"
+            needs_replay = (w.restarts > 0 and w.state == "ok"
+                            and w.admits_replayed < len(self._admit_log))
+        if was_starting and w.restarts > 0:
+            timeline_event("worker_recovered", cat="recovery",
+                           resource="pool", worker=w.wid,
+                           restarts=w.restarts)
+        if needs_replay:
+            self._replay_admits(w)
+
+    # ---- scrapes for the router ------------------------------------------
+
+    def scrape_metrics(self, w: Worker, timeout: float = 10.0) -> str:
+        return http_text(w.url + "/metrics", timeout=timeout)
+
+    # ---- shutdown --------------------------------------------------------
+
+    def stop(self, drain_timeout_s: float = 30.0) -> None:
+        """SIGTERM fan-out drain: every worker finishes its in-flight
+        tick, flushes its streams and exits (the daemon's documented
+        drain); stragglers are killed after the timeout. The watcher
+        is stopped FIRST so a draining worker is never respawned.
+        Idempotent."""
+        with self._lock:
+            self._draining = True
+        if self._watcher is not None and self._watcher.is_alive():
+            # The watcher emits timeline records; it is joined on
+            # every stop path so process exit never tears its writes
+            # (graftlint JGL011). First attempt bounded — the watcher
+            # may be blocked in an admit replay against a worker we
+            # are about to kill.
+            self._watcher.join(timeout=max(10.0,
+                                           self.health_interval_s * 4))
+        with self._lock:
+            procs = [(w, w.proc) for w in self.workers
+                     if w.proc is not None]
+        for _, proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + drain_timeout_s
+        for w, proc in procs:
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30)
+            with self._lock:
+                w.state = "dead"
+        if self._watcher is not None:
+            # Dead workers reset any HTTP call the watcher was blocked
+            # on; the second join must land. A watcher that is STILL
+            # alive stays referenced so a later stop() can re-join —
+            # never orphaned while claimed joined.
+            if self._watcher.is_alive():
+                self._watcher.join(timeout=30)
+            if not self._watcher.is_alive():
+                self._watcher = None
+        timeline_event("pool_stop", cat="serve", resource="pool",
+                       workers=len(procs))

@@ -1,0 +1,525 @@
+"""HTTP router over a worker fleet: sticky routing, shedding, fleet
+telemetry.
+
+The thin tier that turns N `ScoringDaemon` workers (serve/pool.py)
+into ONE serving endpoint (ISSUE 15):
+
+- **Config-hash-sticky routing.** Scoring requests route by their
+  `model` name (registry key or alias) through BOUNDED-LOAD RENDEZVOUS
+  hashing over the currently-healthy workers: the sticky owner is the
+  first candidate in the key's highest-random-weight ranking with
+  spare sticky capacity (bound = ceil(assigned_keys / healthy), the
+  c=1 consistent-hashing-with-bounded-loads rule — pure rendezvous
+  skews badly at registry-sized key counts, and a 4:0 split is a fleet
+  that does not scale). Each model's traffic concentrates on ONE
+  worker — its warm registry entry, compiled programs and drift chain
+  live in one place instead of N — assignments are cached sticky, and
+  removing a worker remaps ONLY its own keys (the rendezvous
+  property), so a death never cold-shuffles the whole fleet. The
+  ranked candidate list doubles as the failover order: a forward that
+  fails mid-flight reroutes to the next candidate and marks the worker
+  for the pool's watcher.
+
+- **Load shedding.** The router answers 503 with `retry_after_s` (and
+  a `Retry-After` header) instead of queueing unboundedly: when the
+  in-flight request count crosses `max_inflight`, or when every
+  candidate worker for a request is failing/dead. Shed responses are
+  `{"ok": false, "error": ..., "retry_after_s": ...}` — the same
+  fast-fail shape the daemon's circuit breaker speaks.
+
+- **Fleet telemetry.** `GET /metrics` scrapes every live worker's
+  exposition, relabels each family with `worker_id`, merges them under
+  single HELP/TYPE headers (obs/metrics.merge_expositions) and
+  prepends the router's own families (`factorvae_router_*`).
+  `GET /stats` carries the router counters plus the pool's worker
+  table — per-worker scrape URLs included, so an operator can always
+  reach a single worker directly. `GET /healthz` aggregates: 200
+  while any worker is healthy, 503 when the fleet is failing or
+  draining.
+
+- **Fan-out admit.** `POST /admit` delegates to
+  `pool.admit_fanout` — AOT-store refresh + rolling per-worker
+  fidelity-gated alias flips (docs/walkforward.md).
+
+Requests the router cannot attribute to a model (`cmd` requests)
+route to the rendezvous owner of the literal key `#cmd` — stable, and
+shutdown-by-cmd is deliberately NOT fanned out (stopping the fleet is
+the pool's drain, not a client request).
+
+Threading: ThreadingHTTPServer — each client connection is handled on
+its own thread, forwarding to workers concurrently. All router
+counters live behind `self._lock`; the worker table is read through
+the pool's own lock. The SIGTERM drain keeps the daemon's shape: the
+handler only sets an Event, the serve loop promotes it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import threading
+from typing import List, Optional
+
+from factorvae_tpu.serve.pool import WorkerPool
+from factorvae_tpu.utils.logging import timeline_event
+
+
+def rendezvous_order(key: str, worker_ids: List[str]) -> List[str]:
+    """Workers ranked by highest-random-weight hash for `key`: the
+    first is the sticky owner, the rest the failover order. Properties
+    the fleet relies on: deterministic across processes (sha256, no
+    process-seeded hashing), and MINIMAL disruption — removing a
+    worker only remaps keys it owned; every other key keeps its
+    owner."""
+
+    def weight(wid: str) -> int:
+        h = hashlib.sha256(f"{key}|{wid}".encode()).digest()
+        return int.from_bytes(h[:8], "big")
+
+    return sorted(worker_ids, key=lambda w: (-weight(w), w))
+
+
+class Router:
+    """Routing/shedding state over one `WorkerPool`. `serve()` runs
+    the blocking CLI loop; `start()`/`stop()` run it on an internal
+    thread (bench + tests). `max_inflight=0` disables the depth
+    shed."""
+
+    def __init__(self, pool: WorkerPool, max_inflight: int = 64,
+                 shed_retry_s: float = 1.0,
+                 forward_timeout_s: float = 600.0):
+        self.pool = pool
+        self.max_inflight = int(max_inflight)
+        self.shed_retry_s = float(shed_retry_s)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.forwarded = 0
+        self.shed = 0
+        self.reroutes = 0
+        self.proxy_errors = 0
+        self.inflight = 0
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        # Checked-out/checked-in persistent worker connections:
+        # forwarding over keep-alive halves the TCP setups per routed
+        # request, and a shared pool (vs thread-locals) lets the
+        # per-group forwarding threads reuse them too. A connection is
+        # only ever held by one forward at a time.
+        self._conns: dict = {}
+        # Sticky owner cache: model key -> worker id. Guarded by
+        # _lock; entries for no-longer-healthy workers re-place
+        # lazily through the bounded-load rule.
+        self._assign: dict = {}
+
+    def _candidates(self, key: str, healthy: List[str]) -> List[str]:
+        """The key's forward order: sticky owner first (cached, else
+        placed by bounded-load rendezvous), then the rendezvous
+        ranking as failover. Placement takes the first candidate whose
+        sticky-key count is under ceil(keys / workers) — each model
+        lives on ONE worker, and no worker owns more than its fair
+        share plus the rounding key."""
+        if not healthy:
+            return []
+        order = rendezvous_order(key, healthy)
+        with self._lock:
+            wid = self._assign.get(key)
+            if wid not in healthy:
+                counts = {w: 0 for w in healthy}
+                live = 0
+                for w in self._assign.values():
+                    if w in counts:
+                        counts[w] += 1
+                        live += 1
+                bound = -(-(live + 1) // len(healthy))  # ceil
+                wid = next((w for w in order if counts[w] < bound),
+                           order[0])
+                self._assign[key] = wid
+        order.remove(wid)
+        return [wid] + order
+
+    # ---- routing ---------------------------------------------------------
+
+    def _shed_response(self, why: str) -> dict:
+        with self._lock:
+            self.shed += 1
+        return {"ok": False,
+                "error": f"router shedding load: {why}; retry in "
+                         f"{self.shed_retry_s:g}s",
+                "retry_after_s": self.shed_retry_s}
+
+    def route_batch(self, requests: list) -> list:
+        """Answer one client submission: group scoring requests by
+        their sticky worker, forward each group, merge responses in
+        request order. Per-request failures (no healthy candidate,
+        every forward failed) answer in place — one sick model's
+        routing must not 503 the rest of the batch."""
+        healthy = self.pool.healthy_ids()
+        groups: dict = {}
+        responses: list = [None] * len(requests)
+        for i, req in enumerate(requests):
+            if isinstance(req, dict) and "_parse_error" in req:
+                responses[i] = {"id": None, "ok": False,
+                                "error": req["_parse_error"]}
+                continue
+            key = "#cmd"
+            if isinstance(req, dict) and req.get("model"):
+                key = str(req["model"])
+            order = self._candidates(key, healthy)
+            if not order:
+                responses[i] = self._shed_response(
+                    "no healthy worker")
+                continue
+            groups.setdefault(tuple(order), []).append((i, req))
+        group_list = list(groups.items())
+        # Fan the groups out CONCURRENTLY — a mixed-model batch split
+        # over two workers must run on both at once, not serialize the
+        # fleet through one proxy thread (the first group rides this
+        # thread; responses slots are disjoint per group).
+        threads = [threading.Thread(
+            target=self._forward_group,
+            args=(list(order), items, responses),
+            name="router-forward")
+            for order, items in group_list[1:]]
+        for t in threads:
+            t.start()
+        if group_list:
+            order, items = group_list[0]
+            self._forward_group(list(order), items, responses)
+        for t in threads:
+            t.join()
+        return responses
+
+    def _forward(self, wid: str, port: int, body: bytes):
+        """POST one group to a worker over a pooled persistent
+        connection (fresh one on first use or after any failure — a
+        respawned worker keeps its port, so a stale socket heals on
+        the retry)."""
+        import http.client
+
+        last = None
+        for fresh in (False, True):
+            conn = None
+            if not fresh:
+                with self._lock:
+                    stack = self._conns.get(wid)
+                    if stack:
+                        conn = stack.pop()
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=self.forward_timeout_s)
+            try:
+                conn.request("POST", "/score", body=body, headers={
+                    "Content-Type": "application/json"})
+                resp = conn.getresponse()
+                out = json.loads(resp.read().decode() or "null")
+            except (OSError, ValueError, http.client.HTTPException) \
+                    as e:
+                last = e
+                with contextlib.suppress(OSError):
+                    conn.close()
+                continue
+            with self._lock:
+                stack = self._conns.setdefault(wid, [])
+                if len(stack) < 16:
+                    stack.append(conn)
+                    conn = None
+            if conn is not None:
+                conn.close()
+            return out
+        raise last
+
+    def _forward_group(self, order: List[str], items: list,
+                       responses: list) -> None:
+        body = json.dumps([req for _, req in items]).encode()
+        for attempt, wid in enumerate(order):
+            worker = self.pool.worker(wid)
+            try:
+                out = self._forward(wid, worker.port, body)
+            except Exception as e:
+                # Transport failure: the worker just died or hung —
+                # tell the pool, reroute to the next candidate.
+                with self._lock:
+                    self.proxy_errors += 1
+                    if attempt + 1 < len(order):
+                        self.reroutes += 1
+                self.pool.note_failure(wid)
+                timeline_event("router_reroute", cat="serve",
+                               resource="router", worker=wid,
+                               error=str(e)[:200])
+                continue
+            if isinstance(out, dict):
+                out = [out]
+            if not isinstance(out, list) or len(out) != len(items):
+                with self._lock:
+                    self.proxy_errors += 1
+                continue
+            with self._lock:
+                self.forwarded += len(items)
+            for (i, _), resp in zip(items, out):
+                if isinstance(resp, dict):
+                    resp.setdefault("worker", wid)
+                responses[i] = resp
+            return
+        shed = self._shed_response("every candidate worker failed")
+        for i, _ in items:
+            responses[i] = dict(shed)
+
+    # ---- telemetry -------------------------------------------------------
+
+    def healthz(self) -> dict:
+        pool = self.pool.stats()
+        healthy, total = pool["healthy"], len(pool["workers"])
+        if pool["draining"]:
+            status = "draining"
+        elif healthy == 0:
+            status = "failing"
+        elif healthy < total:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {"status": status,
+                "ok": status in ("ok", "degraded"),
+                "workers_healthy": healthy, "workers": total}
+
+    def stats(self) -> dict:
+        with self._lock:
+            router = {
+                "requests": self.requests,
+                "forwarded": self.forwarded,
+                "shed": self.shed,
+                "reroutes": self.reroutes,
+                "proxy_errors": self.proxy_errors,
+                "inflight": self.inflight,
+                "max_inflight": self.max_inflight,
+            }
+        return {"router": router, "health": self.healthz(),
+                "pool": self.pool.stats()}
+
+    def metrics(self) -> str:
+        """The fleet-level exposition: router families first, then
+        every reachable worker's `/metrics` relabeled with its
+        `worker_id` and merged under single family headers."""
+        from factorvae_tpu.obs.metrics import (
+            PREFIX,
+            merge_expositions,
+            metric_line,
+        )
+
+        pool = self.pool.stats()
+        with self._lock:
+            counters = [("requests_total", "counter",
+                         "client requests through the router",
+                         self.requests),
+                        ("forwarded_total", "counter",
+                         "requests forwarded to a worker",
+                         self.forwarded),
+                        ("shed_total", "counter",
+                         "requests shed with 503 + retry_after",
+                         self.shed),
+                        ("reroutes_total", "counter",
+                         "forwards retried on a failover candidate",
+                         self.reroutes),
+                        ("proxy_errors_total", "counter",
+                         "worker forwards that failed",
+                         self.proxy_errors),
+                        ("inflight", "gauge",
+                         "client requests currently in flight",
+                         self.inflight)]
+        fam = [(f"{PREFIX}_router_{n}", typ, help_,
+                [metric_line(f"{PREFIX}_router_{n}", v)])
+               for n, typ, help_, v in counters]
+        fam.append((f"{PREFIX}_router_workers", "gauge",
+                    "pool workers by liveness",
+                    [metric_line(f"{PREFIX}_router_workers",
+                                 pool["healthy"],
+                                 {"state": "healthy"}),
+                     metric_line(f"{PREFIX}_router_workers",
+                                 len(pool["workers"]),
+                                 {"state": "total"})]))
+        fam.append((f"{PREFIX}_router_respawns_total", "counter",
+                    "workers respawned by the pool watcher",
+                    [metric_line(f"{PREFIX}_router_respawns_total",
+                                 pool["respawns"])]))
+        parts = []
+        for w in pool["workers"]:
+            if w["state"] == "dead":
+                continue
+            try:
+                text = self.pool.scrape_metrics(
+                    self.pool.worker(w["worker_id"]))
+            except Exception as e:
+                # a mid-scrape worker death drops ITS families only;
+                # the merged exposition carries the rest
+                timeline_event("router_scrape_failed", cat="serve",
+                               resource="router",
+                               worker=w["worker_id"],
+                               error=str(e)[:200])
+                continue
+            parts.append(({"worker_id": w["worker_id"]}, text))
+        return merge_expositions(parts, extra_families=fam)
+
+    # ---- HTTP front ------------------------------------------------------
+
+    def _build_server(self, port: int, host: str):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from factorvae_tpu.serve.daemon import _parse_line
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Threaded front + Content-Length on every response:
+            # keep-alive is safe and saves a TCP setup per request.
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code: int, payload,
+                      retry_after: Optional[float] = None) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header("Retry-After",
+                                     f"{retry_after:g}")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                if self.path == "/healthz":
+                    health = router.healthz()
+                    self._send(200 if health["ok"] else 503, health)
+                elif self.path == "/stats":
+                    self._send(200, router.stats())
+                elif self.path == "/metrics":
+                    from factorvae_tpu.obs.metrics import CONTENT_TYPE
+
+                    body = router.metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._send(404, {
+                        "ok": False,
+                        "error": f"unknown path {self.path} (router "
+                                 f"serves /score /admit /stats "
+                                 f"/metrics /healthz)"})
+
+            def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                if self.path not in ("/score", "/admit"):
+                    self._send(404, {"ok": False,
+                                     "error": f"unknown path "
+                                              f"{self.path}"})
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                requests = _parse_line(self.rfile.read(n).decode())
+                if self.path == "/admit":
+                    req = requests[0] if requests else {}
+                    if not (isinstance(req, dict)
+                            and isinstance(req.get("path"), str)):
+                        self._send(400, {
+                            "ok": False,
+                            "error": "POST /admit wants {\"path\": "
+                                     "\"<checkpoint dir>\", "
+                                     "\"alias\": \"<alias>\"}; the "
+                                     "router fans it out to every "
+                                     "worker"})
+                        return
+                    self._send(200, router.pool.admit_fanout(req))
+                    return
+                single = (len(requests) == 1)
+                with router._lock:
+                    router.requests += len(requests)
+                    overloaded = (router.max_inflight > 0
+                                  and router.inflight
+                                  >= router.max_inflight)
+                    if not overloaded:
+                        router.inflight += 1
+                if overloaded:
+                    shed = router._shed_response(
+                        f"inflight >= {router.max_inflight}")
+                    self._send(503, shed if single
+                               else [dict(shed) for _ in requests],
+                               retry_after=router.shed_retry_s)
+                    return
+                try:
+                    responses = router.route_batch(requests)
+                finally:
+                    with router._lock:
+                        router.inflight -= 1
+                if single and isinstance(responses[0], dict) \
+                        and responses[0].get("retry_after_s") \
+                        and "shedding" in str(
+                            responses[0].get("error", "")):
+                    self._send(503, responses[0],
+                               retry_after=router.shed_retry_s)
+                    return
+                self._send(200, responses if not single
+                           else responses[0])
+
+            def log_message(self, fmt, *args):  # stderr stays quiet
+                timeline_event("router_http", cat="serve",
+                               resource="router", line=fmt % args)
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        server.timeout = 0.25
+        return server
+
+    def serve(self, port: int, host: str = "127.0.0.1") -> None:
+        """The CLI loop: blocks until SIGTERM (drain: stop accepting,
+        stop the pool) — the daemon's set-flag-and-return SIGTERM
+        shape, promoted to a fleet-wide drain in main-line code."""
+        from factorvae_tpu.serve.daemon import _drain_on_sigterm
+
+        server = self._build_server(port, host)
+        self.port = port
+
+        class _Stub:
+            # _drain_on_sigterm only needs somewhere to hang the flag
+            closing = False
+
+            def request_drain(self):
+                self.closing = True
+
+        stub = _Stub()
+        with _drain_on_sigterm(stub) as term:
+            try:
+                while not stub.closing:
+                    if term.is_set():
+                        stub.request_drain()
+                        break
+                    server.handle_request()
+            finally:
+                server.server_close()
+                self.pool.stop()
+
+    def start(self, port: Optional[int] = None,
+              host: str = "127.0.0.1") -> int:
+        """Serve on an internal thread (bench/tests); returns the
+        port. `stop()` shuts the server down and joins the thread."""
+        from factorvae_tpu.serve.pool import free_port
+
+        port = port or free_port()
+        server = self._build_server(port, host)
+        self._server = server
+        self.port = port
+        self._thread = threading.Thread(
+            target=server.serve_forever, kwargs={"poll_interval": 0.1},
+            name="router-http")
+        self._thread.start()
+        return port
+
+    def stop(self, stop_pool: bool = True) -> None:
+        server, thread = self._server, self._thread
+        self._server = self._thread = None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=30)
+        if stop_pool:
+            self.pool.stop()

@@ -125,6 +125,17 @@ STREAM_CHUNK_CANDIDATES = [16, 32, 64]
 # is a thin shim over the same race (one variant per rung).
 SERVE_PRECISIONS = ["float32", "bfloat16", "int8"]
 SERVE_FIDELITY_FLOOR = 0.99
+# --serve also races the continuous-batching scheduler window
+# (serve/daemon.TickScheduler, ISSUE 15) under a closed-loop
+# concurrent client load at the winning rung: how long an under-full
+# tick holds open for late arrivals. 0 (dispatch immediately) is
+# always in the raced set, so a persisted window can never regress a
+# low-concurrency deployment below the immediate path; the winner
+# lands in the row's `serve` block as `tick_ms`/`max_tick_batch`
+# (plan_for -> Plan.serve_tick_ms / Plan.serve_max_tick_batch).
+SERVE_TICK_CANDIDATES = [0.0, 2.0, 10.0]
+SERVE_TICK_CLIENTS = 4
+SERVE_TICK_MAX_BATCH = 64
 # --mesh: mesh-shape race on the winning train knobs — every
 # (data x stock) factorization of the visible devices, with the no-mesh
 # serial path always in the raced set (a persisted "mesh" block can
@@ -459,14 +470,86 @@ def race_serve(name: str, shape: dict, score_knobs: dict,
         eligible = corr == corr and corr >= SERVE_FIDELITY_FLOOR
         if eligible and (best_wps is None or wps > best_wps):
             best, best_wps = prec, wps
+    tick_block = race_serve_tick(name, cfg, state.params, reg, ds,
+                                 day_idx, best, reps, logger=logger)
     return {
         "precision": best,
+        "tick_ms": tick_block["tick_ms"],
+        "max_tick_batch": tick_block["max_tick_batch"],
         "measured": measured,
         "fidelity": fidelity,
+        "tick_measured": tick_block["measured"],
         "source": f"serve precision race on score "
                   f"flat={int(score_knobs['flatten_days'])}: best {best} "
                   f"at {best_wps:,.0f} w/s (rank-fidelity floor "
-                  f"{SERVE_FIDELITY_FLOOR})",
+                  f"{SERVE_FIDELITY_FLOOR}); {tick_block['source']}",
+    }
+
+
+def race_serve_tick(name: str, cfg, params, reg, ds, day_idx,
+                    precision: str, reps: int, logger=None) -> dict:
+    """Race the continuous-batching window (TickScheduler's tick_ms)
+    under a closed-loop concurrent client load: SERVE_TICK_CLIENTS
+    threads hammer two model variants of the winning rung with
+    same-day single requests through the scheduler queue — the fleet
+    worker's request shape (ISSUE 15). QPS decides; 0ms (immediate
+    dispatch) is always raced."""
+    import dataclasses
+    import threading
+
+    from factorvae_tpu.serve.daemon import ScoringDaemon, TickScheduler
+
+    cfg2 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train,
+                                       seed=cfg.train.seed + 1000))
+    keys = [
+        reg.register_params(params, cfg, precision=precision),
+        reg.register_params(params, cfg2, precision=precision),
+    ]
+    daemon = ScoringDaemon(reg, ds)
+    day = int(day_idx[-1])
+    per_client = max(10, 5 * reps)
+    measured = {}
+    best_tick, best_qps = SERVE_TICK_CANDIDATES[0], None
+    for tick in SERVE_TICK_CANDIDATES:
+        sched = TickScheduler(daemon, tick_ms=tick,
+                              max_tick_batch=SERVE_TICK_MAX_BATCH)
+        try:
+            def client(tid, n):
+                for i in range(n):
+                    sched.submit([{"model": keys[(tid + i) % 2],
+                                   "day": day, "top": 3}])
+
+            # warmup: compile the fused fleet programs this load fuses
+            warm = [threading.Thread(target=client, args=(t, 4))
+                    for t in range(SERVE_TICK_CLIENTS)]
+            for t in warm:
+                t.start()
+            for t in warm:
+                t.join()
+            threads = [threading.Thread(target=client,
+                                        args=(t, per_client))
+                       for t in range(SERVE_TICK_CLIENTS)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            qps = SERVE_TICK_CLIENTS * per_client / (time.time() - t0)
+        finally:
+            sched.close()
+        measured[f"tick{tick:g}ms"] = round(qps, 1)
+        _log(logger, "autotune_serve_tick_candidate", shape=name,
+             tick_ms=tick, qps=round(qps, 1))
+        if best_qps is None or qps > best_qps:
+            best_tick, best_qps = tick, qps
+    return {
+        "tick_ms": best_tick,
+        "max_tick_batch": SERVE_TICK_MAX_BATCH,
+        "measured": measured,
+        "source": f"scheduler race ({SERVE_TICK_CLIENTS} concurrent "
+                  f"clients, {precision}): best tick_ms={best_tick:g} "
+                  f"at {best_qps:,.0f} req/s",
     }
 
 
@@ -715,7 +798,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         measured["stream"] = stream_block.pop("measured")
     if serve_block is not None:
         measured["serve"] = {"rates": serve_block.pop("measured"),
-                             "fidelity": serve_block.pop("fidelity")}
+                             "fidelity": serve_block.pop("fidelity"),
+                             "tick": serve_block.pop("tick_measured")}
     if mesh_block is not None:
         measured["mesh"] = mesh_block.pop("measured")
     row = {
@@ -748,11 +832,17 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         row["source"] += f"; {stream_block['source']}"
     if serve_block is not None:
         row["source"] += f"; {serve_block['source']}"
+        # f32 winners persist NO precision key (the conservative
+        # default — plan_for resolves an absent key to float32, which
+        # is bitwise the offline scan), same rule as no-mesh winners.
+        # The scheduler knobs (ISSUE 15) always persist: they are
+        # precision-independent and 0ms is itself a measured winner.
+        row["serve"] = {
+            "tick_ms": serve_block["tick_ms"],
+            "max_tick_batch": serve_block["max_tick_batch"],
+        }
         if serve_block["precision"] != "float32":
-            # f32 winners persist NO block (the conservative default —
-            # plan_for resolves absent blocks to float32, which is
-            # bitwise the offline scan), same rule as no-mesh winners.
-            row["serve"] = {"precision": serve_block["precision"]}
+            row["serve"]["precision"] = serve_block["precision"]
     if mesh_block is not None:
         row["source"] += f"; {mesh_block['source']}"
         if mesh_block["data_axis"] > 0 and mesh_block["stock_axis"] > 0:

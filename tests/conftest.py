@@ -82,6 +82,13 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # refit-bitwise-plain-warm-start pin) plus the registry re-admission
 # version-bump; the subprocess SIGKILL-at-each-boundary resume rigs
 # stay slow (test_wf.py in _SLOW_FILES).
+# The ISSUE-15 router/pool classes are quick BY DESIGN: tier-1 must
+# exercise the scale-out tier — bounded-load rendezvous routing, the
+# exposition relabel/merge, cross-tick continuous batching, and one
+# REAL 2-worker fleet (zero-compile fleet join, sticky routing, fleet
+# /metrics, fan-out /admit, kill -> reroute -> respawn-from-AOT-store)
+# — on every run; the fleet's subprocess startup is paid once per
+# class (test_pool.py).
 _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestLockOrderRecorder", "TestLockOrderTier1",
                   "TestComposeValidate", "TestComposedOracles",
@@ -99,7 +106,9 @@ _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestHyperObsLabels",
                   "TestCycleJournal", "TestPanelStore",
                   "TestExtendDays", "TestAdmitGate",
-                  "TestWalkForwardCycle", "TestReadmission"}
+                  "TestWalkForwardCycle", "TestReadmission",
+                  "TestRendezvous", "TestExpositionMerge",
+                  "TestTickScheduler", "TestWorkerFleetE2E"}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,373 @@
+"""Serving scale-out (ISSUE 15): router + worker-fleet tier.
+
+The contracts pinned here are the acceptance bar of the scale-out PR:
+- bounded-load rendezvous routing: deterministic, sticky, minimally
+  disruptive on worker loss, and BALANCED at registry-sized key counts
+  (pure rendezvous can skew 4:0 — the fleet that does not scale);
+- exposition relabel/merge: every worker family gains `worker_id` and
+  merges under ONE HELP/TYPE header (valid exposition);
+- cross-tick continuous batching: concurrent submissions fuse into
+  shared `handle_batch` ticks, response order mirrors request order,
+  close() drains instead of stranding blocked clients;
+- the ZERO-COMPILE fleet-join contract: worker N+1 joining a warm pool
+  scrapes `compile == 0, compile_cached > 0` (the PR-10 warm-restart
+  scrape, extended from restarts to pool joins);
+- router end-to-end over a real 2-worker pool: sticky /score, /stats
+  with per-worker scrape URLs, fleet /metrics with worker_id labels,
+  fan-out /admit, and kill -> reroute -> respawn-from-AOT-store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel_dense
+from factorvae_tpu.models.factorvae import load_model
+from factorvae_tpu.serve.daemon import ScoringDaemon, TickScheduler
+from factorvae_tpu.serve.registry import ModelRegistry
+from factorvae_tpu.serve.router import Router, rendezvous_order
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(num_features=6, hidden_size=8, num_factors=4,
+            num_portfolios=8, seq_len=5)
+
+
+def tiny_cfg(seed: int = 0) -> Config:
+    return Config(
+        model=ModelConfig(stochastic_inference=False, **TINY),
+        data=DataConfig(seq_len=TINY["seq_len"], start_time=None,
+                        fit_end_time=None, val_start_time=None,
+                        val_end_time=None),
+        train=TrainConfig(seed=seed),
+    )
+
+
+class TestRendezvous:
+    def test_deterministic_and_total(self):
+        ids = ["w0", "w1", "w2", "w3"]
+        a = rendezvous_order("model-a", ids)
+        assert a == rendezvous_order("model-a", list(reversed(ids)))
+        assert sorted(a) == sorted(ids)
+
+    def test_minimal_disruption_on_worker_loss(self):
+        """Removing one worker remaps ONLY the keys it owned; every
+        other key keeps its owner — the rendezvous property the
+        respawn path relies on."""
+        ids = [f"w{i}" for i in range(4)]
+        keys = [f"cfg{i:03d}" for i in range(64)]
+        before = {k: rendezvous_order(k, ids)[0] for k in keys}
+        survivors = [w for w in ids if w != "w2"]
+        after = {k: rendezvous_order(k, survivors)[0] for k in keys}
+        for k in keys:
+            if before[k] != "w2":
+                assert after[k] == before[k]
+
+    def test_bounded_load_placement_balances(self):
+        """Router._candidates applies the c=1 bounded-load rule: no
+        worker owns more than ceil(keys / workers) sticky keys — even
+        for adversarial key sets where pure rendezvous skews."""
+        import types
+
+        router = Router(types.SimpleNamespace(), max_inflight=0)
+        healthy = ["w0", "w1"]
+        keys = [f"m{i}" for i in range(4)]   # skews 4:0 unbounded
+        owners = [router._candidates(k, healthy)[0] for k in keys]
+        counts = {w: owners.count(w) for w in healthy}
+        assert max(counts.values()) <= 2
+        # sticky: repeat placement answers from the cache
+        assert [router._candidates(k, healthy)[0]
+                for k in keys] == owners
+        # failover order covers every healthy worker
+        assert sorted(router._candidates("m0", healthy)) == healthy
+
+    def test_reassignment_only_on_loss(self):
+        import types
+
+        router = Router(types.SimpleNamespace(), max_inflight=0)
+        healthy = ["w0", "w1", "w2"]
+        owners = {k: router._candidates(k, healthy)[0]
+                  for k in (f"k{i}" for i in range(12))}
+        dead = "w1"
+        left = [w for w in healthy if w != dead]
+        for k, own in owners.items():
+            new = router._candidates(k, left)[0]
+            if own != dead:
+                assert new == own   # unaffected keys keep their owner
+            else:
+                assert new in left
+
+
+class TestExpositionMerge:
+    def test_inject_labels_shapes(self):
+        from factorvae_tpu.obs.metrics import inject_labels
+
+        assert inject_labels("m 1", {"worker_id": "w0"}) == \
+            'm{worker_id="w0"} 1'
+        assert inject_labels('m{a="b"} 1', {"worker_id": "w0"}) == \
+            'm{worker_id="w0",a="b"} 1'
+        assert inject_labels("m 1", {}) == "m 1"
+
+    def test_merge_single_headers_and_histograms(self):
+        from factorvae_tpu.obs.metrics import merge_expositions
+
+        w = ("# HELP f_seconds lat\n# TYPE f_seconds histogram\n"
+             'f_seconds_bucket{le="1"} 2\nf_seconds_sum 0.5\n'
+             "f_seconds_count 2\n")
+        out = merge_expositions([({"worker_id": "w0"}, w),
+                                 ({"worker_id": "w1"}, w)])
+        assert out.count("# HELP f_seconds lat") == 1
+        assert out.count("# TYPE f_seconds histogram") == 1
+        assert 'f_seconds_bucket{worker_id="w0",le="1"} 2' in out
+        assert 'f_seconds_count{worker_id="w1"} 2' in out
+        # extra families render first, once
+        out2 = merge_expositions(
+            [({"worker_id": "w0"}, w)],
+            extra_families=[("router_up", "gauge", "router liveness",
+                             ["router_up 1"])])
+        assert out2.splitlines()[0] == "# HELP router_up router liveness"
+
+
+@pytest.fixture(scope="module")
+def tiny_ds():
+    panel = synthetic_panel_dense(num_days=16, num_instruments=12,
+                                  num_features=TINY["num_features"])
+    return PanelDataset(panel, seq_len=TINY["seq_len"])
+
+
+class TestTickScheduler:
+    @pytest.fixture(scope="class")
+    def daemon(self, tiny_ds):
+        reg = ModelRegistry()
+        for s in (0, 1):
+            cfg = tiny_cfg(seed=s)
+            reg.register_params(load_model(cfg, n_max=tiny_ds.n_max)[1],
+                                cfg, alias=f"seed{s}")
+        return ScoringDaemon(reg, tiny_ds)
+
+    def test_concurrent_submissions_fuse_cross_tick(self, daemon):
+        """Two clients submitting single requests for DIFFERENT models
+        land in one scheduler tick: both answers carry batched_with=2
+        — the fused dispatch the single-threaded front could never
+        produce from separate POSTs."""
+        sched = TickScheduler(daemon, tick_ms=500.0, max_tick_batch=8)
+        try:
+            results = {}
+
+            def client(alias):
+                results[alias] = sched.submit(
+                    [{"model": alias, "day": 2}])[0]
+
+            threads = [threading.Thread(target=client, args=(a,))
+                       for a in ("seed0", "seed1")]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(r["ok"] for r in results.values()), results
+            assert [r["batched_with"]
+                    for r in results.values()] == [2, 2]
+            assert sched.fused_ticks >= 1
+        finally:
+            sched.close()
+
+    def test_order_parse_errors_and_close(self, daemon):
+        sched = TickScheduler(daemon, tick_ms=0.0, max_tick_batch=8)
+        try:
+            out = sched.submit([
+                {"id": 1, "model": "seed0", "day": 0},
+                {"_parse_error": "bad JSON: boom"},
+                {"id": 3, "model": "seed1", "day": 0},
+            ])
+            assert [r.get("id") for r in out] == [1, None, 3]
+            assert out[0]["ok"] and not out[1]["ok"] and out[2]["ok"]
+            assert "bad JSON" in out[1]["error"]
+        finally:
+            sched.close()
+        # a closed scheduler answers instead of blocking forever
+        late = sched.submit([{"model": "seed0", "day": 0}])
+        assert not late[0]["ok"] and "shutting down" in late[0]["error"]
+
+    def test_full_queue_dispatches_without_window_wait(self, daemon):
+        """Depth-awareness: a queue already at max_tick_batch must not
+        sit out the batching window."""
+        daemon.handle({"model": "seed0", "day": 1})  # warm the serial jit
+        sched = TickScheduler(daemon, tick_ms=5000.0, max_tick_batch=2)
+        try:
+            t0 = time.perf_counter()
+            out = sched.submit([{"model": "seed0", "day": 1},
+                                {"model": "seed0", "day": 1}])
+            wall = time.perf_counter() - t0
+            assert all(r["ok"] for r in out)
+            assert wall < 4.0   # far below the 5s window
+        finally:
+            sched.close()
+
+
+def _make_checkpoints(root, seeds=(0, 1)):
+    from factorvae_tpu.train.checkpoint import save_params
+
+    paths = []
+    for s in seeds:
+        cfg = tiny_cfg(seed=s)
+        params = load_model(cfg, n_max=16)[1]
+        save_params(str(root), f"m{s}", params)
+        with open(os.path.join(str(root), f"m{s}",
+                               "serve_config.json"), "w") as fh:
+            json.dump(cfg.to_dict(), fh)
+        paths.append(os.path.join(str(root), f"m{s}"))
+    return paths
+
+
+class TestWorkerFleetE2E:
+    """One real 2-worker pool + router shared across the class: the
+    subprocess startup is paid once; the tests read/kill/respawn
+    against it in order."""
+
+    @pytest.fixture(scope="class")
+    def fleet(self, tmp_path_factory):
+        from factorvae_tpu.serve.pool import WorkerPool
+
+        root = tmp_path_factory.mktemp("fleet")
+        specs = _make_checkpoints(root)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        pool = WorkerPool(
+            specs, ["--synthetic", "16,12"], 2,
+            cache_dir=str(root / "xla_cache"),
+            store_dir=str(root / "aot_store"),
+            work_dir=str(root / "pool"),
+            health_interval_s=0.2, env=env)
+        router = Router(pool)
+        try:
+            pool.start()
+            router.start()
+            yield pool, router
+        finally:
+            router.stop()
+
+    def _score(self, router, req, timeout=120.0):
+        from factorvae_tpu.serve.pool import http_json
+
+        return http_json(f"http://127.0.0.1:{router.port}/score",
+                         req, timeout=timeout)
+
+    def test_zero_compile_fleet_join(self, fleet):
+        """The cold-start contract (extends the PR-10 warm-restart
+        scrape to the pool): worker 0 BUILT the programs; worker 1
+        joined the warm fleet and deserialized everything —
+        compile==0, compile_cached>0 on its own /metrics."""
+        pool, _ = fleet
+
+        def counts(w):
+            out = {"compile": 0.0, "compile_cached": 0.0}
+            for line in pool.scrape_metrics(w).splitlines():
+                if line.startswith("factorvae_compile_total{"):
+                    kind = line.split('kind="')[1].split('"')[0]
+                    out[kind] = float(line.rsplit(" ", 1)[1])
+            return out
+
+        c0, c1 = counts(pool.workers[0]), counts(pool.workers[1])
+        assert c0["compile"] > 0, c0          # first worker built
+        assert c1["compile"] == 0, c1         # joiner built NOTHING
+        assert c1["compile_cached"] > 0, c1   # ...it deserialized
+
+    def test_routed_scoring_sticky_and_balanced(self, fleet):
+        pool, router = fleet
+        by_model = {}
+        for m in ("m0", "m1"):
+            for day in (0, 1):
+                resp = self._score(router, {"model": m, "day": day})
+                assert resp["ok"], resp
+                by_model.setdefault(m, set()).add(resp["worker"])
+        # sticky: one worker per model; bounded-load: 2 models over
+        # 2 workers land on DISTINCT workers
+        assert all(len(ws) == 1 for ws in by_model.values())
+        assert by_model["m0"] != by_model["m1"]
+
+    def test_stats_lists_worker_scrape_urls(self, fleet):
+        from factorvae_tpu.serve.pool import http_json
+
+        pool, router = fleet
+        stats = http_json(f"http://127.0.0.1:{router.port}/stats")
+        workers = stats["pool"]["workers"]
+        assert len(workers) == 2
+        for w in workers:
+            for key in ("healthz", "metrics", "stats"):
+                assert w[key].startswith("http://127.0.0.1:")
+        assert stats["router"]["forwarded"] >= 1
+        assert stats["health"]["ok"]
+
+    def test_fleet_metrics_relabeled(self, fleet):
+        import urllib.request
+
+        pool, router = fleet
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics",
+            timeout=60).read().decode()
+        for wid in ("w0", "w1"):
+            assert f'factorvae_compile_total{{worker_id="{wid}"' \
+                in text
+        # merged exposition: ONE header per family even with 2 workers
+        assert text.count("# TYPE factorvae_compile_total counter") == 1
+        assert "factorvae_router_requests_total" in text
+        assert 'factorvae_router_workers{state="healthy"} 2' in text
+
+    def test_admit_fanout_reaches_every_worker(self, fleet):
+        pool, router = fleet
+        # re-admit the same bytes behind a fresh alias: an idempotent
+        # bootstrap admission on BOTH workers (no incumbent)
+        resp = pool.admit_fanout({"path": pool.model_specs[0],
+                                  "alias": "prod"})
+        assert resp["ok"], resp
+        assert [r["worker"] for r in resp["workers"]] == ["w0", "w1"]
+        assert all(r.get("promoted") for r in resp["workers"])
+        ok = self._score(router, {"model": "prod", "day": 3})
+        assert ok["ok"], ok
+
+    def test_kill_reroute_respawn_from_store(self, fleet):
+        """SIGKILL the owner of m0 mid-fleet: the router reroutes m0
+        to the survivor immediately; the watcher respawns the worker
+        from the AOT store (zero-trace cold start) and it rejoins
+        healthy, replaying the fan-out admit."""
+        pool, router = fleet
+        owner = self._score(router, {"model": "m0", "day": 0})["worker"]
+        victim = pool.worker(owner)
+        restarts_before = victim.restarts
+        victim.proc.kill()
+        # reroute: m0 keeps answering through the survivor
+        resp = self._score(router, {"model": "m0", "day": 0})
+        assert resp["ok"], resp
+        assert resp["worker"] != owner
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            st = pool.stats()
+            w = next(x for x in st["workers"]
+                     if x["worker_id"] == owner)
+            if w["state"] == "ok" and w["restarts"] > restarts_before:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"worker {owner} never respawned: "
+                        f"{pool.stats()}")
+        assert w["respawn_source"] == "aot_store"
+        # the respawned worker serves again — including the fanned-out
+        # alias, which the watcher replays just after the rejoin (poll:
+        # the replay POST may still be in flight when state turns ok)
+        for m in ("m0", "m1"):
+            resp = self._score(router, {"model": m, "day": 1})
+            assert resp["ok"], (m, resp)
+        deadline = time.time() + 60
+        resp = None
+        while time.time() < deadline:
+            resp = self._score(router, {"model": "prod", "day": 1})
+            if resp.get("ok"):
+                break
+            time.sleep(0.2)
+        assert resp and resp.get("ok"), resp
