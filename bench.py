@@ -90,6 +90,19 @@ dropped request fails the payload). BENCH_WALKFORWARD.json + a
 `walkforward_serve_continuity` history row under --track. Same
 robustness contract.
 
+Mixed mode (`python bench.py --mixed`, or BENCH_MIXED=1): the
+training-precision A/B (ISSUE 16) — the same flagship-shape workload
+trained twice at matched planner knobs, once on the float32 oracle
+path and once on the mixed bf16 path (train/state.py: f32 master
+weights, one bf16 cast feeding forward/backward, dynamic loss
+scaling), reporting both windows/sec rates, `bf16_speedup_vs_f32`,
+the bf16 leg's final loss scale / skipped steps, and the remat audit:
+`peak_bytes` of the compiled epoch programs at remat=none vs
+remat=dots (obs/compile.py capture, observation-only). On host CPU
+there is no native bf16 unit — the A/B is a correctness/ceiling
+probe, flagged `no_native_bf16`, never a speedup claim.
+BENCH_MIXED.json carries the detail. Same robustness contract.
+
 Stream mode (`python bench.py --stream`, or BENCH_STREAM=1 with
 BENCH_STREAM_CHUNK=n): A/B the panel residency — HBM-resident
 whole-epoch scan vs the out-of-core stream path (data/stream.py,
@@ -205,6 +218,18 @@ STREAM_CHUNK_DAYS = int(os.environ.get("BENCH_STREAM_CHUNK", 0))
 # itself a tracked number (the acceptance envelope is <= 5% windows/sec
 # on the flagship shape). Same robustness contract.
 USE_OBS = os.environ.get("BENCH_OBS", "0") == "1"
+# Mixed mode (`python bench.py --mixed` or BENCH_MIXED=1): the
+# training-precision A/B (ISSUE 16). Train the flagship shape twice at
+# the same planner-resolved knobs with the MODEL dtype pinned f32 —
+# once with train.compute_dtype=float32 (the bitwise oracle trace) and
+# once with train.compute_dtype=bfloat16 (the master-weight mixed path:
+# f32 params/opt state, bf16 compute cast, dynamic loss scaling) — and
+# report both rates plus the remat audit: compiled-program peak_bytes
+# of the epoch jits at TrainConfig.remat none vs dots. The `value` is
+# the MIXED rate (the path under test). On CPU hosts bf16 is emulated
+# in f32 arithmetic, so the A/B is a correctness/ceiling probe there
+# (`no_native_bf16: true`), never a speedup claim.
+USE_MIXED = os.environ.get("BENCH_MIXED", "0") == "1"
 # Mesh mode (`python bench.py --mesh` or BENCH_MESH=1): the composed
 # scaling grid (PR 6, partition-rule sharding). For each mesh shape
 # (data x stock factorization of the visible devices) x S in
@@ -412,6 +437,8 @@ def fail_metric() -> str:
         return "stream_train_throughput_failed"
     if USE_OBS or os.environ.get("BENCH_OBS", "0") == "1":
         return "obs_train_throughput_failed"
+    if USE_MIXED or os.environ.get("BENCH_MIXED", "0") == "1":
+        return "mixed_train_throughput_failed"
     if USE_MESH or os.environ.get("BENCH_MESH", "0") == "1":
         return "mesh_train_throughput_failed"
     if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
@@ -1124,6 +1151,149 @@ def run_obs_bench() -> dict:
         "live_overhead_ok": live_overhead <= 0.05,
         "plan": plan_block,
     }
+
+
+def run_mixed_bench() -> dict:
+    """Training-precision A/B (BENCH_MIXED, ISSUE 16): the same
+    flagship-shape workload trained at matched planner knobs on the
+    float32 oracle path and on the mixed bf16 path (train/state.py:
+    f32 master weights + one bf16 compute cast + dynamic loss
+    scaling), MODEL dtype pinned f32 on both legs so the raced knob is
+    train.compute_dtype alone. Reports both windows/sec rates,
+    `bf16_speedup_vs_f32`, the bf16 leg's loss-scale telemetry, and
+    the remat audit — compiled epoch-program `peak_bytes` at
+    TrainConfig.remat none vs dots (obs/compile.py, observation-only:
+    lower+compile on abstract shapes, nothing timed runs remat). One
+    JSON line; `value` is the MIXED rate; BENCH_MIXED.json carries
+    the detail."""
+    import dataclasses
+
+    import jax
+
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    from factorvae_tpu.data import synthetic_panel_dense
+    from factorvae_tpu.obs import compile as compilelib
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    platform, _ = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    # Pin the MODEL dtype f32 for both legs: ISSUE 16 made
+    # train.compute_dtype the training-precision knob (the model knob
+    # is the serving ladder's), and the A/B must isolate it.
+    knobs = dict(knobs, compute_dtype="float32")
+    panel = synthetic_panel_dense(
+        num_days=NUM_DAYS, num_instruments=N_STOCKS,
+        num_features=NUM_FEATURES)
+
+    def leg_cfg(dtype, remat="none"):
+        cfg, ds = bench_setup(knobs, panel=panel)
+        return dataclasses.replace(cfg, train=dataclasses.replace(
+            cfg.train, compute_dtype=dtype, remat=remat)), ds
+
+    legs = {}
+    for dtype in ("float32", "bfloat16"):
+        cfg, ds = leg_cfg(dtype)
+        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = trainer.init_state()
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(0))
+        jax.block_until_ready(m["loss"])
+        days_per_epoch = float(m["days"])
+        t0 = time.time()
+        for epoch in range(1, EPOCHS_TIMED + 1):
+            state, m = trainer._train_epoch(
+                state, trainer._epoch_orders(epoch))
+        jax.block_until_ready(m["loss"])
+        dt = time.time() - t0
+        leg = {
+            "windows_per_sec": EPOCHS_TIMED * days_per_epoch * N_STOCKS / dt,
+            "final_train_loss": float(m["loss"]),
+        }
+        if dtype == "bfloat16":
+            # mixed-path telemetry: the dynamic scale the leg settled
+            # at and the updates the overflow gate skipped (both zero
+            # concern on a healthy run; a collapsed scale means the
+            # rate above was bought by shedding updates)
+            leg["final_loss_scale"] = (
+                float(state.loss_scale)
+                if getattr(state, "loss_scale", None) is not None else None)
+            leg["skipped_steps"] = (
+                float(m["skipped_steps"]) if "skipped_steps" in m else None)
+        legs[dtype] = leg
+
+    # Remat audit (observation-only): peak_bytes of the compiled epoch
+    # programs at remat=none vs remat=dots, per jit, on the mixed
+    # config — nothing here is timed, so the A/B rates above stay
+    # clean. capture_compile is guarded: a backend without
+    # memory_analysis yields nulls, never a dead payload.
+    remat_audit = {}
+    for remat in ("none", "dots"):
+        cfg, ds = leg_cfg("bfloat16", remat=remat)
+        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = trainer.init_state()
+        order = trainer._epoch_orders(0)
+        caps = {"train_epoch": compilelib.capture_compile(
+            trainer._train_epoch_jit,
+            compilelib.abstractify((state, order, trainer.panel_args())))}
+        caps["eval_epoch"] = compilelib.capture_compile(
+            trainer._eval_epoch_jit,
+            compilelib.abstractify((state.params, order,
+                                    jax.random.PRNGKey(0),
+                                    trainer.panel_args())))
+        for jit_name, cap in caps.items():
+            remat_audit.setdefault(jit_name, {})[remat] = {
+                k: cap.get(k) for k in ("peak_bytes", "temp_bytes",
+                                        "flops", "compile_s")}
+    for jit_name, by_remat in remat_audit.items():
+        before = (by_remat.get("none") or {}).get("peak_bytes")
+        after = (by_remat.get("dots") or {}).get("peak_bytes")
+        by_remat["peak_reduction_frac"] = (
+            round(1.0 - after / before, 4)
+            if before and after is not None else None)
+
+    f32 = legs["float32"]["windows_per_sec"]
+    bf16 = legs["bfloat16"]["windows_per_sec"]
+    use_pallas = knobs["pallas_attention"]
+    payload = {
+        "metric": (
+            f"mixed_train_throughput_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+            f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}"
+            f"_dps{knobs['days_per_step']}_d{NUM_DAYS}e{EPOCHS_TIMED}"
+            + ("" if use_pallas == "auto" else
+               f"_pallas{int(bool(use_pallas))}")
+            + ("" if knobs["flatten_days"] else "_per_day_vmap")
+            + ("_cpu_fallback" if FORCED_CPU else "")),
+        "value": round(bf16, 1),
+        "unit": "windows/sec/chip",
+        "vs_baseline": round(bf16 / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        "windows_per_sec_f32": round(f32, 1),
+        "windows_per_sec_bf16_mixed": round(bf16, 1),
+        "bf16_speedup_vs_f32": round(bf16 / max(f32, 1e-9), 3),
+        # honesty flag: host CPUs have no bf16 execution unit — XLA
+        # emulates via f32 with round-trips, so a <=1x "speedup" there
+        # is the expected ceiling probe, not a regression
+        "no_native_bf16": platform == "cpu",
+        "final_train_loss_f32": round(legs["float32"]["final_train_loss"], 6),
+        "final_train_loss_bf16": round(
+            legs["bfloat16"]["final_train_loss"], 6),
+        "final_loss_scale_bf16": legs["bfloat16"]["final_loss_scale"],
+        "skipped_steps_bf16": legs["bfloat16"]["skipped_steps"],
+        "remat_audit": remat_audit,
+        "plan": plan_block,
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_MIXED.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return payload
 
 
 def run_serve_bench() -> dict:
@@ -2440,7 +2610,8 @@ def run_mesh_bench() -> dict:
 def bench_payload() -> dict:
     """Fleet mode (--fleet / BENCH_FLEET=1), stream-residency A/B
     (--stream / BENCH_STREAM=1), probe-overhead A/B (--obs /
-    BENCH_OBS=1), composed mesh grid (--mesh / BENCH_MESH=1),
+    BENCH_OBS=1), training-precision A/B (--mixed / BENCH_MIXED=1),
+    composed mesh grid (--mesh / BENCH_MESH=1),
     served-latency bench (--serve / BENCH_SERVE=1), or the
     single-model headline. The payload carries the MEASURING process's
     `run_meta` (git sha + backend env): the forced-CPU fallback and the
@@ -2456,6 +2627,8 @@ def bench_payload() -> dict:
         payload = run_stream_bench()
     elif USE_OBS:
         payload = run_obs_bench()
+    elif USE_MIXED:
+        payload = run_mixed_bench()
     elif USE_MESH:
         payload = run_mesh_bench()
     elif USE_SERVE:
@@ -2621,8 +2794,9 @@ def run_accel_child() -> tuple[bool, str]:
 
 
 def main() -> None:
-    global USE_FLEET, USE_STREAM, USE_OBS, USE_MESH, USE_SERVE, \
-        USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD, SERVE_WORKERS
+    global USE_FLEET, USE_STREAM, USE_OBS, USE_MIXED, USE_MESH, \
+        USE_SERVE, USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD, \
+        SERVE_WORKERS
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -2640,6 +2814,9 @@ def main() -> None:
     if "--obs" in sys.argv:
         USE_OBS = True
         os.environ["BENCH_OBS"] = "1"
+    if "--mixed" in sys.argv:
+        USE_MIXED = True
+        os.environ["BENCH_MIXED"] = "1"
     if "--mesh" in sys.argv:
         USE_MESH = True
         os.environ["BENCH_MESH"] = "1"

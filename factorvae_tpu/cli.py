@@ -100,7 +100,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="bfloat16 compute dtype — the default on every CLI "
                         "path and preset (measured-best on TPU, PERF.md); "
-                        "--no-bf16 forces float32")
+                        "--no-bf16 forces float32. Since the mixed-"
+                        "precision path landed, a bf16 TRAINING run keeps "
+                        "f32 master weights and optimizer state with one "
+                        "bf16 cast feeding forward/backward plus dynamic "
+                        "loss scaling (train/state.py; docs/precision.md) "
+                        "— not a whole-model cast. An --auto_plan row may "
+                        "also pin the training dtype separately "
+                        "(train_precision); an explicit --bf16/--no-bf16 "
+                        "still wins for both")
     p.add_argument("--pallas", action=argparse.BooleanOptionalAction,
                    default=None,
                    help="force the fused Pallas kernels (attention + GRU "

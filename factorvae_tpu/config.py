@@ -52,7 +52,10 @@ class ModelConfig:
     # Parameters, softmax/softplus statistics and losses stay float32.
     # The bare-library default is float32 (exact torch-oracle numerics);
     # every CLI path and preset sets bfloat16, the measured-best TPU
-    # configuration (PERF.md) — pass --no-bf16 to opt out.
+    # configuration (PERF.md) — pass --no-bf16 to opt out. TRAINING at
+    # bfloat16 resolves through the mixed-precision master-weight path
+    # (train.compute_dtype / docs/precision.md), never a naive
+    # whole-model cast: f32 params + loss scaling + overflow-skip.
     compute_dtype: str = "float32"
     # Use torch-style U(+-1/sqrt(fan_in)) initializers so training dynamics
     # match the reference's scale. False -> flax defaults (lecun_normal).
@@ -169,6 +172,38 @@ class TrainConfig:
     recover_after: int = 2
     recover_lr_backoff: float = 0.5
     recover_max_rollbacks: int = 2
+    # Training compute dtype ("float32" | "bfloat16" | None). None (the
+    # default) inherits `model.compute_dtype`, so a bf16 model now
+    # TRAINS through the mixed-precision path instead of the old naive
+    # whole-model cast: params and opt_state stay float32 (master
+    # weights — checkpoints and best-weight artifacts keep the serial
+    # f32 format), one explicit bf16 cast of the param tree feeds the
+    # forward/backward, and the loss is dynamically scaled (knobs
+    # below). "float32" forces the exact pre-mixed trace regardless of
+    # the model dtype — the bitwise training oracle the fidelity floor
+    # in `autotune_plan.py --train_precision` is judged against.
+    # Resolution + validation: train/state.resolve_train_dtype.
+    compute_dtype: Optional[str] = None
+    # Dynamic loss-scaling knobs (mixed builds only — a float32 trace
+    # never references them). The loss is multiplied by the running
+    # scale before the backward pass and the grads divided after it;
+    # a non-finite grad tree skips the update through the SAME select
+    # as finite_guard (the step counts into `skipped_steps`), multiplies
+    # the scale by `loss_scale_backoff` (clamped at `loss_scale_floor`),
+    # and `loss_scale_growth_interval` consecutive good steps multiply
+    # it by `loss_scale_growth`.
+    loss_scale_init: float = 32768.0
+    loss_scale_growth: float = 2.0
+    loss_scale_backoff: float = 0.5
+    loss_scale_growth_interval: int = 200
+    loss_scale_floor: float = 1.0
+    # Rematerialization policy for the epoch-scan backward pass
+    # ("none" | "dots" | "full", train/loop.py). "none" is the exact
+    # pre-remat graph; "dots" wraps the day loss in jax.checkpoint
+    # keeping matmul results (recompute the cheap elementwise chain);
+    # "full" recomputes everything. Peak-HBM win measured per jit by
+    # `bench.py --mixed` via obs.compile.capture_compile.
+    remat: str = "none"
 
 
 @dataclass(frozen=True)

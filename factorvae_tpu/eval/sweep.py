@@ -258,11 +258,16 @@ def seed_sweep(
 # Hyper-fleet config-grid sweep (ISSUE 12)
 # ---------------------------------------------------------------------------
 
-#: grid-point keys that change PARAMETER SHAPES — points sharing these
-#: values share one compiled program; points differing in them bucket
-#: into separate programs (the serve daemon's (arch, dtype, days)
-#: bucketing rule, applied to training).
-SHAPE_KEYS = ("num_factors", "hidden_size", "num_portfolios")
+#: grid-point keys that change the COMPILED TRACE — points sharing
+#: these values share one compiled program; points differing in them
+#: bucket into separate programs (the serve daemon's (arch, dtype,
+#: days) bucketing rule, applied to training). `compute_dtype` rides
+#: here rather than the lane axis (ISSUE 16): the training dtype
+#: changes the trace (bf16 cast + loss-scale graph, train/loop.py), so
+#: an {f32, bf16} x lr grid races as two shape buckets whose lanes PBT
+#: can still kill independently.
+SHAPE_KEYS = ("num_factors", "hidden_size", "num_portfolios",
+              "compute_dtype")
 #: grid-point keys that ride the lane axis as runtime scalars (lr,
 #: kl_weight — train/fleet.py hyper trace) or as the established
 #: per-lane seed axis.
@@ -272,14 +277,24 @@ LANE_KEYS = ("lr", "kl_weight", "seed")
 def parse_hyper_grid(spec: str) -> list:
     """'1e-4:1.0,3e-4:0.1' -> [{"lr": 1e-4, "kl_weight": 1.0}, ...] —
     the lr:kl_weight token format scripts/parity_k60_sweep.py always
-    used, shared by `cli.py --hyper_grid`."""
+    used, shared by `cli.py --hyper_grid`. An optional third field
+    names the training compute dtype ('1e-4:1.0:bfloat16'), bucketing
+    that point into the bf16 trace (SHAPE_KEYS) — so one --hyper_grid
+    races {f32, bf16} x lr in one invocation."""
     points = []
     for tok in spec.split(","):
         tok = tok.strip()
         if not tok:
             continue
-        lr, klw = tok.split(":")
-        points.append({"lr": float(lr), "kl_weight": float(klw)})
+        parts = tok.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"bad hyper-grid token {tok!r}: expected lr:kl_weight "
+                "or lr:kl_weight:compute_dtype")
+        point = {"lr": float(parts[0]), "kl_weight": float(parts[1])}
+        if len(parts) == 3:
+            point["compute_dtype"] = parts[2]
+        points.append(point)
     return points
 
 
@@ -289,7 +304,8 @@ def point_label(point: dict) -> str:
     parts = []
     for key, tag in (("lr", "lr"), ("kl_weight", "kl"),
                      ("num_factors", "K"), ("hidden_size", "H"),
-                     ("num_portfolios", "M"), ("seed", "s")):
+                     ("num_portfolios", "M"), ("compute_dtype", "dt"),
+                     ("seed", "s")):
         if key in point:
             v = point[key]
             parts.append(f"{tag}{v:g}" if isinstance(v, float)
@@ -357,7 +373,8 @@ def grid_sweep(
 ) -> pd.DataFrame:
     """Race a hyperparameter-config grid through hyper-fleet programs
     (ISSUE 12): each point is a dict over SHAPE_KEYS (num_factors /
-    hidden_size / num_portfolios — per-shape programs) and LANE_KEYS
+    hidden_size / num_portfolios / compute_dtype — per-trace programs;
+    the training dtype buckets like a shape, ISSUE 16) and LANE_KEYS
     (lr / kl_weight / seed — per-lane runtime scalars on the stacked
     TrainState, train/fleet.py). Points bucket by shape, each bucket
     trains in hyper-fleet programs of ``lanes_per_program`` lanes

@@ -55,6 +55,15 @@ EVAL_PROBE_KEYS = (
     "factor_mu_spread",
     "factor_sigma_mean",
 )
+# Mixed-precision probes (ISSUE 16): compiled into finalize_train by
+# every MIXED build (train/loop.py), not gated on obs_probes — the
+# dynamic loss scale is training state the host must see to flag a
+# collapse (obs/report.py `loss_scale_collapse`), the way
+# `skipped_steps` already rides every guarded build.
+MIXED_PROBE_KEYS = (
+    "loss_scale",
+    "loss_scale_floor_steps",
+)
 
 
 def _count_nonfinite(tree) -> jnp.ndarray:
@@ -107,6 +116,21 @@ def finalize_train_probes(auxes, days: jnp.ndarray) -> dict:
         "nonfinite_loss": jnp.sum(auxes["nf_loss"]),
         "factor_mu_spread": jnp.sum(auxes["mu_spread_sum"]) / days,
         "factor_sigma_mean": jnp.sum(auxes["sigma_mean_sum"]) / days,
+    }
+
+
+def loss_scale_probes(auxes, floor) -> dict:
+    """(steps,) loss-scale aux -> the epoch's mixed-precision metrics:
+    the scale AFTER the last step (the value the next epoch resumes at)
+    and how many steps sat at the floor — the `loss_scale_collapse`
+    signal (a healthy run backs off a few times then stabilizes well
+    above the floor; pinned there, every step is overflowing and bf16
+    training is no longer learning). Scalars, so the fleet vmap returns
+    them per-lane like every other metric."""
+    return {
+        "loss_scale": auxes["loss_scale"][-1],
+        "loss_scale_floor_steps": jnp.sum(
+            (auxes["loss_scale"] <= floor).astype(jnp.float32)),
     }
 
 

@@ -29,6 +29,14 @@ raises health flags:
                     stream) below slow-frac x the plan row's measured
                     rate — a throughput regression against the envelope
                     the planner promised.
+- `loss_scale_collapse`
+                  — the mixed-precision dynamic loss scale spent steps
+                    pinned at its floor this epoch
+                    (`loss_scale_floor_steps` probe; per seed lane on
+                    fleets). A bf16 lane overflowing faster than the
+                    backoff can absorb is silently skipping its updates
+                    wholesale — the lane has numerically collapsed even
+                    though every loss it reports is finite (ISSUE 16).
 - `compile_storm` — a retrace storm, now with its COST dimension: the
                     per-miss `compile` records say what the storm burned
                     in compile wall seconds (ISSUE 7).
@@ -280,6 +288,25 @@ def health_flags(epochs: List[dict], events: List[dict],
                 n = _mean(rec.get(key, 0.0))
                 if n and n > 0:
                     flag(rec, "nonfinite", f"{key}={n:g} (probe counter)")
+
+        # loss-scale collapse (mixed precision, ISSUE 16): the dynamic
+        # loss scale spent steps pinned at its configured floor this
+        # epoch. Every one of those steps overflowed AND could not back
+        # off further — the lane is shedding updates wholesale while
+        # its reported losses stay finite, so nothing else flags it.
+        s_ls = _lane_count(seg, "loss_scale_floor_steps")
+        for rec in seg:
+            for s in range(s_ls):
+                n = _lane(rec, "loss_scale_floor_steps", s)
+                if n is None or n <= 0:
+                    continue
+                scale = _lane(rec, "loss_scale", s)
+                at = (f", scale={scale:g}" if scale is not None
+                      and math.isfinite(scale) else "")
+                flag(rec, "loss_scale_collapse",
+                     f"loss scale pinned at its floor for {n:g} "
+                     f"overflowed step(s){at}"
+                     + seed_tag(rec, s, s_ls))
 
         # grad spikes (probe data required), per seed lane: each seed
         # is judged against ITS OWN epoch-median grad_norm_mean

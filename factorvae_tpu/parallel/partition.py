@@ -183,6 +183,7 @@ def gather_tree(mesh: Mesh, specs, tree):
 TRAIN_STATE_RULES: list = [
     (r"^step$", P()),
     (r"^rng$", P()),
+    (r"^(loss_scale|good_steps)$", P()),
     (r"^params/", P()),
     (r"^opt_state/", P()),
 ]
@@ -192,6 +193,9 @@ TRAIN_STATE_RULES: list = [
 FLEET_STATE_RULES: list = [
     (r"^step$", P(SEED_AXIS)),
     (r"^rng$", P(SEED_AXIS)),
+    # mixed-precision loss-scale leaves (train/state.py): per-lane
+    # scalars, (S,) stacked — ride the seed axis like step/rng.
+    (r"^(loss_scale|good_steps)$", P(SEED_AXIS)),
     (r"^params/", P(SEED_AXIS)),
     (r"^opt_state/", P(SEED_AXIS)),
 ]

@@ -211,6 +211,19 @@ class Plan:
     without the keys (every pre-ISSUE-15 table) keep resolving exactly
     as before.
 
+    `train_compute_dtype` is the TRAINING-precision knob (ISSUE 16,
+    train/state.py resolve_train_dtype, docs/precision.md): which rung
+    of the TRAINING ladder — "float32" (the bitwise oracle) or
+    "bfloat16" (mixed master-weight path: f32 masters + one bf16
+    compute cast + dynamic loss scaling) — a training run of this shape
+    should use. Raced by `scripts/autotune_plan.py --train_precision`
+    (a `"train_precision"` block: `{"precision": ...}`; a bf16 rung
+    only persists when its trained model's masked-Spearman Rank-IC vs
+    the f32 oracle clears the documented floor — the same discipline as
+    `serve_precision`). "" means "no measured verdict": apply_plan then
+    leaves `TrainConfig.compute_dtype` alone (None — it inherits the
+    model dtype), so every pre-ISSUE-16 row resolves exactly as before.
+
     `budget_*` are the OBSERVABILITY envelopes (ISSUE 7): a row's
     optional `"budgets"` block (`{"compile_seconds": s,
     "peak_hbm_bytes": b, "comm_bytes_per_epoch": c}`) states what a
@@ -240,6 +253,7 @@ class Plan:
     stream_chunk_days: int = 32
     obs_probes: bool = False
     serve_precision: str = "float32"
+    train_compute_dtype: str = ""
     serve_tick_ms: float = -1.0
     serve_max_tick_batch: int = 0
     mesh_data_axis: int = 0
@@ -495,6 +509,13 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 serve_precision=str(
                     (row.get("serve") or {}).get("precision")
                     or "float32"),
+                # Pre-ISSUE-16 rows have no "train_precision" block:
+                # "" = no measured training-precision verdict (the
+                # TrainConfig dtype stays None and inherits the model
+                # dtype — same no-schema-break rule).
+                train_compute_dtype=str(
+                    (row.get("train_precision") or {}).get("precision")
+                    or ""),
                 # Pre-ISSUE-15 serve blocks carry no scheduler keys:
                 # -1/0 = no measured scheduler row (the serving CLI
                 # falls back to its own defaults). A PRESENT tick_ms
@@ -595,6 +616,12 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
             plan.mesh_days_per_step
             if apply_mesh and plan.mesh_days_per_step > 0
             else plan.days_per_step)
+    if not keep_dtype and plan.train_compute_dtype:
+        # A measured training-precision verdict (ISSUE 16): the rung
+        # autotune raced past the Rank-IC floor. Absent ("") the
+        # TrainConfig dtype stays None — it inherits the model dtype
+        # through resolve_train_dtype, exactly the pre-ISSUE-16 path.
+        train_kw["compute_dtype"] = plan.train_compute_dtype
     if not keep_obs:
         train_kw["obs_probes"] = plan.obs_probes
     train = dataclasses.replace(config.train, **train_kw) \

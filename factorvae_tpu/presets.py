@@ -5,6 +5,14 @@ The reference's deployed hyperparameters diverge from its CLI defaults
 H=K on 158 features (scores/readme.md), the notebook loads K=64/H=32/
 M=100, and the CLI defaults to K=96/H=64/M=128. These presets pin the
 five BASELINE.json configs plus the CLI-default flagship.
+
+Every preset's `compute_dtype="bfloat16"` is the measured-best TPU
+default (PERF.md). Since the mixed-precision path landed it no longer
+means a whole-model cast: training resolves the dtype through
+`TrainConfig.compute_dtype` (default: this model knob) into the
+master-weight path — f32 params/optimizer state, one bf16 compute
+cast, dynamic loss scaling (train/state.py; docs/precision.md) —
+while scoring keeps the serving ladder's `serve_precision` choice.
 """
 
 from __future__ import annotations

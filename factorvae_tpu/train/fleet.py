@@ -93,6 +93,7 @@ from factorvae_tpu.train.state import (
     learning_rate_at,
     make_hyper_optimizer,
     make_optimizer,
+    resolve_train_dtype,
 )
 from factorvae_tpu.utils.logging import (
     MetricsLogger,
@@ -129,6 +130,13 @@ def validate_lane_configs(base: Config, lane_configs: Sequence[Config]):
             if f.name in LANE_TRAIN_FIELDS:
                 continue
             if getattr(c.train, f.name) != getattr(base.train, f.name):
+                if f.name == "compute_dtype":
+                    raise ValueError(
+                        f"lane {i} varies train.compute_dtype: the "
+                        "compute dtype changes the TRACE (cast + "
+                        "loss-scale graph), so it buckets like a shape "
+                        "— grid_sweep races f32 vs bf16 as separate "
+                        "shape buckets, not lanes")
                 raise ValueError(
                     f"lane {i} varies train.{f.name}: only "
                     f"{sorted(LANE_TRAIN_FIELDS)} may differ per lane")
@@ -297,8 +305,19 @@ class FleetTrainer:
         self.steps_per_chunk = max(
             1, config.data.stream_chunk_days // self.batch_days)
 
-        self.model = day_forward(config.model, train=True)
-        self.model_eval = day_forward(config.model, train=False)
+        # Training compute dtype, resolved through the ONE ladder
+        # (train/state.py): bf16 lanes train the mixed master-weight
+        # path, never the naive whole-model cast. The dtype is
+        # trace-baked — lanes cannot vary it (validate_lane_configs);
+        # grid_sweep buckets dtypes like shapes instead.
+        self._train_dtype = resolve_train_dtype(config.train, config.model)
+        self._mixed = self._train_dtype != "float32"
+        model_cfg = config.model
+        if model_cfg.compute_dtype != self._train_dtype:
+            model_cfg = dataclasses.replace(
+                model_cfg, compute_dtype=self._train_dtype)
+        self.model = day_forward(model_cfg, train=True)
+        self.model_eval = day_forward(model_cfg, train=False)
         self._build_step_fns()
 
         self.logger.log(
@@ -309,7 +328,9 @@ class FleetTrainer:
             lane_labels=self.lane_labels(),
             flatten_days=config.model.flatten_days,
             days_per_step=self.batch_days,
-            compute_dtype=config.model.compute_dtype,
+            compute_dtype=self._train_dtype,
+            model_compute_dtype=config.model.compute_dtype,
+            mixed_precision=self._mixed,
             n_real=getattr(dataset, "n_real", dataset.n_max),
             n_padded=dataset.n_max,
             obs_probes=config.train.obs_probes,
@@ -381,6 +402,13 @@ class FleetTrainer:
             shard_batch=shard_batch, obs=cfg.train.obs_probes,
             guard=cfg.train.finite_guard, inject_nan=self._inject,
             hyper_step_size=self._hyper_step_size,
+            compute_dtype=self._train_dtype,
+            loss_scale_cfg=((cfg.train.loss_scale_growth,
+                             cfg.train.loss_scale_backoff,
+                             cfg.train.loss_scale_growth_interval,
+                             cfg.train.loss_scale_floor)
+                            if self._mixed else None),
+            remat=cfg.train.remat,
         )
         from factorvae_tpu.obs.watchdog import watch_jit
 
@@ -428,14 +456,19 @@ class FleetTrainer:
                 self._train_chunk_jit = watch_jit(jax.jit(
                     self.fns.train_chunk, donate_argnums=(0,), **chunk_kw),
                     "fleet_train_chunk")
+                # Donation parity with the serial Trainer (ISSUE 16
+                # audit): the threaded eval key is rebound every chunk
+                # and the finalize aux is dead after the reduce.
                 self._eval_chunk_jit = watch_jit(
-                    jax.jit(self.fns.eval_chunk, **eval_chunk_kw),
+                    jax.jit(self.fns.eval_chunk, donate_argnums=(2,),
+                            **eval_chunk_kw),
                     "fleet_eval_chunk")
                 self._finalize_train_jit = watch_jit(
-                    jax.jit(self.fns.finalize_train),
+                    jax.jit(self.fns.finalize_train, donate_argnums=(0,)),
                     "fleet_finalize_train")
                 self._finalize_eval_jit = watch_jit(
-                    jax.jit(self.fns.finalize_eval), "fleet_finalize_eval")
+                    jax.jit(self.fns.finalize_eval, donate_argnums=(0,)),
+                    "fleet_finalize_eval")
         else:
             # Panel broadcast (in_axes=None): ONE HBM copy serves every
             # seed; state and day orders carry the seed axis.
@@ -531,17 +564,19 @@ class FleetTrainer:
                     jax.vmap(self.fns.train_chunk, in_axes=chunk_axes),
                     donate_argnums=(0,), **chunk_kw,
                 ), "fleet_train_chunk")
+                # Same donation audit as the S=1 path: per-seed keys are
+                # rebound each chunk, finalize auxes die at the reduce.
                 self._eval_chunk_jit = watch_jit(jax.jit(
                     jax.vmap(self.fns.eval_chunk,
                              in_axes=(0, None, 0, None) + hyp_ax),
-                    **eval_chunk_kw,
+                    donate_argnums=(2,), **eval_chunk_kw,
                 ), "fleet_eval_chunk")
                 self._finalize_train_jit = watch_jit(jax.jit(
-                    jax.vmap(self.fns.finalize_train)),
-                    "fleet_finalize_train")
+                    jax.vmap(self.fns.finalize_train),
+                    donate_argnums=(0,)), "fleet_finalize_train")
                 self._finalize_eval_jit = watch_jit(jax.jit(
-                    jax.vmap(self.fns.finalize_eval)),
-                    "fleet_finalize_eval")
+                    jax.vmap(self.fns.finalize_eval),
+                    donate_argnums=(0,)), "fleet_finalize_eval")
 
     def panel_args(self):
         return (self.ds.values, self.ds.last_valid, self.ds.next_valid)
@@ -569,7 +604,9 @@ class FleetTrainer:
                 {"params": k_param, "sample": k_sample, "dropout": k_drop},
                 x, y, mask,
             )
-            return create_train_state(params, self.tx, seed)
+            return create_train_state(params, self.tx, seed,
+                                      train_cfg=cfg.train,
+                                      compute_dtype=self._train_dtype)
 
         seeds = jnp.asarray(self.seeds, jnp.uint32)
         # graftlint: disable=JGL003 init traces once per fit by design — it closes over the (unhashable) model/tx, and its cost is one S-wide init vs hours of training
@@ -966,6 +1003,15 @@ class FleetTrainer:
                 # obs.report renders any >0 as a `skip_step` flag.
                 rec["skipped_steps"] = [
                     float(v) for v in np.asarray(train_m["skipped_steps"])]
+            if "loss_scale" in train_m:
+                # Per-lane dynamic loss scale (mixed builds, ISSUE 16):
+                # the values obs.report's `loss_scale_collapse` flag and
+                # the PBT fitness readers see.
+                from factorvae_tpu.obs.probes import MIXED_PROBE_KEYS
+
+                for k in MIXED_PROBE_KEYS:
+                    if k in train_m:
+                        rec[k] = [float(v) for v in np.asarray(train_m[k])]
             if cfg.train.obs_probes:
                 # Per-seed probe lists (obs/probes.py): the vmapped
                 # epoch returns every scalar probe (S,)-shaped.
@@ -1002,7 +1048,23 @@ class FleetTrainer:
             nf_np = (np.nan_to_num(np.asarray(
                 rec["nonfinite_grads"], np.float64))
                 if "nonfinite_grads" in rec else np.zeros(self.num_seeds))
-            bad_lanes = ~np.isfinite(loss_np) | (skip_np > 0) | (nf_np > 0)
+            if self._mixed:
+                # Mixed lanes earn a skip allowance: every loss-scale
+                # growth attempt may overflow once by design (trainer.py
+                # uses the same budget), so a lane is sick only when it
+                # skips beyond that budget or its scale sat at the
+                # floor — not on the first routine backoff.
+                skip_budget = (self.steps_per_epoch // max(
+                    1, cfg.train.loss_scale_growth_interval) + 1)
+                ls_np = (np.asarray(rec["loss_scale"], np.float64)
+                         if "loss_scale" in rec
+                         else np.full(self.num_seeds, np.inf))
+                bad_lanes = (~np.isfinite(loss_np)
+                             | (skip_np > skip_budget)
+                             | (ls_np <= cfg.train.loss_scale_floor))
+            else:
+                bad_lanes = (~np.isfinite(loss_np) | (skip_np > 0)
+                             | (nf_np > 0))
             for i in range(self.num_seeds):
                 lane_streak[i] = lane_streak[i] + 1 if bad_lanes[i] else 0
             to_roll = [

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import optax
 
 from factorvae_tpu.data.windows import gather_day
-from factorvae_tpu.train.state import TrainState
+from factorvae_tpu.train.state import TrainState, cast_compute
 
 
 def concat_auxes(parts, axis: int = 0):
@@ -84,6 +84,9 @@ def make_step_fns(
     guard: bool = False,
     inject_nan: bool = False,
     hyper_step_size: Any = None,
+    compute_dtype: str = "float32",
+    loss_scale_cfg: Any = None,
+    remat: str = "none",
 ) -> StepFns:
     """`model_train` / `model_eval` are the day-batched forward variants
     (models.day_forward with train=True/False; they share one param tree).
@@ -136,9 +139,43 @@ def make_step_fns(
     on an installed chaos plan, factorvae_tpu/chaos) appends a `poison`
     gradient multiplier argument to the train entry points — NaN on the
     epochs/lanes a fault targets, 1.0 elsewhere — applied between the
-    backward pass and the gate."""
+    backward pass and the gate.
+
+    `compute_dtype` != "float32" (the RESOLVED training dtype,
+    state.resolve_train_dtype) compiles the mixed master-weight trace:
+    the f32 master params get ONE `cast_compute` inside the
+    differentiated day loss (so the astype transpose returns f32 master
+    grads), the loss is multiplied by the state's dynamic `loss_scale`
+    before the backward and the grads divided by it after, and a
+    non-finite grad tree skips the update through the SAME `jnp.where`
+    select as `guard` (compiled in whenever guard OR mixed) while
+    backing the scale off; `loss_scale_cfg` is the knob tuple
+    ``(growth, backoff, growth_interval, floor)`` (TrainConfig
+    loss_scale_*). Trace-gated like everything else: the default
+    float32 build never references the scale leaves and is bitwise the
+    pre-mixed graph.
+
+    `remat` ("none" | "dots" | "full", TrainConfig.remat) wraps the
+    TRAIN day loss in `jax.checkpoint` — "dots" keeps matmul results
+    and recomputes the elementwise chain, "full" recomputes everything
+    — shrinking the epoch scan's saved-residual footprint (the win is
+    measured per jit by bench.py --mixed via obs.compile). "none" is
+    the exact pre-remat graph; eval never backprops and stays
+    unwrapped."""
 
     hyper = hyper_step_size is not None
+    mixed = compute_dtype != "float32"
+    gate = guard or mixed
+    if mixed:
+        if loss_scale_cfg is None:
+            raise ValueError(
+                "mixed build (compute_dtype != float32) needs "
+                "loss_scale_cfg=(growth, backoff, growth_interval, "
+                "floor) — TrainConfig's loss_scale_* knobs")
+        ls_growth, ls_backoff, ls_interval, ls_floor = (
+            jnp.float32(loss_scale_cfg[0]), jnp.float32(loss_scale_cfg[1]),
+            jnp.int32(loss_scale_cfg[2]), jnp.float32(loss_scale_cfg[3]))
+        _cdtype = jnp.dtype(compute_dtype)
 
     def _split_extras(extras: tuple) -> tuple:
         """(hp, poison) from a train entry point's trailing positional
@@ -166,6 +203,11 @@ def make_step_fns(
         return x, y, mask
 
     def weighted_day_loss(params, days, key, panel, train: bool, hp=None):
+        if mixed:
+            # THE master->compute cast (state.cast_compute): inside the
+            # differentiated function, so grads flow back through the
+            # astype transpose as f32 cotangents onto the f32 masters.
+            params = cast_compute(params, _cdtype)
         x, y, mask = batch_for(days, panel)
         day_w = (days >= 0).astype(jnp.float32)
         k_sample, k_drop = jax.random.split(key)
@@ -206,13 +248,45 @@ def make_step_fns(
             aux.update(loss_probes(out, day_w))
         return loss, aux
 
+    # Remat policy for the backward pass: wrap the TRAIN loss only
+    # (eval never differentiates). `train` (arg 4) is trace-static.
+    if remat == "dots":
+        _train_loss = jax.checkpoint(
+            weighted_day_loss, static_argnums=(4,),
+            policy=jax.checkpoint_policies.checkpoint_dots)
+    elif remat == "full":
+        _train_loss = jax.checkpoint(weighted_day_loss,
+                                     static_argnums=(4,))
+    elif remat == "none":
+        _train_loss = weighted_day_loss
+    else:
+        raise ValueError(
+            f"remat={remat!r}: expected 'none', 'dots' or 'full' "
+            "(TrainConfig.remat)")
+
+    def _scaled_loss(params, days, key, panel, train, hp, scale):
+        # Dynamic loss scaling (mixed builds): ONE f32 multiply on the
+        # scalar loss so the bf16 backward's small cotangents sit in
+        # representable range; grads are divided back down outside.
+        loss, aux = _train_loss(params, days, key, panel, train, hp)
+        return loss * scale, aux
+
     def train_step(state: TrainState, days: jnp.ndarray, panel,
                    *extras):
         hp, poison = _split_extras(extras)
         state, key = state.advance_rng()
-        (_, aux), grads = jax.value_and_grad(weighted_day_loss, has_aux=True)(
-            state.params, days, key, panel, True, hp
-        )
+        if mixed:
+            (_, aux), grads = jax.value_and_grad(
+                _scaled_loss, has_aux=True)(
+                state.params, days, key, panel, True, hp,
+                state.loss_scale)
+            inv = jnp.float32(1.0) / state.loss_scale
+            grads = jax.tree.map(lambda g: g * inv, grads)
+        else:
+            (_, aux), grads = jax.value_and_grad(
+                _train_loss, has_aux=True)(
+                state.params, days, key, panel, True, hp
+            )
         if inject_nan:
             # Chaos-only trace (factorvae_tpu/chaos): poison is 1.0 on
             # clean epochs/lanes (an exact float multiply — identity),
@@ -230,12 +304,14 @@ def make_step_fns(
             updates = jax.tree.map(
                 lambda u: jnp.asarray(s, dtype=u.dtype) * u, updates)
         new_params = optax.apply_updates(state.params, updates)
-        if guard:
+        if gate:
             # The all-finite gate: a poisoned step KEEPS the previous
             # params/opt_state (a pure elementwise select — bitwise the
             # ungated path when ok is always True); step and RNG still
             # advance so the scan stays static-length and the key
-            # stream is unchanged.
+            # stream is unchanged. Mixed builds compile the SAME select
+            # even with finite_guard off: a loss-scale overflow IS a
+            # skipped step (ISSUE 16 — one gate, one `skipped` metric).
             ok = _all_finite(grads)
             new_params = jax.tree.map(
                 lambda a, b: jnp.where(ok, a, b), new_params, state.params)
@@ -245,6 +321,24 @@ def make_step_fns(
         state = state.replace(
             step=state.step + 1, params=new_params, opt_state=new_opt
         )
+        if mixed:
+            # In-graph scale walk: overflow -> backoff (clamped at the
+            # floor) + counter reset; `ls_interval` consecutive finite
+            # steps -> growth + counter reset. Rides the state, so fleet
+            # vmap gives every lane its own (S,) scale for free.
+            good = jnp.where(ok, state.good_steps + 1,
+                             jnp.zeros((), jnp.int32))
+            grow = good >= ls_interval
+            new_scale = jnp.where(
+                ok,
+                jnp.where(grow, state.loss_scale * ls_growth,
+                          state.loss_scale),
+                jnp.maximum(state.loss_scale * ls_backoff, ls_floor))
+            state = state.replace(
+                loss_scale=new_scale,
+                good_steps=jnp.where(grow, jnp.zeros((), jnp.int32),
+                                     good))
+            aux["loss_scale"] = new_scale
         if obs:
             from factorvae_tpu.obs.probes import grad_probes
 
@@ -263,10 +357,15 @@ def make_step_fns(
             "kl": jnp.sum(auxes["kl_sum"]) / days,
             "days": jnp.sum(auxes["days"]),
         }
-        if guard:
+        if gate:
             # Steps whose update the gate skipped this epoch — the
-            # host-side escalation signal (trainer.py recovery).
+            # host-side escalation signal (trainer.py recovery). On
+            # mixed builds this includes loss-scale overflow skips.
             m["skipped_steps"] = jnp.sum(auxes["skipped"])
+        if mixed:
+            from factorvae_tpu.obs.probes import loss_scale_probes
+
+            m.update(loss_scale_probes(auxes, ls_floor))
         if obs:
             from factorvae_tpu.obs.probes import finalize_train_probes
 

@@ -6,6 +6,20 @@ which advances once per optimizer update (the reference steps its
 scheduler once per batch, train_model.py:31-32). torch's
 CosineAnnealingLR with eta_min=0 is exactly optax's
 cosine_decay_schedule(alpha=0).
+
+Mixed-precision master/compute split (ISSUE 16, docs/precision.md):
+`params` and `opt_state` are ALWAYS float32 — the master weights every
+checkpoint row and best-weight artifact carries, serial-format-
+compatible regardless of the training dtype. A mixed build (resolved
+train dtype != float32, `resolve_train_dtype`) feeds the forward/
+backward ONE explicit low-precision cast of the master tree
+(`cast_compute`, applied inside the differentiated day loss so the
+`astype` transpose returns f32 master gradients) and carries the
+dynamic loss scale + consecutive-good-step counter as two extra state
+leaves (`mixed_fields`). Float32 builds leave both fields `None` —
+an EMPTY pytree subtree, so the state's leaf set (and therefore every
+pre-mixed checkpoint and restore template) is byte-identical to the
+pre-mixed layout.
 """
 
 from __future__ import annotations
@@ -27,13 +41,63 @@ class TrainState:
     on crash; this is the fix called out in SURVEY.md §5)."""
 
     step: jnp.ndarray            # optimizer updates taken
-    params: Any
-    opt_state: Any
+    params: Any                  # f32 master weights (mixed builds cast
+    opt_state: Any               # a bf16 COPY per step; these never move)
     rng: jax.Array               # threaded PRNG key (sample/dropout noise)
+    # Mixed-precision extras (None = absent leaf on f32 builds, so the
+    # pytree structure — and every existing checkpoint — is unchanged):
+    # the dynamic loss scale (f32 scalar) and the consecutive finite-
+    # step counter (int32 scalar) driving its growth schedule.
+    loss_scale: Any = None
+    good_steps: Any = None
 
     def advance_rng(self):
         new_rng, sub = jax.random.split(self.rng)
         return self.replace(rng=new_rng), sub
+
+
+_TRAIN_DTYPES = ("float32", "bfloat16")
+
+
+def resolve_train_dtype(train_cfg, model_cfg) -> str:
+    """The ONE place the training compute dtype is decided.
+
+    ``train.compute_dtype`` wins when set; ``None`` inherits
+    ``model.compute_dtype`` — which is how the old naive whole-model
+    bf16 cast "resolves through" the mixed master-weight path instead
+    of silently training without loss scaling. Anything outside the
+    ladder errors loudly (int8 is a SERVING rung — training through a
+    quantized forward has no gradient contract; plan.py serve_precision).
+    """
+    dtype = train_cfg.compute_dtype or model_cfg.compute_dtype
+    if dtype not in _TRAIN_DTYPES:
+        raise ValueError(
+            f"train compute dtype {dtype!r} is not in the training "
+            f"ladder {_TRAIN_DTYPES} — int8 and friends are serving "
+            "rungs (plan.serve_precision); training runs f32 masters "
+            "with an optional bf16 compute cast (docs/precision.md)")
+    return dtype
+
+
+def cast_compute(tree, dtype):
+    """The single master->compute cast of a mixed step: every floating
+    leaf of the f32 master tree as `dtype`, non-float leaves untouched.
+    Applied INSIDE the differentiated loss (train/loop.py), so the
+    `astype` transpose hands f32 cotangents straight back to the f32
+    masters — there is no second cast site to drift from."""
+    return jax.tree.map(
+        lambda p: p.astype(dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, tree)
+
+
+def mixed_fields(cfg: TrainConfig) -> dict:
+    """The two extra TrainState leaves a mixed build carries (f32 builds
+    leave them None): the dynamic loss scale seeded at
+    ``loss_scale_init`` and the consecutive-good-step counter."""
+    return {
+        "loss_scale": jnp.asarray(cfg.loss_scale_init, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32),
+    }
 
 
 def make_optimizer(
@@ -107,12 +171,24 @@ def make_hyper_optimizer(
     return tx, step_size
 
 
-def create_train_state(params, tx: optax.GradientTransformation, seed: int) -> TrainState:
+def create_train_state(
+    params, tx: optax.GradientTransformation, seed: int,
+    train_cfg: Optional[TrainConfig] = None,
+    compute_dtype: str = "float32",
+) -> TrainState:
+    """`compute_dtype` != float32 (a mixed build; pass the RESOLVED
+    dtype + the TrainConfig carrying the scaling knobs) seeds the
+    loss-scale leaves; the default leaves them None — the exact
+    pre-mixed state layout."""
+    extra = (mixed_fields(train_cfg)
+             if train_cfg is not None and compute_dtype != "float32"
+             else {})
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
         opt_state=tx.init(params),
         rng=jax.random.PRNGKey(seed),
+        **extra,
     )
 
 

@@ -43,6 +43,7 @@ from factorvae_tpu.train.state import (
     create_train_state,
     learning_rate_at,
     make_optimizer,
+    resolve_train_dtype,
 )
 from factorvae_tpu.utils.logging import (
     MetricsLogger,
@@ -105,9 +106,24 @@ class Trainer:
             shard_dataset(self.mesh, dataset)
             shard_batch = make_batch_constraint(self.mesh)
 
-        # model + optimizer
-        self.model = day_forward(config.model, train=True)
-        self.model_eval = day_forward(config.model, train=False)
+        # model + optimizer. The TRAINING compute dtype resolves in one
+        # place (train/state.resolve_train_dtype): train.compute_dtype
+        # wins, None inherits model.compute_dtype — so the old naive
+        # whole-model bf16 cast now routes through the mixed
+        # master-weight path (f32 params/opt_state, one compute cast,
+        # dynamic loss scaling) instead of training unscaled. An
+        # explicit train.compute_dtype="float32" forces the bitwise f32
+        # oracle under a bf16 serving/scoring model.
+        self._train_dtype = resolve_train_dtype(config.train, config.model)
+        self._mixed = self._train_dtype != "float32"
+        model_cfg = config.model
+        if model_cfg.compute_dtype != self._train_dtype:
+            import dataclasses
+
+            model_cfg = dataclasses.replace(
+                model_cfg, compute_dtype=self._train_dtype)
+        self.model = day_forward(model_cfg, train=True)
+        self.model_eval = day_forward(model_cfg, train=False)
         self._shard_batch = shard_batch
         self._build_step_fns()
 
@@ -120,7 +136,13 @@ class Trainer:
             "execution_layout",
             flatten_days=config.model.flatten_days,
             days_per_step=self.batch_days,
-            compute_dtype=config.model.compute_dtype,
+            # the dtype the TRAINING programs actually run (resolved
+            # through the precision ladder), not the raw model knob —
+            # the stale pre-mixed seam logged the model dtype even when
+            # it never reached the hot loop
+            compute_dtype=self._train_dtype,
+            model_compute_dtype=config.model.compute_dtype,
+            mixed_precision=self._mixed,
             n_real=getattr(dataset, "n_real", dataset.n_max),
             n_padded=dataset.n_max,
             dead_compute_frac=round(
@@ -170,6 +192,12 @@ class Trainer:
             obs=cfg.train.obs_probes,
             guard=cfg.train.finite_guard,
             inject_nan=self._inject,
+            compute_dtype=self._train_dtype,
+            loss_scale_cfg=(
+                cfg.train.loss_scale_growth, cfg.train.loss_scale_backoff,
+                cfg.train.loss_scale_growth_interval,
+                cfg.train.loss_scale_floor) if self._mixed else None,
+            remat=cfg.train.remat,
         )
 
         # Every jit goes through the compile watchdog (obs/watchdog.py):
@@ -230,12 +258,22 @@ class Trainer:
             self._train_chunk_jit = watch_jit(jax.jit(
                 self.fns.train_chunk, donate_argnums=donate, **chunk_kw),
                 "train_chunk")
+            # Donation audit (ISSUE 16): the eval chunk's threaded key
+            # rebinds every chunk (`key, aux = jit(...)`) — its input
+            # buffer is dead on return, so donate it; likewise the
+            # finalizers consume the chunk-concatenated aux stacks,
+            # which nothing reads afterwards. No-ops where the backend
+            # doesn't support donation; the epoch-jit state donation
+            # precedent applies.
             self._eval_chunk_jit = watch_jit(
-                jax.jit(self.fns.eval_chunk, **eval_chunk_kw), "eval_chunk")
+                jax.jit(self.fns.eval_chunk, donate_argnums=(2,),
+                        **eval_chunk_kw), "eval_chunk")
             self._finalize_train_jit = watch_jit(
-                jax.jit(self.fns.finalize_train), "finalize_train")
+                jax.jit(self.fns.finalize_train, donate_argnums=(0,)),
+                "finalize_train")
             self._finalize_eval_jit = watch_jit(
-                jax.jit(self.fns.finalize_eval), "finalize_eval")
+                jax.jit(self.fns.finalize_eval, donate_argnums=(0,)),
+                "finalize_eval")
             self._chunk_placement = (
                 chunk_placement(self.mesh) if self.mesh is not None
                 else None)
@@ -348,7 +386,9 @@ class Trainer:
         params = self.model.init(
             {"params": k_param, "sample": k_sample, "dropout": k_drop}, x, y, mask
         )
-        return create_train_state(params, self.tx, cfg.train.seed)
+        return create_train_state(params, self.tx, cfg.train.seed,
+                                  train_cfg=cfg.train,
+                                  compute_dtype=self._train_dtype)
 
     def _epoch_orders(self, epoch: int):
         cfg = self.cfg
@@ -535,6 +575,14 @@ class Trainer:
                 # (train/loop.py) — obs.report renders >0 as a
                 # `skip_step` recovery flag.
                 rec["skipped_steps"] = float(train_m["skipped_steps"])
+            if "loss_scale" in train_m:
+                # Mixed-precision telemetry (loop.py/probes.py): the
+                # dynamic scale after the epoch's last step and how
+                # many steps sat at the floor — obs.report renders a
+                # floored scale as `loss_scale_collapse`.
+                rec["loss_scale"] = float(train_m["loss_scale"])
+                rec["loss_scale_floor_steps"] = float(
+                    train_m["loss_scale_floor_steps"])
             if cfg.train.obs_probes:
                 # On-device health probes (obs/probes.py), already in
                 # the fetched metric dicts — same per-epoch host sync
@@ -568,9 +616,29 @@ class Trainer:
             watermark_event(epoch=epoch)
 
             # ---- recovery escalation -----------------------------------
-            bad = (not np.isfinite(train_loss)
-                   or float(train_m.get("skipped_steps", 0.0) or 0.0) > 0
-                   or float(train_m.get("nonfinite_grads", 0.0) or 0.0) > 0)
+            # Mixed builds EXPECT about one overflow-skip per loss-scale
+            # growth attempt (the scale probes upward every
+            # growth_interval steps and backs off when it overshoots) —
+            # that housekeeping must not read as a hazard, or a healthy
+            # bf16 run would rollback-loop. Only a skip count past the
+            # per-epoch growth budget, or a scale pinned at its floor
+            # (bf16 training no longer learning), escalates; float32
+            # builds keep the exact pre-mixed signal. The nonfinite-
+            # grads probe is folded into the same budget on mixed
+            # builds (an overflow step IS a nonfinite-grad step).
+            skipped = float(train_m.get("skipped_steps", 0.0) or 0.0)
+            if self._mixed:
+                skip_budget = self.steps_per_epoch // max(
+                    1, self.cfg.train.loss_scale_growth_interval) + 1
+                bad = (not np.isfinite(train_loss)
+                       or skipped > skip_budget
+                       or float(train_m.get("loss_scale", np.inf))
+                       <= cfg.train.loss_scale_floor)
+            else:
+                bad = (not np.isfinite(train_loss)
+                       or skipped > 0
+                       or float(train_m.get("nonfinite_grads", 0.0)
+                                or 0.0) > 0)
             bad_streak = bad_streak + 1 if bad else 0
             escalate = bool(recover_after and bad_streak >= recover_after)
             if (escalate

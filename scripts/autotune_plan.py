@@ -43,6 +43,7 @@ Usage:
     python scripts/autotune_plan.py --stream              # + residency race
     python scripts/autotune_plan.py --mesh                # + mesh-shape race
     python scripts/autotune_plan.py --serve               # + precision ladder
+    python scripts/autotune_plan.py --train_precision     # + training ladder
         [--out PLAN_TABLE.json] [--dry_run] [--metrics_jsonl RUN.jsonl]
 
 `--serve` races the serving-precision ladder (f32/bf16/int8) through
@@ -51,6 +52,16 @@ score layout; a sub-f32 winner persists as the row's `serve` block
 (`Plan.serve_precision`) ONLY when its measured rank fidelity vs f32
 clears the floor — rows without the block serve float32, bitwise the
 offline scan.
+
+`--train_precision` races the TRAINING-precision ladder (ISSUE 16):
+the f32 oracle vs the bf16 mixed master-weight path
+(train/state.py — f32 masters, one bf16 compute cast, dynamic loss
+scaling), trained short from one init and each scored through the
+deterministic f32 scan. A bf16 winner persists as the row's
+`train_precision` block (`Plan.train_compute_dtype`) ONLY when it is
+faster AND its trained model's masked-Spearman Rank-IC correlation vs
+the f32-trained model clears the floor — rows without the block leave
+`TrainConfig.compute_dtype` alone (f32 oracle behavior preserved).
 
 Race progress is emitted as structured events through MetricsLogger
 (echoed to stderr; stdout stays the table-JSON artifact). With
@@ -125,6 +136,19 @@ STREAM_CHUNK_CANDIDATES = [16, 32, 64]
 # is a thin shim over the same race (one variant per rung).
 SERVE_PRECISIONS = ["float32", "bfloat16", "int8"]
 SERVE_FIDELITY_FLOOR = 0.99
+# --train_precision: TRAINING-precision ladder (ISSUE 16,
+# train/state.py resolve_train_dtype) raced as short f32 vs bf16
+# mixed-master-weight trainings from the same init on the winning train
+# knobs, each scored deterministically through the f32 scan. The bf16
+# rung persists (row "train_precision" block -> Plan.train_compute_dtype)
+# only when it is BOTH faster (the main race's measured rates) AND its
+# trained model's mean per-day Spearman Rank-IC correlation vs the f32
+# oracle's clears this floor. The floor is lower than the serve gate's:
+# training noise compounds across steps, so two short trainings diverge
+# far more than one activation cast — 0.80 on a short synthetic run
+# still pins rank ORDER agreement while tolerating trajectory drift.
+TRAIN_FIDELITY_FLOOR = 0.80
+TRAIN_PRECISION_EPOCHS = 3
 # --serve also races the continuous-batching scheduler window
 # (serve/daemon.TickScheduler, ISSUE 15) under a closed-loop
 # concurrent client load at the winning rung: how long an under-full
@@ -486,6 +510,84 @@ def race_serve(name: str, shape: dict, score_knobs: dict,
     }
 
 
+def race_train_precision(name: str, shape: dict, train_knobs: dict,
+                         train_rates: dict, days: int, reps: int,
+                         logger=None) -> dict:
+    """Race the TRAINING-precision ladder — the f32 oracle vs the bf16
+    mixed master-weight path (train/state.py) — on the winning train
+    knobs; return the row's `train_precision` block.
+
+    Same discipline as `race_serve`: f32 is always eligible (it IS the
+    serial oracle), and bf16 only wins when (a) its measured training
+    rate at the winning (flatten, dps) — already timed by the main
+    race — beats f32's, and (b) the model it TRAINS, scored through the
+    deterministic f32 scan, keeps a mean per-day `masked_spearman` rank
+    correlation vs the f32-trained model at or above
+    TRAIN_FIDELITY_FLOOR. Trained-model fidelity (not an activation
+    corr) is the right gate here: training noise compounds across
+    steps, so only the end-to-end trained artifact says whether bf16
+    training preserved the rank signal the backtest consumes."""
+    import dataclasses as _dc
+
+    import jax
+
+    from factorvae_tpu.eval.predict import predict_panel
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    flat = bool(train_knobs["flatten_days"])
+    dps = int(train_knobs["days_per_step"])
+    epochs = max(TRAIN_PRECISION_EPOCHS, reps)
+    grids: dict = {}
+    rates: dict = {}
+    for dtype in DTYPES:
+        # The MODEL stays f32 — scoring must run the f32 scan for both
+        # rungs so the fidelity number isolates what TRAINING at bf16
+        # did to the weights, not what scoring at bf16 does to
+        # activations; train.compute_dtype alone selects the rung
+        # (resolve_train_dtype). Same seed => bit-identical inits.
+        cfg, ds = _setup(shape, "float32", flat, dps, days)
+        cfg = _dc.replace(cfg, train=_dc.replace(
+            cfg.train, compute_dtype=dtype))
+        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = trainer.init_state()
+        for e in range(epochs):
+            state, m = trainer._train_epoch(state,
+                                            trainer._epoch_orders(e))
+        jax.block_until_ready(m["loss"])
+        day_idx = ds.split_days(None, None)
+        grids[dtype] = predict_panel(
+            state.params, cfg, ds, day_idx, stochastic=False,
+            chunk=min(16, len(day_idx)))
+        rates[dtype] = train_rates.get(
+            f"flat={int(flat)}_dps{dps}_{dtype}")
+    corr = _rank_corr(grids["bfloat16"], grids["float32"])
+    f32_s, bf16_s = rates.get("float32"), rates.get("bfloat16")
+    faster = (f32_s is not None and bf16_s is not None
+              and bf16_s < f32_s)
+    eligible = corr == corr and corr >= TRAIN_FIDELITY_FLOOR
+    best = "bfloat16" if (eligible and faster) else "float32"
+    _log(logger, "autotune_train_precision_candidate", shape=name,
+         rank_fidelity=(round(corr, 4) if corr == corr else None),
+         f32_s_per_day=f32_s, bf16_s_per_day=bf16_s,
+         bf16_fidelity_ok=bool(eligible), bf16_faster=bool(faster),
+         winner=best)
+    return {
+        "precision": best,
+        "fidelity": (round(corr, 4) if corr == corr else None),
+        "measured": {"s_per_day": {"float32": f32_s,
+                                   "bfloat16": bf16_s},
+                     "fidelity": (round(corr, 4) if corr == corr
+                                  else None),
+                     "epochs": epochs},
+        "source": (f"train-precision race (epochs={epochs}, "
+                   f"Rank-IC floor {TRAIN_FIDELITY_FLOOR}): "
+                   "bf16 fidelity "
+                   + (f"{corr:.4f}" if corr == corr else "nan")
+                   + f", winner {best}"),
+    }
+
+
 def race_serve_tick(name: str, cfg, params, reg, ds, day_idx,
                     precision: str, reps: int, logger=None) -> dict:
     """Race the continuous-batching window (TickScheduler's tick_ms)
@@ -687,7 +789,8 @@ def _existing_measured_row(shape: dict, platform: str):
 def race_shape(name: str, shape: dict, days: int, reps: int,
                fleet: bool = False, stream: bool = False,
                mesh: bool = False, serve: bool = False,
-               hyper: bool = False, logger=None) -> dict:
+               hyper: bool = False, train_precision: bool = False,
+               logger=None) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
     plan-table row.
@@ -781,6 +884,11 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
     if serve:
         serve_block = race_serve(name, shape, best_score_key, days,
                                  reps, logger=logger)
+    tp_block = None
+    if train_precision:
+        tp_block = race_train_precision(
+            name, shape, best_train_key, measured["train"], days, reps,
+            logger=logger)
     mesh_block = None
     if mesh:
         mesh_block = race_mesh(name, shape, best_train_key, days,
@@ -800,6 +908,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         measured["serve"] = {"rates": serve_block.pop("measured"),
                              "fidelity": serve_block.pop("fidelity"),
                              "tick": serve_block.pop("tick_measured")}
+    if tp_block is not None:
+        measured["train_precision"] = tp_block.pop("measured")
     if mesh_block is not None:
         measured["mesh"] = mesh_block.pop("measured")
     row = {
@@ -843,6 +953,18 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         }
         if serve_block["precision"] != "float32":
             row["serve"]["precision"] = serve_block["precision"]
+    if tp_block is not None:
+        row["source"] += f"; {tp_block['source']}"
+        # f32 winners persist NO block (the conservative default —
+        # plan_for resolves an absent block to "" = no verdict, and the
+        # TrainConfig dtype stays None), the same rule as serve: a bf16
+        # training rung is a measured win past the Rank-IC floor, never
+        # inferred.
+        if tp_block["precision"] != "float32":
+            row["train_precision"] = {
+                "precision": tp_block["precision"],
+                "fidelity": tp_block["fidelity"],
+            }
     if mesh_block is not None:
         row["source"] += f"; {mesh_block['source']}"
         if mesh_block["data_axis"] > 0 and mesh_block["stock_axis"] > 0:
@@ -860,7 +982,8 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
 def race_widths(name: str, shape: dict, days: int, reps: int,
                 fleet: bool = False, stream: bool = False,
                 mesh: bool = False, serve: bool = False,
-                hyper: bool = False, logger=None) -> list:
+                hyper: bool = False, train_precision: bool = False,
+                logger=None) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
     envelope row — both bounds measured, no extrapolation beyond them
@@ -871,15 +994,18 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
         widths = [widths]
     rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
                        fleet=fleet, stream=stream, mesh=mesh,
-                       serve=serve, hyper=hyper, logger=logger)
+                       serve=serve, hyper=hyper,
+                       train_precision=train_precision, logger=logger)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
         p = merged[-1]
         if (r["train"], r["score"], r.get("fleet"), r.get("stream"),
-                r.get("mesh"), r.get("serve"), r.get("hyper")) != (
+                r.get("mesh"), r.get("serve"), r.get("hyper"),
+                r.get("train_precision")) != (
                 p["train"], p["score"], p.get("fleet"), p.get("stream"),
-                p.get("mesh"), p.get("serve"), p.get("hyper")):
+                p.get("mesh"), p.get("serve"), p.get("hyper"),
+                p.get("train_precision")):
             merged.append(r)
             continue
         if not any(k.startswith("n=") for k in p["measured"]):
@@ -953,6 +1079,20 @@ def main() -> int:
                         "Plan.serve_precision; f32 winners persist NO "
                         "block and rows without one serve float32 — "
                         "bitwise the offline scan)")
+    p.add_argument("--train_precision", action="store_true",
+                   help="also race the TRAINING-precision ladder "
+                        "(f32 oracle vs the bf16 mixed master-weight "
+                        "path, train/state.py; ISSUE 16) on each "
+                        "shape's winning train knobs: two short "
+                        "trainings from one init, each scored through "
+                        "the deterministic f32 scan; a bf16 winner "
+                        "(eligible only when faster AND past the "
+                        f"{TRAIN_FIDELITY_FLOOR} masked-Spearman "
+                        "Rank-IC floor vs the f32-trained model) is "
+                        "persisted on the row's 'train_precision' "
+                        "block (plan_for -> Plan.train_compute_dtype; "
+                        "f32 winners persist NO block and rows without "
+                        "one leave TrainConfig.compute_dtype alone)")
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="with --mesh under JAX_PLATFORMS=cpu: force "
                         "this many virtual host-CPU devices (the test-"
@@ -1010,12 +1150,15 @@ def main() -> int:
             names = sorted(SHAPES) if args.all else [args.config]
             with capture_disabled():
                 rows = [r for n in names
-                        for r in race_widths(n, SHAPES[n], args.days,
-                                             args.reps, fleet=args.fleet,
-                                             stream=args.stream,
-                                             mesh=args.mesh,
-                                             serve=args.serve,
-                                             hyper=args.hyper, logger=lg)]
+                        for r in race_widths(
+                            n, SHAPES[n], args.days,
+                            args.reps, fleet=args.fleet,
+                            stream=args.stream,
+                            mesh=args.mesh,
+                            serve=args.serve,
+                            hyper=args.hyper,
+                            train_precision=args.train_precision,
+                            logger=lg)]
             print(json.dumps({"rows": rows}, indent=1))
             if args.dry_run:
                 lg.log("autotune_dry_run", rows=len(rows),

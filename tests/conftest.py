@@ -82,6 +82,12 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # refit-bitwise-plain-warm-start pin) plus the registry re-admission
 # version-bump; the subprocess SIGKILL-at-each-boundary resume rigs
 # stay slow (test_wf.py in _SLOW_FILES).
+# The ISSUE-16 mixed-precision classes (test_mixed.py) are quick BY
+# DESIGN: the f32-bitwise oracle pins, the loss-scale overflow/growth/
+# floor step semantics, the mixed fold/stream/resume discipline and
+# the dtype-bucket + PBT-kill races guard the training trace gate —
+# a drift there invalidates every other bitwise pin in the suite, so
+# it must be proven on every tier-1 run.
 # The ISSUE-15 router/pool classes are quick BY DESIGN: tier-1 must
 # exercise the scale-out tier — bounded-load rendezvous routing, the
 # exposition relabel/merge, cross-tick continuous batching, and one

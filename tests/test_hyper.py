@@ -351,9 +351,12 @@ class TestShapeBuckets:
         assert [(k, [i for i, _ in m]) for k, m in a] \
             == [(k, [i for i, _ in m]) for k, m in b]
         # three distinct shapes, ordered by first occurrence; lane order
-        # preserved within each bucket
+        # preserved within each bucket. The 4th key element is the
+        # training compute_dtype (ISSUE 16): the dtype changes the
+        # trace, so it buckets like a shape.
         assert [k for k, _ in a] == [
-            (None, None, None), (60, None, None), (60, 60, None)]
+            (None, None, None, None), (60, None, None, None),
+            (60, 60, None, None)]
         assert [i for i, _ in a[0][1]] == [0, 1]
         assert [i for i, _ in a[1][1]] == [2, 4]
 

@@ -1,0 +1,485 @@
+"""Mixed-precision training contract (ISSUE 16: train/state.py dtype
+resolution + master weights, train/loop.py mixed trace + dynamic loss
+scaling, plan train_precision rows, sweep dtype buckets, PBT kill).
+
+The oracle discipline, in order:
+
+- F32 BITWISE: train.compute_dtype="float32" (explicit or resolved) is
+  trace-gated — the compiled graph is the pre-mixed one, so the serial
+  Trainer, the fleet S=1 fold and the stream path all stay bitwise
+  their pre-PR selves. Pinned against real runs here.
+- MIXED SEMANTICS: a bf16 build keeps f32 master params/opt_state, and
+  the loss-scale walk (overflow -> skip + backoff at the floor;
+  growth_interval good steps -> growth) is pinned at the train_step
+  level with injected poison.
+- LADDER PLUMBING: plan rows without a train_precision block resolve
+  to "no verdict" (TrainConfig.compute_dtype stays None), dtype
+  buckets partition a hyper grid like a shape, a lane that varies the
+  dtype is rejected with the pointed message, and PBT ranks a
+  diverged bf16 lane last (NaN fitness) and exploits it.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from factorvae_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+from factorvae_tpu.data import PanelDataset, synthetic_panel
+from factorvae_tpu.train import FleetTrainer, Trainer
+from factorvae_tpu.train.fleet import unstack_state
+from factorvae_tpu.train.state import (
+    create_train_state,
+    resolve_train_dtype,
+)
+from factorvae_tpu.utils.logging import MetricsLogger
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(
+        num_days=20, num_instruments=6, num_features=8, missing_prob=0.1,
+        seed=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def mixed_ds(panel):
+    return PanelDataset(panel, seq_len=5)
+
+
+def base_config(save_dir, ds, residency="hbm", model_dtype="float32",
+                train_dtype=None, **train_kw) -> Config:
+    defaults = dict(num_epochs=2, lr=1e-3, seed=0, save_dir=str(save_dir),
+                    checkpoint_every=0, compute_dtype=train_dtype)
+    defaults.update(train_kw)
+    return Config(
+        model=ModelConfig(num_features=8, hidden_size=8, num_factors=4,
+                          num_portfolios=6, seq_len=5,
+                          compute_dtype=model_dtype),
+        data=DataConfig(seq_len=5, start_time=None,
+                        fit_end_time=str(ds.dates[12].date()),
+                        val_start_time=str(ds.dates[13].date()),
+                        val_end_time=str(ds.dates[-1].date()),
+                        panel_residency=residency, stream_chunk_days=4),
+        train=TrainConfig(**defaults),
+    )
+
+
+def assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# dtype resolution (train/state.py)
+
+
+class TestDtypeResolution:
+    def test_train_knob_wins_none_inherits(self):
+        model = ModelConfig(compute_dtype="bfloat16")
+        assert resolve_train_dtype(TrainConfig(), model) == "bfloat16"
+        assert resolve_train_dtype(
+            TrainConfig(compute_dtype="float32"), model) == "float32"
+        assert resolve_train_dtype(
+            TrainConfig(compute_dtype="bfloat16"),
+            ModelConfig(compute_dtype="float32")) == "bfloat16"
+
+    def test_serving_rungs_rejected_loudly(self):
+        with pytest.raises(ValueError, match="serv"):
+            resolve_train_dtype(TrainConfig(compute_dtype="int8"),
+                                ModelConfig())
+
+    def test_f32_state_has_no_mixed_leaves(self):
+        """The f32 TrainState must be tree-identical to the pre-mixed
+        one: None-default fields are absent pytree leaves, so templates
+        and checkpoints keep the serial format byte for byte."""
+        import optax
+
+        tx = optax.sgd(1e-3)
+        params = {"w": jnp.ones((2,), jnp.float32)}
+        f32 = create_train_state(params, tx, seed=0)
+        assert f32.loss_scale is None and f32.good_steps is None
+        mixed = create_train_state(params, tx, seed=0,
+                                   train_cfg=TrainConfig(),
+                                   compute_dtype="bfloat16")
+        assert float(mixed.loss_scale) == TrainConfig().loss_scale_init
+        assert int(mixed.good_steps) == 0
+        assert len(jax.tree.leaves(mixed)) == len(jax.tree.leaves(f32)) + 2
+
+
+# ---------------------------------------------------------------------------
+# f32 bitwise oracle
+
+
+class TestF32Oracle:
+    def test_explicit_f32_bitwise_default(self, mixed_ds, tmp_path):
+        """train.compute_dtype='float32' compiles the same trace as the
+        unset default — the mixed machinery is gated out entirely."""
+        cfg_a = base_config(tmp_path / "a", mixed_ds)
+        sa, _ = Trainer(cfg_a, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit()
+        cfg_b = base_config(tmp_path / "b", mixed_ds,
+                            train_dtype="float32")
+        sb, _ = Trainer(cfg_b, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit()
+        assert_trees_bitwise(sa.params, sb.params)
+
+    def test_f32_forced_under_bf16_model_bitwise_f32_model(
+            self, mixed_ds, tmp_path):
+        """The oracle escape hatch: a bf16 serving model with
+        train.compute_dtype='float32' trains the f32 graph — bitwise a
+        plain f32 model's run, not a cast-and-hope variant."""
+        cfg_a = base_config(tmp_path / "a", mixed_ds)
+        sa, _ = Trainer(cfg_a, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit()
+        cfg_b = base_config(tmp_path / "b", mixed_ds,
+                            model_dtype="bfloat16",
+                            train_dtype="float32")
+        sb, _ = Trainer(cfg_b, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit()
+        assert_trees_bitwise(sa.params, sb.params)
+
+    def test_fleet_s1_f32_bitwise_serial(self, mixed_ds, tmp_path):
+        cfg = base_config(tmp_path / "s", mixed_ds,
+                          train_dtype="float32")
+        ss, _ = Trainer(cfg, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit()
+        cfg_f = base_config(tmp_path / "f", mixed_ds,
+                            train_dtype="float32")
+        ft = FleetTrainer(cfg_f, mixed_ds, seeds=[0],
+                          logger=MetricsLogger(echo=False))
+        sf, _ = ft.fit()
+        assert_trees_bitwise(ss.params, unstack_state(sf, 0).params)
+
+
+# ---------------------------------------------------------------------------
+# mixed training semantics
+
+
+class TestMixedTraining:
+    def test_masters_stay_f32_and_scale_rides_state(self, mixed_ds,
+                                                    tmp_path):
+        cfg = base_config(tmp_path, mixed_ds, train_dtype="bfloat16")
+        tr = Trainer(cfg, mixed_ds, logger=MetricsLogger(echo=False))
+        state, out = tr.fit()
+        for leaf in jax.tree.leaves(state.params):
+            assert leaf.dtype == jnp.float32
+        assert np.isfinite(float(state.loss_scale))
+        # healthy tiny run: no overflow, so nothing skipped and the
+        # scale never fell to the floor
+        for h in out["history"]:
+            assert np.isfinite(h["train_loss"])
+            assert h["loss_scale"] >= cfg.train.loss_scale_init
+            assert h["loss_scale_floor_steps"] == 0.0
+
+    def test_fleet_s1_bf16_bitwise_serial_bf16(self, mixed_ds, tmp_path):
+        """The fold discipline extends to mixed builds: a 1-seed bf16
+        fleet runs the un-vmapped mixed trace, bitwise the serial
+        mixed Trainer — scale walk included."""
+        cfg = base_config(tmp_path / "s", mixed_ds,
+                          train_dtype="bfloat16")
+        ss, _ = Trainer(cfg, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit()
+        cfg_f = base_config(tmp_path / "f", mixed_ds,
+                            train_dtype="bfloat16")
+        ft = FleetTrainer(cfg_f, mixed_ds, seeds=[0],
+                          logger=MetricsLogger(echo=False))
+        sf, _ = ft.fit()
+        lane = unstack_state(sf, 0)
+        assert_trees_bitwise(ss.params, lane.params)
+        np.testing.assert_array_equal(np.asarray(ss.loss_scale),
+                                      np.asarray(lane.loss_scale))
+
+    def test_fleet_lanes_carry_per_lane_scales(self, mixed_ds, tmp_path):
+        cfg = base_config(tmp_path, mixed_ds, train_dtype="bfloat16")
+        ft = FleetTrainer(cfg, mixed_ds, seeds=[0, 1],
+                          logger=MetricsLogger(echo=False))
+        sf, out = ft.fit()
+        assert sf.loss_scale.shape == (2,)
+        assert np.isfinite(np.asarray(sf.loss_scale)).all()
+        assert len(out["history"][-1]["loss_scale"]) == 2
+
+    def test_stream_bitwise_hbm_mixed(self, panel, tmp_path):
+        """The residency discipline holds on the mixed trace: chunked
+        stream epochs == the whole-epoch scan, bitwise, loss-scale
+        walk included."""
+        ds_h = PanelDataset(panel, seq_len=5)
+        ds_s = PanelDataset(panel, seq_len=5, residency="stream")
+        cfg_h = base_config(tmp_path / "h", ds_h,
+                            train_dtype="bfloat16", days_per_step=2)
+        sh, _ = Trainer(cfg_h, ds_h,
+                        logger=MetricsLogger(echo=False)).fit()
+        cfg_s = base_config(tmp_path / "s", ds_s, residency="stream",
+                            train_dtype="bfloat16", days_per_step=2)
+        ss, _ = Trainer(cfg_s, ds_s,
+                        logger=MetricsLogger(echo=False)).fit()
+        assert_trees_bitwise(sh.params, ss.params)
+        np.testing.assert_array_equal(np.asarray(sh.loss_scale),
+                                      np.asarray(ss.loss_scale))
+
+
+# ---------------------------------------------------------------------------
+# loss-scale walk, pinned at the step level
+
+
+class TestLossScaleSemantics:
+    def _step_rig(self, mixed_ds, tmp_path, interval):
+        """A mixed train_step with the chaos poison argument compiled
+        in, driven directly: poison=NaN is an overflow, poison=1.0 an
+        exact-identity clean step."""
+        from factorvae_tpu.train.loop import make_step_fns
+
+        cfg = base_config(tmp_path, mixed_ds, train_dtype="bfloat16",
+                          loss_scale_growth_interval=interval)
+        tr = Trainer(cfg, mixed_ds, logger=MetricsLogger(echo=False))
+        fns = make_step_fns(
+            tr.model, tr.model_eval, tr.tx, seq_len=5, inject_nan=True,
+            compute_dtype="bfloat16",
+            loss_scale_cfg=(cfg.train.loss_scale_growth,
+                            cfg.train.loss_scale_backoff,
+                            cfg.train.loss_scale_growth_interval,
+                            cfg.train.loss_scale_floor))
+        state = tr.init_state()
+        days = jnp.asarray([0], jnp.int32)
+        return fns, state, days, tr.panel_args(), cfg.train
+
+    def test_overflow_skips_keeps_params_and_backs_off(self, mixed_ds,
+                                                       tmp_path):
+        fns, state, days, pargs, tc = self._step_rig(mixed_ds, tmp_path,
+                                                     interval=200)
+        nan = jnp.float32(float("nan"))
+        new, aux = fns.train_step(state, days, pargs, nan)
+        assert float(aux["skipped"]) == 1.0
+        assert_trees_bitwise(state.params, new.params)
+        assert_trees_bitwise(state.opt_state, new.opt_state)
+        assert float(new.loss_scale) == \
+            tc.loss_scale_init * tc.loss_scale_backoff
+        assert int(new.good_steps) == 0
+        assert int(new.step) == 1  # step/RNG advance even when skipped
+
+    def test_clean_step_updates_and_grows_at_interval(self, mixed_ds,
+                                                      tmp_path):
+        fns, state, days, pargs, tc = self._step_rig(mixed_ds, tmp_path,
+                                                     interval=1)
+        one = jnp.float32(1.0)
+        new, aux = fns.train_step(state, days, pargs, one)
+        assert float(aux["skipped"]) == 0.0
+        # params moved, and interval=1 means every good step grows
+        assert any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(state.params),
+                            jax.tree.leaves(new.params)))
+        assert float(new.loss_scale) == \
+            tc.loss_scale_init * tc.loss_scale_growth
+        assert int(new.good_steps) == 0  # reset at growth
+
+    def test_backoff_clamps_at_floor(self, mixed_ds, tmp_path):
+        fns, state, days, pargs, tc = self._step_rig(mixed_ds, tmp_path,
+                                                     interval=200)
+        state = state.replace(
+            loss_scale=jnp.float32(tc.loss_scale_floor))
+        new, _ = fns.train_step(state, days, pargs,
+                                jnp.float32(float("nan")))
+        assert float(new.loss_scale) == tc.loss_scale_floor
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip
+
+
+class TestMixedCheckpoints:
+    def test_mixed_resume_bitwise(self, mixed_ds, tmp_path):
+        """4 straight mixed epochs == 2 + checkpoint-resume 2: the
+        scale/counter leaves ride the checkpoint like every other state
+        leaf, so the resumed walk is the unbroken one, bitwise."""
+        cfg_a = base_config(tmp_path / "a", mixed_ds,
+                            train_dtype="bfloat16", num_epochs=4,
+                            checkpoint_every=1)
+        sa, _ = Trainer(cfg_a, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit()
+        cfg_b = base_config(tmp_path / "b", mixed_ds,
+                            train_dtype="bfloat16", num_epochs=4,
+                            checkpoint_every=1)
+        Trainer(cfg_b, mixed_ds,
+                logger=MetricsLogger(echo=False)).fit(num_epochs=2)
+        sb, _ = Trainer(cfg_b, mixed_ds,
+                        logger=MetricsLogger(echo=False)).fit(resume=True)
+        assert_trees_bitwise(sa.params, sb.params)
+        np.testing.assert_array_equal(np.asarray(sa.loss_scale),
+                                      np.asarray(sb.loss_scale))
+        np.testing.assert_array_equal(np.asarray(sa.good_steps),
+                                      np.asarray(sb.good_steps))
+
+    def test_mixed_best_params_load_into_f32_serving(self, mixed_ds,
+                                                     tmp_path):
+        """Master weights are f32: the exported best-params directory
+        from a mixed run loads into a plain f32 template unchanged —
+        serving never sees a bf16 parameter."""
+        from factorvae_tpu.train import load_params
+
+        cfg = base_config(tmp_path, mixed_ds, train_dtype="bfloat16",
+                          checkpoint_every=1)
+        tr = Trainer(cfg, mixed_ds, logger=MetricsLogger(echo=False))
+        state, _ = tr.fit()
+        cfg_f32 = base_config(tmp_path, mixed_ds)
+        template = Trainer(cfg_f32, mixed_ds,
+                           logger=MetricsLogger(echo=False)).init_state()
+        params = load_params(
+            os.path.join(str(tmp_path), cfg.checkpoint_name()),
+            template.params)
+        for leaf in jax.tree.leaves(params):
+            assert leaf.dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# plan rows
+
+
+class TestPlanTrainPrecision:
+    def test_row_without_block_means_no_verdict(self):
+        from factorvae_tpu import plan as planlib
+
+        shape = planlib.ShapeKey(num_features=8, seq_len=5, hidden_size=8,
+                                 num_factors=4, num_portfolios=6,
+                                 n_stocks=6)
+        pl = planlib.plan_for(shape, platform="cpu")
+        assert pl.train_compute_dtype == ""
+        cfg = Config(model=ModelConfig(), data=DataConfig(),
+                     train=TrainConfig())
+        out = planlib.apply_plan(cfg, pl)
+        assert out.train.compute_dtype is None
+
+    def test_row_with_block_sets_train_dtype_unless_kept(self):
+        from factorvae_tpu import plan as planlib
+
+        pl = dataclasses.replace(
+            planlib.plan_for(planlib.ShapeKey(
+                num_features=8, seq_len=5, hidden_size=8, num_factors=4,
+                num_portfolios=6, n_stocks=6), platform="cpu"),
+            train_compute_dtype="bfloat16")
+        cfg = Config(model=ModelConfig(), data=DataConfig(),
+                     train=TrainConfig())
+        assert planlib.apply_plan(
+            cfg, pl).train.compute_dtype == "bfloat16"
+        # an explicit user dtype wins (cli --bf16/--no-bf16)
+        assert planlib.apply_plan(
+            cfg, pl, keep_dtype=True).train.compute_dtype is None
+
+    def test_measured_row_block_round_trips(self):
+        """A persisted train_precision block resolves into the plan; a
+        row without one stays un-verdicted (back-compat with every
+        pre-ISSUE-16 PLAN_TABLE.json row)."""
+        from factorvae_tpu import plan as planlib
+
+        row = {
+            "platform": "cpu",
+            "shape": {"c": 9, "t": 5, "h": 8, "k": 4, "m": 6},
+            "n_min": 1, "n_max": 16,
+            "train": {"flatten_days": False, "days_per_step": 1,
+                      "compute_dtype": "float32"},
+            "pad_target": 6,
+            "source": "test row",
+            "train_precision": {"precision": "bfloat16",
+                                "fidelity": 0.97},
+        }
+        shape = planlib.ShapeKey(num_features=9, seq_len=5, hidden_size=8,
+                                 num_factors=4, num_portfolios=6,
+                                 n_stocks=6)
+        pl = planlib.plan_for(shape, platform="cpu", table=[row])
+        assert pl.provenance == "measured"
+        assert pl.train_compute_dtype == "bfloat16"
+        del row["train_precision"]
+        pre16 = planlib.plan_for(shape, platform="cpu", table=[row])
+        assert pre16.provenance == "measured"
+        assert pre16.train_compute_dtype == ""
+
+
+# ---------------------------------------------------------------------------
+# sweep buckets + lane rejection + PBT kill
+
+
+class TestDtypeRaces:
+    def test_dtype_buckets_like_a_shape(self):
+        from factorvae_tpu.eval.sweep import (
+            parse_hyper_grid,
+            shape_buckets,
+        )
+
+        points = parse_hyper_grid(
+            "1e-3:1.0,3e-3:1.0,1e-3:1.0:bfloat16,3e-3:1.0:bfloat16")
+        assert points[2]["compute_dtype"] == "bfloat16"
+        buckets = shape_buckets(points)
+        assert len(buckets) == 2
+        assert [len(pts) for _, pts in buckets] == [2, 2]
+        with pytest.raises(ValueError, match="hyper-grid token"):
+            parse_hyper_grid("1e-3:1.0:bfloat16:extra")
+
+    def test_lane_varying_dtype_rejected(self, mixed_ds, tmp_path):
+        from factorvae_tpu.train.fleet import validate_lane_configs
+
+        cfg = base_config(tmp_path, mixed_ds)
+        lane = dataclasses.replace(
+            cfg, train=dataclasses.replace(
+                cfg.train, compute_dtype="bfloat16",
+                run_name="bf16_lane"))
+        with pytest.raises(ValueError, match="shape"):
+            validate_lane_configs(cfg, [cfg, lane])
+
+    def test_grid_sweep_races_both_dtypes(self, mixed_ds, tmp_path):
+        """One grid_sweep invocation covers {f32, bf16} x lr: the dtype
+        buckets into two hyper-fleet programs, both score finite."""
+        from factorvae_tpu.eval.sweep import grid_sweep
+
+        cfg = base_config(tmp_path, mixed_ds, num_epochs=1,
+                          run_name="dtrace")
+        points = [
+            {"lr": 1e-3, "kl_weight": 1.0},
+            {"lr": 3e-3, "kl_weight": 1.0},
+            {"lr": 1e-3, "kl_weight": 1.0, "compute_dtype": "bfloat16"},
+            {"lr": 3e-3, "kl_weight": 1.0, "compute_dtype": "bfloat16"},
+        ]
+        df = grid_sweep(cfg, mixed_ds, points,
+                        score_start=str(mixed_ds.dates[13].date()),
+                        logger=MetricsLogger(echo=False))
+        assert df.attrs["summary"]["num_buckets"] == 2
+        assert list(df.index) == ["lr0.001_kl1", "lr0.003_kl1",
+                                  "lr0.001_kl1_dtbfloat16",
+                                  "lr0.003_kl1_dtbfloat16"]
+        assert np.isfinite(df["rank_ic"]).all()
+
+    def test_pbt_kills_diverged_bf16_lane(self, mixed_ds, tmp_path):
+        """A bf16 lane whose lr detonates it goes NaN-fitness, ranks
+        last (train/pbt.py isfinite ordering) and is exploited from the
+        healthy lane's checkpoint in generation 0."""
+        from factorvae_tpu.train.pbt import pbt_fit
+
+        cfg = base_config(tmp_path, mixed_ds, train_dtype="bfloat16",
+                          checkpoint_every=1, run_name="pbtmix")
+
+        def lane(seed, lr, tag):
+            return dataclasses.replace(
+                cfg, train=dataclasses.replace(
+                    cfg.train, seed=seed, lr=lr,
+                    run_name=f"{cfg.train.run_name}_{tag}"))
+
+        lanes = [lane(0, 1e-3, "sane"), lane(1, 1e3, "boom")]
+        # generations=2 so generation 0 HAS a successor to exploit
+        # for; stop_after=0 ends the run right after that exploit.
+        _, res = pbt_fit(cfg, mixed_ds, lanes, generations=2,
+                         epochs_per_generation=1, exploit_frac=0.5,
+                         stop_after=0,
+                         logger=MetricsLogger(echo=False))
+        gen = res["generations"][0]
+        kills = {e["lane"]: e for e in gen["exploited"]}
+        assert 1 in kills, gen
+        assert kills[1]["from"] == 0  # cloned from the healthy lane
+        assert not np.isfinite(gen["fitness"][1]), \
+            "the detonated lane must carry non-finite fitness"
+        assert np.isfinite(gen["fitness"][0])
