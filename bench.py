@@ -290,6 +290,21 @@ SCALE_MODELS = int(os.environ.get("BENCH_SCALE_MODELS", 8))
 SCALE_CLIENTS = int(os.environ.get("BENCH_SCALE_CLIENTS", 8))
 SCALE_REQUESTS = int(os.environ.get("BENCH_SCALE_REQUESTS", 240))
 SCALE_WARMUP = int(os.environ.get("BENCH_SCALE_WARMUP", 160))
+# Multi-host mode (`python bench.py --serve --remote` or
+# BENCH_SERVE_REMOTE=1): the multi-host serving plane (ISSUE 17,
+# serve/remote.py + serve/autoscale.py). One local worker anchors the
+# fleet behind the router; BENCH_REMOTE_HOSTS-1 joining AGENTS
+# (localhost ports standing in for hosts — the identical `--join`
+# protocol a real remote host speaks) sync the content-addressed
+# artifact store, digest-verify every blob, and register back. The
+# payload carries (a) the single-host ceiling (same router, 1 worker)
+# the multi-host QPS must beat, (b) a hedged-vs-unhedged tail-latency
+# A/B over the SAME fleet (hedging must not worsen the p99/p50 tail
+# ratio), and (c) a rolling upgrade under continuous client load that
+# must drop ZERO requests. Breaking any of the three flips the metric
+# to *_failed. Shapes reuse the scale-out knobs (BENCH_SCALE_*).
+USE_SERVE_REMOTE = os.environ.get("BENCH_SERVE_REMOTE", "0") == "1"
+REMOTE_HOSTS = int(os.environ.get("BENCH_REMOTE_HOSTS", 3))
 # Chaos mode (`python bench.py --chaos` or BENCH_CHAOS=1): the MTTR
 # bench (ISSUE 9, docs/robustness.md). One representative fault per
 # class from factorvae_tpu/chaos — poisoned gradients, a hard-killed
@@ -1699,6 +1714,240 @@ def run_serve_scaleout_bench() -> dict:
     return payload
 
 
+def run_serve_remote_bench() -> dict:
+    """Multi-host serving bench (ISSUE 17): QPS past the single-host
+    ceiling through remote workers. One local worker anchors the pool;
+    REMOTE_HOSTS-1 joining agents sync the content-addressed artifact
+    store over HTTP (digest-verified, `--join`) and register back —
+    the identical protocol a real remote host speaks, with localhost
+    ports standing in for hosts. Three acceptance pins, any broken one
+    flipping the metric to *_failed: multi-host QPS (unhedged, full
+    load — hedging spends duplicate work on tails, not throughput)
+    strictly above the single-host (1-worker, same router) ceiling;
+    the hedged A/B's p99/p50 tail ratio no worse than unhedged over
+    the same fleet — which includes one deliberately DEGRADED host (a
+    chaos `serve_stall` slow replica owning one model) — at moderate
+    load; a rolling upgrade under continuous load with ZERO dropped
+    requests. `value` is the unhedged multi-host QPS."""
+    import shutil
+    import tempfile
+    import threading
+
+    from factorvae_tpu.serve.pool import WorkerPool
+    from factorvae_tpu.serve.router import Router
+
+    platform, _ = detect_platform()
+    work = tempfile.mkdtemp(prefix="bench_remote_")
+    cache_dir = os.path.join(work, "xla_cache")
+    store_dir = os.path.join(work, "aot_store")
+    specs = _scale_checkpoints(os.path.join(work, "ckpts"),
+                               SCALE_MODELS)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(chaos_env_var(), None)
+    day = SCALE_DAYS - 1
+    hosts = max(2, REMOTE_HOSTS)
+    dataset_args = ["--synthetic", f"{SCALE_DAYS},{SCALE_STOCKS}"]
+
+    def _pool(tag):
+        return WorkerPool(
+            specs, dataset_args, 1, cache_dir, store_dir,
+            work_dir=os.path.join(work, tag), env=env)
+
+    def _best_of(n, *load_args):
+        # Closed-loop QPS at full saturation on a 2-core sandbox is
+        # noisy run to run (scheduler placement of workers vs router
+        # vs clients); best-of-n is the standard noise floor for a
+        # throughput pin. `ok` stays AND-of-all — a failed pass can't
+        # hide behind a fast one.
+        runs = [_scale_load(*load_args) for _ in range(n)]
+        best = dict(max(runs, key=lambda r: r["qps"]))
+        best["ok"] = all(r["ok"] for r in runs)
+        best["passes"] = [r["qps"] for r in runs]
+        return best
+
+    try:
+        # ---- single-host ceiling: the SAME router tier over the one
+        # worker this host runs (the ceiling remote workers exist to
+        # break). The shared compile cache + AOT store mean the
+        # multi-host fleet below joins warm — the comparison measures
+        # capacity, not compile walls.
+        pool = _pool("single")
+        router = None
+        try:
+            pool.start()
+            router = Router(pool,
+                            max_inflight=max(64, 4 * SCALE_CLIENTS))
+            port = router.start()
+            _scale_load(port, SCALE_CLIENTS, SCALE_WARMUP, day,
+                        SCALE_MODELS)
+            single = _best_of(3, port, SCALE_CLIENTS,
+                              SCALE_REQUESTS, day, SCALE_MODELS)
+        finally:
+            if router is not None:
+                router.stop()
+            else:
+                pool.stop()
+
+        # ---- multi-host: 1 local worker + (hosts-1) joining agents.
+        from factorvae_tpu import chaos as chaoslib
+        from factorvae_tpu.chaos import ChaosPlan, Fault
+
+        stall_ms = 300.0
+        pool = _pool("multi")
+        router = None
+        try:
+            pool.start()
+            # hedge_quantile 0.7: the straggler A/B below pins
+            # 1/SCALE_MODELS of traffic (one model) to a slow host,
+            # so the measured quantile must sit BELOW the healthy/
+            # stalled boundary (needs SCALE_MODELS >= 4).
+            router = Router(pool,
+                            max_inflight=max(64, 4 * SCALE_CLIENTS),
+                            hedge_quantile=0.7)
+            port = router.start()
+            pool.router_url = f"http://127.0.0.1:{port}"
+            for _ in range(hosts - 1):
+                pool.launch_remote(wait_healthy=True)
+            _scale_load(port, SCALE_CLIENTS, SCALE_WARMUP, day,
+                        SCALE_MODELS)
+
+            # Throughput at full load, unhedged (the QPS-past-ceiling
+            # pin below).
+            router.hedge_enabled = False
+            multi = _best_of(3, port, SCALE_CLIENTS,
+                             SCALE_REQUESTS, day, SCALE_MODELS)
+
+            # Hedged A/B: one MORE host joins — degraded. Its env
+            # carries a permanent `serve_stall` (the chaos harness's
+            # deterministic slow replica: every score on that host
+            # sleeps stall_ms — an overloaded/throttled machine), and
+            # one model is pinned to it so a fixed 1/models slice of
+            # traffic pays the straggler. This is the tail hedging
+            # exists for: on this sandbox every simulated host shares
+            # the same 2 cores, so a straggler-free fleet's p99 is
+            # CPU saturation — duplicating work there only adds load
+            # (the Tail-at-Scale caveat) — while a sleeping straggler
+            # burns no CPU and isolates the policy's effect. Both A/B
+            # legs run the same fleet, same moderate load; only the
+            # hedge toggle differs.
+            clean_env = pool.env   # ctor env + the pool's PYTHONPATH
+            pool.env = chaoslib.child_env(
+                ChaosPlan([Fault("serve_stall", times=-1,
+                                 delay_s=stall_ms / 1e3)]),
+                env=clean_env)
+            straggler = pool.launch_remote(wait_healthy=True)
+            pool.env = clean_env
+            ab_clients = max(2, SCALE_CLIENTS // 4)
+            with router._lock:
+                router._assign["m0"] = straggler.wid
+                # hedge delay must be the A/B's own measured
+                # quantile, not the saturated phase's
+                router._lat_window.clear()
+            unhedged = _scale_load(port, ab_clients, SCALE_REQUESTS,
+                                   day, SCALE_MODELS)
+            router.hedge_enabled = True
+            hedges_before = router.hedges
+            hedged = _scale_load(port, ab_clients, SCALE_REQUESTS,
+                                 day, SCALE_MODELS)
+            hedges_fired = router.hedges - hedges_before
+            hedge_wins = router.hedge_wins
+
+            # Rolling upgrade (new code, same artifacts) under a
+            # continuous closed loop: zero drops or the run fails.
+            bg: dict = {}
+
+            def _bg_load():
+                bg.update(_scale_load(
+                    port, max(2, SCALE_CLIENTS // 2),
+                    SCALE_REQUESTS, day, SCALE_MODELS))
+
+            t = threading.Thread(target=_bg_load,
+                                 name="bench-upgrade-load")
+            t.start()
+            upgrade = pool.rolling_upgrade()
+            t.join()
+            stats = pool.stats()
+            rstats = router.stats()["router"]
+        finally:
+            if router is not None:
+                router.stop()
+            else:
+                pool.stop()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    # QPS-past-ceiling is judged UNHEDGED at full load: hedging
+    # spends duplicate work to buy tail latency (its own pin below is
+    # the p99/p50 ratio at the A/B load).
+    qps_ok = bool(multi["qps"] > single["qps"])
+    tail_unhedged = (unhedged["p99_ms"] / unhedged["p50_ms"]
+                     if unhedged["p50_ms"] else None)
+    tail_hedged = (hedged["p99_ms"] / hedged["p50_ms"]
+                   if hedged["p50_ms"] else None)
+    # 5% tolerance: p99 over a few hundred closed-loop samples is
+    # noisy; the pin is "hedging does not WORSEN the tail", the
+    # payload carries the measured reduction.
+    hedge_ok = bool(tail_unhedged and tail_hedged
+                    and tail_hedged <= 1.05 * tail_unhedged)
+    upgrade_ok = bool(upgrade["ok"] and bg.get("ok")
+                      and bg.get("dropped") == 0)
+    served_ok = bool(single["ok"] and multi["ok"] and unhedged["ok"]
+                     and hedged["ok"])
+    ok_all = qps_ok and hedge_ok and upgrade_ok and served_ok
+    payload = {
+        "metric": (
+            f"serve_remote_qps_C{SCALE_FEATURES}_T{SCALE_SEQ_LEN}"
+            f"_H{SCALE_HIDDEN}_K{SCALE_FACTORS}_M{SCALE_PORTFOLIOS}"
+            f"_N{SCALE_STOCKS}_models{SCALE_MODELS}_h{hosts}"
+            + ("" if ok_all else "_failed")),
+        "value": multi["qps"],
+        "unit": "req/sec",
+        "vs_baseline": None,   # no reference multi-host baseline
+        "platform": platform,
+        "hosts": hosts,
+        "models": SCALE_MODELS,
+        "clients": SCALE_CLIENTS,
+        "requests_per_point": SCALE_REQUESTS,
+        "single_host": single,
+        "multi_host": multi,
+        "ab_clients": ab_clients,
+        "ab_unhedged": unhedged,
+        "ab_hedged": hedged,
+        "qps_over_single_host": (round(multi["qps"] / single["qps"],
+                                       3) if single["qps"] else None),
+        "tail_ratio_unhedged": (round(tail_unhedged, 3)
+                                if tail_unhedged else None),
+        "tail_ratio_hedged": (round(tail_hedged, 3)
+                              if tail_hedged else None),
+        "hedges_fired": hedges_fired,
+        "hedge_wins": hedge_wins,
+        "hedge_delay_ms": rstats["hedge"]["delay_ms"],
+        "straggler": {"stall_ms": stall_ms, "pinned_model": "m0",
+                      "worker": straggler.wid,
+                      "note": "extra host joined degraded for the "
+                              "A/B: every score sleeps stall_ms "
+                              "(chaos serve_stall, times=-1)"},
+        "rolling_upgrade": upgrade,
+        "upgrade_load": bg,
+        "remote_workers": stats["remote"],
+        "scaling_ok": qps_ok,
+        "hedge_ok": hedge_ok,
+        "upgrade_zero_drop_ok": upgrade_ok,
+        "workload": "same-day multi-model closed loop (top=3)",
+        "worker_backend": "cpu (single-thread XLA per worker; "
+                          "localhost agents stand in for hosts)",
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_REMOTE.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return payload
+
+
 def chaos_env_var() -> str:
     from factorvae_tpu import chaos
 
@@ -2067,6 +2316,96 @@ for s in range(3):
         recovered["kill_worker"] = False
     finally:
         kw_router.stop()
+
+    # --- kill_remote_worker (ISSUE 17): a joining AGENT (a localhost
+    # port standing in for a remote host) of a 1-local + 1-agent fleet
+    # is SIGKILLed by the watcher's chaos hook; recovery = the router
+    # REROUTES to the surviving local worker while the "host" is down
+    # AND the watcher respawns the agent through the full re-join —
+    # digest-verified artifact sync off the content-addressed store +
+    # re-registration on the same host:port (the slot heals). MTTR =
+    # kill -> re-joined agent healthy and answering a direct score.
+    rw_root = os.path.join(work, "kill_remote")
+    save_params(rw_root, "rw0", sparams)
+    with open(os.path.join(rw_root, "rw0", "serve_config.json"),
+              "w") as fh:
+        json.dump(scfg.to_dict(), fh)
+    rw_pool = WorkerPool(
+        [os.path.join(rw_root, "rw0")], ["--synthetic", "12,10"], 1,
+        cache_dir=os.path.join(rw_root, "cache"),
+        store_dir=os.path.join(rw_root, "store"),
+        work_dir=os.path.join(rw_root, "pool"),
+        health_interval_s=0.2, env=kw_env)
+    rw_router = Router(rw_pool)
+    rw_scaler = None
+    try:
+        from factorvae_tpu.serve.autoscale import AutoScaler
+
+        rw_pool.start()
+        rw_port = rw_router.start()
+        rw_pool.router_url = f"http://127.0.0.1:{rw_port}"
+        agent = rw_pool.launch_remote(wait_healthy=True)
+        # The autoscaler's control loop runs LIVE through the fault:
+        # recovery must not fight it. min == fleet size matters: this
+        # scenario runs at idle, and idle down-pressure would
+        # legitimately RETIRE the dead agent's slot before the
+        # watcher's re-join (observed) — the pin keeps the scaler
+        # reading stats through the dead-worker window without
+        # changing fleet size.
+        rw_scaler = AutoScaler(rw_pool, rw_router, min_workers=2,
+                               max_workers=2, interval_s=0.2)
+        rw_router.autoscaler = rw_scaler
+        rw_scaler.start()
+
+        def rw_score(port=None):
+            return http_json(
+                f"http://127.0.0.1:{port or rw_port}/score",
+                {"model": "rw0", "day": 0}, timeout=120)
+
+        warm_ok = bool(rw_score().get("ok")
+                       and rw_score(agent.port).get("ok"))
+        plan = ChaosPlan([Fault("kill_remote_worker",
+                                request=agent.index)])
+        t0 = time.perf_counter()
+        with chaos.active(plan):
+            deadline = t0 + 30
+            while time.perf_counter() < deadline and not plan.fired:
+                time.sleep(0.05)
+        # reroute: scoring keeps answering THROUGH the router while
+        # the simulated host is dead
+        reroute_ok = bool(rw_score().get("ok"))
+        rejoined = False
+        deadline = time.perf_counter() + 240
+        while time.perf_counter() < deadline:
+            st = rw_pool.stats()
+            aw = next((w for w in st["workers"]
+                       if w["worker_id"] == agent.wid), None)
+            if aw is None:   # slot retired: re-join can't happen
+                break
+            if aw["state"] == "ok" and aw["restarts"] > 0:
+                # the re-join must have come through the artifact
+                # service, not a local checkpoint respawn
+                rejoined = aw["respawn_source"] == "artifact_service"
+                break
+            time.sleep(0.1)
+        # the re-joined agent itself serves (not just the survivor)
+        post_ok = bool(rejoined and rw_score(agent.port).get("ok")
+                       and rw_score().get("ok"))
+        t1 = time.perf_counter()
+        recovered["kill_remote_worker"] = bool(
+            warm_ok and plan.fired
+            and rw_pool.stats()["remote_kills"] >= 1
+            and reroute_ok and rejoined and post_ok)
+        if recovered["kill_remote_worker"]:
+            mttr["kill_remote_worker"] = max(t1 - t0, 1e-4)
+    except Exception as e:
+        print(f"[bench] kill_remote_worker scenario failed: {e}",
+              file=sys.stderr)
+        recovered["kill_remote_worker"] = False
+    finally:
+        if rw_scaler is not None:
+            rw_scaler.stop()
+        rw_router.stop()
 
     # ---- walk-forward cycle-stage classes (ISSUE 14) ------------------
     # The nightly loop's crash windows (docs/walkforward.md fault
@@ -2632,10 +2971,14 @@ def bench_payload() -> dict:
     elif USE_MESH:
         payload = run_mesh_bench()
     elif USE_SERVE:
-        # --workers 1,2,4 switches the serve bench to the scale-out
+        # --remote switches the serve bench to the multi-host plane
+        # (ISSUE 17); --workers 1,2,4 to the single-host scale-out
         # curve through the router + worker-fleet tier (ISSUE 15).
-        payload = (run_serve_scaleout_bench() if SERVE_WORKERS
-                   else run_serve_bench())
+        if USE_SERVE_REMOTE:
+            payload = run_serve_remote_bench()
+        else:
+            payload = (run_serve_scaleout_bench() if SERVE_WORKERS
+                       else run_serve_bench())
     elif USE_CHAOS:
         payload = run_chaos_bench()
     elif USE_WALKFORWARD:
@@ -2796,7 +3139,7 @@ def run_accel_child() -> tuple[bool, str]:
 def main() -> None:
     global USE_FLEET, USE_STREAM, USE_OBS, USE_MIXED, USE_MESH, \
         USE_SERVE, USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD, \
-        SERVE_WORKERS
+        SERVE_WORKERS, USE_SERVE_REMOTE
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -2835,6 +3178,12 @@ def main() -> None:
             print("error: --workers wants a comma list (e.g. 1,2,4)",
                   file=sys.stderr)
             sys.exit(2)
+    if "--remote" in sys.argv:
+        # `--serve --remote`: the multi-host plane (ISSUE 17).
+        # Propagated via env so the probe/fallback subprocesses keep
+        # the mode.
+        USE_SERVE_REMOTE = True
+        os.environ["BENCH_SERVE_REMOTE"] = "1"
     if "--chaos" in sys.argv:
         USE_CHAOS = True
         os.environ["BENCH_CHAOS"] = "1"

@@ -72,6 +72,19 @@ Serving scale-out fault class (serve/pool.py, ISSUE 15):
                                                     replays fan-out
                                                     admits
 
+Multi-host serving fault class (serve/pool.py remote slots, ISSUE 17):
+
+    kill_remote_worker   WorkerPool watcher tick    router reroutes; the
+                         (request=worker index):    pool respawns the
+                         a REMOTE worker's agent    agent, which re-joins
+                         process is SIGKILLed       through the full cold
+                         (the simulated host dies)  path — artifact
+                                                    downloads off the
+                                                    content-addressed
+                                                    store, digest verify,
+                                                    re-registration on
+                                                    the same host:port
+
 Opt-in and zero-cost when off: with no plan installed and no env var,
 `fault()` is a None check — no allocation, no locking, no jax import —
 and every in-graph injection is gated at TRACE time (`has_fault`), so
@@ -115,6 +128,8 @@ KINDS = (
     "fidelity_gate_reject",
     # serving scale-out class (serve/pool.py, ISSUE 15)
     "kill_worker",
+    # multi-host serving class (serve/pool.py remote slots, ISSUE 17)
+    "kill_remote_worker",
 )
 
 # Coordinate fields a Fault can pin (-1 / "" = wildcard, matches any).

@@ -196,6 +196,46 @@ def merge_expositions(
                             for n, (t, h, ls) in merged.items()])
 
 
+def autoscale_families(
+        signals: Dict) -> List[Tuple[str, str, str, List[str]]]:
+    """The router's autoscaler input signals as exposition families
+    (ISSUE 17): queue depth, observed p50/p99 against the declared SLO,
+    and per-worker inflight under `worker_id` labels — the exact
+    numbers the scaling loop decides from, exported so an operator can
+    replay any scale decision off the scrape. Router `/metrics` merges
+    these via `merge_expositions(extra_families=...)`; absent signals
+    render as no samples (an absent metric beats a lying 0)."""
+    p = f"{PREFIX}_router"
+    fam: List[Tuple[str, str, str, List[str]]] = []
+    for key, name, help_ in (
+            ("queue_depth", f"{p}_queue_depth",
+             "client requests queued/in flight at the router (the "
+             "autoscaler's load signal)"),
+            ("p50_ms", f"{p}_observed_p50_ms",
+             "median client-request latency over the router's sliding "
+             "window"),
+            ("p99_ms", f"{p}_observed_p99_ms",
+             "p99 client-request latency over the router's sliding "
+             "window (compared against the declared SLO)"),
+            ("slo_ms", f"{p}_slo_ms",
+             "declared latency SLO the autoscaler defends (0 = none "
+             "declared)"),
+            ("workers_healthy", f"{p}_autoscale_workers_healthy",
+             "healthy workers the autoscaler can spread load over"),
+            ("workers_total", f"{p}_autoscale_workers_total",
+             "pool worker slots, healthy or not")):
+        v = signals.get(key)
+        fam.append((name, "gauge", help_,
+                    [] if v is None else [metric_line(name, v)]))
+    inflight = signals.get("worker_inflight") or {}
+    fam.append((f"{p}_worker_inflight", "gauge",
+                "forwards currently in flight per worker",
+                [metric_line(f"{p}_worker_inflight", v,
+                             {"worker_id": wid})
+                 for wid, v in sorted(inflight.items())]))
+    return fam
+
+
 # ---------------------------------------------------------------------------
 # serving-side exposition
 # ---------------------------------------------------------------------------

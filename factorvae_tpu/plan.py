@@ -211,6 +211,30 @@ class Plan:
     without the keys (every pre-ISSUE-15 table) keep resolving exactly
     as before.
 
+    `serve_slo_ms` / `serve_hedge_ms` are the MULTI-HOST serving knobs
+    (serve/router.py + serve/autoscale.py, ISSUE 17): the declared
+    per-request latency SLO the autoscaler's control loop holds the
+    fleet's observed p99 against, and the hedge delay after which the
+    router duplicates a still-unanswered forward to its second
+    rendezvous candidate (first answer wins). slo_ms = 0 means "no
+    declared SLO" (the autoscaler falls back to queue-depth-only
+    signals); hedge_ms = -1 means "no measured hedge row" — the router
+    then derives the delay from its own measured latency quantile — and
+    a PRESENT 0 is a measured hedge-immediately winner that must
+    survive, exactly the serve_tick_ms explicit-None rule. Rows without
+    the keys (every pre-ISSUE-17 table) keep resolving exactly as
+    before.
+
+    `train_remat` is the rematerialization knob (ISSUE 17 satellite of
+    ROADMAP item 4, train/loop.py `jax.checkpoint` wrapping via
+    `TrainConfig.remat`): "none" | "dots" | "full". `bench.py --mixed`
+    measures the peak_bytes cut per rung (23.9% at "dots" on the
+    flagship shape); a row's `"train_remat"` block (`{"remat": ...}`)
+    persists the rung once a rig shows a wall-clock or batch-size win.
+    "" means "no measured verdict": apply_plan leaves
+    `TrainConfig.remat` alone, so every pre-ISSUE-17 row resolves
+    exactly as before — the same rule as `train_precision`.
+
     `train_compute_dtype` is the TRAINING-precision knob (ISSUE 16,
     train/state.py resolve_train_dtype, docs/precision.md): which rung
     of the TRAINING ladder — "float32" (the bitwise oracle) or
@@ -254,8 +278,11 @@ class Plan:
     obs_probes: bool = False
     serve_precision: str = "float32"
     train_compute_dtype: str = ""
+    train_remat: str = ""
     serve_tick_ms: float = -1.0
     serve_max_tick_batch: int = 0
+    serve_slo_ms: float = 0.0
+    serve_hedge_ms: float = -1.0
     mesh_data_axis: int = 0
     mesh_stock_axis: int = 0
     mesh_days_per_step: int = 0
@@ -516,6 +543,11 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 train_compute_dtype=str(
                     (row.get("train_precision") or {}).get("precision")
                     or ""),
+                # Pre-ISSUE-17 rows have no "train_remat" block: "" =
+                # no measured remat verdict (TrainConfig.remat keeps
+                # its own default — same no-schema-break rule).
+                train_remat=str(
+                    (row.get("train_remat") or {}).get("remat") or ""),
                 # Pre-ISSUE-15 serve blocks carry no scheduler keys:
                 # -1/0 = no measured scheduler row (the serving CLI
                 # falls back to its own defaults). A PRESENT tick_ms
@@ -529,6 +561,18 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 serve_max_tick_batch=int(
                     (row.get("serve") or {}).get("max_tick_batch")
                     or 0),
+                # Pre-ISSUE-17 serve blocks carry no multi-host keys:
+                # slo_ms=0 = no declared SLO; hedge_ms=-1 = no measured
+                # hedge delay (the router derives it from its own
+                # latency quantile). A PRESENT hedge_ms of 0 is a
+                # measured hedge-immediately winner and must survive —
+                # same explicit-None rule as tick_ms.
+                serve_slo_ms=float(
+                    (row.get("serve") or {}).get("slo_ms") or 0.0),
+                serve_hedge_ms=(
+                    float((row.get("serve") or {})["hedge_ms"])
+                    if (row.get("serve") or {}).get("hedge_ms")
+                    is not None else -1.0),
                 # Pre-PR-6 rows have no "mesh" block: 0/0 = keep the
                 # run's own MeshConfig (no schema break).
                 mesh_data_axis=int(
@@ -587,7 +631,7 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
                keep_dtype: bool = False, keep_layout: bool = False,
                keep_pad: bool = False, keep_kernels: bool = False,
                keep_residency: bool = False, keep_obs: bool = False,
-               keep_mesh: bool = False):
+               keep_mesh: bool = False, keep_remat: bool = False):
     """Return a Config with the plan's TRAINING knobs applied. `keep_*`
     leaves an explicitly user-set knob alone (CLI flag precedence)."""
     model_kw: dict = {}
@@ -622,6 +666,12 @@ def apply_plan(config, plan: Plan, *, keep_days_per_step: bool = False,
         # TrainConfig dtype stays None — it inherits the model dtype
         # through resolve_train_dtype, exactly the pre-ISSUE-16 path.
         train_kw["compute_dtype"] = plan.train_compute_dtype
+    if not keep_remat and plan.train_remat:
+        # A measured remat verdict (ISSUE 17 satellite): the rung a rig
+        # raced to a wall-clock/batch-size win. Absent ("") the
+        # TrainConfig.remat default stands — every pre-ISSUE-17 row
+        # resolves exactly as before.
+        train_kw["remat"] = plan.train_remat
     if not keep_obs:
         train_kw["obs_probes"] = plan.obs_probes
     train = dataclasses.replace(config.train, **train_kw) \

@@ -118,6 +118,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "admitted models into (respawned workers "
                         "cold-start from it with zero traces; "
                         "default: <work dir>/aot_store)")
+    p.add_argument("--join", type=str, default=None, metavar="URL",
+                   help="join an existing fleet as a REMOTE worker "
+                        "(ISSUE 17): sync every artifact from the "
+                        "router's content-addressed store "
+                        "(GET /artifacts + /artifact/<sha256>, "
+                        "digest-verified), mirror the fleet's panel/"
+                        "worker args, serve, and register once "
+                        "healthy. Needs no --model and no --dataset "
+                        "— a cold host joins with zero local traces")
+    p.add_argument("--advertise_host", type=str, default="127.0.0.1",
+                   help="host address presented at registration with "
+                        "--join (what the router forwards to)")
+    p.add_argument("--slo_ms", type=float, default=None,
+                   help="declared p99 latency SLO the router defends "
+                        "(--workers > 1): exported on /metrics and "
+                        "driving --autoscale. Default: the plan "
+                        "row's serve block, else none")
+    p.add_argument("--hedge_ms", type=float, default=None,
+                   help="hedged-forward delay (--workers > 1): a "
+                        "forward still unanswered past this "
+                        "duplicates to the second candidate, first "
+                        "answer wins. Default: the plan row's serve "
+                        "block, else auto — the measured p90 of the "
+                        "router's latency window")
+    p.add_argument("--no_hedge", action="store_true",
+                   help="disable hedged forwards entirely")
+    p.add_argument("--autoscale", type=int, default=0, metavar="MAX",
+                   help="SLO-driven autoscaling (--workers > 1): "
+                        "scale the fleet between --workers and MAX "
+                        "from queue depth + observed p99 vs --slo_ms "
+                        "(hysteresis both ways; serve/autoscale.py). "
+                        "0 (default) disables")
     p.add_argument("--max_inflight", type=int, default=64,
                    help="router load-shed bound: in-flight client "
                         "requests past this answer 503 with "
@@ -219,12 +251,55 @@ def run_pool(args) -> int:
         for w in pool.stats()["workers"]:
             print(f"[pool] {w['worker_id']} pid={w['pid']} "
                   f"{w['url']} ({w['state']})", file=sys.stderr)
-        router = Router(pool, max_inflight=args.max_inflight)
+        # SLO + hedge delay: explicit flags win, else the measured
+        # plan row's serve block (autotune_plan.py --serve), else
+        # no SLO and auto-quantile hedging.
+        slo_ms, hedge_ms = args.slo_ms, args.hedge_ms
+        if slo_ms is None or hedge_ms is None:
+            pl = None
+            try:
+                from factorvae_tpu import plan as planlib
+                from factorvae_tpu.serve.registry import (
+                    checkpoint_config,
+                )
+
+                if os.path.isdir(args.model[0]):
+                    pl = planlib.plan_for_config(
+                        checkpoint_config(args.model[0]), pool.n_max)
+            except Exception:  # graftlint: disable=JGL007 plan lookup is an optional default source for flags the user left unset — a missing/corrupt plan file or non-checkpoint model path degrades to the documented no-SLO/auto-quantile defaults, and the startup banner below reports the resolved hedge/SLO state
+                pl = None
+            if slo_ms is None:
+                slo_ms = pl.serve_slo_ms if pl is not None else 0.0
+            if hedge_ms is None:
+                hedge_ms = (pl.serve_hedge_ms if pl is not None
+                            else -1.0)
+        pool.router_url = f"http://127.0.0.1:{args.router_port}"
+        router = Router(pool, max_inflight=args.max_inflight,
+                        slo_ms=slo_ms, hedge_ms=hedge_ms,
+                        hedge=not args.no_hedge)
+        scaler = None
+        if args.autoscale and args.autoscale > args.workers:
+            from factorvae_tpu.serve.autoscale import AutoScaler
+
+            scaler = AutoScaler(pool, router,
+                                min_workers=args.workers,
+                                max_workers=args.autoscale,
+                                slo_ms=slo_ms or 0.0)
+            router.autoscaler = scaler
+            scaler.start()
+            print(f"[pool] autoscaler: {args.workers}.."
+                  f"{args.autoscale} workers, SLO "
+                  f"{slo_ms or 0:g}ms", file=sys.stderr)
         print(f"[pool] router ready: "
               f"http://127.0.0.1:{args.router_port}/score "
-              f"({args.workers} workers, sticky rendezvous routing)",
+              f"({args.workers} workers, sticky rendezvous routing, "
+              f"hedge={'off' if args.no_hedge else 'on'})",
               file=sys.stderr)
-        router.serve(args.router_port)
+        try:
+            router.serve(args.router_port)
+        finally:
+            if scaler is not None:
+                scaler.stop()
         return 0
     except PoolError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -238,13 +313,41 @@ def run_pool(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if not args.model:
-        print("error: at least one --model is required", file=sys.stderr)
+    if not args.model and not args.join:
+        print("error: at least one --model is required (or --join "
+              "URL to sync models from a fleet)", file=sys.stderr)
         return 2
-    if not args.dataset and not args.synthetic:
+    if not args.dataset and not args.synthetic and not args.join:
         print("error: pass --dataset PATH or --synthetic DAYS,STOCKS",
               file=sys.stderr)
         return 2
+    if args.join:
+        # Remote-worker bootstrap (ISSUE 17): sync the fleet's
+        # artifacts (digest-verified), mirror its args, then fall
+        # through to the ordinary single-daemon path below — a
+        # remote worker IS a daemon, just one whose inputs came off
+        # the wire and who announces itself when healthy.
+        if args.workers > 1:
+            print("error: --join runs ONE worker agent; scale by "
+                  "joining more hosts (or --autoscale on the "
+                  "router)", file=sys.stderr)
+            return 2
+        from factorvae_tpu.serve import remote
+        from factorvae_tpu.serve.pool import free_port
+
+        if args.http is None:
+            args.http = free_port()
+        args.scheduler = True
+        try:
+            capability = remote.prepare_join(args, build_parser())
+        except remote.JoinError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"[join] synced {len(args.model)} artifact(s) from "
+              f"{args.join} into {args.aot_store}", file=sys.stderr)
+        remote.register_when_healthy(
+            args.join, args.http, capability,
+            host=args.advertise_host)
     if args.workers > 1:
         # The scale-out tier (ISSUE 15). N=1 falls through to the
         # single-daemon path below — byte-identical to the pre-pool
@@ -355,7 +458,10 @@ def main(argv=None) -> int:
                         spec, config=facts, precision=precision,
                         n_stocks=dataset.n_max)
                 else:
-                    key = registry.register_artifact(spec)
+                    key = registry.register_artifact(
+                        spec,
+                        expected_sha256=getattr(
+                            args, "_expected_sha256", {}).get(spec))
             except RegistryError as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2

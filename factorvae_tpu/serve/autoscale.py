@@ -1,0 +1,255 @@
+"""SLO-driven worker autoscaling over the router's own signals.
+
+The control loop (ISSUE 17) that turns `--autoscale MAX` into fleet
+size: every `interval_s` it reads the router's `autoscale_signals()` —
+queue depth, observed p99 vs the declared `--slo_ms`, healthy worker
+count — and decides **up**, **down**, or nothing. No new measurement
+machinery: the signals are the counters the router already keeps (and
+`/metrics` already exports via `obs.metrics.autoscale_families`), so
+any scale decision can be replayed off a scrape.
+
+Policy, deliberately boring:
+
+- **Scale UP** when the fleet is pressured: observed p99 above the
+  declared SLO, queue depth above `queue_high_per_worker x healthy`,
+  or fewer healthy workers than `min_workers` (a death the watcher
+  hasn't healed yet). Pressure must hold for `up_after` CONSECUTIVE
+  ticks — hysteresis, so one slow compile doesn't double the fleet.
+- **Scale DOWN** when idle: queue depth at/under `queue_low` AND p99
+  comfortably inside the SLO (under half, when one is declared) for
+  `down_after` consecutive ticks. Down is slower than up on purpose —
+  flapping costs cold joins.
+- **Bounds**: never below `min_workers`, never above `max_workers`;
+  `cooldown_s` after any action before the next (scale_up already
+  blocks on the new worker turning healthy, a natural cooldown on
+  top).
+
+`decide()` is pure — signals in, verdict out — so the hysteresis and
+bound logic unit-tests without a fleet (tests/test_remote.py). The
+actuation (`pool.scale_up` / `pool.scale_down`) grows remote workers
+when the pool has a `router_url` (joining agents that bootstrap off
+the artifact service), local ones otherwise.
+
+Threading: one daemon thread, `Event.wait(interval)` paced, joined on
+`stop()` — the watcher-thread discipline from serve/pool.py. The loop
+calls pool/router methods that take their own locks and holds none of
+its own while actuating.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from factorvae_tpu.utils.logging import timeline_event
+
+
+class AutoScaler:
+    """Scale `pool` between `min_workers` and `max_workers` from the
+    router's signals. `start()`/`stop()` run the loop on an internal
+    thread; `tick()` runs one read-decide-act round inline (tests, and
+    the bench's deterministic drives)."""
+
+    def __init__(self, pool, router, min_workers: int = 1,
+                 max_workers: int = 4, slo_ms: float = 0.0,
+                 interval_s: float = 1.0, up_after: int = 2,
+                 down_after: int = 6, cooldown_s: float = 5.0,
+                 queue_high_per_worker: int = 4, queue_low: int = 1):
+        self.pool = pool
+        self.router = router
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.slo_ms = float(slo_ms)
+        self.interval_s = float(interval_s)
+        self.up_after = max(1, int(up_after))
+        self.down_after = max(1, int(down_after))
+        self.cooldown_s = float(cooldown_s)
+        self.queue_high_per_worker = int(queue_high_per_worker)
+        self.queue_low = int(queue_low)
+        # hysteresis state: consecutive pressured / idle ticks, and
+        # ticks remaining in the post-action cooldown. One lock
+        # serializes every counter write/composite read — decide()
+        # runs on the loop thread while describe()//metric_families()
+        # scrape from the router's request threads.
+        self._lock = threading.Lock()
+        self._above = 0
+        self._below = 0
+        self._cooldown_ticks = 0
+        self.ticks = 0
+        self.ups = 0
+        self.downs = 0
+        self.last_decision: Optional[str] = None
+        self.last_reason: str = ""
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- policy (pure) ---------------------------------------------------
+
+    def _pressure(self, sig: dict) -> Tuple[bool, List[str]]:
+        """Is the fleet pressured this tick, and why."""
+        why = []
+        healthy = int(sig.get("workers_healthy") or 0)
+        queue = int(sig.get("queue_depth") or 0)
+        p99 = sig.get("p99_ms")
+        slo = float(sig.get("slo_ms") or self.slo_ms or 0.0)
+        if healthy < self.min_workers:
+            why.append(f"healthy {healthy} < min {self.min_workers}")
+        if queue > self.queue_high_per_worker * max(1, healthy):
+            why.append(f"queue {queue} > "
+                       f"{self.queue_high_per_worker}/worker")
+        if slo > 0 and p99 is not None and p99 > slo:
+            why.append(f"p99 {p99:.1f}ms > SLO {slo:g}ms")
+        return bool(why), why
+
+    def _idle(self, sig: dict) -> bool:
+        queue = int(sig.get("queue_depth") or 0)
+        p99 = sig.get("p99_ms")
+        slo = float(sig.get("slo_ms") or self.slo_ms or 0.0)
+        if queue > self.queue_low:
+            return False
+        if slo > 0 and p99 is not None and p99 > 0.5 * slo:
+            return False
+        return True
+
+    def decide(self, sig: dict) -> Optional[str]:
+        """One tick of policy: 'up', 'down', or None. Pure in `sig`
+        (plus the instance's hysteresis counters) — no pool, no
+        router, no clock — so the policy unit-tests standalone."""
+        with self._lock:
+            self.ticks += 1
+            if self._cooldown_ticks > 0:
+                self._cooldown_ticks -= 1
+                self.last_decision = None
+                self.last_reason = "cooldown"
+                return None
+            total = int(sig.get("workers_total") or 0)
+            pressured, why = self._pressure(sig)
+            if pressured:
+                self._above += 1
+                self._below = 0
+            elif self._idle(sig):
+                self._below += 1
+                self._above = 0
+            else:
+                self._above = self._below = 0
+            if (self._above >= self.up_after
+                    and total < self.max_workers):
+                self._above = self._below = 0
+                self._cooldown_ticks = self._cooldown_ratio()
+                self.last_decision = "up"
+                self.last_reason = "; ".join(why)
+                return "up"
+            if (self._below >= self.down_after
+                    and total > self.min_workers):
+                self._above = self._below = 0
+                self._cooldown_ticks = self._cooldown_ratio()
+                self.last_decision = "down"
+                self.last_reason = (f"idle: queue <= "
+                                    f"{self.queue_low} for "
+                                    f"{self.down_after} ticks")
+                return "down"
+            self.last_decision = None
+            self.last_reason = "; ".join(why) if pressured else ""
+            return None
+
+    def _cooldown_ratio(self) -> int:
+        if self.interval_s <= 0:
+            return 0
+        return max(0, int(round(self.cooldown_s / self.interval_s)))
+
+    # ---- actuation -------------------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        """One read-decide-act round. Returns the action taken."""
+        sig = self.router.autoscale_signals()
+        verdict = self.decide(sig)
+        if verdict is None:
+            return None
+        try:
+            if verdict == "up":
+                w = self.pool.scale_up()
+                if w is not None:
+                    with self._lock:
+                        self.ups += 1
+            else:
+                wid = self.pool.scale_down()
+                if wid is not None:
+                    with self._lock:
+                        self.downs += 1
+        except Exception as e:
+            timeline_event("autoscale_failed", cat="serve",
+                           resource="autoscaler", action=verdict,
+                           error=str(e)[:200])
+            return None
+        timeline_event("autoscale", cat="serve",
+                       resource="autoscaler", action=verdict,
+                       reason=self.last_reason,
+                       queue=sig.get("queue_depth"),
+                       p99_ms=sig.get("p99_ms"),
+                       healthy=sig.get("workers_healthy"))
+        return verdict
+
+    # ---- loop ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()  # graftlint: disable=JGL009 threading.Event is itself the synchronization primitive (internally locked); this re-arm runs strictly before Thread.start() below, and stop() joins the loop thread before any restart — no concurrent wait() can exist here
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler")
+        self._thread.daemon = True
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        self._thread = None
+        if t is not None and t.is_alive():
+            t.join(timeout=60)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:
+                # the loop outlives any one bad tick — a scrape
+                # hiccup must not kill autoscaling for the run
+                timeline_event("autoscale_tick_error", cat="serve",
+                               resource="autoscaler",
+                               error=str(e)[:200])
+
+    # ---- telemetry -------------------------------------------------------
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"min_workers": self.min_workers,
+                    "max_workers": self.max_workers,
+                    "slo_ms": self.slo_ms,
+                    "interval_s": self.interval_s,
+                    "ticks": self.ticks,
+                    "ups": self.ups, "downs": self.downs,
+                    "last_decision": self.last_decision,
+                    "last_reason": self.last_reason,
+                    "pressured_ticks": self._above,
+                    "idle_ticks": self._below,
+                    "cooldown_ticks": self._cooldown_ticks}
+
+    def metric_families(self):
+        """Exposition families for the router's /metrics merge."""
+        from factorvae_tpu.obs.metrics import PREFIX, metric_line
+
+        with self._lock:
+            ups, downs = self.ups, self.downs
+        p = f"{PREFIX}_router_autoscale"
+        return [
+            (f"{p}_ups_total", "counter",
+             "autoscaler scale-up actions",
+             [metric_line(f"{p}_ups_total", ups)]),
+            (f"{p}_downs_total", "counter",
+             "autoscaler scale-down actions",
+             [metric_line(f"{p}_downs_total", downs)]),
+            (f"{p}_max_workers", "gauge",
+             "autoscaler worker-count ceiling",
+             [metric_line(f"{p}_max_workers", self.max_workers)]),
+            (f"{p}_min_workers", "gauge",
+             "autoscaler worker-count floor",
+             [metric_line(f"{p}_min_workers", self.min_workers)]),
+        ]

@@ -40,6 +40,25 @@ holding the alias, one zero-downtime alias flip at a time, and
 respawned workers replay the same admissions so a crash never
 resurrects yesterday's incumbent (docs/walkforward.md).
 
+**Multi-host (ISSUE 17).** The worker table is no longer only local
+subprocesses: a REMOTE worker registers over HTTP (the router's
+`POST /register` with its host, port and capability digest —
+`adopt_remote`), and the `AotStore` doubles as a CONTENT-ADDRESSED
+artifact service (`manifest()` / `capability_digest()` /
+`blob_path(sha256)`, served by the router as `GET /artifacts` +
+`GET /artifact/<sha256>`) so a cold host joins with zero traces —
+only digest-verified artifact downloads (serve/remote.py) into the
+same warm path respawns use. `launch_remote` spawns a joining agent
+on localhost (the simulated-host mode bench/chaos drive);
+externally-started agents register the same way and are adopted
+without a process handle. `scale_up`/`scale_down` give the
+SLO-driven autoscaler (serve/autoscale.py) its actuators, and
+`rolling_upgrade` drains+respawns the fleet one worker at a time
+(new code, same artifacts — the PR-13 rollover discipline applied to
+processes). The chaos class `kill_remote_worker` (request = worker
+index) SIGKILLs a pool-launched agent from the watcher tick; recovery
+is the router's reroute plus the agent's full cold re-join.
+
 Locking: `self._lock` guards the worker table, counters and the admit
 log. Network scrapes, subprocess spawns and AOT exports all run
 OUTSIDE it — a slow worker must not stall the router's
@@ -96,6 +115,23 @@ def http_text(url: str, timeout: float = 30.0) -> str:
         return resp.read().decode()
 
 
+def http_bytes(url: str, timeout: float = 600.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def file_sha256(path: str) -> str:
+    """Streamed sha256 of a file — the content address an artifact is
+    served and verified under (GET /artifact/<sha256>)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 # ---------------------------------------------------------------------------
 # AOT artifact store
 # ---------------------------------------------------------------------------
@@ -110,11 +146,23 @@ class AotStore:
     artifact-backed fleet. A `<alias>.meta.json` sidecar records the
     exported weights' digest so an unchanged checkpoint re-exports
     nothing (the export's one trace per call is the cost being
-    skipped)."""
+    skipped).
+
+    The store is also CONTENT-ADDRESSED (ISSUE 17): the sidecar
+    records the artifact file's sha256, `manifest()` lists every
+    alias with its content address, `capability_digest()` collapses
+    the manifest into one fleet-identity digest (what a registering
+    remote worker must present), and `blob_path(sha256)` resolves a
+    content address back to bytes — the router serves exactly these
+    as `GET /artifacts` + `GET /artifact/<sha256>`."""
 
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        # (alias, mtime, size) -> sha256: recomputing a content
+        # address per scrape would re-read every artifact.
+        self._sha_cache: dict = {}
+        self._sha_lock = threading.Lock()
 
     def path_for(self, alias: str) -> str:
         return os.path.join(self.root, alias)
@@ -161,6 +209,8 @@ class AotStore:
             return out
         blob = export_prediction(params, config, n_max=int(n_max),
                                  stochastic=False)
+        import hashlib
+
         tmp = out + ".tmp"
         with open(tmp, "wb") as fh:
             fh.write(blob)
@@ -168,7 +218,8 @@ class AotStore:
         tmp_meta = meta_path + ".tmp"
         with open(tmp_meta, "w") as fh:
             json.dump({"digest": digest, "n_max": int(n_max),
-                       "source": path}, fh)
+                       "source": path,
+                       "sha256": hashlib.sha256(blob).hexdigest()}, fh)
         os.replace(tmp_meta, meta_path)
         timeline_event("aot_export", cat="serve", resource="pool",
                        alias=alias, n_max=int(n_max), bytes=len(blob))
@@ -189,6 +240,88 @@ class AotStore:
             os.replace(tmp, out)
         return out
 
+    # ---- content addressing (ISSUE 17) -----------------------------------
+
+    def sha256_for(self, alias: str) -> str:
+        """The alias' content address, cached by (mtime, size) and
+        persisted into the meta sidecar so a restarted control plane
+        never re-hashes an unchanged artifact."""
+        path = self.path_for(alias)
+        st = os.stat(path)
+        key = (alias, st.st_mtime_ns, st.st_size)
+        with self._sha_lock:
+            sha = self._sha_cache.get(key)
+        if sha:
+            return sha
+        meta_path = path + ".meta.json"
+        meta: dict = {}
+        try:
+            with open(meta_path) as fh:
+                meta = json.load(fh)
+        except (OSError, ValueError):
+            meta = {}
+        sha = meta.get("sha256")
+        meta_fresh = False
+        try:
+            meta_fresh = (os.stat(meta_path).st_mtime_ns
+                          >= st.st_mtime_ns)
+        except OSError:
+            pass
+        if not (sha and meta_fresh):
+            sha = file_sha256(path)
+            tmp = meta_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({**meta, "sha256": sha}, fh)
+            os.replace(tmp, meta_path)
+        with self._sha_lock:
+            self._sha_cache[key] = sha
+        return sha
+
+    def manifest(self) -> List[dict]:
+        """Every alias with its content address (+ size and the
+        exported n_max when the sidecar knows it) — the body of the
+        router's `GET /artifacts`."""
+        out = []
+        for alias in self.aliases():
+            path = self.path_for(alias)
+            try:
+                meta = {}
+                try:
+                    with open(path + ".meta.json") as fh:
+                        meta = json.load(fh)
+                except (OSError, ValueError):
+                    meta = {}
+                out.append({"alias": alias,
+                            "sha256": self.sha256_for(alias),
+                            "bytes": os.path.getsize(path),
+                            "n_max": meta.get("n_max")})
+            except OSError:
+                continue   # torn mid-replace: next scrape sees it
+        return out
+
+    def capability_digest(self) -> str:
+        """One digest over the sorted (alias, sha256) pairs — the
+        fleet's artifact-set identity. A registering remote worker
+        presents the digest of what IT serves; a mismatch means it
+        materialized a different artifact set and must re-sync, not
+        join."""
+        import hashlib
+
+        lines = sorted(f"{m['alias']} {m['sha256']}"
+                       for m in self.manifest())
+        return hashlib.sha256("\n".join(lines).encode()).hexdigest()
+
+    def blob_path(self, sha256: str) -> Optional[str]:
+        """Resolve a content address to an artifact path (None when no
+        alias hashes to it) — the router's `GET /artifact/<sha256>`."""
+        for alias in self.aliases():
+            try:
+                if self.sha256_for(alias) == sha256:
+                    return self.path_for(alias)
+            except OSError:
+                continue
+        return None
+
 
 # ---------------------------------------------------------------------------
 # worker handle + pool
@@ -196,34 +329,52 @@ class AotStore:
 
 
 class Worker:
-    """One worker process slot. Field mutation happens under the
-    pool's lock; the subprocess handle itself is only driven by the
-    pool (spawn/terminate/kill/poll)."""
+    """One worker slot. Field mutation happens under the pool's lock;
+    the subprocess handle itself is only driven by the pool
+    (spawn/terminate/kill/poll).
 
-    def __init__(self, index: int, port: int, log_path: str):
+    `kind` is "local" (a daemon subprocess the pool spawned) or
+    "remote" (a worker that REGISTERED over HTTP — ISSUE 17). A
+    remote slot routes by `host:port` like any other; its `proc` is
+    the joining AGENT process when the pool launched it
+    (`launch_remote` — killable, respawnable, the simulated-host
+    mode) and None when the host joined on its own (health scrapes
+    are then the only liveness signal, and death deregisters instead
+    of respawning)."""
+
+    def __init__(self, index: int, port: int, log_path: str,
+                 host: str = "127.0.0.1", kind: str = "local"):
         self.index = index
-        self.wid = f"w{index}"
+        self.kind = kind          # "local" | "remote"
+        self.wid = (f"w{index}" if kind == "local" else f"r{index}")
+        self.host = host
         self.port = port
         self.log_path = log_path
         self.proc: Optional[subprocess.Popen] = None
+        self.cmd: Optional[list] = None  # remote agent respawn cmd
+        self.capability: Optional[str] = None  # registered digest
         self.state = "starting"   # starting|ok|degraded|failing|dead
+                                  # (+ draining|upgrading: hands-off)
         self.restarts = 0
         self.fails = 0            # consecutive scrape failures
         self.last_health: Optional[dict] = None
         self.admits_replayed = 0
-        self.respawn_source = None  # "aot_store" | "specs" on respawn
+        self.respawn_source = None  # "aot_store" | "specs" |
+                                    # "artifact_service" on respawn
 
     @property
     def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        return f"http://{self.host}:{self.port}"
 
     def describe(self) -> dict:
         return {
-            "worker_id": self.wid, "port": self.port, "url": self.url,
+            "worker_id": self.wid, "kind": self.kind,
+            "host": self.host, "port": self.port, "url": self.url,
             "state": self.state,
             "pid": self.proc.pid if self.proc else None,
             "restarts": self.restarts,
             "respawn_source": self.respawn_source,
+            "capability": self.capability,
             "healthz": f"{self.url}/healthz",
             "metrics": f"{self.url}/metrics",
             "stats": f"{self.url}/stats",
@@ -330,6 +481,14 @@ class WorkerPool:
         self.n_max: Optional[int] = None
         self.respawns = 0
         self.kills = 0            # chaos kill_worker firings
+        self.remote_kills = 0     # chaos kill_remote_worker firings
+        self.remote_adopts = 0    # /register adoptions (ISSUE 17)
+        self.upgrades = 0         # rolling-upgrade worker cycles
+        self._next_index = int(n_workers)
+        # The URL remote agents should (re)join through; set by the
+        # CLI/bench once the router is listening. launch_remote needs
+        # it explicitly otherwise.
+        self.router_url: Optional[str] = None
         self._admit_log: List[dict] = []
         self._draining = False
         self._watcher: Optional[threading.Thread] = None
@@ -365,9 +524,12 @@ class WorkerPool:
         return list(self.model_specs), "specs"
 
     def _spawn(self, w: Worker, models: Sequence[str]) -> None:
-        """Start (or restart) one worker process; the handle and state
-        land under the lock, the spawn itself runs outside it."""
-        cmd = self._worker_cmd(w, models)
+        """Start (or restart) one LOCAL worker process; the handle and
+        state land under the lock, the spawn itself runs outside it."""
+        self._spawn_cmd(w, self._worker_cmd(w, models))
+
+    def _spawn_cmd(self, w: Worker, cmd: Sequence[str]) -> None:
+        cmd = list(cmd)
         log = open(w.log_path, "ab")
         try:
             proc = subprocess.Popen(cmd, stdout=log, stderr=log,
@@ -426,15 +588,17 @@ class WorkerPool:
         into the shared cache), then the AOT pre-export at the
         measured panel width, then the rest of the fleet — warm by
         construction."""
-        self._spawn(self.workers[0], self.model_specs)
-        self._wait_healthy(self.workers[:1])
-        stats = http_json(self.workers[0].url + "/stats", timeout=30.0)
+        with self._lock:   # snapshot: scale_up appends from threads
+            ws = list(self.workers)
+        self._spawn(ws[0], self.model_specs)
+        self._wait_healthy(ws[:1])
+        stats = http_json(ws[0].url + "/stats", timeout=30.0)
         self.n_max = int((stats.get("panel") or {}).get("n_max") or 0)
         self.pre_export()
-        for w in self.workers[1:]:
+        for w in ws[1:]:
             self._spawn(w, self.model_specs)
-        if len(self.workers) > 1:
-            self._wait_healthy(self.workers[1:])
+        if len(ws) > 1:
+            self._wait_healthy(ws[1:])
         self._watcher = threading.Thread(
             target=self._watch, name="pool-watcher", daemon=True)
         self._watcher.start()
@@ -494,14 +658,292 @@ class WorkerPool:
                 "workers": [w.describe() for w in self.workers],
                 "healthy": sum(1 for w in self.workers
                                if w.state in ("ok", "degraded")),
+                "remote": sum(1 for w in self.workers
+                              if w.kind == "remote"),
                 "respawns": self.respawns,
                 "kills": self.kills,
+                "remote_kills": self.remote_kills,
+                "remote_adopts": self.remote_adopts,
+                "upgrades": self.upgrades,
                 "admits_fanned_out": len(self._admit_log),
                 "aot_store": self.store.root,
                 "compile_cache": self.cache_dir,
                 "n_max": self.n_max,
                 "draining": self._draining,
             }
+
+    # ---- multi-host: registration / scaling / upgrade (ISSUE 17) ---------
+
+    def adopt_remote(self, host: str, port: int,
+                     capability: Optional[str] = None) -> Worker:
+        """Adopt a worker that registered over HTTP (`POST /register`).
+        The capability digest it presents must match the store's —
+        a worker serving a different artifact set would answer
+        requests with the wrong model bytes, the one failure mode
+        routing can never detect. Registration is idempotent by
+        (host, port): a respawned agent re-registering on the same
+        address HEALS its slot instead of growing the table."""
+        expect = self.store.capability_digest()
+        if capability is not None and expect \
+                and capability != expect:
+            raise PoolError(
+                f"remote worker {host}:{port} presented capability "
+                f"digest {capability[:12]}… but the fleet serves "
+                f"{expect[:12]}… — it materialized a different "
+                f"artifact set; re-sync from GET /artifacts and "
+                f"register again")
+        with self._lock:
+            w = next((x for x in self.workers
+                      if x.host == host and x.port == int(port)), None)
+            if w is not None:
+                rejoin = w.state == "dead"
+                if rejoin:
+                    w.restarts += 1
+                w.capability = capability
+                w.fails = 0
+                w.state = "starting"
+                # A joining agent materialized the CURRENT store —
+                # admit_fanout refreshes the store before any fan-out,
+                # so the downloads already carry every promotion.
+                w.admits_replayed = len(self._admit_log)
+            else:
+                rejoin = False
+                idx = self._next_index
+                self._next_index += 1
+                w = Worker(idx, int(port),
+                           os.path.join(self.work_dir, f"r{idx}.log"),
+                           host=host, kind="remote")
+                w.capability = capability
+                w.admits_replayed = len(self._admit_log)
+                self.workers.append(w)
+                self.remote_adopts += 1
+        # Registration arrives from an agent that is already serving:
+        # one immediate scrape makes it routable now instead of one
+        # watcher interval later.
+        try:
+            health = http_json(w.url + "/healthz", timeout=2.0)
+        except (OSError, ValueError, PoolError):
+            health = None
+        with self._lock:
+            if health is not None:
+                w.last_health = health
+                w.state = "ok" if health.get("ok") else "failing"
+        timeline_event("remote_adopt", cat="serve", resource="pool",
+                       worker=w.wid, host=host, port=int(port),
+                       rejoin=rejoin, state=w.state)
+        return w
+
+    def deregister(self, wid: str) -> dict:
+        """Graceful leave (`POST /deregister`): the slot drops out of
+        routing and off the table; a pool-launched agent process is
+        terminated (its drain finishes in-flight work)."""
+        w = self.worker(wid)
+        with self._lock:
+            w.state = "draining"
+        proc = w.proc
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30)
+        with self._lock:
+            w.state = "dead"
+            if w in self.workers:
+                self.workers.remove(w)
+        timeline_event("remote_deregister", cat="serve",
+                       resource="pool", worker=wid)
+        return {"ok": True, "worker": wid}
+
+    def launch_remote(self, router_url: Optional[str] = None,
+                      port: Optional[int] = None,
+                      extra_args: Sequence[str] = (),
+                      wait_healthy: bool = False) -> Worker:
+        """Spawn a JOINING agent on localhost (the simulated-host mode
+        bench/chaos/autoscale drive): `python -m factorvae_tpu.serve
+        --join <router>` downloads the artifact set from the content-
+        addressed store, verifies every digest, serves it, and
+        registers itself back — the identical protocol a real remote
+        host speaks. The slot is created up front so the watcher owns
+        the agent process (kill -> respawn -> cold re-join)."""
+        router_url = router_url or self.router_url
+        if not router_url:
+            raise PoolError(
+                "launch_remote needs the router's URL (set "
+                "pool.router_url once the router listens, or pass "
+                "router_url=)")
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+        w = Worker(idx, int(port or free_port()),
+                   os.path.join(self.work_dir, f"r{idx}.log"),
+                   kind="remote")
+        cmd = [sys.executable, "-m", "factorvae_tpu.serve",
+               "--join", router_url, "--http", str(w.port),
+               "--compile_cache", self.cache_dir,
+               "--aot_store",
+               os.path.join(self.work_dir, f"r{idx}_store"),
+               "--scheduler"]
+        if self.warmup:
+            cmd += ["--warmup"]
+        if self.metrics_base:
+            base, ext = os.path.splitext(self.metrics_base)
+            cmd += ["--metrics_jsonl",
+                    f"{base}_{w.wid}{ext or '.jsonl'}"]
+        cmd += list(extra_args)
+        w.cmd = cmd
+        with self._lock:
+            self.workers.append(w)
+        self._spawn_cmd(w, cmd)
+        timeline_event("remote_launch", cat="serve", resource="pool",
+                       worker=w.wid, port=w.port)
+        if wait_healthy:
+            self._wait_healthy([w])
+        return w
+
+    def artifact_manifest(self) -> dict:
+        """Everything a cold host needs to join (`GET /artifacts`):
+        the content-addressed artifact list, the fleet's capability
+        digest, and the panel/worker arguments the agents mirror."""
+        return {"ok": True,
+                "artifacts": self.store.manifest(),
+                "capability_digest": self.store.capability_digest(),
+                "dataset_args": list(self.dataset_args),
+                "extra_args": list(self.extra_args),
+                "n_max": self.n_max}
+
+    def scale_up(self, timeout_s: Optional[float] = None
+                 ) -> Optional[Worker]:
+        """Autoscaler actuator: one more worker. A remote fleet
+        (router_url set) grows by launching a joining agent; a local
+        fleet by spawning a daemon warm off the AOT store + shared
+        cache. Blocks until the newcomer answers /healthz — the
+        control loop's natural cooldown."""
+        with self._lock:
+            if self._draining:
+                return None
+        if self.router_url:
+            w = self.launch_remote(wait_healthy=False)
+        else:
+            with self._lock:
+                idx = self._next_index
+                self._next_index += 1
+            w = Worker(idx, free_port(),
+                       os.path.join(self.work_dir, f"w{idx}.log"))
+            with self._lock:
+                self.workers.append(w)
+            models, source = self._respawn_models()
+            self._spawn(w, models)
+            with self._lock:
+                w.respawn_source = source
+        self._wait_healthy([w], timeout_s or self.start_timeout_s)
+        with self._lock:
+            n = len(self.workers)
+        timeline_event("scale_up", cat="serve", resource="pool",
+                       worker=w.wid, kind=w.kind, workers=n)
+        return w
+
+    def scale_down(self, wid: Optional[str] = None
+                   ) -> Optional[Worker]:
+        """Autoscaler actuator: retire one worker (newest first;
+        worker 0 never — it anchors n_max and the warm cache).
+        Retiring is drain-shaped: the slot leaves routing, the
+        process SIGTERMs (its daemon finishes in-flight work), the
+        row leaves the table."""
+        with self._lock:
+            # Only workers whose PROCESS this pool owns are
+            # candidates: "retiring" an externally joined agent
+            # (proc None — its host owns it) frees no resources, it
+            # just orphans live serving capacity out of the routing
+            # table. External capacity leaves via deregister.
+            cands = [w for w in self.workers
+                     if w.index != 0 and w.proc is not None
+                     and w.state not in
+                     ("dead", "draining", "upgrading")]
+            if not cands:
+                return None
+            w = (next((x for x in cands if x.wid == wid), None)
+                 if wid else cands[-1])
+            if w is None:
+                return None
+            w.state = "draining"
+        proc = w.proc
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30)
+        with self._lock:
+            w.state = "dead"
+            if w in self.workers:
+                self.workers.remove(w)
+            n = len(self.workers)
+        timeline_event("scale_down", cat="serve", resource="pool",
+                       worker=w.wid, workers=n)
+        return w
+
+    def rolling_upgrade(self,
+                        timeout_s: Optional[float] = None) -> dict:
+        """Drain/join choreography (new code, same artifacts): one
+        worker at a time leaves routing ("upgrading" — the watcher
+        keeps hands off), SIGTERMs (the daemon's graceful drain
+        finishes in-flight ticks, so nothing drops), respawns from
+        the SAME artifacts under whatever code is now on disk, and
+        must answer /healthz before the next worker starts — the
+        PR-13 rollover discipline applied to processes. Externally
+        joined remotes are skipped with an actionable note (their
+        host owns their process)."""
+        with self._lock:
+            snapshot = [w for w in self.workers if w.state != "dead"]
+        results = []
+        for w in snapshot:
+            if w.proc is None:
+                results.append({
+                    "worker": w.wid, "ok": False,
+                    "error": "externally joined remote worker; "
+                             "upgrade its agent from its own host"})
+                continue
+            t0 = time.monotonic()
+            with self._lock:
+                w.state = "upgrading"
+            proc = w.proc
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=30)
+            if w.kind == "remote":
+                self._spawn_cmd(w, w.cmd)
+                source = "artifact_service"
+            else:
+                models, source = self._respawn_models()
+                self._spawn(w, models)
+            try:
+                self._wait_healthy(
+                    [w], timeout_s or self.start_timeout_s)
+            except PoolError as e:
+                results.append({"worker": w.wid, "ok": False,
+                                "error": str(e)})
+                # stop the roll: a fleet that cannot raise the new
+                # code must keep the rest of its capacity serving
+                break
+            with self._lock:
+                w.restarts += 1
+                w.respawn_source = source
+                self.upgrades += 1
+            wall = time.monotonic() - t0
+            results.append({"worker": w.wid, "ok": True,
+                            "wall_s": round(wall, 3)})
+            timeline_event("worker_upgraded", cat="serve",
+                           resource="pool", worker=w.wid,
+                           wall_s=round(wall, 3), source=source)
+        ok = all(r.get("ok") for r in results) and bool(results)
+        return {"ok": ok, "workers": results}
 
     # ---- rolling admit fan-out -------------------------------------------
 
@@ -582,48 +1024,74 @@ class WorkerPool:
         with self._lock:
             proc, state = w.proc, w.state
             draining = self._draining
-        if proc is None or draining:
+        if draining or state in ("draining", "upgrading"):
+            # scale_down/rolling_upgrade own this slot right now: a
+            # watcher respawn would resurrect a worker mid-drain.
             return
-        # Chaos injection point (request = worker index): SIGKILL the
-        # worker mid-tick; the recovery exercised is the router's
-        # reroute plus THIS watcher's respawn-from-AOT-store.
-        if chaos_fault("kill_worker", request=w.index) is not None:
-            proc.kill()
-            proc.wait(timeout=30)
-            with self._lock:
-                self.kills += 1
-            timeline_event("chaos_kill_worker", cat="recovery",
-                           resource="pool", worker=w.wid)
-        if proc.poll() is not None:
-            with self._lock:
-                w.state = "dead"
-                w.last_health = None
-                do_respawn = self.respawn and not self._draining
-                if do_respawn:
-                    self.respawns += 1
-            timeline_event("worker_dead", cat="recovery",
-                           resource="pool", worker=w.wid,
-                           rc=proc.returncode, respawn=do_respawn)
-            if not do_respawn:
+        if proc is not None:
+            # Chaos injection points (request = worker index): SIGKILL
+            # the process mid-tick. kill_worker exercises the local
+            # respawn-from-AOT-store; kill_remote_worker kills a
+            # pool-launched AGENT (the simulated host dying) whose
+            # recovery is the full cold re-join — artifact downloads
+            # off the content-addressed store + re-registration.
+            kind = ("kill_worker" if w.kind == "local"
+                    else "kill_remote_worker")
+            if chaos_fault(kind, request=w.index) is not None:
+                proc.kill()
+                proc.wait(timeout=30)
+                with self._lock:
+                    if w.kind == "local":
+                        self.kills += 1
+                    else:
+                        self.remote_kills += 1
+                timeline_event(f"chaos_{kind}", cat="recovery",
+                               resource="pool", worker=w.wid)
+            if proc.poll() is not None:
+                with self._lock:
+                    w.state = "dead"
+                    w.last_health = None
+                    do_respawn = self.respawn and not self._draining
+                    if do_respawn:
+                        self.respawns += 1
+                timeline_event("worker_dead", cat="recovery",
+                               resource="pool", worker=w.wid,
+                               rc=proc.returncode, respawn=do_respawn)
+                if not do_respawn:
+                    return
+                if w.kind == "remote":
+                    # The agent re-joins cold: it re-downloads the
+                    # artifact set from the content-addressed store
+                    # and re-registers on the same host:port (the
+                    # slot heals rather than growing the table).
+                    self._spawn_cmd(w, w.cmd)
+                    source = "artifact_service"
+                else:
+                    models, source = self._respawn_models()
+                    self._spawn(w, models)
+                with self._lock:
+                    w.restarts += 1
+                    w.respawn_source = source
+                timeline_event("worker_respawn", cat="recovery",
+                               resource="pool", worker=w.wid,
+                               source=source)
                 return
-            models, source = self._respawn_models()
-            self._spawn(w, models)
-            with self._lock:
-                w.restarts += 1
-                w.respawn_source = source
-            timeline_event("worker_respawn", cat="recovery",
-                           resource="pool", worker=w.wid,
-                           source=source)
-            return
         try:
             health = http_json(w.url + "/healthz", timeout=2.0)
         except (OSError, ValueError, PoolError):
-            # unreachable/slow: strikes accrue toward "failing"
+            # unreachable/slow: strikes accrue toward "failing"; an
+            # externally joined remote (no process to poll) is
+            # declared dead after a second round of strikes — its
+            # only way back is to re-register.
             with self._lock:
                 w.fails += 1
                 if (w.fails >= self.SCRAPE_FAILS_FAILING
                         and w.state != "starting"):
                     w.state = "failing"
+                if (w.proc is None and w.kind == "remote"
+                        and w.fails >= 2 * self.SCRAPE_FAILS_FAILING):
+                    w.state = "dead"
+                    w.last_health = None
             return
         status = str(health.get("status", "failing"))
         with self._lock:

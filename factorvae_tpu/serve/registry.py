@@ -421,12 +421,20 @@ class ModelRegistry:
             source_path=path)
 
     def register_artifact(self, path_or_blob,
-                          alias: Optional[str] = None) -> str:
+                          alias: Optional[str] = None,
+                          expected_sha256: Optional[str] = None) -> str:
         """Admit a serialized AOT export (eval/export_aot.py) through
         the validated `load_exported` round-trip — the cold-start path.
         The key comes from the artifact HEADER's config hash (headerless
         pre-ISSUE-8 blobs cannot be admitted: the registry has nothing
-        to key them on — re-export them)."""
+        to key them on — re-export them).
+
+        `expected_sha256` extends the ISSUE-9 manifest discipline to
+        content-addressed artifacts (ISSUE 17): a remote worker that
+        fetched the blob from the fleet's artifact service passes the
+        advertised digest, and bytes that no longer hash to it are
+        REFUSED before any deserialization — a corrupt download (or a
+        disk flip between download and admission) never serves."""
         from factorvae_tpu.eval.export_aot import (
             ArtifactError,
             load_exported,
@@ -439,6 +447,20 @@ class ModelRegistry:
             path = os.path.abspath(path_or_blob)
             with open(path, "rb") as fh:
                 blob = fh.read()
+        if expected_sha256 is not None:
+            import hashlib
+
+            got = hashlib.sha256(blob).hexdigest()
+            if got != expected_sha256:
+                timeline_event("serve_quarantine", cat="recovery",
+                               resource="serve", path=path or "<bytes>",
+                               reason="artifact sha256 mismatch")
+                raise RegistryError(
+                    f"artifact {path or '<bytes>'} hashes to "
+                    f"{got[:12]}… but the store advertised "
+                    f"{expected_sha256[:12]}… — the bytes are corrupt; "
+                    f"re-fetch from the artifact service "
+                    f"(GET /artifact/<sha256>) instead of admitting")
         try:
             art = load_exported(blob)
         except ArtifactError as e:

@@ -1,0 +1,235 @@
+"""Remote worker bootstrap: join a fleet with zero local traces.
+
+The agent side of the multi-host serving plane (ISSUE 17). A cold
+host runs
+
+    python -m factorvae_tpu.serve --join http://router:8800 \
+        --http 8787 --scheduler
+
+and this module turns that into a serving fleet member in three
+moves:
+
+1. **Sync** — `GET /artifacts` on the router lists every artifact as
+   (alias, sha256, bytes); `fetch_artifact` downloads each blob from
+   `GET /artifact/<sha256>` and VERIFIES the digest before a single
+   byte lands under its final name (tmp + fsync-free `os.replace`,
+   the store's own atomicity discipline). A mismatch retries — the
+   transfer may have torn — and exhausted retries raise `JoinError`
+   with the observed vs expected digests; a corrupt blob is never
+   admitted and never left on disk where a respawn could find it.
+   An artifact already on disk that hashes correctly is skipped — a
+   respawned agent (the watcher's `kill_remote_worker` recovery path)
+   re-joins warm.
+2. **Mirror** — the manifest carries the fleet's `dataset_args` and
+   worker `extra_args`; `prepare_join` applies them to the agent's
+   own argparse namespace (explicit user flags win — argparse only
+   fills attributes the namespace doesn't already pin).
+3. **Register** — once the daemon's own `/healthz` answers,
+   `register_when_healthy`'s thread POSTs `/register` with the host,
+   port and the capability digest over what was ACTUALLY
+   materialized (same formula as `AotStore.capability_digest`). The
+   pool refuses a digest that differs from the fleet's — serving the
+   wrong artifact set is the one failure routing can never detect —
+   and registration is idempotent by (host, port), so a re-join
+   heals the old slot instead of growing the table.
+
+The registry then composes the same verification one layer deeper:
+admission passes `expected_sha256` so the bytes are re-hashed at load
+(serve/registry.py, the PR-9 manifest discipline extended to the
+artifact service).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from factorvae_tpu.serve.pool import http_bytes, http_json
+from factorvae_tpu.utils.logging import timeline_event
+
+
+class JoinError(RuntimeError):
+    """The join bootstrap failed in a way a retry won't fix."""
+
+
+def fetch_manifest(router_url: str, timeout: float = 30.0) -> dict:
+    """The fleet's `GET /artifacts` manifest."""
+    try:
+        man = http_json(router_url.rstrip("/") + "/artifacts",
+                        timeout=timeout)
+    except (OSError, ValueError) as e:
+        raise JoinError(
+            f"cannot reach the fleet's artifact service at "
+            f"{router_url}/artifacts: {e}") from e
+    if not (isinstance(man, dict) and man.get("ok")
+            and isinstance(man.get("artifacts"), list)):
+        raise JoinError(
+            f"{router_url}/artifacts answered {str(man)[:200]} — not "
+            f"an artifact manifest; is that a router port?")
+    return man
+
+
+def fetch_artifact(router_url: str, alias: str, sha256: str,
+                   dest_dir: str, retries: int = 3,
+                   timeout: float = 600.0) -> str:
+    """Download one artifact by content address into
+    `dest_dir/<alias>`, digest-verified BEFORE the bytes land under
+    the final name. Returns the path. Never leaves a corrupt file:
+    the tmp is unlinked on mismatch and the final name only ever
+    appears via `os.replace` of verified bytes."""
+    os.makedirs(dest_dir, exist_ok=True)
+    dest = os.path.join(dest_dir, alias)
+    if os.path.isfile(dest):
+        h = hashlib.sha256()
+        with open(dest, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() == sha256:
+            return dest   # warm re-join: already materialized
+    url = (router_url.rstrip("/") + "/artifact/" + sha256)
+    last = ""
+    for attempt in range(max(1, int(retries))):
+        try:
+            blob = http_bytes(url, timeout=timeout)
+        except (OSError, ValueError) as e:
+            last = f"transfer failed: {e}"
+            time.sleep(min(2.0, 0.2 * (attempt + 1)))
+            continue
+        got = hashlib.sha256(blob).hexdigest()
+        if got != sha256:
+            # torn/corrupt transfer — nothing touches disk; re-fetch
+            last = (f"digest mismatch: fetched bytes hash to "
+                    f"{got[:12]}… not {sha256[:12]}…")
+            timeline_event("join_refetch", cat="serve",
+                           resource="remote", alias=alias,
+                           attempt=attempt, error=last)
+            continue
+        tmp = dest + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+        os.replace(tmp, dest)
+        # sidecar so a local AotStore over dest_dir answers
+        # sha256_for without re-hashing
+        meta_tmp = dest + ".meta.json.tmp"
+        with open(meta_tmp, "w") as fh:
+            json.dump({"sha256": sha256, "source": url}, fh)
+        os.replace(meta_tmp, dest + ".meta.json")
+        return dest
+    raise JoinError(
+        f"artifact {alias} ({sha256[:12]}…) could not be fetched "
+        f"from {url} after {retries} attempts ({last}); the agent "
+        f"refuses to serve unverified bytes — check the router's "
+        f"store and re-join")
+
+
+def capability_digest(alias_to_sha: Dict[str, str]) -> str:
+    """The digest over what this agent materialized — same formula as
+    `AotStore.capability_digest`, so a faithful sync matches the
+    fleet byte-for-byte."""
+    lines = sorted(f"{a} {s}" for a, s in alias_to_sha.items())
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()
+
+
+def prepare_join(args, parser) -> str:
+    """Bootstrap an argparse namespace from the fleet: download every
+    artifact (digest-verified), point `--model` at the local copies,
+    mirror the fleet's dataset/worker args (explicit user flags win),
+    and stash the per-path expected digests for registry admission.
+    Returns the capability digest to present at registration."""
+    import tempfile
+
+    man = fetch_manifest(args.join)
+    arts = man["artifacts"]
+    if not arts:
+        raise JoinError(
+            f"{args.join}/artifacts lists no artifacts — the fleet "
+            f"has nothing to serve yet; start the pool with --model "
+            f"first")
+    dest = args.aot_store or tempfile.mkdtemp(prefix="join_store_")
+    args.aot_store = dest
+    paths: Dict[str, str] = {}
+    expected: Dict[str, str] = {}
+    for a in arts:
+        alias, sha = str(a.get("alias")), str(a.get("sha256"))
+        p = fetch_artifact(args.join, alias, sha, dest)
+        paths[alias] = p
+        expected[p] = sha
+    if not args.model:
+        args.model = [paths[a] for a in sorted(paths)]
+    args._expected_sha256 = expected
+    # Fleet args: worker extra flags always mirror; panel args only
+    # when the user pinned none (argparse leaves attributes already
+    # present on the namespace alone unless the flag is in argv).
+    argv = [str(x) for x in (man.get("extra_args") or [])]
+    if not args.dataset and not args.synthetic:
+        argv += [str(x) for x in (man.get("dataset_args") or [])]
+    if argv:
+        parser.parse_args(argv, namespace=args)
+    if args.max_stocks is None and man.get("n_max"):
+        args.max_stocks = int(man["n_max"])
+    cap = capability_digest(
+        {a: expected[p] for a, p in paths.items()})
+    fleet_cap = man.get("capability_digest")
+    if fleet_cap and cap != fleet_cap:
+        raise JoinError(
+            f"materialized capability digest {cap[:12]}… does not "
+            f"match the fleet's {str(fleet_cap)[:12]}… — the "
+            f"manifest changed mid-sync; re-join")
+    timeline_event("join_synced", cat="serve", resource="remote",
+                   artifacts=len(paths), capability=cap[:12],
+                   store=dest)
+    return cap
+
+
+def register_when_healthy(router_url: str, port: int,
+                          capability: str,
+                          host: str = "127.0.0.1",
+                          timeout_s: float = 600.0
+                          ) -> threading.Thread:
+    """Background thread: poll the daemon's OWN /healthz (it is
+    starting up on this same process's serving thread), then POST
+    /register to the router — with retries, since the router may
+    itself be mid-restart. Daemon thread: it must never outlive the
+    serving loop."""
+
+    def run() -> None:
+        deadline = time.monotonic() + timeout_s
+        me = f"http://127.0.0.1:{port}/healthz"
+        while time.monotonic() < deadline:
+            try:
+                if http_json(me, timeout=2.0).get("ok"):
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.2)
+        else:
+            return
+        backoff = 0.2
+        while time.monotonic() < deadline:
+            try:
+                out = http_json(
+                    router_url.rstrip("/") + "/register",
+                    payload={"host": host, "port": int(port),
+                             "capability": capability},
+                    timeout=10.0)
+            except (OSError, ValueError):
+                out = None
+            if isinstance(out, dict) and out.get("ok"):
+                timeline_event("join_registered", cat="serve",
+                               resource="remote", host=host,
+                               port=int(port))
+                return
+            timeline_event("join_register_retry", cat="serve",
+                           resource="remote",
+                           answer=str(out)[:200])
+            time.sleep(backoff)
+            backoff = min(5.0, backoff * 2)
+
+    t = threading.Thread(target=run, name="join-register")
+    t.daemon = True
+    t.start()
+    return t

@@ -41,6 +41,27 @@ into ONE serving endpoint (ISSUE 15):
   `pool.admit_fanout` — AOT-store refresh + rolling per-worker
   fidelity-gated alias flips (docs/walkforward.md).
 
+- **Multi-host control plane (ISSUE 17).** `POST /register` adopts a
+  remote worker into the pool's table (host, port, capability digest —
+  refused with an actionable error on a digest mismatch);
+  `GET /artifacts` publishes the content-addressed artifact manifest a
+  cold host joins from and `GET /artifact/<sha256>` serves the bytes;
+  `POST /deregister` is the graceful leave; `POST /upgrade` starts the
+  pool's rolling drain/join upgrade on a background thread.
+
+- **Hedged forwards (ISSUE 17).** The router keeps a sliding window of
+  client-request latencies; once a forward has been in flight past the
+  measured `hedge_quantile` (default p90 — by construction only the
+  slowest decile waits that long), the SAME request duplicates to the
+  key's second rendezvous candidate, the first answer wins and the
+  loser's socket is shut down (its response is discarded, its
+  connection never pooled). A hedged pair stays ONE request in every
+  counter and in the router's latency histogram; `hedges`/`hedge_wins`
+  count the duplication itself. A plan row's `serve` block (or
+  `--hedge_ms`) pins the delay instead of measuring it; scoring
+  requests are idempotent by construction, which is what makes the
+  duplicate safe.
+
 Requests the router cannot attribute to a model (`cmd` requests)
 route to the rendezvous owner of the literal key `#cmd` — stable, and
 shutdown-by-cmd is deliberately NOT fanned out (stopping the fleet is
@@ -55,14 +76,22 @@ handler only sets an Event, the serve loop promotes it.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import hashlib
 import json
 import threading
+import time
 from typing import List, Optional
 
 from factorvae_tpu.serve.pool import WorkerPool
 from factorvae_tpu.utils.logging import timeline_event
+
+
+class _Cancelled(Exception):
+    """A hedged forward lost the race: its socket was shut down by the
+    winner. NOT a worker failure — the loser must neither retry nor
+    mark the worker failing."""
 
 
 def rendezvous_order(key: str, worker_ids: List[str]) -> List[str]:
@@ -88,11 +117,28 @@ class Router:
 
     def __init__(self, pool: WorkerPool, max_inflight: int = 64,
                  shed_retry_s: float = 1.0,
-                 forward_timeout_s: float = 600.0):
+                 forward_timeout_s: float = 600.0,
+                 slo_ms: float = 0.0, hedge_ms: float = -1.0,
+                 hedge: bool = True, hedge_quantile: float = 0.9,
+                 hedge_min_samples: int = 20):
+        from factorvae_tpu.obs.metrics import LatencyHistogram
+
         self.pool = pool
         self.max_inflight = int(max_inflight)
         self.shed_retry_s = float(shed_retry_s)
         self.forward_timeout_s = float(forward_timeout_s)
+        # SLO declared by --slo_ms / the plan row's serve block: the
+        # autoscaler defends it, /stats and /metrics publish it. 0 =
+        # none declared (autoscaling then keys on queue depth alone).
+        self.slo_ms = float(slo_ms)
+        # hedge_ms >= 0 pins the hedge delay; -1 = measure it as the
+        # hedge_quantile of the sliding latency window (no hedging
+        # until hedge_min_samples latencies have been observed — an
+        # unmeasured fleet must not guess).
+        self.hedge_enabled = bool(hedge)
+        self.hedge_ms = float(hedge_ms)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_min_samples = int(hedge_min_samples)
         self._lock = threading.Lock()
         self.requests = 0
         self.forwarded = 0
@@ -100,6 +146,20 @@ class Router:
         self.reroutes = 0
         self.proxy_errors = 0
         self.inflight = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        # One observation per CLIENT request group item — a hedged
+        # pair lands exactly one sample. The deque feeds the hedge
+        # delay quantile and /stats p50/p99; the histogram feeds
+        # /metrics.
+        self.lat_hist = LatencyHistogram()
+        self._lat_window: collections.deque = collections.deque(
+            maxlen=512)
+        self._worker_inflight: dict = {}
+        # set by serve/__main__ when --autoscale is on; /stats and
+        # /metrics publish its state when present
+        self.autoscaler = None
+        self.last_upgrade: Optional[dict] = None
         self._server = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
@@ -192,15 +252,22 @@ class Router:
             t.join()
         return responses
 
-    def _forward(self, wid: str, port: int, body: bytes):
+    def _forward(self, wid: str, host: str, port: int, body: bytes,
+                 cancel: Optional[threading.Event] = None,
+                 slot: Optional[list] = None):
         """POST one group to a worker over a pooled persistent
         connection (fresh one on first use or after any failure — a
         respawned worker keeps its port, so a stale socket heals on
-        the retry)."""
+        the retry). Hedged legs pass `cancel` (the lost-the-race
+        signal) and `slot` (a one-element list the live connection
+        parks in so the winner can shut its socket down); a cancelled
+        leg raises `_Cancelled` and never pools its connection."""
         import http.client
 
         last = None
         for fresh in (False, True):
+            if cancel is not None and cancel.is_set():
+                raise _Cancelled()
             conn = None
             if not fresh:
                 with self._lock:
@@ -209,7 +276,9 @@ class Router:
                         conn = stack.pop()
             if conn is None:
                 conn = http.client.HTTPConnection(
-                    "127.0.0.1", port, timeout=self.forward_timeout_s)
+                    host, port, timeout=self.forward_timeout_s)
+            if slot is not None:
+                slot[0] = conn
             try:
                 conn.request("POST", "/score", body=body, headers={
                     "Content-Type": "application/json"})
@@ -217,10 +286,21 @@ class Router:
                 out = json.loads(resp.read().decode() or "null")
             except (OSError, ValueError, http.client.HTTPException) \
                     as e:
-                last = e
+                if slot is not None:
+                    slot[0] = None
                 with contextlib.suppress(OSError):
                     conn.close()
+                if cancel is not None and cancel.is_set():
+                    # the winner shut this socket down mid-recv — a
+                    # race loss, not a worker failure
+                    raise _Cancelled()
+                last = e
                 continue
+            if slot is not None:
+                slot[0] = None
+            if cancel is not None and cancel.is_set():
+                conn.close()
+                raise _Cancelled()
             with self._lock:
                 stack = self._conns.setdefault(wid, [])
                 if len(stack) < 16:
@@ -231,33 +311,158 @@ class Router:
             return out
         raise last
 
+    def _try_forward(self, wid: str, body: bytes, n: int,
+                     cancel: Optional[threading.Event] = None,
+                     slot: Optional[list] = None) -> Optional[list]:
+        """One validated forward attempt: the worker's answers as a
+        list of `n` responses, else None. Transport failures count a
+        proxy_error and mark the worker for the watcher; a CANCELLED
+        hedge leg counts nothing — losing the race says nothing about
+        the worker's health."""
+        worker = self.pool.worker(wid)
+        with self._lock:
+            self._worker_inflight[wid] = \
+                self._worker_inflight.get(wid, 0) + 1
+        try:
+            out = self._forward(wid, worker.host, worker.port, body,
+                                cancel=cancel, slot=slot)
+        except _Cancelled:
+            return None
+        except Exception as e:
+            with self._lock:
+                self.proxy_errors += 1
+            self.pool.note_failure(wid)
+            timeline_event("router_reroute", cat="serve",
+                           resource="router", worker=wid,
+                           error=str(e)[:200])
+            return None
+        finally:
+            with self._lock:
+                self._worker_inflight[wid] = \
+                    max(0, self._worker_inflight.get(wid, 1) - 1)
+        if isinstance(out, dict):
+            out = [out]
+        if not isinstance(out, list) or len(out) != n:
+            with self._lock:
+                self.proxy_errors += 1
+            return None
+        return out
+
+    # ---- hedging (ISSUE 17) ----------------------------------------------
+
+    def _hedge_delay_s(self) -> Optional[float]:
+        """The delay before a forward duplicates, in seconds — or None
+        when hedging must not fire: disabled, or auto mode
+        (`hedge_ms < 0`) without `hedge_min_samples` measured
+        latencies yet (an unmeasured fleet must not guess a delay)."""
+        if not self.hedge_enabled:
+            return None
+        if self.hedge_ms >= 0:
+            return self.hedge_ms / 1e3
+        with self._lock:
+            if len(self._lat_window) < self.hedge_min_samples:
+                return None
+            lat = sorted(self._lat_window)
+        return lat[min(len(lat) - 1,
+                       int(self.hedge_quantile * len(lat)))]
+
+    @staticmethod
+    def _cancel_leg(cancel: threading.Event, slot: list) -> None:
+        """Wake a losing hedge leg: set its cancel flag, then shut the
+        parked socket down — `close()` alone does NOT interrupt a
+        blocked `recv`, `shutdown(SHUT_RDWR)` does."""
+        import socket as _socket
+
+        cancel.set()
+        conn = slot[0]
+        if conn is not None:
+            with contextlib.suppress(OSError):
+                if getattr(conn, "sock", None) is not None:
+                    conn.sock.shutdown(_socket.SHUT_RDWR)
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _forward_hedged(self, primary: str, secondary: str,
+                        body: bytes, n: int, delay_s: float):
+        """Forward to `primary`; past `delay_s` without an answer,
+        duplicate to `secondary` — first validated answer wins, the
+        loser's socket is shut down and its (eventual) response
+        discarded. Returns `(out, wid, hedged)`; a FAST primary
+        failure returns `(None, primary, False)` so the caller's
+        serial failover takes over (an immediate failure is reroute
+        ground, not hedge ground)."""
+        import queue
+
+        q: "queue.Queue" = queue.Queue()
+        legs: dict = {}
+
+        def run(wid: str) -> None:
+            cancel, slot = legs[wid]
+            q.put((wid, self._try_forward(wid, body, n,
+                                          cancel=cancel, slot=slot)))
+
+        def launch(wid: str) -> None:
+            legs[wid] = (threading.Event(), [None])
+            threading.Thread(target=run, args=(wid,),
+                             name="router-hedge").start()
+
+        launch(primary)
+        try:
+            wid, out = q.get(timeout=delay_s)
+        except queue.Empty:  # primary is past the delay
+            with self._lock:
+                self.hedges += 1
+            timeline_event("router_hedge", cat="serve",
+                           resource="router", primary=primary,
+                           secondary=secondary,
+                           delay_ms=round(delay_s * 1e3, 3))
+            launch(secondary)
+            wid, out = q.get()
+            if out is None:
+                wid, out = q.get()  # first finisher failed; wait out
+        else:
+            return out, wid, False  # answered (or failed) pre-delay
+        if out is not None:
+            with self._lock:
+                if wid == secondary:
+                    self.hedge_wins += 1
+            for lw, (cancel, slot) in legs.items():
+                if lw != wid:
+                    self._cancel_leg(cancel, slot)
+        return out, wid, True
+
     def _forward_group(self, order: List[str], items: list,
                        responses: list) -> None:
         body = json.dumps([req for _, req in items]).encode()
-        for attempt, wid in enumerate(order):
-            worker = self.pool.worker(wid)
-            try:
-                out = self._forward(wid, worker.port, body)
-            except Exception as e:
-                # Transport failure: the worker just died or hung —
-                # tell the pool, reroute to the next candidate.
+        n = len(items)
+        t0 = time.monotonic()
+        out, wid, start = None, None, 0
+        delay = (self._hedge_delay_s() if len(order) >= 2 else None)
+        if delay is not None:
+            out, wid, hedged = self._forward_hedged(
+                order[0], order[1], body, n, delay)
+            # hand the serial loop whatever the hedge didn't consume
+            start = 2 if hedged else 1
+            if out is None and start < len(order):
                 with self._lock:
-                    self.proxy_errors += 1
-                    if attempt + 1 < len(order):
+                    self.reroutes += 1
+        if out is None:
+            for attempt in range(start, len(order)):
+                wid = order[attempt]
+                out = self._try_forward(wid, body, n)
+                if out is not None:
+                    break
+                if attempt + 1 < len(order):
+                    with self._lock:
                         self.reroutes += 1
-                self.pool.note_failure(wid)
-                timeline_event("router_reroute", cat="serve",
-                               resource="router", worker=wid,
-                               error=str(e)[:200])
-                continue
-            if isinstance(out, dict):
-                out = [out]
-            if not isinstance(out, list) or len(out) != len(items):
-                with self._lock:
-                    self.proxy_errors += 1
-                continue
+        if out is not None:
+            dt = time.monotonic() - t0
             with self._lock:
-                self.forwarded += len(items)
+                self.forwarded += n
+                for _ in range(n):
+                    self._lat_window.append(dt)
+            for _ in range(n):
+                self.lat_hist.observe(dt)
             for (i, _), resp in zip(items, out):
                 if isinstance(resp, dict):
                     resp.setdefault("worker", wid)
@@ -284,7 +489,40 @@ class Router:
                 "ok": status in ("ok", "degraded"),
                 "workers_healthy": healthy, "workers": total}
 
+    def _quantiles(self):
+        """(p50_ms, p99_ms) over the sliding latency window, or
+        (None, None) before any request landed."""
+        with self._lock:
+            lat = sorted(self._lat_window)
+        if not lat:
+            return None, None
+
+        def q(p: float) -> float:
+            return lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3
+
+        return q(0.5), q(0.99)
+
+    def autoscale_signals(self) -> dict:
+        """The signal dict the autoscaler decides from and /metrics
+        exports (obs.metrics.autoscale_families): queue depth,
+        observed p50/p99 vs the declared SLO, per-worker inflight,
+        fleet liveness."""
+        p50, p99 = self._quantiles()
+        pool = self.pool.stats()
+        with self._lock:
+            return {
+                "queue_depth": self.inflight,
+                "p50_ms": p50,
+                "p99_ms": p99,
+                "slo_ms": self.slo_ms,
+                "workers_healthy": pool["healthy"],
+                "workers_total": len(pool["workers"]),
+                "worker_inflight": dict(self._worker_inflight),
+            }
+
     def stats(self) -> dict:
+        delay = self._hedge_delay_s()
+        p50, p99 = self._quantiles()
         with self._lock:
             router = {
                 "requests": self.requests,
@@ -294,9 +532,26 @@ class Router:
                 "proxy_errors": self.proxy_errors,
                 "inflight": self.inflight,
                 "max_inflight": self.max_inflight,
+                "slo_ms": self.slo_ms,
+                "observed_p50_ms": p50,
+                "observed_p99_ms": p99,
+                "worker_inflight": dict(self._worker_inflight),
+                "hedge": {
+                    "enabled": self.hedge_enabled,
+                    "delay_ms": (None if delay is None
+                                 else round(delay * 1e3, 3)),
+                    "hedges": self.hedges,
+                    "hedge_wins": self.hedge_wins,
+                },
             }
-        return {"router": router, "health": self.healthz(),
-                "pool": self.pool.stats()}
+        out = {"router": router, "health": self.healthz(),
+               "pool": self.pool.stats()}
+        scaler = self.autoscaler
+        if scaler is not None:
+            out["autoscale"] = scaler.describe()
+        if self.last_upgrade is not None:
+            out["last_upgrade"] = self.last_upgrade
+        return out
 
     def metrics(self) -> str:
         """The fleet-level exposition: router families first, then
@@ -304,11 +559,13 @@ class Router:
         `worker_id` and merged under single family headers."""
         from factorvae_tpu.obs.metrics import (
             PREFIX,
+            autoscale_families,
             merge_expositions,
             metric_line,
         )
 
         pool = self.pool.stats()
+        signals = self.autoscale_signals()
         with self._lock:
             counters = [("requests_total", "counter",
                          "client requests through the router",
@@ -325,6 +582,13 @@ class Router:
                         ("proxy_errors_total", "counter",
                          "worker forwards that failed",
                          self.proxy_errors),
+                        ("hedges_total", "counter",
+                         "forwards duplicated past the hedge delay",
+                         self.hedges),
+                        ("hedge_wins_total", "counter",
+                         "hedged forwards won by the speculative "
+                         "duplicate",
+                         self.hedge_wins),
                         ("inflight", "gauge",
                          "client requests currently in flight",
                          self.inflight)]
@@ -343,6 +607,16 @@ class Router:
                     "workers respawned by the pool watcher",
                     [metric_line(f"{PREFIX}_router_respawns_total",
                                  pool["respawns"])]))
+        fam.append((f"{PREFIX}_router_request_latency_seconds",
+                    "histogram",
+                    "router-observed client request latency (a hedged "
+                    "pair observes once)",
+                    self.lat_hist.render(
+                        f"{PREFIX}_router_request_latency_seconds")))
+        fam.extend(autoscale_families(signals))
+        scaler = self.autoscaler
+        if scaler is not None:
+            fam.extend(scaler.metric_families())
         parts = []
         for w in pool["workers"]:
             if w["state"] == "dead":
@@ -402,14 +676,109 @@ class Router:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/artifacts":
+                    self._send(200, router.pool.artifact_manifest())
+                elif self.path.startswith("/artifact/"):
+                    sha = self.path[len("/artifact/"):]
+                    path = router.pool.store.blob_path(sha)
+                    if path is None:
+                        self._send(404, {
+                            "ok": False,
+                            "error": f"no artifact with sha256 "
+                                     f"{sha[:16]}… in the store; "
+                                     f"GET /artifacts lists the "
+                                     f"aliases + digests this fleet "
+                                     f"serves"})
+                        return
+                    with open(path, "rb") as fh:
+                        blob = fh.read()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
                 else:
                     self._send(404, {
                         "ok": False,
                         "error": f"unknown path {self.path} (router "
                                  f"serves /score /admit /stats "
-                                 f"/metrics /healthz)"})
+                                 f"/metrics /healthz /artifacts "
+                                 f"/artifact/<sha256> /register "
+                                 f"/deregister /upgrade)"})
+
+            def _control_body(self) -> Optional[dict]:
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    req = json.loads(
+                        self.rfile.read(n).decode() or "{}")
+                except ValueError:
+                    return None
+                return req if isinstance(req, dict) else None
 
             def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                if self.path == "/register":
+                    req = self._control_body()
+                    if req is None or not req.get("port"):
+                        self._send(400, {
+                            "ok": False,
+                            "error": "POST /register wants {\"port\": "
+                                     "<int>, \"host\": \"...\" "
+                                     "(defaults to the caller's "
+                                     "address), \"capability\": "
+                                     "\"<sha256 digest from GET "
+                                     "/artifacts>\"}"})
+                        return
+                    host = str(req.get("host")
+                               or self.client_address[0])
+                    try:
+                        w = router.pool.adopt_remote(
+                            host, int(req["port"]),
+                            capability=req.get("capability"))
+                    except Exception as e:
+                        self._send(400, {"ok": False,
+                                         "error": str(e)})
+                        return
+                    self._send(200, {"ok": True,
+                                     "worker": w.describe()})
+                    return
+                if self.path == "/deregister":
+                    req = self._control_body()
+                    wid = (req or {}).get("worker_id")
+                    if not wid:
+                        self._send(400, {
+                            "ok": False,
+                            "error": "POST /deregister wants "
+                                     "{\"worker_id\": \"<wid>\"}"})
+                        return
+                    try:
+                        self._send(200, router.pool.deregister(
+                            str(wid)))
+                    except Exception as e:
+                        self._send(400, {"ok": False,
+                                         "error": str(e)})
+                    return
+                if self.path == "/upgrade":
+                    self._control_body()  # drain the request body
+
+                    def run_upgrade() -> None:
+                        try:
+                            router.last_upgrade = \
+                                router.pool.rolling_upgrade()
+                        except Exception as e:
+                            router.last_upgrade = {
+                                "ok": False, "error": str(e)[:500]}
+
+                    router.last_upgrade = {"ok": None,
+                                           "running": True}
+                    threading.Thread(target=run_upgrade,
+                                     name="router-upgrade").start()
+                    self._send(200, {
+                        "ok": True, "started": True,
+                        "note": "rolling upgrade running in the "
+                                "background; watch last_upgrade in "
+                                "GET /stats"})
+                    return
                 if self.path not in ("/score", "/admit"):
                     self._send(404, {"ok": False,
                                      "error": f"unknown path "

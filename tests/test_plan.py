@@ -494,6 +494,102 @@ class TestServeKnob:
         assert p.serve_precision == "bfloat16"
 
 
+class TestTrainRematKnob:
+    """train_remat (ISSUE 17 satellite): raced rows may carry a
+    'train_remat' block; rows without one — every pre-PR table — must
+    resolve to NO verdict ('') and leave the config's own remat choice
+    untouched."""
+
+    def test_remat_row_resolves(self):
+        p = plan_for(K60, "cpu",
+                     table=[row(train_remat={"remat": "dots"})])
+        assert p.provenance == "measured"
+        assert p.train_remat == "dots"
+
+    def test_pre_pr_row_has_no_verdict(self):
+        p = plan_for(K60, "cpu", table=[row()])
+        assert p.provenance == "measured"
+        assert p.train_remat == ""
+
+    def test_default_plan_has_no_verdict(self):
+        assert plan_for(K60, "cpu", table=[]).train_remat == ""
+        assert plan_for(FLAGSHIP, "tpu", table=[]).train_remat == ""
+
+    def test_null_block_tolerated(self):
+        assert plan_for(K60, "cpu",
+                        table=[row(train_remat=None)]).train_remat \
+            == ""
+        assert plan_for(K60, "cpu",
+                        table=[row(train_remat={})]).train_remat == ""
+
+    def test_apply_plan_sets_train_remat(self):
+        p = plan_for(K60, "cpu",
+                     table=[row(train_remat={"remat": "dots"})])
+        cfg = apply_plan(Config(), p)
+        assert cfg.train.remat == "dots"
+        # keep_remat: the user's own choice survives the plan
+        kept = apply_plan(Config(), p, keep_remat=True)
+        assert kept.train.remat == Config().train.remat
+        # a no-verdict plan changes nothing
+        p2 = plan_for(K60, "cpu", table=[row()])
+        assert apply_plan(Config(), p2).train.remat == \
+            Config().train.remat
+
+    def test_remat_table_file_round_trip(self, tmp_path):
+        path = tmp_path / "table.json"
+        save_rows([row(train_remat={"remat": "dots"})],
+                  path=str(path))
+        p = plan_for(K60, "cpu", table=load_table(str(path)))
+        assert p.train_remat == "dots"
+        path2 = tmp_path / "pre.json"
+        save_rows([row()], path=str(path2))
+        assert plan_for(K60, "cpu",
+                        table=load_table(str(path2))).train_remat == ""
+
+
+class TestServeSloHedgeKnob:
+    """serve_slo_ms / serve_hedge_ms (ISSUE 17): the multi-host
+    router's SLO + hedge delay ride the same measured 'serve' block as
+    serve_precision. Sentinels matter — slo 0.0 = no SLO declared,
+    hedge -1.0 = measure the delay; an EXPLICIT hedge_ms of 0 (hedge
+    immediately) must survive parsing, so the parse checks key
+    presence, not truthiness."""
+
+    def test_serve_row_resolves_slo_and_hedge(self):
+        p = plan_for(K60, "cpu", table=[row(
+            serve={"precision": "float32", "slo_ms": 50.0,
+                   "hedge_ms": 8.0})])
+        assert p.serve_slo_ms == 50.0
+        assert p.serve_hedge_ms == 8.0
+
+    def test_explicit_zero_hedge_ms_survives(self):
+        p = plan_for(K60, "cpu",
+                     table=[row(serve={"hedge_ms": 0})])
+        assert p.serve_hedge_ms == 0.0
+
+    def test_pre_pr_row_keeps_sentinels(self):
+        p = plan_for(K60, "cpu", table=[row()])
+        assert p.serve_slo_ms == 0.0
+        assert p.serve_hedge_ms == -1.0
+        d = plan_for(K60, "cpu", table=[])
+        assert d.serve_slo_ms == 0.0
+        assert d.serve_hedge_ms == -1.0
+
+    def test_null_serve_block_tolerated(self):
+        for serve in (None, {}):
+            p = plan_for(K60, "cpu", table=[row(serve=serve)])
+            assert p.serve_slo_ms == 0.0
+            assert p.serve_hedge_ms == -1.0
+
+    def test_slo_hedge_table_file_round_trip(self, tmp_path):
+        path = tmp_path / "table.json"
+        save_rows([row(serve={"slo_ms": 75.0, "hedge_ms": 0.0})],
+                  path=str(path))
+        p = plan_for(K60, "cpu", table=load_table(str(path)))
+        assert p.serve_slo_ms == 75.0
+        assert p.serve_hedge_ms == 0.0
+
+
 class TestCompilationCache:
     """plan.setup_compilation_cache (ISSUE 8): flag > env > off, 'off'
     is the explicit opt-out, and the returned dir is what jax was

@@ -1,0 +1,707 @@
+"""Multi-host serving plane (ISSUE 17): content-addressed artifact
+service, digest-verified remote joins, hedged forwards, SLO-driven
+autoscaling policy.
+
+The contracts pinned here are the acceptance bar of the multi-host PR:
+- the AotStore's content addressing: manifest/capability digest/blob
+  resolution, order-independent fleet identity;
+- `fetch_artifact` NEVER admits or leaves corrupt bytes — a digest
+  mismatch refuses with an actionable error, a torn transfer retries
+  and succeeds, a warm re-join skips the download entirely;
+- the registry composes the same gate one layer deeper
+  (`expected_sha256`, the PR-9 manifest discipline extended to the
+  artifact service);
+- `adopt_remote`: capability-digest refusal, idempotent (host, port)
+  healing, graceful deregister — plus the router's HTTP control plane
+  (`/register`, `/artifacts`, `/artifact/<sha256>`) end to end;
+- hedged forwards: the hedge fires only past the measured-quantile
+  delay, the FIRST answer wins and its bytes come back verbatim, the
+  cancelled loser counts as neither a proxy error nor a worker
+  failure, and a hedged pair stays ONE request in /stats and in the
+  router's latency histogram;
+- the autoscaler's pure `decide()` policy: hysteresis both ways,
+  min/max bounds, cooldown.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from factorvae_tpu.serve.autoscale import AutoScaler
+from factorvae_tpu.serve.pool import AotStore, PoolError, WorkerPool
+from factorvae_tpu.serve.remote import (
+    JoinError,
+    capability_digest,
+    fetch_artifact,
+    fetch_manifest,
+)
+from factorvae_tpu.serve.router import Router
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fill_store(root, blobs) -> AotStore:
+    """An AotStore over fake artifact bytes (content addressing never
+    deserializes, so any bytes exercise it)."""
+    store = AotStore(str(root))
+    for alias, blob in blobs.items():
+        with open(store.path_for(alias), "wb") as fh:
+            fh.write(blob)
+    return store
+
+
+class TestContentAddressing:
+    BLOBS = {"m0": b"alpha artifact bytes", "m1": b"beta bytes"}
+
+    def test_manifest_lists_content_addresses(self, tmp_path):
+        store = _fill_store(tmp_path, self.BLOBS)
+        man = {m["alias"]: m for m in store.manifest()}
+        assert set(man) == set(self.BLOBS)
+        for alias, blob in self.BLOBS.items():
+            assert man[alias]["sha256"] == \
+                hashlib.sha256(blob).hexdigest()
+            assert man[alias]["bytes"] == len(blob)
+
+    def test_sha_persisted_in_sidecar(self, tmp_path):
+        store = _fill_store(tmp_path, self.BLOBS)
+        sha = store.sha256_for("m0")
+        with open(store.path_for("m0") + ".meta.json") as fh:
+            assert json.load(fh)["sha256"] == sha
+        # a fresh store over the same dir answers from the sidecar
+        again = AotStore(str(tmp_path))
+        assert again.sha256_for("m0") == sha
+
+    def test_capability_digest_is_order_independent(self, tmp_path):
+        store = _fill_store(tmp_path, self.BLOBS)
+        pairs = {m["alias"]: m["sha256"] for m in store.manifest()}
+        assert store.capability_digest() == capability_digest(pairs)
+        # a different artifact SET is a different fleet identity
+        other = _fill_store(tmp_path / "other",
+                            {"m0": b"alpha artifact bytes",
+                             "m1": b"DIFFERENT"})
+        assert other.capability_digest() != store.capability_digest()
+
+    def test_blob_path_resolves_and_misses(self, tmp_path):
+        store = _fill_store(tmp_path, self.BLOBS)
+        sha = hashlib.sha256(self.BLOBS["m1"]).hexdigest()
+        assert store.blob_path(sha) == store.path_for("m1")
+        assert store.blob_path("0" * 64) is None
+
+    def test_rewrite_changes_address(self, tmp_path):
+        store = _fill_store(tmp_path, self.BLOBS)
+        old = store.sha256_for("m0")
+        time.sleep(0.01)
+        with open(store.path_for("m0"), "wb") as fh:
+            fh.write(b"new bytes entirely")
+        assert store.sha256_for("m0") == \
+            hashlib.sha256(b"new bytes entirely").hexdigest() != old
+
+
+class _ArtifactStub(threading.Thread):
+    """A stub artifact service: serves /artifacts + /artifact/<sha>,
+    with the first `corrupt_first` blob responses flipped — the torn
+    transfer the fetch retry must survive."""
+
+    def __init__(self, blobs, corrupt_first=0, corrupt_always=False):
+        super().__init__(name="artifact-stub", daemon=True)
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        self.blobs = dict(blobs)
+        self.fetches = 0
+        stub = self
+        remaining = [corrupt_first]
+
+        class H(BaseHTTPRequestHandler):
+            def _body(self, code, body, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/artifacts":
+                    arts = [{"alias": a,
+                             "sha256":
+                                 hashlib.sha256(b).hexdigest(),
+                             "bytes": len(b)}
+                            for a, b in sorted(stub.blobs.items())]
+                    cap = capability_digest(
+                        {a["alias"]: a["sha256"] for a in arts})
+                    self._body(200, json.dumps(
+                        {"ok": True, "artifacts": arts,
+                         "capability_digest": cap,
+                         "dataset_args": ["--synthetic", "8,8"],
+                         "extra_args": [], "n_max": 8}).encode())
+                    return
+                if self.path.startswith("/artifact/"):
+                    stub.fetches += 1
+                    sha = self.path.rsplit("/", 1)[1]
+                    for b in stub.blobs.values():
+                        if hashlib.sha256(b).hexdigest() == sha:
+                            if corrupt_always or remaining[0] > 0:
+                                remaining[0] -= 1
+                                b = b"CORRUPTED" + b
+                            self._body(200, b,
+                                       "application/octet-stream")
+                            return
+                self._body(404, b'{"ok": false}')
+
+            def log_message(self, *a):
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.start()
+
+    def run(self):
+        self.server.serve_forever(poll_interval=0.05)
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.join(timeout=10)
+
+
+class TestFetchArtifact:
+    BLOB = b"FVAE-AOT1\n{}\npretend stablehlo payload"
+    SHA = hashlib.sha256(BLOB).hexdigest()
+
+    def test_manifest_round_trip(self, tmp_path):
+        stub = _ArtifactStub({"m0": self.BLOB})
+        try:
+            man = fetch_manifest(stub.url)
+            assert man["artifacts"][0]["alias"] == "m0"
+            assert man["artifacts"][0]["sha256"] == self.SHA
+        finally:
+            stub.close()
+
+    def test_corrupt_transfer_retries_then_succeeds(self, tmp_path):
+        stub = _ArtifactStub({"m0": self.BLOB}, corrupt_first=1)
+        try:
+            dest = fetch_artifact(stub.url, "m0", self.SHA,
+                                  str(tmp_path))
+            with open(dest, "rb") as fh:
+                assert fh.read() == self.BLOB
+            assert stub.fetches == 2          # torn once, refetched
+            # nothing half-written survives
+            assert sorted(os.listdir(tmp_path)) == \
+                ["m0", "m0.meta.json"]
+        finally:
+            stub.close()
+
+    def test_persistent_corruption_refuses_actionably(self, tmp_path):
+        stub = _ArtifactStub({"m0": self.BLOB}, corrupt_always=True)
+        try:
+            with pytest.raises(JoinError) as ei:
+                fetch_artifact(stub.url, "m0", self.SHA,
+                               str(tmp_path), retries=2)
+            msg = str(ei.value)
+            assert "digest mismatch" in msg and "re-join" in msg
+            assert self.SHA[:12] in msg
+            # a corrupt blob NEVER lands on disk, not even as tmp
+            assert os.listdir(tmp_path) == []
+        finally:
+            stub.close()
+
+    def test_warm_rejoin_skips_download(self, tmp_path):
+        with open(tmp_path / "m0", "wb") as fh:
+            fh.write(self.BLOB)
+        stub = _ArtifactStub({"m0": self.BLOB})
+        try:
+            dest = fetch_artifact(stub.url, "m0", self.SHA,
+                                  str(tmp_path))
+            assert dest == str(tmp_path / "m0")
+            assert stub.fetches == 0
+        finally:
+            stub.close()
+
+
+class TestRegistryDigestGate:
+    """Satellite: the registry composes the content-address check with
+    the PR-9 manifest discipline — corrupt bytes are refused BEFORE
+    deserialization, matching bytes admit normally."""
+
+    @pytest.fixture(scope="class")
+    def export(self, tmp_path_factory):
+        from factorvae_tpu.eval.export_aot import export_prediction
+        from factorvae_tpu.models.factorvae import load_model
+        from tests.test_pool import tiny_cfg
+
+        cfg = tiny_cfg(seed=3)
+        params = load_model(cfg, n_max=8)[1]
+        blob = export_prediction(params, cfg, n_max=8,
+                                 stochastic=False)
+        path = tmp_path_factory.mktemp("arts") / "m.aot"
+        with open(path, "wb") as fh:
+            fh.write(blob)
+        return str(path), blob
+
+    def test_mismatch_refused_before_deserialization(self, export):
+        from factorvae_tpu.serve.registry import (
+            ModelRegistry,
+            RegistryError,
+        )
+
+        path, _ = export
+        with pytest.raises(RegistryError) as ei:
+            ModelRegistry().register_artifact(
+                path, expected_sha256="0" * 64)
+        msg = str(ei.value)
+        assert "corrupt" in msg and "artifact service" in msg
+
+    def test_matching_digest_admits(self, export):
+        from factorvae_tpu.serve.registry import ModelRegistry
+
+        path, blob = export
+        reg = ModelRegistry()
+        key = reg.register_artifact(
+            path,
+            expected_sha256=hashlib.sha256(blob).hexdigest())
+        assert reg.get(key).source == "artifact"
+
+
+def _cold_pool(root) -> WorkerPool:
+    """A real pool over fake store bytes, never started — the control
+    plane (adopt/deregister/manifest) is pure table + HTTP work."""
+    pool = WorkerPool(
+        [], ["--synthetic", "8,8"], 1,
+        cache_dir=str(root / "cache"),
+        store_dir=str(root / "store"),
+        work_dir=str(root / "work"))
+    _fill_store(root / "store", {"m0": b"artifact zero",
+                                 "m1": b"artifact one"})
+    return pool
+
+
+class TestAdoptRemote:
+    def test_capability_mismatch_refused(self, tmp_path):
+        pool = _cold_pool(tmp_path)
+        with pytest.raises(PoolError) as ei:
+            pool.adopt_remote("127.0.0.1", 19999,
+                              capability="deadbeef" * 8)
+        assert "re-sync" in str(ei.value)
+        assert pool.stats()["remote"] == 0
+
+    def test_adopt_is_idempotent_by_host_port(self, tmp_path):
+        pool = _cold_pool(tmp_path)
+        cap = pool.store.capability_digest()
+        w1 = pool.adopt_remote("127.0.0.1", 18801, capability=cap)
+        assert w1.kind == "remote" and w1.wid.startswith("r")
+        n = len(pool.workers)
+        # a respawned agent re-registering HEALS the slot, no growth
+        w2 = pool.adopt_remote("127.0.0.1", 18801, capability=cap)
+        assert w2 is w1
+        assert len(pool.workers) == n
+        assert pool.stats()["remote_adopts"] == 1
+
+    def test_deregister_drops_the_slot(self, tmp_path):
+        pool = _cold_pool(tmp_path)
+        w = pool.adopt_remote("127.0.0.1", 18802,
+                              capability=pool.store.capability_digest())
+        out = pool.deregister(w.wid)
+        assert out["ok"]
+        assert all(x.wid != w.wid for x in pool.workers)
+
+
+class TestRouterControlPlane:
+    """The HTTP face of the control plane, over a real (unstarted)
+    pool: a cold host's whole join conversation — manifest, blob
+    fetch, register — without spawning a single daemon."""
+
+    @pytest.fixture()
+    def front(self, tmp_path):
+        from factorvae_tpu.serve.pool import http_json
+
+        pool = _cold_pool(tmp_path)
+        router = Router(pool)
+        port = router.start()
+        try:
+            yield pool, router, (
+                lambda p, payload=None, **kw: http_json(
+                    f"http://127.0.0.1:{port}{p}", payload, **kw))
+        finally:
+            router.stop(stop_pool=False)
+
+    def test_artifacts_manifest_over_http(self, front):
+        pool, _, call = front
+        man = call("/artifacts")
+        assert man["ok"] and len(man["artifacts"]) == 2
+        assert man["capability_digest"] == \
+            pool.store.capability_digest()
+        assert man["dataset_args"] == ["--synthetic", "8,8"]
+
+    def test_blob_fetch_digest_verified(self, front, tmp_path):
+        pool, router, call = front
+        man = call("/artifacts")
+        art = man["artifacts"][0]
+        dest = fetch_artifact(f"http://127.0.0.1:{router.port}",
+                              art["alias"], art["sha256"],
+                              str(tmp_path / "dl"))
+        with open(dest, "rb") as fh:
+            assert hashlib.sha256(fh.read()).hexdigest() == \
+                art["sha256"]
+
+    def test_artifact_404_is_actionable(self, front):
+        _, _, call = front
+        out = call(f"/artifact/{'0' * 64}")
+        assert out["ok"] is False
+        assert "GET /artifacts" in out["error"]
+
+    def test_register_and_deregister_over_http(self, front):
+        pool, _, call = front
+        cap = pool.store.capability_digest()
+        out = call("/register", {"port": 18901, "capability": cap})
+        assert out["ok"] and out["worker"]["kind"] == "remote"
+        wid = out["worker"]["worker_id"]
+        assert any(w["worker_id"] == wid
+                   for w in pool.stats()["workers"])
+        out2 = call("/deregister", {"worker_id": wid})
+        assert out2["ok"]
+        assert all(w["worker_id"] != wid
+                   for w in pool.stats()["workers"])
+
+    def test_register_refuses_wrong_capability(self, front):
+        pool, _, call = front
+        out = call("/register", {"port": 18902,
+                                 "capability": "ff" * 32})
+        assert out["ok"] is False
+        assert "re-sync" in out["error"]
+        assert pool.stats()["remote"] == 0
+
+
+# ---------------------------------------------------------------------------
+# hedged forwards
+# ---------------------------------------------------------------------------
+
+
+class _StubWorker(threading.Thread):
+    """A worker-shaped HTTP server: answers POST /score with a tagged
+    per-item response after `delay_s`."""
+
+    def __init__(self, tag: str, delay_s: float = 0.0):
+        super().__init__(name=f"stub-{tag}", daemon=True)
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        self.tag = tag
+        self.delay_s = delay_s
+        self.hits = 0
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                stub.hits += 1
+                n = int(self.headers.get("Content-Length") or 0)
+                reqs = json.loads(self.rfile.read(n).decode())
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                body = json.dumps(
+                    [{"id": r.get("id"), "ok": True,
+                      "tag": stub.tag} for r in reqs]).encode()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass   # cancelled hedge leg shut us down
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.server.server_address[1]
+        self.start()
+
+    def run(self):
+        self.server.serve_forever(poll_interval=0.05)
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class _FakeWorker:
+    def __init__(self, wid, port):
+        self.wid, self.host, self.port = wid, "127.0.0.1", port
+
+
+class _FakePool:
+    """Just enough pool for the router: a worker table + counters."""
+
+    def __init__(self, workers):
+        self._workers = {w.wid: w for w in workers}
+        self.failures = []
+
+    def healthy_ids(self):
+        return sorted(self._workers)
+
+    def worker(self, wid):
+        return self._workers[wid]
+
+    def note_failure(self, wid):
+        self.failures.append(wid)
+
+    def stats(self):
+        return {"healthy": len(self._workers),
+                "workers": [{"worker_id": w, "state": "ok"}
+                            for w in sorted(self._workers)],
+                "draining": False, "respawns": 0}
+
+    def stop(self):
+        pass
+
+
+class TestHedgedForwards:
+    def _router(self, slow, fast, **kw):
+        pool = _FakePool([_FakeWorker("wslow", slow.port),
+                          _FakeWorker("wfast", fast.port)])
+        router = Router(pool, **kw)
+        # pin the sticky owner so the SLOW worker is always primary
+        router._assign["m"] = "wslow"
+        return pool, router
+
+    def _score(self, router, req, timeout=30.0):
+        from factorvae_tpu.serve.pool import http_json
+
+        return http_json(f"http://127.0.0.1:{router.port}/score",
+                         req, timeout=timeout)
+
+    def test_first_answer_wins_verbatim_and_counts_once(self):
+        """The headline hedging contract: slow primary, fast
+        secondary — the client gets the FAST worker's bytes, the pair
+        counts as ONE request everywhere, and the cancelled loser is
+        neither a proxy error nor a worker failure."""
+        slow, fast = _StubWorker("slow", 1.5), _StubWorker("fast")
+        pool, router = self._router(slow, fast)
+        # measured-quantile mode: seed the window so p90 = 20ms
+        router._lat_window.extend([0.02] * 30)
+        router.start()
+        try:
+            t0 = time.monotonic()
+            resp = self._score(router, {"id": 1, "model": "m"})
+            wall = time.monotonic() - t0
+            assert resp["ok"] and resp["tag"] == "fast"
+            assert resp["worker"] == "wfast"
+            assert wall < 1.0          # never waited out the primary
+            time.sleep(0.3)            # let the cancelled leg settle
+            st = self._score(router, {"cmd": "stats"})
+            # the stats cmd itself routed too: 2 requests total
+            r = router.stats()["router"]
+            assert r["requests"] == 2
+            assert r["hedge"]["hedges"] >= 1
+            assert r["hedge"]["hedge_wins"] >= 1
+            assert r["proxy_errors"] == 0
+            assert "wslow" not in pool.failures
+        finally:
+            router.stop(stop_pool=False)
+            slow.close()
+            fast.close()
+
+    def test_hedged_pair_is_one_request_in_histogram(self):
+        slow, fast = _StubWorker("slow", 1.5), _StubWorker("fast")
+        pool, router = self._router(slow, fast, hedge_ms=10.0)
+        router.start()
+        try:
+            resp = self._score(router, {"id": 1, "model": "m"})
+            assert resp["tag"] == "fast"
+            time.sleep(0.2)
+            r = router.stats()["router"]
+            assert r["requests"] == 1
+            assert r["forwarded"] == 1      # the pair forwarded ONCE
+            assert router.lat_hist.count == 1
+            assert r["hedge"]["hedges"] == 1
+            assert r["hedge"]["hedge_wins"] == 1
+        finally:
+            router.stop(stop_pool=False)
+            slow.close()
+            fast.close()
+
+    def test_hedge_fires_only_past_the_delay(self):
+        """A fast primary never trips the hedge: the secondary sees
+        zero traffic."""
+        fast = _StubWorker("primary")
+        other = _StubWorker("secondary")
+        pool, router = self._router(fast, other, hedge_ms=500.0)
+        router._assign["m"] = "wslow"   # wslow IS the fast stub here
+        router.start()
+        try:
+            for i in range(3):
+                resp = self._score(router,
+                                   {"id": i, "model": "m"})
+                assert resp["ok"] and resp["tag"] == "primary"
+            r = router.stats()["router"]
+            assert r["hedge"]["hedges"] == 0
+            assert other.hits == 0
+        finally:
+            router.stop(stop_pool=False)
+            fast.close()
+            other.close()
+
+    def test_no_hedging_without_measured_samples(self):
+        """Auto mode (hedge_ms=-1) must not guess: with an empty
+        latency window the delay is None and forwards stay single."""
+        pool = _FakePool([_FakeWorker("w0", 1), _FakeWorker("w1", 2)])
+        router = Router(pool)     # defaults: auto, min 20 samples
+        assert router._hedge_delay_s() is None
+        router._lat_window.extend([0.01] * 19)
+        assert router._hedge_delay_s() is None
+        router._lat_window.append(0.01)
+        assert router._hedge_delay_s() == pytest.approx(0.01)
+        # explicit delay pins it regardless of the window
+        pinned = Router(pool, hedge_ms=7.5)
+        assert pinned._hedge_delay_s() == pytest.approx(0.0075)
+        # the kill switch wins over everything
+        off = Router(pool, hedge_ms=7.5, hedge=False)
+        assert off._hedge_delay_s() is None
+
+    def test_stats_publish_slo_and_observed_quantiles(self):
+        pool = _FakePool([_FakeWorker("w0", 1)])
+        router = Router(pool, slo_ms=50.0)
+        router._lat_window.extend([0.01] * 99 + [0.2])
+        r = router.stats()["router"]
+        assert r["slo_ms"] == 50.0
+        assert r["observed_p50_ms"] == pytest.approx(10.0)
+        assert r["observed_p99_ms"] == pytest.approx(200.0)
+        sig = router.autoscale_signals()
+        assert sig["slo_ms"] == 50.0
+        assert sig["p99_ms"] == pytest.approx(200.0)
+        assert sig["workers_healthy"] == 1
+
+
+class TestAutoScalerPolicy:
+    """`decide()` is pure — the whole scaling policy unit-tests
+    without a fleet."""
+
+    def _scaler(self, **kw):
+        kw.setdefault("min_workers", 1)
+        kw.setdefault("max_workers", 3)
+        kw.setdefault("slo_ms", 100.0)
+        kw.setdefault("up_after", 2)
+        kw.setdefault("down_after", 3)
+        kw.setdefault("cooldown_s", 0.0)
+        return AutoScaler(pool=None, router=None, **kw)
+
+    @staticmethod
+    def _sig(queue=0, p99=None, healthy=1, total=1, slo=100.0):
+        return {"queue_depth": queue, "p99_ms": p99, "slo_ms": slo,
+                "workers_healthy": healthy, "workers_total": total,
+                "worker_inflight": {}}
+
+    def test_slo_pressure_scales_up_with_hysteresis(self):
+        s = self._scaler()
+        hot = self._sig(p99=250.0)        # p99 over the 100ms SLO
+        assert s.decide(hot) is None      # one tick is noise
+        assert s.decide(hot) == "up"      # two consecutive: act
+        assert "SLO" in s.last_reason
+
+    def test_pressure_must_be_consecutive(self):
+        s = self._scaler()
+        hot, calm = self._sig(p99=250.0), self._sig(p99=10.0)
+        assert s.decide(hot) is None
+        assert s.decide(calm) is None     # streak broken
+        assert s.decide(hot) is None      # back to 1
+        assert s.decide(hot) == "up"
+
+    def test_queue_depth_scales_up(self):
+        s = self._scaler()
+        deep = self._sig(queue=50, healthy=2, total=2)
+        assert s.decide(deep) is None
+        assert s.decide(deep) == "up"
+        assert "queue" in s.last_reason
+
+    def test_max_bound_holds(self):
+        s = self._scaler()
+        hot = self._sig(p99=500.0, total=3)     # already at max
+        for _ in range(6):
+            assert s.decide(hot) is None
+
+    def test_idle_scales_down_slowly_and_min_bound_holds(self):
+        s = self._scaler()
+        idle = self._sig(queue=0, p99=5.0, healthy=2, total=2)
+        assert s.decide(idle) is None
+        assert s.decide(idle) is None
+        assert s.decide(idle) == "down"   # down_after=3
+        floor = self._sig(queue=0, p99=5.0, healthy=1, total=1)
+        for _ in range(6):
+            assert s.decide(floor) is None    # never below min
+
+    def test_cooldown_blocks_consecutive_actions(self):
+        s = self._scaler(cooldown_s=3.0, interval_s=1.0)
+        hot = self._sig(p99=250.0)
+        assert s.decide(hot) is None
+        assert s.decide(hot) == "up"
+        for _ in range(3):                # 3 cooldown ticks
+            assert s.decide(hot) is None
+            assert s.last_reason == "cooldown"
+        assert s.decide(hot) is None      # hysteresis restarts
+        assert s.decide(hot) == "up"
+
+    def test_dead_worker_counts_as_pressure(self):
+        s = self._scaler(min_workers=2, max_workers=3)
+        short = self._sig(healthy=1, total=2)
+        assert s.decide(short) is None
+        assert s.decide(short) == "up"
+        assert "healthy" in s.last_reason
+
+    def test_metric_families_render(self):
+        from factorvae_tpu.obs.metrics import render_families
+
+        s = self._scaler()
+        text = render_families(s.metric_families())
+        assert "factorvae_router_autoscale_max_workers 3" in text
+
+
+class TestAutoscaleExposition:
+    def test_signal_families_carry_worker_labels(self):
+        from factorvae_tpu.obs.metrics import (
+            autoscale_families,
+            render_families,
+        )
+
+        text = render_families(autoscale_families({
+            "queue_depth": 4, "p50_ms": 9.5, "p99_ms": 80.0,
+            "slo_ms": 100.0, "workers_healthy": 2,
+            "workers_total": 2,
+            "worker_inflight": {"w0": 3, "r2": 1}}))
+        assert "factorvae_router_queue_depth 4" in text
+        assert "factorvae_router_observed_p99_ms 80" in text
+        assert "factorvae_router_slo_ms 100" in text
+        assert ('factorvae_router_worker_inflight{worker_id="r2"} 1'
+                in text)
+        assert ('factorvae_router_worker_inflight{worker_id="w0"} 3'
+                in text)
+
+    def test_absent_signals_render_no_samples(self):
+        from factorvae_tpu.obs.metrics import (
+            autoscale_families,
+            render_families,
+        )
+
+        text = render_families(autoscale_families(
+            {"queue_depth": 0, "worker_inflight": {}}))
+        assert "factorvae_router_queue_depth 0" in text
+        assert "observed_p99" not in text    # absent beats a lying 0
+
+    def test_router_metrics_merge_autoscale_families(self):
+        pool = _FakePool([_FakeWorker("w0", 1)])
+        router = Router(pool, slo_ms=42.0)
+        text = router.metrics()
+        assert "factorvae_router_slo_ms 42" in text
+        assert "factorvae_router_hedges_total 0" in text
+        assert "factorvae_router_request_latency_seconds_count 0" \
+            in text
