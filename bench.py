@@ -1189,6 +1189,7 @@ def run_mixed_bench() -> dict:
 
     enable_persistent_compile_cache()
 
+    from factorvae_tpu.analysis import ir as irlib
     from factorvae_tpu.data import synthetic_panel_dense
     from factorvae_tpu.obs import compile as compilelib
     from factorvae_tpu.train import Trainer
@@ -1237,6 +1238,19 @@ def run_mixed_bench() -> dict:
                 if getattr(state, "loss_scale", None) is not None else None)
             leg["skipped_steps"] = (
                 float(m["skipped_steps"]) if "skipped_steps" in m else None)
+        # JIR002 donation audit (analysis/ir.py): the epoch jit's
+        # donate_argnums=(0,) claim verified against the compiled
+        # HLO's input_output_alias map — a silently dropped donation
+        # doubles state residency, which would invalidate the
+        # remat_audit peak_bytes story below. Abstract shapes only
+        # (donation leaves the metadata intact), after the timed
+        # window, so the A/B rates stay clean. Schema additive.
+        leg["donation_audit"] = irlib.donation_audit(
+            trainer._train_epoch_jit,
+            (compilelib.abstractify(state),
+             compilelib.abstractify(trainer._epoch_orders(0)),
+             compilelib.abstractify(trainer.panel_args())),
+            (0,))
         legs[dtype] = leg
 
     # Remat audit (observation-only): peak_bytes of the compiled epoch
@@ -1298,6 +1312,8 @@ def run_mixed_bench() -> dict:
         "final_loss_scale_bf16": legs["bfloat16"]["final_loss_scale"],
         "skipped_steps_bf16": legs["bfloat16"]["skipped_steps"],
         "remat_audit": remat_audit,
+        "donation_audit_f32": legs["float32"]["donation_audit"],
+        "donation_audit_bf16": legs["bfloat16"]["donation_audit"],
         "plan": plan_block,
     }
     try:

@@ -1,14 +1,17 @@
-"""graftlint — a JAX-aware static-analysis pass for this repo.
+"""graftlint — JAX-aware static + semantic analysis for this repo.
 
-The three hot paths (serial trainer, fleet, scan scoring) depend on
-invariants nothing in Python enforces: no host sync inside jitted epoch
-bodies, donated buffers never read after the donating call, every PRNG
-key consumed exactly once, jits constructed once per config (not per
-call), and hot-path array constructors pinned to an explicit dtype so a
-bf16 plan is not silently f32. A stray `.item()` or reused key costs the
-chip-day win or breaks seed independence without failing a single test —
-so the invariants are checked at the AST level instead, on every tier-1
-run.
+Two backends. The AST backend (JGL rules) checks what the source says:
+no host sync inside jitted epoch bodies, donated buffers never read
+after the donating call, every PRNG key consumed exactly once, jits
+constructed once per config (not per call), hot-path array constructors
+pinned to an explicit dtype so a bf16 plan is not silently f32. A stray
+`.item()` or reused key costs the chip-day win or breaks seed
+independence without failing a single test — so the invariants are
+checked at the AST level instead, on every tier-1 run. The IR backend
+(JIR rules, `analysis/ir.py`) checks what XLA actually compiled: it
+abstractly lowers the repo's real jitted entry points (train/eval
+epochs, scoring scans, serving rungs — never executing them) and walks
+the jaxpr + post-SPMD HLO for claims the source only declares.
 
 Rule catalog (docs/analysis.md has the long-form version):
 
@@ -34,8 +37,30 @@ Rule catalog (docs/analysis.md has the long-form version):
           lock acquisition) reachable from a signal handler.
 - JGL011  whole-program only: daemon=True thread performing file
           writes with no join/flush barrier on any shutdown path.
-- JGL000  meta: unparseable file, or a `graftlint: disable` suppression
-          carrying no justification. Never suppressible.
+- JGL012  blocking network call (urlopen/create_connection/requests/
+          HTTPConnection) without a timeout, or a zero-argument
+          Event/Condition `.wait()` that cannot notice a dead waker.
+- JGL000  meta: unparseable file, a `graftlint: disable` suppression
+          carrying no justification, or — in IR mode — a registry
+          builder that raised / an unknown program name (the gate
+          reports what it could NOT check instead of no-opping
+          green). Never suppressible.
+
+IR rules (run with `--ir`; anchored at the program's `@_program(...)`
+declaration in analysis/ir.py, where suppressions also live):
+
+- JIR001  compiled dtype discipline: any f64 in any program; on bf16
+          programs, zero bf16 dots (wholesale dropped cast) or an f32
+          share of dot FLOPs past the program's sanctioned budget.
+- JIR002  donation effectiveness: every donate_argnums claim must
+          appear as real input_output_alias entries in the compiled
+          HLO — zero aliases is a silently dropped donation.
+- JIR003  partition coverage: exactly one rule per declared leaf,
+          no dead rules across the registry, and the epoch carry's
+          output_shardings a fixed point of its input_shardings.
+- JIR004  serving hazards: closed-over constants past the baked-bytes
+          budget (weights compiled into the executable) and
+          weak-typed inputs (a guaranteed second retrace).
 
 Suppression syntax (same line, or a standalone comment on the line
 above)::
@@ -49,6 +74,8 @@ CLI::
 
     python -m factorvae_tpu.analysis factorvae_tpu scripts --format human
     python -m factorvae_tpu.analysis --project          # whole-program
+    python -m factorvae_tpu.analysis --ir               # compiled programs
+    python -m factorvae_tpu.analysis --ir --programs train_epoch,serve_int8
 
 `--project` builds ONE cross-module index (import-resolved call graph,
 thread/signal/HTTP entry reachability, per-class guarded-attribute
@@ -62,11 +89,16 @@ recorder tier-1 drives over the Checkpointer/Timeline/metrics/registry
 /chaos lock set, failing on held-while-acquiring cycles static
 analysis cannot prove (tests/test_sanitize.py).
 
-The engine itself is stdlib-only (ast + tokenize) and never executes or
-imports the code under analysis, so the whole-repo pass takes well
-under a second. (Reaching it through `python -m factorvae_tpu.analysis`
-still imports the parent package — and therefore jax/flax; in-process
-callers like the tier-1 gate pay nothing extra.)
+The AST engine itself is stdlib-only (ast + tokenize) and never
+executes or imports the code under analysis, so the whole-repo pass
+takes well under a second. (Reaching it through
+`python -m factorvae_tpu.analysis` still imports the parent package —
+and therefore jax/flax; in-process callers like the tier-1 gate pay
+nothing extra.) The IR backend traces and AOT-compiles (but never
+runs) the registered programs — a full `--ir` sweep costs tens of
+seconds; where the watchdog already captured a program's HLO in this
+process, the audit reuses it from `obs/compile.compiled_view` instead
+of compiling a second time.
 """
 
 from factorvae_tpu.analysis.engine import (

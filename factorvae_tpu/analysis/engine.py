@@ -733,6 +733,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(import-resolved call graph, thread-entry "
                              "reachability) over every path, enabling the "
                              "concurrency rules JGL009-011")
+    parser.add_argument("--ir", action="store_true",
+                        help="semantic backend: abstractly lower the "
+                             "registered compiled programs (analysis/"
+                             "ir.py) and audit jaxpr + post-SPMD HLO "
+                             "(JIR001-004); composes with paths/"
+                             "--project")
+    parser.add_argument("--programs",
+                        help="with --ir: comma-separated registry "
+                             "subset (default: every registered "
+                             "program)")
     parser.add_argument("--format", choices=("human", "json"),
                         default="human")
     parser.add_argument("--show-suppressed", action="store_true",
@@ -741,12 +751,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     paths = list(args.paths)
-    if not paths:
+    if not paths and not args.ir:
         if not args.project:
-            parser.error("paths are required without --project")
+            parser.error("paths are required without --project/--ir")
         paths = default_project_paths()
-    findings = analyze_project(paths) if args.project \
-        else analyze_paths(paths)
+    if not paths and args.project:
+        paths = default_project_paths()
+    findings = []
+    if paths:
+        findings.extend(analyze_project(paths) if args.project
+                        else analyze_paths(paths))
+    if args.ir:
+        from factorvae_tpu.analysis import ir
+
+        names = None
+        if args.programs:
+            names = [n.strip() for n in args.programs.split(",")
+                     if n.strip()]
+        findings.extend(ir.analyze_programs(names=names))
     active = [f for f in findings if not f.suppressed]
     suppressed = [f for f in findings if f.suppressed]
 

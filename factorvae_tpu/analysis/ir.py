@@ -1,0 +1,929 @@
+"""Semantic graftlint: jaxpr/HLO-level audit of the COMPILED programs.
+
+The AST backend (engine.py / rules.py) guards the *source*; this second
+backend guards what XLA actually builds. It abstractly lowers the
+repo's real jitted programs — no execution, shapes only, reusing
+`obs/compile.py`'s `abstractify`/`capture_compile` surface, and never
+paying a second lower+compile where the watchdog already captured one
+(`obs.compile.compiled_view`) — over a declared **program registry**
+(`REGISTRY`: the serial/fleet/hyper train+eval epochs, the four
+`eval/predict` scoring scans, the serve precision rungs), then walks
+the jaxpr and the post-SPMD HLO to enforce four rules:
+
+- **JIR001 — dtype discipline.** f64 anywhere in a program is a
+  finding (nothing in this repo ever wants x64 compute). Inside a
+  declared-bf16 leg, the compute-dominant ops (dot_general /
+  conv_general_dilated) must run in bf16: f32 dots beyond the
+  program's sanctioned master-weight boundary count (default 0) mean
+  the bf16 cast silently re-promoted mid-graph — exactly the PR-16
+  regression class docs/precision.md warns about.
+- **JIR002 — donation effectiveness.** Every `donate_argnums` claim
+  must appear as a real `input_output_alias` entry in the compiled
+  HLO. XLA drops unusable donations with at most a warning; a dropped
+  donation silently doubles the argument's residency. This turns the
+  `bench.py --mixed` remat/donation observations into checked facts.
+- **JIR003 — partition coverage.** Every leaf of a program's declared
+  state trees must be matched by EXACTLY one partition-rule-table
+  entry (parallel/partition.py; zero matches means `shard_tree` would
+  raise in production, two means first-match-wins is hiding a rule),
+  dead table entries are flagged (aggregated across the audited set —
+  `loss_scale` only exists on mixed states), and the epoch-jit output
+  sharding of the carried state must be a FIXED POINT of its input
+  sharding — the PR-6 failure (GSPMD re-sharding an unpinned output
+  leaf that then mismatches the next call's in_shardings) codified.
+- **JIR004 — serving retrace/bloat hazards.** A serving program must
+  not bake large constants into the executable (the panel belongs in
+  the arguments, not the compile payload) and must not take weak-typed
+  inputs (a Python scalar at the boundary re-traces against strongly
+  typed callers).
+
+Findings are ordinary `engine.Finding`s anchored at the registry
+declaration in THIS file, so the existing suppression machinery
+applies verbatim: a `# graftlint: disable=JIR00x justification`
+comment on a program's `@_program(...)` declaration suppresses with a
+recorded justification, and an unjustified disable is a JGL000 finding
+exactly as in the AST backend. CLI: `python -m factorvae_tpu.analysis
+--ir [--programs a,b] [--format human|json]`.
+
+Registry programs are built at TINY synthetic shapes — the properties
+audited (dtype legs, donation aliases, rule-table coverage, output
+sharding fixed points, baked constants, weak types) are shape-
+independent, and tiny shapes keep the tier-1 self-audit gate's
+compiles cheap.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from factorvae_tpu.analysis.engine import Finding, apply_suppressions
+
+__all__ = [
+    "Program",
+    "ProgramSpec",
+    "REGISTRY",
+    "alias_report",
+    "analyze_programs",
+    "audit_program",
+    "donation_audit",
+]
+
+# Compute-dominant primitives for the JIR001 bf16-leg check: everything
+# else (adds, selects, reductions, the f32 loss-scale/optimizer math)
+# is boundary or elementwise work the mixed design keeps in f32.
+_DOT_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+@dataclasses.dataclass
+class Program:
+    """One audited compiled program: the jitted callable, the abstract
+    arguments of one real call, and the program's declared contracts
+    (what the four JIR rules check the IR against)."""
+
+    fn: Any
+    args: tuple
+    # declared compute leg: "bfloat16" arms the JIR001 dot-dtype check
+    compute_dtype: str = "float32"
+    # declared donation claims (mirrors the jit's donate_argnums)
+    donate_argnums: Tuple[int, ...] = ()
+    # (table_name, rule_table, abstract_tree) coverage declarations
+    coverage: Tuple[Tuple[str, Sequence, Any], ...] = ()
+    # carried-state fixed point: arg index -> output index (or None)
+    carried_arg: Optional[int] = None
+    carried_out: Optional[int] = None
+    serving: bool = False
+    const_bytes_limit: int = 1 << 20
+    # JIR001 dominance budget for a bf16 leg: the fraction of total
+    # dot/conv FLOPs allowed to run f32. 0.0 = pure-bf16 compute; the
+    # real programs sanction their deliberately-f32 factor head (the
+    # encoder/decoder/predictor carry NO dtype plumbing — tiny per-day
+    # matrices stay f32 for numerics while the compute-dominant
+    # extractor casts; docs/precision.md) with a minority share.
+    sanctioned_f32_dot_frac: float = 0.0
+    # watchdog name for compiled-view reuse; defaults to fn.name
+    watch_name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """Registry entry: a name, a zero-arg builder returning a
+    `Program`, and the declaration line findings anchor to (so the
+    engine's suppression comments attach to the declaration)."""
+
+    name: str
+    build: Callable[[], Program]
+    line: int
+
+
+REGISTRY: List[ProgramSpec] = []
+
+
+def _program(name: str):
+    """Register a builder under `name`; findings for the program anchor
+    at the decorated function's declaration line in this file."""
+
+    def deco(fn):
+        REGISTRY.append(ProgramSpec(name, fn, fn.__code__.co_firstlineno))
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# jaxpr / HLO walkers
+# ---------------------------------------------------------------------------
+
+
+def _subjaxprs(value):
+    """Jaxprs nested inside one eqn-param value (scan/cond/pjit bodies
+    arrive as ClosedJaxpr/Jaxpr, sometimes in tuples/lists)."""
+    import jax
+
+    core = jax.core
+    if isinstance(value, core.ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, core.Jaxpr):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _subjaxprs(v)
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn of `jaxpr` and (recursively) of every jaxpr nested in
+    its eqn params — scan bodies, cond branches, inlined pjit calls."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _subjaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _make_jaxpr(prog: Program):
+    """Closed jaxpr of one abstract call — tracing only, no lowering,
+    no compile. Raises on an unbuildable trace: the caller converts
+    that into a loud JGL000 finding (a gate must never no-op green)."""
+    import jax
+
+    fn = getattr(prog.fn, "_fn", prog.fn)  # unwrap WatchedJit
+    return jax.make_jaxpr(lambda *a: fn(*a))(*prog.args)
+
+
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}[,\s]*entry",
+                             re.DOTALL)
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{[0-9, ]*\}:\s*\(([0-9]+),\s*\{[0-9, ]*\},\s*(?:may|must)-alias\)")
+
+
+def _hlo_aliased_params(hlo_text: str) -> List[int]:
+    """Entry-parameter numbers that appear in the compiled module's
+    `input_output_alias` map (flat-argument numbering: jit parameters
+    are the flattened leaves of the call's arguments, in order)."""
+    m = _ALIAS_BLOCK_RE.search(hlo_text)
+    if m is None:
+        # alias map absent entirely (no donation survived, or a
+        # text-format skew) — fall back to scanning the whole header
+        m = re.search(r"input_output_alias=\{([^\n]*)\}", hlo_text)
+        if m is None:
+            return []
+    return sorted({int(p) for p in _ALIAS_ENTRY_RE.findall(m.group(1))})
+
+
+def _compiled_view(prog: Program) -> dict:
+    """The program's compiled artifacts (post-SPMD HLO text + in/out
+    shardings): the watchdog's stashed first-miss capture when one
+    exists for this jit (no second lower+compile), a fresh
+    `capture_compile(want_text=True)` replay otherwise."""
+    from factorvae_tpu.obs import compile as compilelib
+
+    name = prog.watch_name or str(getattr(prog.fn, "name", "") or "")
+    if name:
+        view = compilelib.compiled_view(name)
+        if view is not None and view.get("hlo_text"):
+            return view
+    rec = compilelib.capture_compile(prog.fn, prog.args, want_text=True)
+    return {"hlo_text": rec.get("hlo_text"),
+            "input_shardings": rec.get("input_shardings"),
+            "output_shardings": rec.get("output_shardings")}
+
+
+# ---------------------------------------------------------------------------
+# JIR001 — dtype discipline
+# ---------------------------------------------------------------------------
+
+
+def _dot_flops(eqn) -> float:
+    """Rough FLOP weight of one dot/conv eqn: 2 x |out| x contraction.
+    Only RELATIVE weight matters here (f32 share of the program's dot
+    FLOPs), so conv window arithmetic is approximated by |out| alone."""
+    import numpy as np
+
+    out_aval = eqn.outvars[0].aval
+    flops = 2.0 * float(np.prod(out_aval.shape))
+    dn = eqn.params.get("dimension_numbers")
+    if eqn.primitive.name == "dot_general" and dn is not None:
+        (lhs_contract, _), _ = dn
+        lhs = eqn.invars[0].aval
+        for d in lhs_contract:
+            flops *= lhs.shape[d]
+    return flops
+
+
+def _dtype_findings(spec: ProgramSpec, prog: Program, closed,
+                    path: str) -> List[Finding]:
+    import numpy as np
+
+    f64_prims: List[str] = []
+    dot_count: Dict[str, int] = {}
+    dot_flops: Dict[str, float] = {}
+    for eqn in _iter_eqns(closed.jaxpr):
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is not None and dt == np.float64 \
+                    and len(f64_prims) < 8:
+                f64_prims.append(eqn.primitive.name)
+        if eqn.primitive.name in _DOT_PRIMS:
+            dt = str(eqn.outvars[0].aval.dtype)
+            dot_count[dt] = dot_count.get(dt, 0) + 1
+            dot_flops[dt] = dot_flops.get(dt, 0.0) + _dot_flops(eqn)
+    out: List[Finding] = []
+    if f64_prims:
+        out.append(Finding(
+            "JIR001", path, spec.line,
+            f"[{spec.name}] f64 compute in the traced program "
+            f"(via {', '.join(sorted(set(f64_prims)))}) — nothing in "
+            "this repo wants x64; a Python float or np.float64 leaked "
+            "into the trace", entry_point=f"ir:{spec.name}"))
+    if prog.compute_dtype == "bfloat16":
+        total = sum(dot_flops.values())
+        f32_frac = dot_flops.get("float32", 0.0) / total if total else 0.0
+        bf16_dots = dot_count.get("bfloat16", 0)
+        if bf16_dots == 0 and sum(dot_count.values()) > 0:
+            out.append(Finding(
+                "JIR001", path, spec.line,
+                f"[{spec.name}] declared-bf16 leg contains no bf16 "
+                f"dot/conv at all (dot dtypes: {dot_count}) — the "
+                "compute cast never happened",
+                entry_point=f"ir:{spec.name}"))
+        elif f32_frac > prog.sanctioned_f32_dot_frac:
+            out.append(Finding(
+                "JIR001", path, spec.line,
+                f"[{spec.name}] declared-bf16 leg runs {f32_frac:.0%} "
+                "of its dot/conv FLOPs in f32 (sanctioned: "
+                f"{prog.sanctioned_f32_dot_frac:.0%}; op counts: "
+                f"{dot_count}) — the master-weight cast re-promoted "
+                "to f32 mid-graph", entry_point=f"ir:{spec.name}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JIR002 — donation effectiveness
+# ---------------------------------------------------------------------------
+
+
+def alias_report(view: dict, args: tuple,
+                 donate_argnums: Sequence[int]) -> dict:
+    """Per-donation alias verdict from a compiled view. JSON-ready —
+    this is also the `bench.py --mixed` per-leg donation audit block.
+
+    Flat-parameter attribution: jit flattens the call's argument
+    pytrees into one parameter list in order, so argnum i owns the
+    contiguous leaf range [offset(i), offset(i)+leaves(i))."""
+    import jax
+
+    hlo = view.get("hlo_text")
+    if not hlo:
+        return {"ok": False, "error": "compiled HLO text unavailable",
+                "declared": sorted(int(i) for i in donate_argnums),
+                "aliased_params": 0, "per_arg": []}
+    aliased = _hlo_aliased_params(hlo)
+    sizes = [len(jax.tree_util.tree_leaves(a)) for a in args]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+    per_arg = []
+    for i in sorted(int(i) for i in donate_argnums):
+        if i >= len(sizes):
+            per_arg.append({"argnum": i, "leaves": 0, "aliased": 0,
+                            "verified": False})
+            continue
+        lo, hi = offsets[i], offsets[i + 1]
+        hits = [p for p in aliased if lo <= p < hi]
+        per_arg.append({"argnum": i, "leaves": sizes[i],
+                        "aliased": len(hits),
+                        "verified": bool(hits)})
+    return {"ok": True, "declared": [a["argnum"] for a in per_arg],
+            "aliased_params": len(aliased), "per_arg": per_arg}
+
+
+def donation_audit(fn, args: tuple,
+                   donate_argnums: Sequence[int]) -> dict:
+    """One-call donation audit for external consumers (bench.py): the
+    compiled view (stash-first) of `fn` at `args`, reduced to the
+    JIR002 alias report."""
+    prog = Program(fn=fn, args=tuple(args),
+                   donate_argnums=tuple(donate_argnums))
+    return alias_report(_compiled_view(prog), prog.args,
+                        prog.donate_argnums)
+
+
+def _donation_findings(spec: ProgramSpec, prog: Program, view: dict,
+                       path: str) -> List[Finding]:
+    if not prog.donate_argnums:
+        return []
+    rep = alias_report(view, prog.args, prog.donate_argnums)
+    if not rep["ok"]:
+        return [Finding(
+            "JIR002", path, spec.line,
+            f"[{spec.name}] donate_argnums={tuple(prog.donate_argnums)} "
+            f"declared but the compiled HLO is unavailable "
+            f"({rep['error']}) — the donation claim cannot be verified",
+            entry_point=f"ir:{spec.name}")]
+    out = []
+    for arg in rep["per_arg"]:
+        if not arg["verified"]:
+            out.append(Finding(
+                "JIR002", path, spec.line,
+                f"[{spec.name}] donated argument {arg['argnum']} "
+                f"({arg['leaves']} leaves) produced ZERO input-output "
+                "aliases in the compiled HLO — XLA dropped the "
+                "donation silently (shape/dtype mismatch with every "
+                "output?); the buffer is resident twice",
+                entry_point=f"ir:{spec.name}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JIR003 — partition coverage + carried-state fixed point
+# ---------------------------------------------------------------------------
+
+
+def _leaf_names(tree) -> List[str]:
+    from factorvae_tpu.parallel import partition
+
+    names: List[str] = []
+    partition.named_tree_map(
+        lambda name, leaf: names.append(name) or leaf, tree)
+    return names
+
+
+def _coverage_findings(spec: ProgramSpec, prog: Program, path: str,
+                       table_hits: Dict[str, Dict[str, int]],
+                       ) -> List[Finding]:
+    """Exactly-one-rule coverage per leaf. Also accumulates per-table
+    pattern hit counts into `table_hits` for the end-of-run dead-rule
+    aggregation (a pattern may be live only on SOME programs' trees —
+    `loss_scale` exists only on mixed states)."""
+    out: List[Finding] = []
+    for table_name, table, tree in prog.coverage:
+        hits = table_hits.setdefault(
+            table_name, {pat: 0 for pat, _ in table})
+        for pat, _ in table:
+            hits.setdefault(pat, 0)
+        for name in _leaf_names(tree):
+            matched = [pat for pat, _ in table if re.search(pat, name)]
+            for pat in matched:
+                hits[pat] += 1
+            if not matched:
+                out.append(Finding(
+                    "JIR003", path, spec.line,
+                    f"[{spec.name}] state leaf '{name}' matches NO "
+                    f"{table_name} entry — shard_tree would raise on "
+                    "a real mesh; add a rule for it",
+                    entry_point=f"ir:{spec.name}"))
+            elif len(matched) > 1:
+                out.append(Finding(
+                    "JIR003", path, spec.line,
+                    f"[{spec.name}] state leaf '{name}' matches "
+                    f"{len(matched)} {table_name} entries "
+                    f"({matched}) — first-match-wins is silently "
+                    "shadowing the later rule(s)",
+                    entry_point=f"ir:{spec.name}"))
+    return out
+
+
+def _dead_rule_findings(table_hits: Dict[str, Dict[str, int]],
+                        path: str, line: int) -> List[Finding]:
+    out = []
+    for table_name in sorted(table_hits):
+        for pat, count in table_hits[table_name].items():
+            if count == 0:
+                out.append(Finding(
+                    "JIR003", path, line,
+                    f"dead partition rule: {table_name} pattern "
+                    f"{pat!r} matched zero leaves across every audited "
+                    "program — delete it or register the program whose "
+                    "state it covers", entry_point="ir:<registry>"))
+    return out
+
+
+def _sharding_leaves(tree) -> list:
+    import jax
+
+    return jax.tree_util.tree_leaves(
+        tree, is_leaf=lambda x: hasattr(x, "is_equivalent_to"))
+
+
+def _fixed_point_findings(spec: ProgramSpec, prog: Program, view: dict,
+                          path: str) -> List[Finding]:
+    """Compiled output sharding of the carried state must equal the
+    carried argument's input sharding, leaf for leaf."""
+    import jax
+
+    if prog.carried_arg is None or prog.carried_out is None:
+        return []
+    in_sh = view.get("input_shardings")
+    out_sh = view.get("output_shardings")
+    if in_sh is None or out_sh is None:
+        return [Finding(
+            "JIR003", path, spec.line,
+            f"[{spec.name}] carried-state fixed point declared but the "
+            "compiled shardings are unavailable — the out_shardings "
+            "pin cannot be verified", entry_point=f"ir:{spec.name}")]
+    args_sh = in_sh[0] if isinstance(in_sh, tuple) and len(in_sh) == 2 \
+        and isinstance(in_sh[1], dict) else in_sh
+    carried_in = _sharding_leaves(args_sh[prog.carried_arg])
+    out_tree = out_sh if prog.carried_out is None else (
+        out_sh[prog.carried_out]
+        if isinstance(out_sh, (tuple, list)) else out_sh)
+    carried_out = _sharding_leaves(out_tree)
+    if len(carried_in) != len(carried_out):
+        return [Finding(
+            "JIR003", path, spec.line,
+            f"[{spec.name}] carried state has {len(carried_in)} input "
+            f"sharding leaves but {len(carried_out)} output sharding "
+            "leaves — output index/arg index declaration is wrong",
+            entry_point=f"ir:{spec.name}")]
+    avals = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda a: a, prog.args[prog.carried_arg]))
+    out = []
+    for i, (si, so) in enumerate(zip(carried_in, carried_out)):
+        ndim = len(getattr(avals[i], "shape", ())) \
+            if i < len(avals) else 0
+        try:
+            same = bool(si.is_equivalent_to(so, ndim))
+        except (TypeError, ValueError):
+            same = si == so
+        if not same:
+            out.append(Finding(
+                "JIR003", path, spec.line,
+                f"[{spec.name}] carried-state leaf {i}: output "
+                f"sharding {so} != input sharding {si} — the epoch "
+                "jit's out_shardings are NOT a fixed point of the "
+                "carried state; the next call re-shards (the PR-6 "
+                "failure)", entry_point=f"ir:{spec.name}"))
+            if len(out) >= 4:  # one program, one storm — cap the noise
+                break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JIR004 — serving retrace/bloat hazards
+# ---------------------------------------------------------------------------
+
+
+def _all_consts(closed):
+    """Constants of the closed jaxpr AND of every ClosedJaxpr nested in
+    eqn params — a jit-closed-over array is hoisted into the inner
+    pjit's closure, not the outer trace's."""
+    import jax
+
+    core = jax.core
+    yield from closed.consts
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for v in eqn.params.values():
+                stack = [v]
+                while stack:
+                    item = stack.pop()
+                    if isinstance(item, core.ClosedJaxpr):
+                        yield from item.consts
+                        yield from walk(item.jaxpr)
+                    elif isinstance(item, core.Jaxpr):
+                        yield from walk(item)
+                    elif isinstance(item, (tuple, list)):
+                        stack.extend(item)
+
+    yield from walk(closed.jaxpr)
+
+
+def _serving_findings(spec: ProgramSpec, prog: Program, closed,
+                      path: str) -> List[Finding]:
+    import numpy as np
+
+    if not prog.serving:
+        return []
+    out: List[Finding] = []
+    const_bytes = 0
+    for c in _all_consts(closed):
+        try:
+            const_bytes += int(np.asarray(c).nbytes)
+        except (TypeError, ValueError):
+            continue
+    if const_bytes > prog.const_bytes_limit:
+        out.append(Finding(
+            "JIR004", path, spec.line,
+            f"[{spec.name}] serving program bakes "
+            f"{const_bytes / 1e6:.1f} MB of constants into the "
+            f"executable (limit {prog.const_bytes_limit / 1e6:.1f} MB) "
+            "— a closed-over panel/param tree belongs in the jit "
+            "arguments, not the compile payload",
+            entry_point=f"ir:{spec.name}"))
+    weak = [str(v.aval) for v in closed.jaxpr.invars
+            if getattr(v.aval, "weak_type", False)]
+    if weak:
+        out.append(Finding(
+            "JIR004", path, spec.line,
+            f"[{spec.name}] serving program takes {len(weak)} "
+            f"weak-typed input(s) ({weak[:4]}) — a Python scalar at "
+            "the boundary retraces against strongly-typed callers; "
+            "pass arrays with explicit dtypes",
+            entry_point=f"ir:{spec.name}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-program audit + the analyze entry point
+# ---------------------------------------------------------------------------
+
+
+def audit_program(spec: ProgramSpec, prog: Program, path: str,
+                  table_hits: Optional[Dict[str, Dict[str, int]]] = None,
+                  ) -> List[Finding]:
+    """All four JIR rules over one built program. Compiles only when a
+    compiled artifact is actually needed (donation claims or a carried-
+    state fixed point declared) and no watchdog capture is stashed."""
+    findings: List[Finding] = []
+    try:
+        closed = _make_jaxpr(prog)
+    except Exception as e:
+        return [Finding(
+            "JGL000", path, spec.line,
+            f"[{spec.name}] program failed to trace — the IR gate "
+            f"checks nothing here: {type(e).__name__}: {e}",
+            entry_point=f"ir:{spec.name}")]
+    findings.extend(_dtype_findings(spec, prog, closed, path))
+    findings.extend(_serving_findings(spec, prog, closed, path))
+    if table_hits is not None:
+        findings.extend(
+            _coverage_findings(spec, prog, path, table_hits))
+    needs_compile = bool(prog.donate_argnums) or (
+        prog.carried_arg is not None and prog.carried_out is not None)
+    if needs_compile:
+        view = _compiled_view(prog)
+        findings.extend(_donation_findings(spec, prog, view, path))
+        findings.extend(_fixed_point_findings(spec, prog, view, path))
+    return findings
+
+
+def _source_anchor() -> Tuple[str, str]:
+    import inspect
+    import sys
+
+    path = inspect.getsourcefile(sys.modules[__name__]) or __file__
+    with open(path, "r", encoding="utf-8") as fh:
+        return path, fh.read()
+
+
+def analyze_programs(names: Optional[Sequence[str]] = None,
+                     registry: Optional[Sequence[ProgramSpec]] = None,
+                     suppress: bool = True) -> List[Finding]:
+    """Audit the program registry (or the `names` subset / an explicit
+    fixture `registry`) and return findings with the engine's
+    suppression comments applied over THIS file's source. The
+    aggregated dead-rule check runs only over a full-registry audit
+    (or any explicit registry): on a `names` subset a table entry can
+    look dead merely because its program was filtered out."""
+    specs = list(REGISTRY if registry is None else registry)
+    if names is not None:
+        wanted = set(names)
+        unknown = wanted - {s.name for s in specs}
+        specs = [s for s in specs if s.name in wanted]
+    else:
+        unknown = set()
+    path, src = _source_anchor()
+    findings: List[Finding] = []
+    for n in sorted(unknown):
+        findings.append(Finding(
+            "JGL000", path, 1,
+            f"unknown program {n!r} — the IR gate would check nothing "
+            "here (known: "
+            f"{', '.join(s.name for s in (registry or REGISTRY))})",
+            entry_point=f"ir:{n}"))
+    table_hits: Dict[str, Dict[str, int]] = {}
+    for spec in specs:
+        try:
+            prog = spec.build()
+        except Exception as e:
+            findings.append(Finding(
+                "JGL000", path, spec.line,
+                f"[{spec.name}] program builder failed — the IR gate "
+                f"checks nothing here: {type(e).__name__}: {e}",
+                entry_point=f"ir:{spec.name}"))
+            continue
+        findings.extend(audit_program(spec, prog, path, table_hits))
+    if names is None:
+        findings.extend(_dead_rule_findings(
+            table_hits, path, _program.__code__.co_firstlineno))
+    if not suppress:
+        return findings
+    return apply_suppressions(src, ast.parse(src), path, findings)
+
+
+# ---------------------------------------------------------------------------
+# the program registry: every compiled program the repo ships
+# ---------------------------------------------------------------------------
+#
+# Builders construct the REAL jits (Trainer/FleetTrainer/_score_*_fn —
+# the exact watch_jit-wrapped programs production calls) over tiny
+# synthetic panels, then hand audit_program abstract ShapeDtypeStruct
+# arguments. Construction only: eval_shape for states, no train step,
+# no scoring dispatch ever runs.
+
+
+def _tiny_config(train_dtype: Optional[str] = None,
+                 model_dtype: str = "float32"):
+    from factorvae_tpu.config import (
+        Config, DataConfig, ModelConfig, TrainConfig,
+    )
+    from factorvae_tpu.data import PanelDataset, synthetic_panel
+
+    panel = synthetic_panel(num_days=16, num_instruments=5,
+                            num_features=6, missing_prob=0.1, seed=0)
+    ds = PanelDataset(panel, seq_len=4)
+    cfg = Config(
+        model=ModelConfig(num_features=6, hidden_size=8, num_factors=3,
+                          num_portfolios=4, seq_len=4,
+                          compute_dtype=model_dtype),
+        data=DataConfig(seq_len=4, start_time=None,
+                        fit_end_time=str(ds.dates[10].date()),
+                        val_start_time=str(ds.dates[11].date()),
+                        val_end_time=str(ds.dates[-1].date())),
+        train=TrainConfig(num_epochs=1, lr=1e-3, seed=0,
+                          save_dir="/tmp/graftlint_ir",
+                          checkpoint_every=0,
+                          compute_dtype=train_dtype),
+    )
+    return cfg, ds
+
+
+def _abstract(tree):
+    from factorvae_tpu.obs import compile as compilelib
+
+    return compilelib.abstractify(tree)
+
+
+def _train_epoch_program(train_dtype: Optional[str]) -> Program:
+    import jax
+
+    from factorvae_tpu.parallel import partition
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _tiny_config(train_dtype=train_dtype)
+    tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = jax.eval_shape(tr.init_state)
+    args = (state, _abstract(tr._epoch_orders(0)),
+            _abstract(tr.panel_args()))
+    panel = {"values": ds.values, "last_valid": ds.last_valid,
+             "next_valid": ds.next_valid}
+    return Program(
+        fn=tr._train_epoch_jit, args=args,
+        compute_dtype=tr._train_dtype, donate_argnums=(0,),
+        coverage=(
+            ("TRAIN_STATE_RULES", partition.TRAIN_STATE_RULES, state),
+            ("PANEL_RULES", partition.PANEL_RULES, _abstract(panel)),
+        ),
+        carried_arg=0, carried_out=0)
+
+
+@_program("train_epoch")
+def _build_train_epoch() -> Program:
+    """Serial f32 train epoch: state donation + TRAIN_STATE_RULES/
+    PANEL_RULES coverage + carried-state fixed point."""
+    return _train_epoch_program(train_dtype=None)
+
+
+@_program("train_epoch_bf16")
+def _build_train_epoch_bf16() -> Program:
+    """Mixed-precision train epoch (PR 16): the declared-bf16 leg the
+    JIR001 dot-dtype walk guards. The factor head (encoder/decoder/
+    predictor — tiny per-day matrices, no dtype plumbing by design) is
+    sanctioned to stay f32 as a MINORITY of dot FLOPs; at this gate's
+    tiny audit shapes the head is ~40% of dot FLOPs (it shrinks with
+    real model sizes), so the 50% budget still trips on the real
+    failure: the extractor cast silently undone (share -> ~100%)."""
+    prog = _train_epoch_program(train_dtype="bfloat16")
+    prog.sanctioned_f32_dot_frac = 0.5
+    return prog
+
+
+@_program("eval_epoch")
+def _build_eval_epoch() -> Program:
+    import jax
+
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _tiny_config()
+    tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    params = jax.eval_shape(tr.init_state).params
+    key = _abstract(jax.random.PRNGKey(1))
+    order = tr._val_order()
+    args = (params, _abstract(order), key, _abstract(tr.panel_args()))
+    return Program(fn=tr._eval_epoch_jit, args=args,
+                   compute_dtype=tr._train_dtype)
+
+
+def _fleet(num_seeds: int = 2, hyper: bool = False):
+    from factorvae_tpu.train import FleetTrainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    if hyper:
+        # bf16 hyper lanes: exercises the runtime-scalar trace AND a
+        # MIXED fleet state, so `loss_scale`/`good_steps` (None leaves
+        # on f32 states) register as live FLEET_STATE_RULES matches in
+        # the JIR003 dead-rule aggregation. Per-lane save_dir: lane
+        # checkpoint paths must not collide (validate_lane_configs).
+        cfg, ds = _tiny_config(train_dtype="bfloat16")
+        lanes = []
+        for i, lr in enumerate((1e-3, 2e-3)):
+            lanes.append(dataclasses.replace(
+                cfg, train=dataclasses.replace(
+                    cfg.train, lr=lr,
+                    save_dir=f"{cfg.train.save_dir}/lane{i}")))
+        return FleetTrainer(cfg, ds, lane_configs=lanes,
+                            logger=MetricsLogger(echo=False)), ds
+    cfg, ds = _tiny_config()
+    return FleetTrainer(cfg, ds, seeds=list(range(num_seeds)),
+                        logger=MetricsLogger(echo=False)), ds
+
+
+def _fleet_train_program(hyper: bool) -> Program:
+    import jax
+
+    from factorvae_tpu.parallel import partition
+
+    ft, _ = _fleet(hyper=hyper)
+    state = jax.eval_shape(ft.init_fleet_state)
+    args = [state, _abstract(ft._epoch_orders(0)),
+            _abstract(ft.panel_args())]
+    args.extend(_abstract(ft._hp_args()))
+    return Program(
+        fn=ft._train_epoch_jit, args=tuple(args),
+        compute_dtype=ft._train_dtype, donate_argnums=(0,),
+        coverage=(("FLEET_STATE_RULES", partition.FLEET_STATE_RULES,
+                   state),),
+        carried_arg=0, carried_out=0)
+
+
+@_program("fleet_train_epoch")
+def _build_fleet_train_epoch() -> Program:
+    """Stacked 2-seed fleet train epoch: FLEET_STATE_RULES coverage +
+    stacked-state donation + fixed point."""
+    return _fleet_train_program(hyper=False)
+
+
+@_program("hyper_train_epoch")
+def _build_hyper_train_epoch() -> Program:
+    """bf16 hyper fleet (per-lane lr as runtime scalars, PR 12): the
+    seed fleet's contracts over the scalar-threaded MIXED trace — the
+    one registry program whose fleet state carries loss_scale/
+    good_steps leaves. f32-head sanction as in train_epoch_bf16."""
+    prog = _fleet_train_program(hyper=True)
+    prog.sanctioned_f32_dot_frac = 0.5
+    return prog
+
+
+@_program("fleet_eval_epoch")
+def _build_fleet_eval_epoch() -> Program:
+    import jax
+
+    ft, _ = _fleet(hyper=False)
+    state = jax.eval_shape(ft.init_fleet_state)
+    keys = _abstract(ft._eval_keys(0))
+    args = (state.params, _abstract(ft._val_order()), keys,
+            _abstract(ft.panel_args()))
+    return Program(fn=ft._eval_epoch_jit, args=args,
+                   compute_dtype=ft._train_dtype)
+
+
+def _score_inputs(ds, model_cfg, stacked: bool = False,
+                  scan: bool = False):
+    """Abstract (params, panel..., day_idx, key(s)) for the scoring
+    programs, mirroring eval/predict's real call shapes."""
+    import jax
+    import numpy as np
+
+    from factorvae_tpu.eval.predict import _scan_inputs
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, _ = _tiny_config(model_dtype=model_cfg.compute_dtype)
+    cfg = dataclasses.replace(cfg, model=model_cfg)
+    params = jax.eval_shape(
+        Trainer(cfg, ds, logger=MetricsLogger(echo=False)).init_state
+    ).params
+    if stacked:
+        params = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct((2,) + tuple(s.shape),
+                                           s.dtype), params)
+    days = np.arange(len(ds.dates), dtype=np.int32)
+    base = jax.random.PRNGKey(0)
+    if scan:
+        day_idx, keys = _scan_inputs(days, 4, base, False)
+        tail = (_abstract(day_idx), _abstract(keys))
+    else:
+        tail = (_abstract(jax.numpy.asarray(days[:4])), _abstract(base))
+    return (params, _abstract(ds.values), _abstract(ds.last_valid),
+            _abstract(ds.next_valid)) + tail
+
+
+def _scoring_program(fleet: bool, scan: bool) -> Program:
+    import jax
+
+    from factorvae_tpu.eval import predict
+
+    cfg, ds = _tiny_config()
+    factory = {
+        (False, False): predict._score_chunk_fn,
+        (True, False): predict._score_chunk_fleet_fn,
+        (False, True): predict._score_scan_fn,
+        (True, True): predict._score_scan_fleet_fn,
+    }[(fleet, scan)]
+    fn = factory(cfg.model, cfg.data.seq_len, None, False)
+    args = _score_inputs(ds, cfg.model, stacked=fleet, scan=scan)
+    # score_scan mirrors the factory's backend-conditional donation
+    # (day_idx/keys buffers; a no-op where aliasing is unsupported)
+    donate = (4, 5) if (scan and not fleet
+                        and jax.default_backend() != "cpu") else ()
+    return Program(fn=fn, args=args,
+                   compute_dtype=cfg.model.compute_dtype,
+                   donate_argnums=donate)
+
+
+@_program("score_chunk")
+def _build_score_chunk() -> Program:
+    return _scoring_program(fleet=False, scan=False)
+
+
+@_program("score_chunk_fleet")
+def _build_score_chunk_fleet() -> Program:
+    return _scoring_program(fleet=True, scan=False)
+
+
+@_program("score_scan")
+def _build_score_scan() -> Program:
+    return _scoring_program(fleet=False, scan=True)
+
+
+@_program("score_scan_fleet")
+def _build_score_scan_fleet() -> Program:
+    return _scoring_program(fleet=True, scan=True)
+
+
+def _serve_rung_program(precision: str) -> Program:
+    import jax
+
+    from factorvae_tpu.eval import predict
+    from factorvae_tpu.serve.registry import precision_config
+
+    cfg, ds = _tiny_config()
+    rung = precision_config(cfg, precision)
+    int8 = precision == "int8"
+    fn = predict._score_chunk_fn(rung.model, rung.data.seq_len, None,
+                                 int8)
+    args = _score_inputs(ds, rung.model)
+    if int8:
+        from factorvae_tpu.ops.quant import quantize_params
+
+        args = (jax.eval_shape(quantize_params, args[0]),) + args[1:]
+    return Program(fn=fn, args=args,
+                   compute_dtype=rung.model.compute_dtype,
+                   serving=True)
+
+
+@_program("serve_float32")
+def _build_serve_float32() -> Program:
+    """Serving ladder rung (serve/registry.PRECISIONS): JIR004 baked-
+    constant/weak-type checks armed on the daemon's scoring program."""
+    return _serve_rung_program("float32")
+
+
+@_program("serve_bfloat16")
+def _build_serve_bfloat16() -> Program:
+    """bf16 rung: f32 factor head sanctioned as a minority of dot
+    FLOPs, as in train_epoch_bf16 (same model, forward only)."""
+    prog = _serve_rung_program("bfloat16")
+    prog.sanctioned_f32_dot_frac = 0.5
+    return prog
+
+
+@_program("serve_int8")
+def _build_serve_int8() -> Program:
+    return _serve_rung_program("int8")

@@ -912,5 +912,119 @@ def rule_jgl008(model: ModuleModel) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# JGL012 — blocking network/synchronization call without a timeout
+
+
+# resolved callable -> number of positional args at which the timeout
+# parameter is covered positionally (urlopen(url, data, timeout) -> 3;
+# create_connection(addr, timeout) -> 2; HTTP*Connection(host, port,
+# timeout) -> 3). A `timeout=` keyword always satisfies the rule.
+JGL012_TIMEOUT_CALLS = {
+    "urllib.request.urlopen": 3,
+    "socket.create_connection": 2,
+    "http.client.HTTPConnection": 3,
+    "http.client.HTTPSConnection": 3,
+    "requests.get": None,
+    "requests.post": None,
+    "requests.put": None,
+    "requests.delete": None,
+    "requests.head": None,
+    "requests.patch": None,
+    "requests.request": None,
+}
+
+# constructors whose zero-arg `.wait()` blocks forever
+JGL012_WAITABLE_CTORS = {"threading.Event", "threading.Condition"}
+
+
+def _jgl012_wait_targets(model: ModuleModel) -> Set[str]:
+    """Names module-locally bound to `threading.Event()` /
+    `threading.Condition(...)` — plain locals ("done") and
+    self-attributes ("self._stop") alike."""
+    tracked: Set[str] = set()
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call) and model.resolve(
+                node.value.func) in JGL012_WAITABLE_CTORS):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                tracked.add(t.id)
+            elif isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                tracked.add(f"self.{t.attr}")
+    return tracked
+
+
+def _jgl012_wait_receiver(func: ast.Attribute) -> Optional[str]:
+    """'done' for `done.wait()`, 'self._x' for `self._x.wait()`."""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+            and v.value.id == "self":
+        return f"self.{v.attr}"
+    return None
+
+
+def rule_jgl012(model: ModuleModel) -> List[Finding]:
+    """Blocking network or synchronization call without an explicit
+    timeout in `factorvae_tpu/` library code. The serving plane
+    (ISSUE 17) is a mesh of sockets — router forwards, remote
+    join/download, autoscale scrapes, readiness probes — and every
+    untimed blocking call in it is a hang that outlives the peer: a
+    worker that dies mid-recv parks the caller forever, invisible to
+    the watcher that would have healed it. Two shapes are flagged:
+    HTTP/socket calls (`urlopen`, `http.client.*Connection`,
+    `socket.create_connection`, `requests.*`) with neither a
+    `timeout=` keyword nor the positional timeout slot filled, and
+    zero-arg `.wait()` on a `threading.Event`/`Condition` (blocks
+    forever; `wait(t)` in a liveness-checking loop keeps the caller
+    able to notice a dead peer). Deliberate untimed blocking carries a
+    justified suppression."""
+    norm = model.path.replace(os.sep, "/")
+    if "factorvae_tpu/" not in norm:
+        return []  # scripts/, tests/, bench.py own their blocking
+    tracked = _jgl012_wait_targets(model)
+    findings: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **kwargs may carry timeout — benefit of doubt
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        resolved = model.resolve(node.func)
+        if resolved in JGL012_TIMEOUT_CALLS:
+            slot = JGL012_TIMEOUT_CALLS[resolved]
+            if slot is not None and len(node.args) >= slot:
+                continue
+            findings.append(Finding(
+                "JGL012", model.path, node.lineno,
+                f"{resolved} without an explicit timeout — an untimed "
+                "network call hangs forever when the peer dies "
+                "mid-exchange; pass timeout= (the serving plane's "
+                "watcher can only heal what returns)",
+            ))
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "wait" and not node.args:
+            recv = _jgl012_wait_receiver(node.func)
+            if recv is not None and recv in tracked:
+                findings.append(Finding(
+                    "JGL012", model.path, node.lineno,
+                    f"untimed {recv}.wait() on a threading "
+                    "Event/Condition blocks forever if the notifier "
+                    "dies — use wait(t) in a loop that can check "
+                    "peer/thread liveness; a deliberate forever-block "
+                    "needs a justified suppression",
+                ))
+    return findings
+
+
 ALL_RULES = (rule_jgl001, rule_jgl002, rule_jgl003, rule_jgl004,
-             rule_jgl005, rule_jgl006, rule_jgl007, rule_jgl008)
+             rule_jgl005, rule_jgl006, rule_jgl007, rule_jgl008,
+             rule_jgl012)

@@ -29,6 +29,14 @@ This module owns that capture (ISSUE 7):
   replay's `lower_s`/`compile_s` give the trace-vs-XLA split of an
   equivalent build.
 
+- **Compiled-view store** (`record_compiled_view`/`compiled_view`): the
+  watchdog's first-miss capture stashes the non-JSON artifacts — the
+  post-SPMD HLO text plus the executable's input/output sharding
+  pytrees — under the jit's watch name, so the semantic lint backend
+  (`analysis/ir.py`, ISSUE 18) audits a program that already compiled
+  without paying a SECOND lower+compile. First-miss-only discipline is
+  preserved: the store only ever holds what a capture already built.
+
 `obs/watchdog.WatchedJit` emits one `compile` record per detected
 cache miss into the same RUN.jsonl stream as the metrics, carrying
 these fields; `obs.report` / `obs.timeline` render and budget-check
@@ -39,15 +47,47 @@ tests/test_obs.py).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+import threading
+from typing import Any, Callable, Dict, Optional
 
 __all__ = [
     "abstractify",
     "capture_compile",
+    "clear_compiled_views",
+    "compiled_view",
     "guarded_compiled_text",
     "guarded_cost_analysis",
     "guarded_memory_analysis",
+    "record_compiled_view",
 ]
+
+# watch name -> {"hlo_text", "input_shardings", "output_shardings"}.
+# Written once per jit (first detected miss); readers get the dict
+# as-is. The lock only guards the map, not the (immutable) views.
+_VIEW_LOCK = threading.Lock()
+_COMPILED_VIEWS: Dict[str, dict] = {}
+
+
+def record_compiled_view(name: str, view: dict) -> None:
+    """Stash one jit's compiled artifacts under its watch name. Last
+    write wins — a re-miss (shape change) replaces the stale view."""
+    if not name:
+        return
+    with _VIEW_LOCK:
+        _COMPILED_VIEWS[name] = dict(view)
+
+
+def compiled_view(name: str) -> Optional[dict]:
+    """The stashed compiled view for `name`, or None if no watchdog
+    capture has run for that jit in this process."""
+    with _VIEW_LOCK:
+        return _COMPILED_VIEWS.get(name)
+
+
+def clear_compiled_views() -> None:
+    """Drop every stashed view (tests isolating compile-count checks)."""
+    with _VIEW_LOCK:
+        _COMPILED_VIEWS.clear()
 
 
 def _as_float(v) -> Optional[float]:
@@ -210,4 +250,22 @@ def capture_compile(fn: Callable, abstract_args: tuple,
             rec[k] = ma.get(k)
     if want_text:
         rec["hlo_text"] = guarded_compiled_text(compiled)
+        # The executable's sharding pytrees ride along for the IR
+        # audit's JIR003 fixed-point check (analysis/ir.py). NOT
+        # JSON-serializable — consumers must strip them before any
+        # metric stream (the watchdog pops them into the view store).
+        rec["input_shardings"] = _guarded_attr(compiled,
+                                               "input_shardings")
+        rec["output_shardings"] = _guarded_attr(compiled,
+                                                "output_shardings")
     return rec
+
+
+def _guarded_attr(obj: Any, attr: str) -> Any:
+    """`getattr` hardened against raising properties (the AOT sharding
+    accessors vary across jax versions) — None on any failure, in the
+    null-degrading discipline of the other guarded accessors."""
+    try:
+        return getattr(obj, attr, None)
+    except Exception:
+        return None

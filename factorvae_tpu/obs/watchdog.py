@@ -228,9 +228,20 @@ class WatchedJit:
                 try:
                     cap = compilelib.capture_compile(
                         self._fn, compilelib.abstractify(args),
-                        compilelib.abstractify(kwargs))
+                        compilelib.abstractify(kwargs),
+                        want_text=True)
                 except Exception:  # graftlint: disable=JGL007 capture is best-effort telemetry; failure degrades to an empty compile record that IS logged unconditionally below
                     cap = {}
+            # Strip the non-JSON artifacts (HLO text is megabytes; the
+            # sharding pytrees aren't serializable) OUT of the metric
+            # record and INTO the compiled-view store, keyed by watch
+            # name — the semantic lint backend (analysis/ir.py) audits
+            # this jit off the stash instead of paying a third compile.
+            view = {k: cap.pop(k) for k in
+                    ("hlo_text", "input_shardings", "output_shardings")
+                    if k in cap}
+            if view.get("hlo_text"):
+                compilelib.record_compiled_view(self.name, view)
             last = dict(cap, fn=self.name, wall_s=wall,
                         compiles=compiles)
             with self._lock:

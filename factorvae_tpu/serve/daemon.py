@@ -1014,8 +1014,22 @@ class TickScheduler:
                                        len(self._q))
             if pending:
                 self._cv.notify_all()
-        if pending:
-            done.wait()
+        # Timed wait in a liveness loop (JGL012): close() answers every
+        # leftover, so the only way `done` never fires is the scheduler
+        # thread dying mid-flight — in which case an untimed wait would
+        # park this client forever. Check the thread each second and
+        # answer the stranded slots with an explicit error instead.
+        while pending and not done.wait(1.0):
+            if self._thread.is_alive():
+                continue
+            with self._lock:
+                for i in range(len(results)):
+                    if results[i] is None:
+                        results[i] = {
+                            "id": None, "ok": False,
+                            "error": "scheduler thread died before "
+                                     "answering"}
+            break
         return results
 
     # ---- scheduler thread ------------------------------------------------

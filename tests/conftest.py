@@ -44,6 +44,7 @@ _SLOW_FILES = {
     "test_reference_oracle.py",  # flagship-shape torch+jax compiles
     "test_chaos.py",             # fleet recovery + subprocess harnesses
     "test_wf.py",                # walk-forward subprocess resume rigs
+    "test_ir.py",                # seeded-violation program compiles
 }
 # Heavy classes inside otherwise-quick files (full-model jit compiles).
 _SLOW_CLASSES = {
@@ -88,6 +89,13 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # the dtype-bucket + PBT-kill races guard the training trace gate —
 # a drift there invalidates every other bitwise pin in the suite, so
 # it must be proven on every tier-1 run.
+# The ISSUE-18 semantic-lint gates are quick BY DESIGN: the IR
+# self-audit (every registered compiled program — train/eval/score/
+# serve — audits to zero findings) is the compiled-program twin of
+# the two AST self-lint gates and must hold on every tier-1 run, and
+# the CLI --ir contract pins the gate's invocation surface; the
+# seeded-violation fixture programs stay slow (test_ir.py in
+# _SLOW_FILES).
 # The ISSUE-15 router/pool classes are quick BY DESIGN: tier-1 must
 # exercise the scale-out tier — bounded-load rendezvous routing, the
 # exposition relabel/merge, cross-tick continuous batching, and one
@@ -114,7 +122,8 @@ _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestExtendDays", "TestAdmitGate",
                   "TestWalkForwardCycle", "TestReadmission",
                   "TestRendezvous", "TestExpositionMerge",
-                  "TestTickScheduler", "TestWorkerFleetE2E"}
+                  "TestTickScheduler", "TestWorkerFleetE2E",
+                  "TestIRSelfAudit", "TestIRCLIContract"}
 
 
 def pytest_collection_modifyitems(config, items):

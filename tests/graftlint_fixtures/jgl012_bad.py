@@ -1,0 +1,44 @@
+"""JGL012 seeded violations: blocking calls without timeouts.
+
+Analyzed (tests/test_analysis.py) under a synthetic
+`factorvae_tpu/...` path — the rule keys on the module's location.
+Expected: 4 findings (untimed urlopen, untimed HTTPConnection, untimed
+create_connection, zero-arg Event.wait); the timed twins in the
+companion fixture stay silent.
+"""
+
+import http.client
+import socket
+import threading
+import urllib.request
+
+
+def fetch_status(url):
+    # BAD: urlopen with no timeout — hangs forever on a dead peer
+    with urllib.request.urlopen(url) as resp:
+        return resp.read()
+
+
+def forward(host, port, body):
+    # BAD: connection with no timeout — a worker dying mid-recv parks
+    # the router thread forever
+    conn = http.client.HTTPConnection(host, port)
+    conn.request("POST", "/score", body=body)
+    return conn.getresponse().read()
+
+
+def probe(host, port):
+    # BAD: untimed connect
+    sock = socket.create_connection((host, port))
+    sock.close()
+
+
+class Submitter:
+    def __init__(self):
+        self._done = threading.Event()
+
+    def submit(self, q, item):
+        done = threading.Event()
+        q.append((item, done))
+        # BAD: blocks forever if the consumer thread died
+        done.wait()

@@ -1,0 +1,38 @@
+"""JGL012 corrected twin: every blocking call carries a timeout (or a
+liveness-checking wait loop). Expected: 0 findings."""
+
+import http.client
+import socket
+import threading
+import urllib.request
+
+
+def fetch_status(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def forward(host, port, body, timeout=10.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("POST", "/score", body=body)
+    return conn.getresponse().read()
+
+
+def probe(host, port):
+    # positional timeout slot filled
+    sock = socket.create_connection((host, port), 2.0)
+    sock.close()
+
+
+class Submitter:
+    def __init__(self):
+        self._done = threading.Event()
+
+    def submit(self, q, item, consumer):
+        done = threading.Event()
+        q.append((item, done))
+        # timed wait in a liveness loop: a dead consumer is noticed
+        while not done.wait(1.0):
+            if not consumer.is_alive():
+                return None
+        return item

@@ -525,6 +525,57 @@ class TestJGL008:
             assert "time.perf_counter() - t0" in src, mod
 
 
+class TestJGL012:
+    """Blocking network/synchronization call without a timeout
+    (ISSUE 18 satellite; path-keyed like JGL006-8): untimed `urlopen`/
+    `HTTPConnection`/`create_connection`/`requests.*` and zero-arg
+    `.wait()` on threading Event/Condition objects — the serving
+    plane's hang-forever class."""
+
+    def _analyze(self, fixture, path):
+        with open(_fixture(fixture)) as fh:
+            return analyze_source(fh.read(), path)
+
+    def test_fires_on_seeded_violations(self):
+        findings = _active(self._analyze(
+            "jgl012_bad.py", "factorvae_tpu/serve/newmod.py"))
+        hits = [f for f in findings if f.rule == "JGL012"]
+        assert len(hits) == 4, [(f.line, f.message) for f in findings]
+        assert _rules(findings) == ["JGL012"]  # no cross-rule noise
+
+    def test_silent_on_corrected_twin(self):
+        assert _active(self._analyze(
+            "jgl012_good.py", "factorvae_tpu/serve/newmod.py")) == []
+
+    def test_timed_wait_and_kwargs_splat_are_exempt(self):
+        # wait(t) is the liveness-loop form; **kw may carry timeout
+        src = ("import threading\n"
+               "import urllib.request\n"
+               "def f(url, kw):\n"
+               "    ev = threading.Event()\n"
+               "    ev.wait(0.5)\n"
+               "    return urllib.request.urlopen(url, **kw)\n")
+        assert _active(analyze_source(
+            src, "factorvae_tpu/serve/newmod.py")) == []
+
+    def test_outside_library_paths_is_exempt(self):
+        # scripts/, tests/, bench.py own their blocking calls
+        assert _active(self._analyze(
+            "jgl012_bad.py", "scripts/some_driver.py")) == []
+        assert _active(analyze_paths([_fixture("jgl012_bad.py")])) == []
+
+    def test_serve_plane_submit_wait_is_timed(self):
+        """The audit half of the satellite: TickScheduler.submit's
+        client wait (the one untimed Event.wait the PR-17 serving
+        plane shipped) now runs a timed liveness loop — pinned so a
+        revert re-flags."""
+        with open(os.path.join(REPO, "factorvae_tpu", "serve",
+                               "daemon.py")) as fh:
+            src = fh.read()
+        assert "done.wait()" not in src
+        assert "done.wait(1.0)" in src
+
+
 # ---------------------------------------------------------------------------
 # whole-program concurrency rules (JGL009-011) — ISSUE 11
 

@@ -1,0 +1,375 @@
+"""Semantic graftlint (analysis/ir.py, ISSUE 18): the jaxpr/HLO-level
+audit backend.
+
+Mirrors the graftlint fixture convention one level up: each JIR rule
+gets a seeded-violation *program* (a tiny jitted fn whose compiled
+form exhibits the failure) and a corrected twin, audited through
+`analyze_programs(registry=...)` exactly like the real registry.
+Tier-1 carries two gates (conftest _QUICK_CLASSES): the full-registry
+self-audit (`TestIRSelfAudit` — the compiled-program twin of the two
+AST self-lint gates) and the CLI `--ir` JSON contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from factorvae_tpu.analysis import ir
+from factorvae_tpu.analysis.ir import Program, ProgramSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _audit(name, build, line=1):
+    """Audit one fixture program through the real entry point (no
+    suppression pass — fixture findings must surface raw)."""
+    return ir.analyze_programs(
+        registry=[ProgramSpec(name, build, line)], suppress=False)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# JIR001 — dtype discipline
+
+
+class TestJIR001:
+    def test_flags_bf16_leg_with_no_bf16_dots(self):
+        fn = jax.jit(lambda a, b: a @ b)
+        prog = Program(fn=fn, args=(_sds((8, 8)), _sds((8, 8))),
+                       compute_dtype="bfloat16")
+        findings = _audit("all_f32", lambda: prog)
+        assert _rules(findings) == ["JIR001"], findings
+        assert "no bf16 dot" in findings[0].message
+
+    def test_silent_on_bf16_compute_twin(self):
+        fn = jax.jit(lambda a, b: (a.astype(jnp.bfloat16)
+                                   @ b.astype(jnp.bfloat16)))
+        prog = Program(fn=fn, args=(_sds((8, 8)), _sds((8, 8))),
+                       compute_dtype="bfloat16")
+        assert _audit("bf16", lambda: prog) == []
+
+    def test_f32_dominance_budget(self):
+        # one big bf16 dot + one tiny f32 dot: a sanctioned minority
+        # passes, a zero budget flags the same trace
+        def mixed(a, b, c):
+            big = a.astype(jnp.bfloat16) @ b.astype(jnp.bfloat16)
+            return big.astype(jnp.float32).sum() + (c @ c).sum()
+
+        fn = jax.jit(mixed)
+        args = (_sds((64, 64)), _sds((64, 64)), _sds((2, 2)))
+        strict = Program(fn=fn, args=args, compute_dtype="bfloat16")
+        flagged = _audit("strict", lambda: strict)
+        assert _rules(flagged) == ["JIR001"]
+        assert "f32" in flagged[0].message
+        lenient = Program(fn=fn, args=args, compute_dtype="bfloat16",
+                          sanctioned_f32_dot_frac=0.5)
+        assert _audit("lenient", lambda: lenient) == []
+
+    def test_f32_program_has_no_dot_discipline(self):
+        fn = jax.jit(lambda a, b: a @ b)
+        prog = Program(fn=fn, args=(_sds((8, 8)), _sds((8, 8))))
+        assert _audit("plain_f32", lambda: prog) == []
+
+
+# ---------------------------------------------------------------------------
+# JIR002 — donation effectiveness
+
+
+class TestJIR002:
+    def test_flags_seeded_dropped_donation(self):
+        # sum() output (scalar) can alias nothing — XLA silently drops
+        # the donation; the claim must be flagged
+        fn = jax.jit(lambda x: x.sum(), donate_argnums=(0,))
+        prog = Program(fn=fn, args=(_sds((8,)),), donate_argnums=(0,))
+        findings = _audit("dropped", lambda: prog)
+        assert _rules(findings) == ["JIR002"], findings
+        assert "ZERO input-output aliases" in findings[0].message
+
+    def test_verifies_real_alias_on_corrected_twin(self):
+        fn = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+        prog = Program(fn=fn, args=(_sds((8,)),), donate_argnums=(0,))
+        assert _audit("aliased", lambda: prog) == []
+
+    def test_donation_audit_block_shape(self):
+        # the bench.py --mixed per-leg block: JSON-ready, per-argnum
+        fn = jax.jit(lambda x, y: x + y, donate_argnums=(0,))
+        rep = ir.donation_audit(fn, (_sds((8,)), _sds((8,))), (0,))
+        assert rep["ok"] is True
+        assert rep["declared"] == [0]
+        assert rep["per_arg"][0]["verified"] is True
+        assert rep["per_arg"][0]["leaves"] == 1
+        json.dumps(rep)  # schema contract: ledger-row serializable
+
+    def test_pytree_donation_attributes_leaf_range(self):
+        # dict-state donation: every leaf of argnum 0 aliases; the
+        # non-donated argnum 1 contributes none
+        fn = jax.jit(
+            lambda s, o: ({k: v + 1.0 for k, v in s.items()}, o.sum()),
+            donate_argnums=(0,))
+        state = {"w": _sds((4, 4)), "b": _sds((4,))}
+        rep = ir.donation_audit(fn, (state, _sds((8,))), (0,))
+        assert rep["per_arg"][0]["leaves"] == 2
+        assert rep["per_arg"][0]["aliased"] == 2
+
+
+# ---------------------------------------------------------------------------
+# JIR003 — partition coverage + carried-state fixed point
+
+
+class TestJIR003:
+    TABLE = (("^w$", None), ("^unused$", None))
+
+    def _prog(self, tree, table):
+        fn = jax.jit(lambda x: x + 1.0)
+        return Program(fn=fn, args=(_sds((2,)),),
+                       coverage=(("T", tuple(table), tree),))
+
+    def test_flags_seeded_dead_rule(self):
+        prog = self._prog({"w": _sds((4, 4))}, self.TABLE)
+        findings = _audit("dead", lambda: prog)
+        assert _rules(findings) == ["JIR003"], findings
+        assert "dead partition rule" in findings[0].message
+        assert "'^unused$'" in findings[0].message
+
+    def test_flags_uncovered_leaf(self):
+        prog = self._prog({"w": _sds((4, 4)), "b": _sds((4,))},
+                          [("^w$", None)])
+        findings = _audit("uncovered", lambda: prog)
+        assert any("matches NO" in f.message for f in findings)
+
+    def test_flags_ambiguous_leaf(self):
+        prog = self._prog({"w": _sds((4, 4))},
+                          [("^w$", None), ("^w.*$", None)])
+        findings = _audit("ambig", lambda: prog)
+        assert any("first-match-wins" in f.message for f in findings)
+
+    def test_silent_on_exact_coverage(self):
+        prog = self._prog({"w": _sds((4, 4)), "b": _sds((4,))},
+                          [("^w$", None), ("^b$", None)])
+        assert _audit("covered", lambda: prog) == []
+
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="fixed point needs a real mesh")
+    def test_flags_non_fixed_point_out_sharding(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("d",))
+        shard = NamedSharding(mesh, P("d"))
+        rep = NamedSharding(mesh, P())
+        bad = jax.jit(lambda s: s + 1.0, in_shardings=(shard,),
+                      out_shardings=rep)
+        prog = Program(fn=bad, args=(_sds((8,)),),
+                       carried_arg=0, carried_out=0)
+        findings = _audit("drift", lambda: prog)
+        assert _rules(findings) == ["JIR003"], findings
+        assert "NOT a fixed point" in findings[0].message
+
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="fixed point needs a real mesh")
+    def test_silent_on_pinned_out_sharding_twin(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("d",))
+        shard = NamedSharding(mesh, P("d"))
+        good = jax.jit(lambda s: s + 1.0, in_shardings=(shard,),
+                       out_shardings=shard)
+        prog = Program(fn=good, args=(_sds((8,)),),
+                       carried_arg=0, carried_out=0)
+        assert _audit("pinned", lambda: prog) == []
+
+
+# ---------------------------------------------------------------------------
+# JIR004 — serving retrace/bloat hazards
+
+
+class TestJIR004:
+    def test_flags_baked_constant_and_weak_type(self):
+        baked = jnp.zeros((1 << 19,), jnp.float32)  # 2 MiB closed over
+
+        def score(x, scale):
+            return x * scale + baked.sum()
+
+        prog = Program(fn=jax.jit(score),
+                       args=(_sds((4,)), 0.5),  # python float: weak
+                       serving=True)
+        findings = _audit("bloated", lambda: prog)
+        assert _rules(findings) == ["JIR004"], findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "bakes" in msgs and "weak-typed" in msgs
+
+    def test_silent_on_explicit_args_twin(self):
+        def score(x, scale):
+            return x * scale
+
+        prog = Program(fn=jax.jit(score),
+                       args=(_sds((4,)), _sds((), jnp.float32)),
+                       serving=True)
+        assert _audit("lean", lambda: prog) == []
+
+    def test_non_serving_program_is_exempt(self):
+        baked = jnp.zeros((1 << 19,), jnp.float32)
+        prog = Program(fn=jax.jit(lambda x: x + baked.sum()),
+                       args=(_sds((4,)),))
+        assert _audit("training", lambda: prog) == []
+
+
+# ---------------------------------------------------------------------------
+# registry/engine semantics
+
+
+class TestRegistrySemantics:
+    def test_unbuildable_program_is_a_loud_finding(self):
+        def boom():
+            raise RuntimeError("nope")
+
+        findings = _audit("broken", boom)
+        assert _rules(findings) == ["JGL000"]
+        assert "checks nothing" in findings[0].message
+
+    def test_untraceable_program_is_a_loud_finding(self):
+        fn = jax.jit(lambda x: x @ x)
+        prog = Program(fn=fn, args=(_sds((3, 5)),))  # shape error
+        findings = _audit("untraceable", lambda: prog)
+        assert _rules(findings) == ["JGL000"]
+
+    def test_unknown_name_is_a_loud_finding(self):
+        findings = ir.analyze_programs(names=["no_such_program"])
+        assert any(f.rule == "JGL000"
+                   and "no_such_program" in f.message
+                   for f in findings)
+
+    def test_registry_covers_the_declared_surface(self):
+        names = {s.name for s in ir.REGISTRY}
+        assert {"train_epoch", "train_epoch_bf16", "eval_epoch",
+                "fleet_train_epoch", "hyper_train_epoch",
+                "fleet_eval_epoch", "score_chunk", "score_chunk_fleet",
+                "score_scan", "score_scan_fleet", "serve_float32",
+                "serve_bfloat16", "serve_int8"} <= names
+
+
+class TestCompiledViewReuse:
+    def test_watchdog_capture_feeds_audit_without_second_compile(
+            self, tmp_path, monkeypatch):
+        """Satellite 2 pin: a program the watchdog already captured is
+        audited off the stashed view — capture_compile must NOT run
+        again (first-miss-only discipline)."""
+        from factorvae_tpu.obs import compile as compilelib
+        from factorvae_tpu.obs.watchdog import watch_jit
+        from factorvae_tpu.utils.logging import (
+            MetricsLogger, Timeline, install_timeline,
+        )
+
+        lg = MetricsLogger(jsonl_path=str(tmp_path / "c.jsonl"),
+                           echo=False)
+        prev = install_timeline(Timeline(lg))
+        try:
+            f = watch_jit(jax.jit(lambda x: x + 1.0,
+                                  donate_argnums=(0,)),
+                          "ir_stash_pin")
+            f(jnp.ones((8,)))
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        view = compilelib.compiled_view("ir_stash_pin")
+        assert view is not None and view.get("hlo_text")
+        # the stash carries the sharding pytrees alongside the HLO
+        assert "input_shardings" in view and "output_shardings" in view
+
+        def boom(*a, **kw):
+            raise AssertionError("second lower+compile attempted")
+
+        monkeypatch.setattr(compilelib, "capture_compile", boom)
+        prog = Program(fn=f, args=(_sds((8,)),), donate_argnums=(0,))
+        assert _audit("stashed", lambda: prog) == []
+
+    def test_compile_record_stream_stays_json(self, tmp_path):
+        """The popped view keys must never reach the metric stream —
+        every compile record still json-round-trips and carries no
+        HLO/sharding payload."""
+        from factorvae_tpu.obs.watchdog import watch_jit
+        from factorvae_tpu.utils.logging import (
+            MetricsLogger, Timeline, install_timeline,
+        )
+
+        p = tmp_path / "c.jsonl"
+        lg = MetricsLogger(jsonl_path=str(p), echo=False)
+        prev = install_timeline(Timeline(lg))
+        try:
+            f = watch_jit(jax.jit(lambda x: x * 2.0), "ir_json_pin")
+            f(jnp.ones((4,)))
+        finally:
+            install_timeline(prev)
+            lg.finish()
+        recs = [json.loads(line)
+                for line in open(p).read().strip().splitlines()]
+        comp = [r for r in recs if r.get("event") == "compile"]
+        assert comp, recs
+        for r in comp:
+            assert "hlo_text" not in r
+            assert "input_shardings" not in r
+            assert "output_shardings" not in r
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gates (conftest _QUICK_CLASSES)
+
+
+class TestIRCLIContract:
+    def test_ir_json_payload(self):
+        """`--ir --programs <cheap subset> --format json`: exit 0, the
+        engine's JSON payload schema, zero active findings."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "factorvae_tpu.analysis", "--ir",
+             "--programs", "eval_epoch,score_chunk",
+             "--format", "json"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert set(payload) == {"findings", "suppressed", "counts"}
+        assert payload["counts"]["active"] == 0
+
+    def test_unknown_program_fails_loudly(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "factorvae_tpu.analysis", "--ir",
+             "--programs", "no_such_program", "--format", "json"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=300)
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload["findings"][0]["rule"] == "JGL000"
+
+    def test_bare_invocation_still_errors(self):
+        # --ir must not weaken the paths-required contract
+        proc = subprocess.run(
+            [sys.executable, "-m", "factorvae_tpu.analysis"],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 2
+
+
+class TestIRSelfAudit:
+    def test_registry_is_ir_clean(self):
+        """The tier-1 compiled-program gate, alongside the two AST
+        self-lint gates: the FULL registry — every train/eval/score/
+        serve program the repo ships — audits to zero active findings,
+        and anything suppressed carries a justification."""
+        findings = ir.analyze_programs()
+        active = [f for f in findings if not f.suppressed]
+        assert active == [], [
+            (f.rule, f.line, f.message) for f in active]
+        for f in findings:
+            if f.suppressed:
+                assert f.justification, (f.rule, f.line)
