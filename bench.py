@@ -103,6 +103,23 @@ there is no native bf16 unit — the A/B is a correctness/ceiling
 probe, flagged `no_native_bf16`, never a speedup claim.
 BENCH_MIXED.json carries the detail. Same robustness contract.
 
+Kernels mode (`python bench.py --kernels`, or BENCH_KERNELS=1): the
+kernel race bench (ISSUE 19, closing ROADMAP item 3) — race
+{pallas, xla} x {gru, attention} forward+backward at the bench shape's
+planner-resolved operating point (scripts/race_kernels.py engine), plus
+the segment-checkpointed-BPTT crossover leg at T > _SEG_MAX, and report
+per-op walls, the VMEM row-block choices (`ops/pallas/gru.py`
+`_block_setup`/`_segment_setup`/`backward_fits`), the shared remat
+audit with the persisted-knob verdict, and the plan block the planner
+resolved. The artifact is RACE_KERNELS.json v2 (per-rig runs map; the
+canonical chip-measured v1 `records` the select predicates are pinned
+to are PRESERVED — only a TPU run refreshes them). Off-TPU the pallas
+legs run in interpret mode: enormous honest walls, flagged `no_tpu` —
+a correctness/ceiling probe, never a kernel verdict for a chip. A
+crashed race leg becomes the `kernels_race_failed` payload the ledger
+refuses — never a silent static-envelope fallback. Same robustness
+contract.
+
 Stream mode (`python bench.py --stream`, or BENCH_STREAM=1 with
 BENCH_STREAM_CHUNK=n): A/B the panel residency — HBM-resident
 whole-epoch scan vs the out-of-core stream path (data/stream.py,
@@ -230,6 +247,18 @@ USE_OBS = os.environ.get("BENCH_OBS", "0") == "1"
 # in f32 arithmetic, so the A/B is a correctness/ceiling probe there
 # (`no_native_bf16: true`), never a speedup claim.
 USE_MIXED = os.environ.get("BENCH_MIXED", "0") == "1"
+# Kernels mode (`python bench.py --kernels` or BENCH_KERNELS=1): the
+# kernel race bench (ISSUE 19). Races {pallas, xla} x {gru, attention}
+# fwd+bwd at the planner-resolved operating point via the
+# scripts/race_kernels.py engine (the same oracles the committed
+# RACE_KERNELS.json v1 chip race used), adds the segmented-BPTT
+# crossover leg at T > ops/pallas/gru._SEG_MAX, and emits the
+# RACE_KERNELS v2 artifact + (under --track) a ledger row. The headline
+# `value` is windows/sec through the winning GRU fwd+bwd — the op that
+# dominates the training wall. BENCH_KERNEL_REPS bounds the per-
+# candidate timing reps (interpret-mode walls off-TPU are seconds each).
+USE_KERNELS = os.environ.get("BENCH_KERNELS", "0") == "1"
+KERNEL_REPS = int(os.environ.get("BENCH_KERNEL_REPS", 5))
 # Mesh mode (`python bench.py --mesh` or BENCH_MESH=1): the composed
 # scaling grid (PR 6, partition-rule sharding). For each mesh shape
 # (data x stock factorization of the visible devices) x S in
@@ -385,6 +414,13 @@ def resolve_plan(platform: str):
         provenance=pl.provenance, source=pl.source,
         use_pallas_attention=knobs["pallas_attention"],
         use_pallas_gru=knobs["pallas_gru"],
+        # measured-verdict provenance (ISSUE 19) rides through the
+        # reconstruction so the payload's plan block shows whether the
+        # kernel/remat choices were raced on a rig or fell back to the
+        # static envelope
+        kernel_gru=pl.kernel_gru,
+        kernel_attention=pl.kernel_attention,
+        train_remat=pl.train_remat,
         seeds_per_program=pl.seeds_per_program,
     )
     return knobs, pl.describe(shape, platform=platform, forced=_FORCED_ENV)
@@ -454,6 +490,11 @@ def fail_metric() -> str:
         return "obs_train_throughput_failed"
     if USE_MIXED or os.environ.get("BENCH_MIXED", "0") == "1":
         return "mixed_train_throughput_failed"
+    if USE_KERNELS or os.environ.get("BENCH_KERNELS", "0") == "1":
+        # ISSUE 19: a crashed race leg must surface as the failed row
+        # the ledger refuses — never fall back silently to the static
+        # envelope as if it had been measured.
+        return "kernels_race_failed"
     if USE_MESH or os.environ.get("BENCH_MESH", "0") == "1":
         return "mesh_train_throughput_failed"
     if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
@@ -478,6 +519,8 @@ def fail_unit() -> str:
         return "recoveries/sec"
     if USE_WALKFORWARD or os.environ.get("BENCH_WALKFORWARD", "0") == "1":
         return "rollovers/sec"
+    if USE_KERNELS or os.environ.get("BENCH_KERNELS", "0") == "1":
+        return "windows/sec"
     return "windows/sec*seed" if fleet else "windows/sec/chip"
 
 
@@ -1168,6 +1211,65 @@ def run_obs_bench() -> dict:
     }
 
 
+def remat_audit_block(make_cfg, plan_remat: str = "") -> dict:
+    """Shared remat audit (BENCH_MIXED + BENCH_KERNELS, ISSUE 19):
+    compiled-program `peak_bytes` of the epoch jits at TrainConfig.remat
+    none vs dots — observation-only (lower+compile on abstract shapes;
+    nothing timed here runs remat), guarded end to end by
+    obs/compile.py (a backend without memory_analysis yields nulls,
+    never a dead payload). `make_cfg(remat)` -> (cfg, ds) builds one
+    leg's config.
+
+    The block also carries the PERSISTED-KNOB verdict: what the plan
+    actually ships for this shape (`Plan.train_remat`, raced by
+    `autotune_plan --remat`) next to what the audit observes — so a
+    measured peak cut the planner declined (no per-trained-day
+    wall-clock win) reads as a decision, not an omission."""
+    import jax
+
+    from factorvae_tpu.obs import compile as compilelib
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    audit: dict = {}
+    for remat in ("none", "dots"):
+        cfg, ds = make_cfg(remat)
+        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = trainer.init_state()
+        order = trainer._epoch_orders(0)
+        caps = {"train_epoch": compilelib.capture_compile(
+            trainer._train_epoch_jit,
+            compilelib.abstractify((state, order, trainer.panel_args())))}
+        caps["eval_epoch"] = compilelib.capture_compile(
+            trainer._eval_epoch_jit,
+            compilelib.abstractify((state.params, order,
+                                    jax.random.PRNGKey(0),
+                                    trainer.panel_args())))
+        for jit_name, cap in caps.items():
+            audit.setdefault(jit_name, {})[remat] = {
+                k: cap.get(k) for k in ("peak_bytes", "temp_bytes",
+                                        "flops", "compile_s")}
+    for jit_name, by_remat in audit.items():
+        before = (by_remat.get("none") or {}).get("peak_bytes")
+        after = (by_remat.get("dots") or {}).get("peak_bytes")
+        by_remat["peak_reduction_frac"] = (
+            round(1.0 - after / before, 4)
+            if before and after is not None else None)
+    shipped = plan_remat or "none"
+    audit["plan_verdict"] = {
+        "persisted_remat": shipped,
+        "persisted": shipped != "none",
+        "detail": (
+            f"plan ships remat={shipped} for this shape (measured "
+            "per-trained-day win, autotune_plan --remat)"
+            if shipped != "none" else
+            "plan ships no remat rung: autotune_plan --remat persists "
+            "one only past a measured per-trained-day win — a "
+            "peak_bytes cut alone is observation, not a verdict"),
+    }
+    return audit
+
+
 def run_mixed_bench() -> dict:
     """Training-precision A/B (BENCH_MIXED, ISSUE 16): the same
     flagship-shape workload trained at matched planner knobs on the
@@ -1253,35 +1355,12 @@ def run_mixed_bench() -> dict:
             (0,))
         legs[dtype] = leg
 
-    # Remat audit (observation-only): peak_bytes of the compiled epoch
-    # programs at remat=none vs remat=dots, per jit, on the mixed
-    # config — nothing here is timed, so the A/B rates above stay
-    # clean. capture_compile is guarded: a backend without
-    # memory_analysis yields nulls, never a dead payload.
-    remat_audit = {}
-    for remat in ("none", "dots"):
-        cfg, ds = leg_cfg("bfloat16", remat=remat)
-        trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
-        state = trainer.init_state()
-        order = trainer._epoch_orders(0)
-        caps = {"train_epoch": compilelib.capture_compile(
-            trainer._train_epoch_jit,
-            compilelib.abstractify((state, order, trainer.panel_args())))}
-        caps["eval_epoch"] = compilelib.capture_compile(
-            trainer._eval_epoch_jit,
-            compilelib.abstractify((state.params, order,
-                                    jax.random.PRNGKey(0),
-                                    trainer.panel_args())))
-        for jit_name, cap in caps.items():
-            remat_audit.setdefault(jit_name, {})[remat] = {
-                k: cap.get(k) for k in ("peak_bytes", "temp_bytes",
-                                        "flops", "compile_s")}
-    for jit_name, by_remat in remat_audit.items():
-        before = (by_remat.get("none") or {}).get("peak_bytes")
-        after = (by_remat.get("dots") or {}).get("peak_bytes")
-        by_remat["peak_reduction_frac"] = (
-            round(1.0 - after / before, 4)
-            if before and after is not None else None)
+    # Remat audit on the mixed config — the shared helper (ISSUE 19);
+    # nothing in it is timed, so the A/B rates above stay clean. The
+    # rows now carry the persisted-knob verdict next to the measurement.
+    remat_audit = remat_audit_block(
+        lambda remat: leg_cfg("bfloat16", remat=remat),
+        plan_block.get("train_remat") or "")
 
     f32 = legs["float32"]["windows_per_sec"]
     bf16 = legs["bfloat16"]["windows_per_sec"]
@@ -1321,6 +1400,164 @@ def run_mixed_bench() -> dict:
                            "BENCH_MIXED.json")
         with open(out, "w") as f:
             json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return payload
+
+
+def run_kernels_bench() -> dict:
+    """Kernel race bench (BENCH_KERNELS, ISSUE 19 — ROADMAP item 3):
+    race {pallas, xla} x {gru, attention} fwd+bwd at the bench shape's
+    planner-resolved operating point (the scripts/race_kernels.py
+    engine — the same oracles the committed chip race used), plus the
+    segment-checkpointed-BPTT crossover leg at T > _SEG_MAX, the VMEM
+    row-block choices the kernels would make, the shared remat audit
+    with the persisted-knob verdict, and the `hbm_over_budget` headroom
+    vs the governing plan row's budget. One JSON line; `value` is
+    windows/sec through the winning GRU fwd+bwd (the op dominating the
+    training wall). The artifact is RACE_KERNELS.json v2: a per-rig
+    `runs` map AROUND the canonical chip-measured v1 `records` the
+    select predicates are pinned to (tests/test_ops.py) — only a TPU
+    run refreshes those; an off-TPU run lands under `runs.cpu` with
+    `no_tpu: true` (interpret-mode walls are honest but are a
+    correctness probe, never a chip verdict). A crashed race leg
+    propagates — the robustness wrapper turns it into the
+    `kernels_race_failed` payload the ledger refuses."""
+    import dataclasses
+
+    from factorvae_tpu.ops.pallas import gru as grulib
+    from factorvae_tpu.utils.testing import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from race_kernels import race_attention, race_gru
+
+    platform, _ = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    no_tpu = platform == "cpu"
+    pad = knobs["pad_target"]
+    # the row count the winning layout actually feeds the GRU: cross-day
+    # flattening folds days_per_step day-independent segments into one
+    # batch (the r3 operating point)
+    gru_rows = (pad * knobs["days_per_step"] if knobs["flatten_days"]
+                else pad)
+
+    ops = {
+        "gru": race_gru(gru_rows, SEQ_LEN, HIDDEN, KERNEL_REPS),
+        "attention": race_attention(pad, HIDDEN, FACTORS, KERNEL_REPS),
+        # crossover leg: T past _SEG_MAX flips the custom-VJP backward
+        # to segment-checkpointed BPTT (VMEM scales with the segment
+        # length, not T) — raced so the regime switch is a measured
+        # wall, not an assumption
+        "gru_long_t": race_gru(gru_rows, 2 * grulib._SEG_MAX, HIDDEN,
+                               KERNEL_REPS),
+    }
+    winners = {
+        name: ("pallas" if rec["pallas_fwdbwd_us"] < rec["xla_fwdbwd_us"]
+               else "xla")
+        for name, rec in ops.items()
+    }
+
+    def vmem_blocks(n, t, h):
+        """The row-block choices the kernels would make at (n, t, h) —
+        the _block_setup/_segment_setup decisions behind the walls."""
+        nb_f, npad_f, grid_f = grulib._fwd_block_setup(n, t, h)
+        info = {"fwd": {"nb": nb_f, "n_pad": npad_f,
+                        "grid": list(grid_f)},
+                "backward_fits": grulib.backward_fits(n, t, h)}
+        if grulib._segment_len(t) < t:
+            s_len, n_segs, nb, npad, grid = grulib._segment_setup(n, t, h)
+            info["bwd"] = {"path": "segmented", "s_len": s_len,
+                           "n_segs": n_segs, "nb": nb, "n_pad": npad,
+                           "grid": list(grid)}
+        else:
+            nb, npad, grid = grulib._block_setup(n, t, h)
+            info["bwd"] = {"path": "full_sequence", "nb": nb,
+                           "n_pad": npad, "grid": list(grid)}
+        return info
+
+    blocks = {
+        "gru": vmem_blocks(gru_rows, SEQ_LEN, HIDDEN),
+        "gru_long_t": vmem_blocks(gru_rows, 2 * grulib._SEG_MAX, HIDDEN),
+        "seg_max": grulib._SEG_MAX,
+        "vmem_budget_bytes": grulib._VMEM_BUDGET,
+    }
+
+    def make_cfg(remat):
+        cfg, ds = bench_setup(knobs)
+        return dataclasses.replace(cfg, train=dataclasses.replace(
+            cfg.train, remat=remat)), ds
+
+    remat_audit = remat_audit_block(make_cfg,
+                                    plan_block.get("train_remat") or "")
+    # hbm_over_budget headroom (obs/report.py's flag, inverted into a
+    # tracked number): how far the compiled train epoch sits under the
+    # governing plan row's peak-HBM budget. Null when no row budgets
+    # this shape (budgets are opt-in) or the backend reports no
+    # memory_analysis.
+    budget = int(plan_block.get("budget_peak_hbm_bytes") or 0)
+    peak = (remat_audit.get("train_epoch", {}).get("none")
+            or {}).get("peak_bytes")
+    hbm = {
+        "budget_peak_hbm_bytes": budget or None,
+        "train_epoch_peak_bytes": peak,
+        "hbm_headroom_bytes": (round(budget - peak)
+                               if budget and peak is not None else None),
+    }
+
+    g = ops["gru"]
+    best_us = min(g["pallas_fwdbwd_us"], g["xla_fwdbwd_us"])
+    value = gru_rows / (best_us * 1e-6)
+    payload = {
+        "metric": (
+            f"kernel_race_gru_fwdbwd_N{gru_rows}_T{SEQ_LEN}_H{HIDDEN}"
+            + ("_cpu_fallback" if FORCED_CPU else "")),
+        "value": round(value, 1),
+        "unit": "windows/sec",
+        "vs_baseline": round(value / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        # honesty flag: off-TPU the pallas legs run in interpret mode —
+        # their walls are real but say nothing about a chip, so the
+        # race correctly pins xla for THIS rig and nothing more
+        "no_tpu": no_tpu,
+        "winners": winners,
+        "ops": ops,
+        "vmem_blocks": blocks,
+        "remat_audit": remat_audit,
+        "hbm": hbm,
+        "reps": KERNEL_REPS,
+        "plan": plan_block,
+    }
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "RACE_KERNELS.json")
+    try:
+        with open(path) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        table = {}
+    table["version"] = 2
+    table.setdefault("backend", platform)
+    table.setdefault("records", [])
+    try:
+        from factorvae_tpu.utils.logging import run_meta
+
+        meta = run_meta()
+    except Exception:
+        meta = None
+    table.setdefault("runs", {})[platform] = dict(
+        payload, run_meta=meta,
+        captured_at=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    if not no_tpu:
+        # a chip run refreshes the canonical records the select
+        # predicates are calibrated against (tests/test_ops.py)
+        table["backend"] = "tpu"
+        table["records"] = list(ops.values())
+    try:
+        with open(path, "w") as f:
+            json.dump(table, f, indent=1)
             f.write("\n")
     except OSError:  # pragma: no cover - read-only checkout
         pass
@@ -2984,6 +3221,8 @@ def bench_payload() -> dict:
         payload = run_obs_bench()
     elif USE_MIXED:
         payload = run_mixed_bench()
+    elif USE_KERNELS:
+        payload = run_kernels_bench()
     elif USE_MESH:
         payload = run_mesh_bench()
     elif USE_SERVE:
@@ -3155,7 +3394,7 @@ def run_accel_child() -> tuple[bool, str]:
 def main() -> None:
     global USE_FLEET, USE_STREAM, USE_OBS, USE_MIXED, USE_MESH, \
         USE_SERVE, USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD, \
-        SERVE_WORKERS, USE_SERVE_REMOTE
+        SERVE_WORKERS, USE_SERVE_REMOTE, USE_KERNELS
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -3176,6 +3415,9 @@ def main() -> None:
     if "--mixed" in sys.argv:
         USE_MIXED = True
         os.environ["BENCH_MIXED"] = "1"
+    if "--kernels" in sys.argv:
+        USE_KERNELS = True
+        os.environ["BENCH_KERNELS"] = "1"
     if "--mesh" in sys.argv:
         USE_MESH = True
         os.environ["BENCH_MESH"] = "1"

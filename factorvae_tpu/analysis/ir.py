@@ -647,7 +647,7 @@ def analyze_programs(names: Optional[Sequence[str]] = None,
 
 
 def _tiny_config(train_dtype: Optional[str] = None,
-                 model_dtype: str = "float32"):
+                 model_dtype: str = "float32", pallas: bool = False):
     from factorvae_tpu.config import (
         Config, DataConfig, ModelConfig, TrainConfig,
     )
@@ -659,7 +659,9 @@ def _tiny_config(train_dtype: Optional[str] = None,
     cfg = Config(
         model=ModelConfig(num_features=6, hidden_size=8, num_factors=3,
                           num_portfolios=4, seq_len=4,
-                          compute_dtype=model_dtype),
+                          compute_dtype=model_dtype,
+                          use_pallas_gru=pallas,
+                          use_pallas_attention=pallas),
         data=DataConfig(seq_len=4, start_time=None,
                         fit_end_time=str(ds.dates[10].date()),
                         val_start_time=str(ds.dates[11].date()),
@@ -678,14 +680,15 @@ def _abstract(tree):
     return compilelib.abstractify(tree)
 
 
-def _train_epoch_program(train_dtype: Optional[str]) -> Program:
+def _train_epoch_program(train_dtype: Optional[str],
+                         pallas: bool = False) -> Program:
     import jax
 
     from factorvae_tpu.parallel import partition
     from factorvae_tpu.train import Trainer
     from factorvae_tpu.utils.logging import MetricsLogger
 
-    cfg, ds = _tiny_config(train_dtype=train_dtype)
+    cfg, ds = _tiny_config(train_dtype=train_dtype, pallas=pallas)
     tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
     state = jax.eval_shape(tr.init_state)
     args = (state, _abstract(tr._epoch_orders(0)),
@@ -721,6 +724,19 @@ def _build_train_epoch_bf16() -> Program:
     prog = _train_epoch_program(train_dtype="bfloat16")
     prog.sanctioned_f32_dot_frac = 0.5
     return prog
+
+
+@_program("train_epoch_pallas")
+def _build_train_epoch_pallas() -> Program:
+    """Plan-raced kernel leg (PR 19): the train epoch with BOTH fused
+    kernels engaged (use_pallas_gru + use_pallas_attention), the exact
+    jit a `kernels` plan block with pallas winners ships. Audited so
+    the custom-VJP wiring cannot silently break the state-donation
+    aliasing or the dtype trace the f32 program pins. On CPU the
+    kernels lower through interpret mode — the compiled artifact
+    differs from the Mosaic one, but the jaxpr-level contracts
+    (donation, rule coverage, carried fixed point) are the same."""
+    return _train_epoch_program(train_dtype=None, pallas=True)
 
 
 @_program("eval_epoch")
@@ -844,12 +860,13 @@ def _score_inputs(ds, model_cfg, stacked: bool = False,
             _abstract(ds.next_valid)) + tail
 
 
-def _scoring_program(fleet: bool, scan: bool) -> Program:
+def _scoring_program(fleet: bool, scan: bool,
+                     pallas: bool = False) -> Program:
     import jax
 
     from factorvae_tpu.eval import predict
 
-    cfg, ds = _tiny_config()
+    cfg, ds = _tiny_config(pallas=pallas)
     factory = {
         (False, False): predict._score_chunk_fn,
         (True, False): predict._score_chunk_fleet_fn,
@@ -870,6 +887,16 @@ def _scoring_program(fleet: bool, scan: bool) -> Program:
 @_program("score_chunk")
 def _build_score_chunk() -> Program:
     return _scoring_program(fleet=False, scan=False)
+
+
+@_program("score_chunk_pallas")
+def _build_score_chunk_pallas() -> Program:
+    """Kernel-leg scoring twin of train_epoch_pallas (PR 19): the
+    chunked scorer with both fused kernels engaged. No donation by
+    design — and in particular the eval/score keys stay un-donated
+    (the measured PR 19 verdict: XLA drops a (2,) uint32 key donation
+    against f32 outputs, see train/trainer.py)."""
+    return _scoring_program(fleet=False, scan=False, pallas=True)
 
 
 @_program("score_chunk_fleet")

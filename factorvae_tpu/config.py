@@ -202,7 +202,10 @@ class TrainConfig:
     # pre-remat graph; "dots" wraps the day loss in jax.checkpoint
     # keeping matmul results (recompute the cheap elementwise chain);
     # "full" recomputes everything. Peak-HBM win measured per jit by
-    # `bench.py --mixed` via obs.compile.capture_compile.
+    # `bench.py --mixed` via obs.compile.capture_compile. Plan-raced
+    # since PR 19: `autotune_plan.py --remat` persists a winning rung
+    # (incl. rungs that win by admitting a doubled days_per_step) into
+    # the plan row, and apply_plan sets this knob from it.
     remat: str = "none"
 
 

@@ -1,12 +1,20 @@
 """Measured per-shape kernel selection — thin shim over the planner.
 
 The envelope predicates behind ``ModelConfig.use_pallas_* = "auto"``
-(round-2 v5e race, RACE_KERNELS.json; PERF.md "Pallas kernels vs XLA on
-the chip") moved to `factorvae_tpu.plan`, which generalizes the same
-measured-envelope idea to the full execution plan (layout, day
-batching, dtype, padding). This module keeps the historical import path
-and the patchable `_on_tpu` seam the kernel tests use; the truth lives
-in plan.py — update envelopes there.
+live in `factorvae_tpu.plan`, and since PR 19 they resolve in two
+tiers: a plan row's raced ``kernels`` block (written by
+``scripts/autotune_plan.py --kernels`` — a fresh pallas-vs-XLA race on
+THIS rig, per op, fwd+bwd) wins when present; the round-2 v5e static
+envelope (RACE_KERNELS.json chip records; PERF.md "Pallas kernels vs
+XLA on the chip") is only the no-row fallback. See ``docs/kernels.md``
+for the refresh workflow.
+
+This module keeps the historical import path and the patchable
+`_on_tpu` seam the kernel tests use. The wrappers below intentionally
+expose only the fallback tier (no plan-row verdict argument): callers
+that have a plan row go through `plan.plan_for(...)` /
+`Plan.kernel_*`, not this shim. The truth lives in plan.py — update
+envelopes there.
 """
 
 from __future__ import annotations
@@ -26,11 +34,15 @@ def _on_tpu() -> bool:
 
 def pallas_attention_wins(n: int, h: int, k: int) -> bool:
     """True where the fused attention beat XLA in the round-2 race;
-    False outside the raced envelope (no extrapolated wins)."""
+    False outside the raced envelope (no extrapolated wins). Fallback
+    tier only — a plan row's raced verdict overrides via
+    `Plan.kernel_attention`."""
     return _plan.pallas_attention_wins(n, h, k, on_tpu=_on_tpu())
 
 
 def pallas_gru_wins(n: int, t: int, h: int) -> bool:
     """True where the fused GRU recurrence beat XLA in the race;
-    False outside the raced envelope (no extrapolated wins)."""
+    False outside the raced envelope (no extrapolated wins). Fallback
+    tier only — a plan row's raced verdict overrides via
+    `Plan.kernel_gru`."""
     return _plan.pallas_gru_wins(n, t, h, on_tpu=_on_tpu())

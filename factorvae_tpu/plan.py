@@ -52,13 +52,19 @@ from typing import Optional, Sequence, Union
 
 # ---------------------------------------------------------------------------
 # Pallas kernel envelope (moved verbatim from ops/pallas/select.py; that
-# module now delegates here). See PERF.md "Pallas kernels vs XLA on the
-# chip": the round-2 race on a real v5e (RACE_KERNELS.json) covered
-# N in {360, 1024}; "auto" applies the measured winners INSIDE that
-# envelope only and resolves to XLA everywhere else (VERDICT r3
-# missing-#4: no extrapolated wins — the r3 cross-day flattening moved
-# the production GRU row count to N = B*N_pad = 2880, a shape with no
-# race row). Widen the *_RACED_N_MAX constants only from new chip rows.
+# module now delegates here). Kernel selection is MEASURED per rig since
+# ISSUE 19: `scripts/autotune_plan.py --kernels` races both kernels
+# against the XLA paths at the preset shapes and persists a per-row
+# "kernels" block whose verdict ("pallas" | "xla") the predicates read
+# FIRST. The static envelope below — the round-2 race on a real v5e
+# (RACE_KERNELS.json, N in {360, 1024}) — is only the NO-ROW fallback:
+# "auto" applies those frozen winners INSIDE the raced envelope and
+# resolves to XLA everywhere else (VERDICT r3 missing-#4: no
+# extrapolated wins — the r3 cross-day flattening moved the production
+# GRU row count to N = B*N_pad = 2880, a shape with no round-2 race
+# row). Widen the *_RACED_N_MAX fallback constants only from new chip
+# rows; prefer re-racing (`--kernels`) so the verdict is a measured
+# block, not a code edit. See docs/kernels.md.
 # ---------------------------------------------------------------------------
 
 _GRU_RACED_N_MAX = 1024
@@ -72,19 +78,28 @@ def _on_tpu() -> bool:
 
 
 def pallas_attention_wins(n: int, h: int, k: int,
-                          on_tpu: Optional[bool] = None) -> bool:
-    """True where the fused attention beat XLA in the round-2 race;
-    False outside the raced envelope (no extrapolated wins). The raced
-    N values are {360, 1024} — both bounds are measured points."""
+                          on_tpu: Optional[bool] = None,
+                          verdict: str = "") -> bool:
+    """Whether the fused attention should run for this shape. A measured
+    per-rig verdict (a plan row's "kernels" block, raced by
+    `autotune_plan --kernels`) decides outright; absent one ("") the
+    round-2 static envelope applies — False outside it (no extrapolated
+    wins; the raced N values are {360, 1024}, both bounds measured)."""
+    if verdict:
+        return verdict == "pallas"
     if on_tpu is None:
         on_tpu = _on_tpu()
     return on_tpu and 360 <= n <= _ATTN_RACED_N_MAX and h <= 24
 
 
 def pallas_gru_wins(n: int, t: int, h: int,
-                    on_tpu: Optional[bool] = None) -> bool:
-    """True where the fused GRU recurrence beat XLA in the race;
-    False outside the raced envelope (no extrapolated wins)."""
+                    on_tpu: Optional[bool] = None,
+                    verdict: str = "") -> bool:
+    """Whether the fused GRU recurrence should run for this shape. Same
+    resolution order as `pallas_attention_wins`: measured row verdict
+    first, round-2 static envelope as the no-row fallback."""
+    if verdict:
+        return verdict == "pallas"
     if on_tpu is None:
         on_tpu = _on_tpu()
     return on_tpu and 512 <= n <= _GRU_RACED_N_MAX and h <= 24 and t <= 20
@@ -235,6 +250,22 @@ class Plan:
     `TrainConfig.remat` alone, so every pre-ISSUE-17 row resolves
     exactly as before — the same rule as `train_precision`.
 
+    `kernel_gru` / `kernel_attention` are the MEASURED kernel verdicts
+    (ISSUE 19, closing ROADMAP item 3): "pallas" | "xla", raced
+    forward+backward against the XLA scan/einsum paths at the row's
+    shape on the row's backend by `scripts/autotune_plan.py --kernels`
+    (the raced walls persist in the row's `measured.kernels` block for
+    audit). A row's `"kernels"` block (`{"gru": ..., "attention": ...}`)
+    both sets these provenance fields AND pins `use_pallas_*` to the
+    winner, so `apply_plan` ships the measured choice; the
+    `pallas_*_wins` predicates read the verdict first and only fall
+    back to the frozen round-2 envelope constants when it is "" — which
+    is exactly what every pre-ISSUE-19 row (no block) resolves to, so
+    existing tables keep resolving through today's static envelope
+    unchanged (no schema break). XLA is always in the raced candidate
+    set, so a persisted verdict can never regress a shape below the
+    fallback path.
+
     `train_compute_dtype` is the TRAINING-precision knob (ISSUE 16,
     train/state.py resolve_train_dtype, docs/precision.md): which rung
     of the TRAINING ladder — "float32" (the bitwise oracle) or
@@ -271,6 +302,8 @@ class Plan:
     source: str
     use_pallas_attention: Union[bool, str] = "auto"
     use_pallas_gru: Union[bool, str] = "auto"
+    kernel_gru: str = ""
+    kernel_attention: str = ""
     seeds_per_program: int = 1
     lanes_per_program: int = 0
     panel_residency: str = "hbm"
@@ -309,11 +342,13 @@ class Plan:
                 "attention": resolve(
                     self.use_pallas_attention,
                     pallas_attention_wins(self.pad_target, shape.hidden_size,
-                                          shape.num_factors, on_tpu=on_tpu)),
+                                          shape.num_factors, on_tpu=on_tpu,
+                                          verdict=self.kernel_attention)),
                 "gru": resolve(
                     self.use_pallas_gru,
                     pallas_gru_wins(gru_rows, shape.seq_len,
-                                    shape.hidden_size, on_tpu=on_tpu)),
+                                    shape.hidden_size, on_tpu=on_tpu,
+                                    verdict=self.kernel_gru)),
             }
         if forced:
             d["forced"] = {k: v for k, v in forced.items() if v}
@@ -497,6 +532,13 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
             pad = pad_target_policy(
                 max(shape.n_stocks, int(row.get("pad_target") or 0)),
                 plat, shard)
+            # Pre-ISSUE-19 rows have no "kernels" block: "" = no
+            # measured kernel verdict, and use_pallas_* stays at the
+            # row's own pin or "auto" (the static round-2 envelope) —
+            # no schema break. A measured block pins the winner; an
+            # EXPLICIT row-level use_pallas_* key still outranks it
+            # (a hand pin is a deliberate override of the race).
+            kern = row.get("kernels") or {}
             return Plan(
                 flatten_days=bool(train.get("flatten_days", False)),
                 days_per_step=int(train.get("days_per_step", 1)),
@@ -508,8 +550,16 @@ def plan_for(shape: ShapeKey, platform: Optional[str] = None,
                 pad_target=pad,
                 provenance="measured",
                 source=str(row.get("source", "plan table")),
-                use_pallas_attention=row.get("use_pallas_attention", "auto"),
-                use_pallas_gru=row.get("use_pallas_gru", "auto"),
+                use_pallas_attention=row.get(
+                    "use_pallas_attention",
+                    (kern.get("attention") == "pallas")
+                    if kern.get("attention") else "auto"),
+                use_pallas_gru=row.get(
+                    "use_pallas_gru",
+                    (kern.get("gru") == "pallas")
+                    if kern.get("gru") else "auto"),
+                kernel_gru=str(kern.get("gru") or ""),
+                kernel_attention=str(kern.get("attention") or ""),
                 # Pre-fleet rows have no "fleet" block: resolve to the
                 # serial default (no schema break for existing tables).
                 seeds_per_program=int(

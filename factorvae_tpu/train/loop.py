@@ -159,9 +159,15 @@ def make_step_fns(
     TRAIN day loss in `jax.checkpoint` — "dots" keeps matmul results
     and recomputes the elementwise chain, "full" recomputes everything
     — shrinking the epoch scan's saved-residual footprint (the win is
-    measured per jit by bench.py --mixed via obs.compile). "none" is
-    the exact pre-remat graph; eval never backprops and stays
-    unwrapped."""
+    measured per jit by bench.py --mixed/--kernels via obs.compile).
+    Since PR 19 the knob is plan-raced: `autotune_plan.py --remat`
+    times the rungs at the row's days_per_step AND, where a rung
+    measurably frees peak_bytes, at doubled days_per_step — so a rung
+    can win by admitting a larger step — and persists a `train_remat`
+    block only past a wall-clock win (apply_plan then sets
+    TrainConfig.remat; docs/kernels.md). "none" is the exact pre-remat
+    graph and what every verdict-free row resolves to; eval never
+    backprops and stays unwrapped."""
 
     hyper = hyper_step_size is not None
     mixed = compute_dtype != "float32"

@@ -207,6 +207,18 @@ class Trainer:
         from factorvae_tpu.obs.watchdog import watch_jit
 
         donate = (0,)
+        # The eval-epoch jits deliberately donate NOTHING — including
+        # the eval key (ISSUE 19 revisited the ROADMAP-item-3 question
+        # with the JIR002 audit): a (2,) uint32 key has no shape/dtype-
+        # matching output among the f32 scalar metrics, so XLA drops
+        # the donation silently (zero `input_output_alias` entries;
+        # jax warns "donated buffers were not usable"). Donating would
+        # free zero bytes, add a standing JIR002 finding, and poison
+        # host-side key reuse (tests/test_train.py recomputes the
+        # sample-weighted metric from the same key — the oracle
+        # pattern). The STREAM eval-chunk jit below is the opposite
+        # case: its key threads through and returns, so that donation
+        # verifies as a real alias.
         # Chaos traces carry one extra replicated scalar (the poison
         # multiplier) on the train entry points.
         extra = (replicated(self.mesh),) if (

@@ -34,6 +34,26 @@ and persists the winner as the row's `stream` block
 (`Plan.panel_residency` / `Plan.stream_chunk_days`); HBM is always in
 the raced set, and rows without the block keep resolving to HBM.
 
+`--kernels` races {pallas, xla} x {gru, attention} forward+backward at
+each shape's production operating point on the CURRENT backend
+(scripts/race_kernels.py is the timing engine) and persists the
+measured verdict as the row's `kernels` block
+(`Plan.kernel_gru`/`Plan.kernel_attention` + a `use_pallas_*` pin);
+the `plan.pallas_*_wins` predicates read the verdict first, with the
+frozen round-2 envelope constants demoted to the no-row fallback. XLA
+is always in the raced set, so a persisted verdict can never regress a
+shape below the fallback path. Off-TPU the pallas legs run in
+interpret mode — honest (enormous) walls that correctly pin XLA.
+
+`--remat` races the rematerialization rung (train/loop.py
+`jax.checkpoint`) on the winning train knobs: remat in
+{none, dots, full}, judged on wall-clock AND on whether the freed
+`peak_bytes` (obs/compile.guarded_memory_analysis) admits a LARGER
+days_per_step that wins end-to-end. "none" (the exact pre-remat graph)
+is always raced; a non-none rung persists as the row's `train_remat`
+block ONLY past a measured per-day wall-clock win — the gate ROADMAP
+item 3 asks for.
+
 Usage:
     python scripts/autotune_plan.py                       # flagship shape
     python scripts/autotune_plan.py --config csi300-k60
@@ -44,6 +64,8 @@ Usage:
     python scripts/autotune_plan.py --mesh                # + mesh-shape race
     python scripts/autotune_plan.py --serve               # + precision ladder
     python scripts/autotune_plan.py --train_precision     # + training ladder
+    python scripts/autotune_plan.py --kernels             # + kernel race
+    python scripts/autotune_plan.py --remat               # + remat race
         [--out PLAN_TABLE.json] [--dry_run] [--metrics_jsonl RUN.jsonl]
 
 `--serve` races the serving-precision ladder (f32/bf16/int8) through
@@ -81,6 +103,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+# --kernels reuses the round-2 chip-race timing engine (same oracles,
+# same one-compile-per-candidate jits) from the sibling script.
+sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 # Preset-shaped race configs (shapes per factorvae_tpu/presets.py; real
 # cross-section widths — the pad policy decides the padded width).
@@ -149,6 +174,13 @@ SERVE_FIDELITY_FLOOR = 0.99
 # still pins rank ORDER agreement while tolerating trajectory drift.
 TRAIN_FIDELITY_FLOOR = 0.80
 TRAIN_PRECISION_EPOCHS = 3
+# --remat: rematerialization rungs (train/loop.py jax.checkpoint; ISSUE
+# 19, closing ROADMAP item 3) raced on the winning train knobs. "none"
+# is the exact pre-remat graph and always raced; a rung that frees
+# peak_bytes vs "none" additionally races a DOUBLED days_per_step (the
+# batch the freed memory admits) — a rung persists only when some
+# operating point of it beats "none" per trained day.
+REMAT_CANDIDATES = ["none", "dots", "full"]
 # --serve also races the continuous-batching scheduler window
 # (serve/daemon.TickScheduler, ISSUE 15) under a closed-loop
 # concurrent client load at the winning rung: how long an under-full
@@ -588,6 +620,155 @@ def race_train_precision(name: str, shape: dict, train_knobs: dict,
     }
 
 
+def race_kernels_block(name: str, shape: dict, train_knobs: dict,
+                       reps: int, logger=None) -> dict:
+    """Race {pallas, xla} x {gru, attention} forward+backward at this
+    shape's production operating point on the current backend and
+    return the row's `kernels` block: a measured per-rig verdict the
+    `plan.pallas_*_wins` predicates read FIRST (the frozen round-2
+    constants demote to the no-row fallback — docs/kernels.md).
+
+    The GRU is raced at the row count the winning layout actually
+    feeds it (pad_target x days_per_step under cross-day flattening —
+    the r3 operating point the static envelope never covered); the
+    attention at (pad_target, H, K). The fwd+bwd wall decides: this
+    race serves the TRAINING path (ROADMAP item 3), where every kernel
+    runs under jax.grad. XLA is always in the raced set, so the
+    persisted verdict can never regress a shape below the fallback.
+    Off-TPU the pallas legs run in interpret mode — honest (enormous)
+    walls that correctly pin XLA for that rig's rows."""
+    import jax
+
+    from race_kernels import race_attention, race_gru
+
+    from factorvae_tpu.plan import pad_target_policy
+
+    backend = jax.default_backend()
+    pad = pad_target_policy(int(shape["stocks"]))
+    gru_rows = (pad * int(train_knobs["days_per_step"])
+                if train_knobs["flatten_days"] else pad)
+    g = race_gru(gru_rows, shape["seq_len"], shape["hidden"], reps)
+    _log(logger, "autotune_kernel_candidate", shape=name, op="gru",
+         n=gru_rows, t=shape["seq_len"], h=shape["hidden"],
+         pallas_fwdbwd_us=g["pallas_fwdbwd_us"],
+         xla_fwdbwd_us=g["xla_fwdbwd_us"])
+    a = race_attention(pad, shape["hidden"], shape["factors"], reps)
+    _log(logger, "autotune_kernel_candidate", shape=name, op="attention",
+         n=pad, h=shape["hidden"], k=shape["factors"],
+         pallas_fwdbwd_us=a["pallas_fwdbwd_us"],
+         xla_fwdbwd_us=a["xla_fwdbwd_us"])
+    gru_win = ("pallas" if g["pallas_fwdbwd_us"] < g["xla_fwdbwd_us"]
+               else "xla")
+    attn_win = ("pallas" if a["pallas_fwdbwd_us"] < a["xla_fwdbwd_us"]
+                else "xla")
+    return {
+        "gru": gru_win,
+        "attention": attn_win,
+        "measured": {"backend": backend, "gru": g, "attention": a},
+        "source": (f"kernel race on {backend} (fwd+bwd wall): "
+                   f"gru[n={gru_rows}] {gru_win} "
+                   f"({g['fwdbwd_speedup']}x xla/pallas), "
+                   f"attention[n={pad}] {attn_win} "
+                   f"({a['fwdbwd_speedup']}x xla/pallas)"),
+    }
+
+
+def _time_remat(shape: dict, train_knobs: dict, remat: str, dps: int,
+                days: int, reps: int) -> tuple:
+    """(seconds per trained day, compiled peak_bytes) for one
+    (remat, days_per_step) operating point on the winning train knobs
+    (compile excluded from the rate). The memory bill comes from the
+    compiled program itself (capture_compile ->
+    guarded_memory_analysis), not a heuristic."""
+    import dataclasses as _dc
+
+    import jax
+
+    from factorvae_tpu.obs import compile as compilelib
+    from factorvae_tpu.train import Trainer
+    from factorvae_tpu.utils.logging import MetricsLogger
+
+    cfg, ds = _setup(shape, train_knobs["compute_dtype"],
+                     train_knobs["flatten_days"], dps, days)
+    cfg = _dc.replace(cfg, train=_dc.replace(cfg.train, remat=remat))
+    trainer = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+    state = trainer.init_state()
+    cap = compilelib.capture_compile(
+        trainer._train_epoch_jit,
+        compilelib.abstractify((state, trainer._epoch_orders(0),
+                                trainer.panel_args())))
+    peak = int(cap.get("peak_bytes") or 0)
+    state, m = trainer._train_epoch(state, trainer._epoch_orders(0))
+    jax.block_until_ready(m["loss"])
+    t0 = time.time()
+    for e in range(1, 1 + reps):
+        state, m = trainer._train_epoch(state, trainer._epoch_orders(e))
+    jax.block_until_ready(m["loss"])
+    return (time.time() - t0) / (reps * days), peak
+
+
+def race_remat(name: str, shape: dict, train_knobs: dict, days: int,
+               reps: int, logger=None) -> dict:
+    """Race the rematerialization rung (ISSUE 19, train/loop.py
+    `jax.checkpoint` wrapping via TrainConfig.remat) on the winning
+    train knobs; return the row's `train_remat` verdict.
+
+    Judged on wall-clock AND on memory-admits-a-larger-batch: a rung
+    whose compiled program frees peak_bytes vs "none" additionally
+    races a doubled days_per_step — freed memory only counts as a win
+    when the bigger batch it admits is faster END-TO-END (per trained
+    day), not merely smaller. "none" is always in the raced set, so
+    the caller persists a rung only past a measured per-day win (the
+    ROADMAP item 3 gate); race_shape writes NO block when "none" wins,
+    and rows without the block keep TrainConfig.remat's own default."""
+    base_dps = int(train_knobs["days_per_step"])
+    measured: dict = {}
+    peaks: dict = {}
+    best, best_sec = ("none", base_dps), None
+    for remat in REMAT_CANDIDATES:
+        sec, peak = _time_remat(shape, train_knobs, remat, base_dps,
+                                days, reps)
+        measured[remat] = {"s_per_day": round(sec, 5),
+                           "peak_bytes": peak}
+        peaks[remat] = peak
+        _log(logger, "autotune_remat_candidate", shape=name, remat=remat,
+             days_per_step=base_dps, s_per_day=round(sec, 5),
+             peak_bytes=peak)
+        if best_sec is None or sec < best_sec:
+            best, best_sec = (remat, base_dps), sec
+    bigger = base_dps * 2
+    if bigger <= days:
+        for remat in REMAT_CANDIDATES[1:]:
+            if not (peaks.get(remat) and peaks.get("none")
+                    and peaks[remat] < peaks["none"]):
+                continue
+            sec, peak = _time_remat(shape, train_knobs, remat, bigger,
+                                    days, reps)
+            key = f"{remat}_dps{bigger}"
+            measured[key] = {"s_per_day": round(sec, 5),
+                             "peak_bytes": peak}
+            _log(logger, "autotune_remat_candidate", shape=name,
+                 remat=remat, days_per_step=bigger,
+                 s_per_day=round(sec, 5), peak_bytes=peak)
+            if sec < best_sec:
+                best, best_sec = (remat, bigger), sec
+    freed = {r: (round(1.0 - peaks[r] / peaks["none"], 4)
+                 if peaks.get("none") else None)
+             for r in REMAT_CANDIDATES[1:] if r in peaks}
+    measured["peak_reduction_frac"] = freed
+    return {
+        "remat": best[0],
+        "days_per_step": best[1],
+        "measured": measured,
+        "source": (f"remat race on {train_knobs['compute_dtype']} "
+                   f"flat={int(train_knobs['flatten_days'])} "
+                   f"dps{base_dps} (peak cut dots="
+                   f"{freed.get('dots')}, full={freed.get('full')}): "
+                   f"best {best[0]} dps{best[1]} at "
+                   f"{best_sec:.4f} s/day"),
+    }
+
+
 def race_serve_tick(name: str, cfg, params, reg, ds, day_idx,
                     precision: str, reps: int, logger=None) -> dict:
     """Race the continuous-batching window (TickScheduler's tick_ms)
@@ -790,6 +971,7 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
                fleet: bool = False, stream: bool = False,
                mesh: bool = False, serve: bool = False,
                hyper: bool = False, train_precision: bool = False,
+               kernels: bool = False, remat: bool = False,
                logger=None) -> dict:
     """Race all candidates for one shape at ONE width (`shape['stocks']`
     must be a scalar here — `race_widths` expands lists); return a
@@ -893,6 +1075,18 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
     if mesh:
         mesh_block = race_mesh(name, shape, best_train_key, days,
                                reps, logger=logger)
+    kernels_block = None
+    if kernels:
+        # A crashed race leg propagates LOUDLY here — a silent fallback
+        # to the static envelope would persist an unmeasured verdict as
+        # if it were measured (bench.py --kernels is the lane that
+        # degrades gracefully, via its kernels_race_failed metric).
+        kernels_block = race_kernels_block(name, shape, best_train_key,
+                                           reps, logger=logger)
+    remat_block = None
+    if remat:
+        remat_block = race_remat(name, shape, best_train_key, days,
+                                 reps, logger=logger)
 
     shp = ShapeKey(
         num_features=shape["features"], seq_len=shape["seq_len"],
@@ -912,6 +1106,10 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
         measured["train_precision"] = tp_block.pop("measured")
     if mesh_block is not None:
         measured["mesh"] = mesh_block.pop("measured")
+    if kernels_block is not None:
+        measured["kernels"] = kernels_block.pop("measured")
+    if remat_block is not None:
+        measured["train_remat"] = remat_block.pop("measured")
     row = {
         "platform": plat,
         "shape": {"c": shp.num_features, "t": shp.seq_len,
@@ -976,6 +1174,32 @@ def race_shape(name: str, shape: dict, days: int, reps: int,
             row["mesh"] = {"data_axis": mesh_block["data_axis"],
                            "stock_axis": mesh_block["stock_axis"],
                            "days_per_step": mesh_block["days_per_step"]}
+    if kernels_block is not None:
+        row["source"] += f"; {kernels_block['source']}"
+        # The block persists EVEN when xla sweeps both ops: a measured
+        # xla verdict upgrades the row from "assumed" to "raced on this
+        # rig", and pins use_pallas_* off instead of leaving the static
+        # envelope to guess. No regression is possible — xla was in the
+        # candidate set, so the winner is never slower than fallback.
+        row["kernels"] = {"gru": kernels_block["gru"],
+                          "attention": kernels_block["attention"]}
+    if remat_block is not None:
+        row["source"] += f"; {remat_block['source']}"
+        # "none" winners persist NO block (the conservative default —
+        # plan_for resolves an absent block to TrainConfig.remat's own
+        # default, which IS "none"): a remat rung ships only past a
+        # measured per-trained-day win, exactly the ROADMAP item 3
+        # gate. When the win came from the doubled batch the freed
+        # peak_bytes admits, the winning days_per_step ships WITH the
+        # row (overriding the train race's dps) so the end-to-end
+        # operating point that actually won is what plan_for resolves.
+        if remat_block["remat"] != "none":
+            row["train_remat"] = {"remat": remat_block["remat"]}
+            if remat_block["days_per_step"] != best_train_key[
+                    "days_per_step"]:
+                row["train"] = dict(best_train_key,
+                                    days_per_step=remat_block[
+                                        "days_per_step"])
     return row
 
 
@@ -983,6 +1207,7 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
                 fleet: bool = False, stream: bool = False,
                 mesh: bool = False, serve: bool = False,
                 hyper: bool = False, train_precision: bool = False,
+                kernels: bool = False, remat: bool = False,
                 logger=None) -> list:
     """Race every width in `shape['stocks']` (scalar or list) and merge
     adjacent widths with IDENTICAL winners into one [n_min, n_max]
@@ -995,17 +1220,20 @@ def race_widths(name: str, shape: dict, days: int, reps: int,
     rows = [race_shape(name, {**shape, "stocks": int(w)}, days, reps,
                        fleet=fleet, stream=stream, mesh=mesh,
                        serve=serve, hyper=hyper,
-                       train_precision=train_precision, logger=logger)
+                       train_precision=train_precision, kernels=kernels,
+                       remat=remat, logger=logger)
             for w in sorted(widths)]
     merged = [rows[0]]
     for r in rows[1:]:
         p = merged[-1]
         if (r["train"], r["score"], r.get("fleet"), r.get("stream"),
                 r.get("mesh"), r.get("serve"), r.get("hyper"),
-                r.get("train_precision")) != (
+                r.get("train_precision"), r.get("kernels"),
+                r.get("train_remat")) != (
                 p["train"], p["score"], p.get("fleet"), p.get("stream"),
                 p.get("mesh"), p.get("serve"), p.get("hyper"),
-                p.get("train_precision")):
+                p.get("train_precision"), p.get("kernels"),
+                p.get("train_remat")):
             merged.append(r)
             continue
         if not any(k.startswith("n=") for k in p["measured"]):
@@ -1093,6 +1321,31 @@ def main() -> int:
                         "block (plan_for -> Plan.train_compute_dtype; "
                         "f32 winners persist NO block and rows without "
                         "one leave TrainConfig.compute_dtype alone)")
+    p.add_argument("--kernels", action="store_true",
+                   help="also race {pallas, xla} x {gru, attention} "
+                        "forward+backward (scripts/race_kernels.py "
+                        "engine; ISSUE 19) at each shape's production "
+                        "operating point on the current backend; the "
+                        "measured winners persist on the row's "
+                        "'kernels' block (plan_for -> Plan.kernel_gru/"
+                        "kernel_attention, pinning use_pallas_*; the "
+                        "pallas_*_wins predicates read the verdict "
+                        "FIRST and rows without one fall back to the "
+                        "static round-2 envelope — docs/kernels.md). "
+                        "xla is always in the raced set, so a "
+                        "persisted verdict never regresses a shape")
+    p.add_argument("--remat", action="store_true",
+                   help="also race the rematerialization rung "
+                        f"({'/'.join(REMAT_CANDIDATES)}, train/loop.py "
+                        "jax.checkpoint; ISSUE 19) on each shape's "
+                        "winning train knobs, judged on wall-clock AND "
+                        "on whether freed compiled peak_bytes admits a "
+                        "doubled days_per_step that wins end-to-end; a "
+                        "non-none winner is persisted on the row's "
+                        "'train_remat' block (plan_for -> "
+                        "Plan.train_remat; 'none' winners persist NO "
+                        "block and rows without one keep "
+                        "TrainConfig.remat's own default)")
     p.add_argument("--mesh_devices", type=int, default=0,
                    help="with --mesh under JAX_PLATFORMS=cpu: force "
                         "this many virtual host-CPU devices (the test-"
@@ -1158,6 +1411,8 @@ def main() -> int:
                             serve=args.serve,
                             hyper=args.hyper,
                             train_precision=args.train_precision,
+                            kernels=args.kernels,
+                            remat=args.remat,
                             logger=lg)]
             print(json.dumps({"rows": rows}, indent=1))
             if args.dry_run:

@@ -96,6 +96,16 @@ _SLOW_TESTS = {"test_flax_default_init_path"}
 # the CLI --ir contract pins the gate's invocation surface; the
 # seeded-violation fixture programs stay slow (test_ir.py in
 # _SLOW_FILES).
+# The ISSUE-19 kernel parity classes are quick BY DESIGN: every tier-1
+# run proves fwd + custom-VJP parity of BOTH Pallas kernels against the
+# XLA oracles in interpret mode at tiny shapes — including the
+# segment-checkpointed GRU backward past _SEG_MAX — so a kernel or VJP
+# regression is caught the run it lands; the thorough sweeps stay slow
+# (test_pallas_gru.py / test_collectives.py in _SLOW_FILES). The
+# eval-key donation pin rides quick too: it locks the MEASURED verdict
+# (XLA drops the (2,) uint32 key donation; metrics bitwise-unchanged)
+# that keeps eval_epoch un-donated — a jax upgrade that changes the
+# aliasing outcome must surface in tier-1, not a slow sweep.
 # The ISSUE-15 router/pool classes are quick BY DESIGN: tier-1 must
 # exercise the scale-out tier — bounded-load rendezvous routing, the
 # exposition relabel/merge, cross-tick continuous batching, and one
@@ -123,7 +133,9 @@ _QUICK_CLASSES = {"TestCLIDefaults", "TestPartitionRules",
                   "TestWalkForwardCycle", "TestReadmission",
                   "TestRendezvous", "TestExpositionMerge",
                   "TestTickScheduler", "TestWorkerFleetE2E",
-                  "TestIRSelfAudit", "TestIRCLIContract"}
+                  "TestIRSelfAudit", "TestIRCLIContract",
+                  "TestQuickGruParity", "TestQuickAttentionParity",
+                  "TestEvalKeyDonation"}
 
 
 def pytest_collection_modifyitems(config, items):

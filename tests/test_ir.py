@@ -253,11 +253,11 @@ class TestRegistrySemantics:
 
     def test_registry_covers_the_declared_surface(self):
         names = {s.name for s in ir.REGISTRY}
-        assert {"train_epoch", "train_epoch_bf16", "eval_epoch",
-                "fleet_train_epoch", "hyper_train_epoch",
-                "fleet_eval_epoch", "score_chunk", "score_chunk_fleet",
-                "score_scan", "score_scan_fleet", "serve_float32",
-                "serve_bfloat16", "serve_int8"} <= names
+        assert {"train_epoch", "train_epoch_bf16", "train_epoch_pallas",
+                "fleet_train_epoch", "hyper_train_epoch", "eval_epoch",
+                "fleet_eval_epoch", "score_chunk", "score_chunk_pallas",
+                "score_chunk_fleet", "score_scan", "score_scan_fleet",
+                "serve_float32", "serve_bfloat16", "serve_int8"} <= names
 
 
 class TestCompiledViewReuse:

@@ -227,3 +227,100 @@ class TestDayBatchedPallas:
         g = jax.grad(lambda p: m_p.apply(p, x, y, mask, rngs=rngs).loss.sum())(params)
         for leaf in jax.tree_util.tree_leaves(g):
             assert np.isfinite(np.asarray(leaf)).all()
+
+
+class TestQuickGruParity:
+    """Tier-1 interpret-mode parity gate for the fused GRU (PR 19).
+
+    The thorough oracles above ride the slow tier; these tiny-shape
+    twins run on EVERY tier-1 pass so a kernel regression (gate math,
+    custom-VJP wiring, segment-boundary carry) is caught the run it
+    lands, not at the next slow sweep. Shapes are minimal: one row
+    block, h=4, with one full-sequence and one segmented-BPTT case.
+    """
+
+    def _args(self, rng, n, t, h):
+        xi = jnp.asarray(rng.normal(size=(n, t, 3 * h)) * 0.5, jnp.float32)
+        wh = jnp.asarray(rng.normal(size=(h, 3 * h)) * 0.3, jnp.float32)
+        bh = jnp.asarray(rng.normal(size=(3 * h,)) * 0.1, jnp.float32)
+        dh = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+        return xi, wh, bh, dh
+
+    def _check(self, xi, wh, bh, dh, grad_tol):
+        np.testing.assert_allclose(
+            np.asarray(gru_scan(xi, wh, bh)),
+            np.asarray(scan_gru_reference(xi, wh, bh)),
+            rtol=1e-5, atol=1e-6,
+        )
+        gf = jax.grad(lambda *a: jnp.sum(gru_scan(*a) * dh),
+                      argnums=(0, 1, 2))(xi, wh, bh)
+        gr = jax.grad(lambda *a: jnp.sum(scan_gru_reference(*a) * dh),
+                      argnums=(0, 1, 2))(xi, wh, bh)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=grad_tol, atol=2e-5)
+
+    def test_forward_and_vjp_match_scan(self, rng):
+        """T <= _SEG_MAX: the single-launch full-sequence backward."""
+        from factorvae_tpu.ops.pallas.gru import _SEG_MAX, _segment_len
+
+        n, t, h = 6, 8, 4
+        assert t <= _SEG_MAX and _segment_len(t) == t
+        self._check(*self._args(rng, n, t, h), grad_tol=2e-4)
+
+    def test_segmented_backward_past_seg_max(self, rng):
+        """T > _SEG_MAX: the segment-checkpointed BPTT path, including
+        the reverse d_h carry across the segment boundary."""
+        from factorvae_tpu.ops.pallas.gru import _SEG_MAX, _segment_len
+
+        n, t, h = 6, 32, 4
+        assert t > _SEG_MAX and _segment_len(t) == 16  # 2 segments
+        self._check(*self._args(rng, n, t, h), grad_tol=5e-4)
+
+
+class TestQuickAttentionParity:
+    """Tier-1 interpret-mode parity gate for fused attention (PR 19).
+
+    Same rationale as TestQuickGruParity: the thorough attention
+    oracles live in test_collectives.py (slow file); this tiny-shape
+    fwd + custom-VJP twin of the einsum path runs every tier-1 pass.
+    """
+
+    def test_forward_and_vjp_match_einsum(self, rng):
+        from factorvae_tpu.ops.pallas.attention_grad import fused_attention
+
+        n, h, k = 8, 4, 3
+        latent = jnp.asarray(rng.normal(size=(n, h)), jnp.float32)
+        maskf = (jnp.asarray(rng.random(n)) > 0.25).astype(jnp.float32)
+        q = jnp.asarray(rng.normal(size=(k, h)), jnp.float32)
+        wk = jnp.asarray(rng.normal(size=(k, h, h)), jnp.float32)
+        bk = jnp.asarray(rng.normal(size=(k, h)), jnp.float32)
+        wv = jnp.asarray(rng.normal(size=(k, h, h)), jnp.float32)
+        bv = jnp.asarray(rng.normal(size=(k, h)), jnp.float32)
+
+        def ref(latent, maskf, q, wk, bk, wv, bv):
+            # models/predictor.py einsum path (relu-scored masked softmax)
+            keys = jnp.einsum("nh,khj->knj", latent, wk) + bk[:, None, :]
+            vals = jnp.einsum("nh,khj->knj", latent, wv) + bv[:, None, :]
+            s = jnp.einsum("kh,knh->kn", q, keys) / jnp.sqrt(
+                jnp.float32(h) + 1e-6)
+            s = jnp.maximum(s, 0.0)
+            neg = jnp.where(maskf[None, :] > 0, s, -1e30)
+            m = jnp.max(neg, axis=1, keepdims=True)
+            ex = jnp.where(maskf[None, :] > 0, jnp.exp(neg - m), 0.0)
+            a = ex / jnp.maximum(jnp.sum(ex, axis=1, keepdims=True), 1e-30)
+            return jnp.einsum("kn,knh->kh", a, vals)
+
+        args = (latent, maskf, q, wk, bk, wv, bv)
+        np.testing.assert_allclose(
+            np.asarray(fused_attention(*args)), np.asarray(ref(*args)),
+            rtol=1e-5, atol=1e-6,
+        )
+        dctx = jnp.asarray(rng.normal(size=(k, h)), jnp.float32)
+        gf = jax.grad(lambda *a: jnp.sum(fused_attention(*a) * dctx),
+                      argnums=(0, 2, 3, 4, 5, 6))(*args)
+        gr = jax.grad(lambda *a: jnp.sum(ref(*a) * dctx),
+                      argnums=(0, 2, 3, 4, 5, 6))(*args)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)

@@ -547,6 +547,91 @@ class TestTrainRematKnob:
                         table=load_table(str(path2))).train_remat == ""
 
 
+class TestKernelsKnob:
+    """kernel_gru / kernel_attention (ISSUE 19, ROADMAP item 3): raced
+    rows may carry a 'kernels' block whose measured verdict pins the
+    model's use_pallas_* flags and overrides the static envelope in the
+    predicates; rows without one — every pre-PR table — must resolve
+    to NO verdict ('') and keep today's static-envelope behavior."""
+
+    def test_kernels_row_resolves_and_pins(self):
+        p = plan_for(K60, "cpu", table=[row(
+            kernels={"gru": "pallas", "attention": "xla"})])
+        assert p.provenance == "measured"
+        assert (p.kernel_gru, p.kernel_attention) == ("pallas", "xla")
+        # the measured winner pins the model flags
+        assert p.use_pallas_gru is True
+        assert p.use_pallas_attention is False
+
+    def test_verdict_overrides_static_envelope_in_describe(self):
+        """K60 on CPU statically resolves both kernels off; a measured
+        'pallas' verdict must flip the resolved choice — the predicates
+        read the block first, constants are only the no-row fallback."""
+        p = plan_for(K60, "cpu", table=[row(
+            kernels={"gru": "pallas", "attention": "pallas"})])
+        d = p.describe(K60, platform="cpu")
+        assert d["kernels_resolved"] == {"attention": True, "gru": True}
+
+    def test_explicit_row_pin_outranks_the_block(self):
+        """A hand-set use_pallas_* key on the row is a deliberate
+        override of the race and must win over the measured block."""
+        p = plan_for(K60, "cpu", table=[row(
+            use_pallas_gru=False, kernels={"gru": "pallas"})])
+        assert p.use_pallas_gru is False
+        assert p.kernel_gru == "pallas"  # provenance still recorded
+
+    def test_pre_pr_row_has_no_verdict(self):
+        p = plan_for(K60, "cpu", table=[row()])
+        assert p.provenance == "measured"
+        assert (p.kernel_gru, p.kernel_attention) == ("", "")
+        assert p.use_pallas_gru == "auto"
+        # no verdict -> the static envelope decides, exactly as before
+        assert p.describe(K60, platform="cpu")["kernels_resolved"] == \
+            {"attention": False, "gru": False}
+
+    def test_default_plan_has_no_verdict(self):
+        assert plan_for(K60, "cpu", table=[]).kernel_gru == ""
+        assert plan_for(FLAGSHIP, "tpu", table=[]).kernel_attention == ""
+
+    def test_null_block_tolerated(self):
+        assert plan_for(K60, "cpu",
+                        table=[row(kernels=None)]).kernel_gru == ""
+        p = plan_for(K60, "cpu", table=[row(kernels={})])
+        assert (p.kernel_gru, p.kernel_attention) == ("", "")
+        assert p.use_pallas_gru == "auto"
+
+    def test_predicates_read_verdict_first(self):
+        # a verdict decides regardless of backend or shape
+        assert planlib.pallas_gru_wins(1, 999, 999, on_tpu=False,
+                                       verdict="pallas") is True
+        assert planlib.pallas_attention_wins(512, 20, 20, on_tpu=True,
+                                             verdict="xla") is False
+        # no verdict: the frozen round-2 envelope (fallback) applies
+        assert planlib.pallas_gru_wins(512, 20, 20, on_tpu=True) is True
+        assert planlib.pallas_gru_wins(2880, 20, 20, on_tpu=True) is False
+
+    def test_apply_plan_ships_the_measured_winner(self):
+        p = plan_for(K60, "cpu", table=[row(
+            kernels={"gru": "pallas", "attention": "xla"})])
+        cfg = apply_plan(Config(), p)
+        assert cfg.model.use_pallas_gru is True
+        assert cfg.model.use_pallas_attention is False
+        kept = apply_plan(Config(), p, keep_kernels=True)
+        assert kept.model.use_pallas_gru == Config().model.use_pallas_gru
+
+    def test_kernels_table_file_round_trip(self, tmp_path):
+        path = tmp_path / "table.json"
+        save_rows([row(kernels={"gru": "xla", "attention": "xla"})],
+                  path=str(path))
+        p = plan_for(K60, "cpu", table=load_table(str(path)))
+        assert (p.kernel_gru, p.kernel_attention) == ("xla", "xla")
+        assert p.use_pallas_gru is False
+        path2 = tmp_path / "pre.json"
+        save_rows([row()], path=str(path2))
+        p2 = plan_for(K60, "cpu", table=load_table(str(path2)))
+        assert (p2.kernel_gru, p2.use_pallas_gru) == ("", "auto")
+
+
 class TestServeSloHedgeKnob:
     """serve_slo_ms / serve_hedge_ms (ISSUE 17): the multi-host
     router's SLO + hedge delay ride the same measured 'serve' block as

@@ -245,6 +245,14 @@ class TestSampleWeightedMetric:
         key = jax.random.PRNGKey(7)
         m = tr._eval_epoch(state.params, order, key)
 
+        # Reusing `key` on the host AFTER the jitted eval epoch is the
+        # oracle pattern this test depends on — legal precisely because
+        # the eval jit donates nothing (a donated key buffer would be
+        # dead here). ISSUE 19 revisited that choice with the JIR002
+        # audit and kept it: the (2,) uint32 key has no matching output
+        # among the f32 scalar metrics, so XLA drops the donation
+        # anyway (zero input_output_alias entries — pinned by
+        # TestEvalKeyDonation below); donating frees nothing.
         # recompute per-day: same key splitting as eval_epoch's scan
         total_w, total_n = 0.0, 0.0
         k = key
@@ -262,6 +270,60 @@ class TestSampleWeightedMetric:
         np.testing.assert_allclose(
             float(m["loss_sample_weighted"]), total_w / total_n, rtol=1e-4
         )
+
+
+class TestEvalKeyDonation:
+    """ISSUE 19 (ROADMAP item 3): the eval-key donation question,
+    settled by measurement. The eval-epoch jit donates nothing — this
+    pins the measured basis so the rationale can't rot silently."""
+
+    def test_key_donation_is_dropped_by_xla_and_metrics_match(
+            self, tmp_path):
+        """A donate_argnums=(2,) variant of the SAME eval_epoch fn
+        yields ZERO input-output aliases in the compiled HLO — the
+        (2,) uint32 key matches no f32 metric output, so XLA drops the
+        claim (JIR002's dropped-donation case) and donating would free
+        nothing. Metrics stay bitwise the undonated jit's. If this
+        ever flips (an alias appears), revisit trainer.py's
+        no-donation rationale and the host key reuse above."""
+        import dataclasses
+
+        from factorvae_tpu.analysis import ir as irlib
+        from factorvae_tpu.obs import compile as compilelib
+
+        panel = synthetic_panel(num_days=6, num_instruments=5,
+                                num_features=8, missing_prob=0.2, seed=5)
+        ds = PanelDataset(panel, seq_len=3)
+        cfg = small_config(tmp_path, checkpoint_every=0)
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, seq_len=3),
+            model=dataclasses.replace(cfg.model, seq_len=3))
+        tr = Trainer(cfg, ds, logger=MetricsLogger(echo=False))
+        state = tr.init_state()
+        order = jnp.asarray(ds.split_days(None, None).reshape(-1, 1))
+        key = jax.random.PRNGKey(7)
+
+        m0 = tr._eval_epoch_jit(state.params, order, key, tr.panel_args())
+        m0 = {k: np.asarray(v).copy() for k, v in m0.items()}
+
+        donated = jax.jit(tr.fns.eval_epoch, donate_argnums=(2,))
+        rep = irlib.donation_audit(
+            donated,
+            (compilelib.abstractify(state.params),
+             compilelib.abstractify(order),
+             compilelib.abstractify(key),
+             compilelib.abstractify(tr.panel_args())),
+            (2,))
+        assert rep["ok"]
+        (arg,) = rep["per_arg"]
+        assert arg["argnum"] == 2
+        assert arg["verified"] is False, (
+            "the eval-key donation now produces a real alias — "
+            "revisit trainer.py's no-donation rationale")
+        m1 = donated(state.params, order, jax.random.PRNGKey(7),
+                     tr.panel_args())
+        for k in m0:
+            np.testing.assert_array_equal(np.asarray(m1[k]), m0[k])
 
 
 class TestProfilingUtils:
