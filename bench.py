@@ -70,6 +70,14 @@ QPS, and the fused multi-model dispatch, with zero `compile` records
 on the warm path proven from the daemon's own RUN stream
 (BENCH_SERVE.json). Same robustness contract.
 
+Tracing A/B (`python bench.py --serve --tracing`, or
+BENCH_SERVE_TRACE=1): the trace-plane overhead bench (ISSUE 20,
+obs/trace.py) — the same closed-loop load with trace propagation off
+vs on; reports `trace_overhead_frac` and the per-stage
+queue/tick/dispatch/response p50/p99 decomposed from the traced leg's
+own span stream (BENCH_TRACE.json). Overhead past BENCH_TRACE_BUDGET
+(2%) fails the row. Same robustness contract.
+
 Chaos mode (`python bench.py --chaos`, or BENCH_CHAOS=1): the MTTR
 bench (ISSUE 9) — inject one deterministic fault per chaos class
 (factorvae_tpu/chaos: poisoned gradients, kill-mid-save, checkpoint/
@@ -319,6 +327,22 @@ SCALE_MODELS = int(os.environ.get("BENCH_SCALE_MODELS", 8))
 SCALE_CLIENTS = int(os.environ.get("BENCH_SCALE_CLIENTS", 8))
 SCALE_REQUESTS = int(os.environ.get("BENCH_SCALE_REQUESTS", 240))
 SCALE_WARMUP = int(os.environ.get("BENCH_SCALE_WARMUP", 160))
+# Tracing A/B (`python bench.py --serve --tracing` or
+# BENCH_SERVE_TRACE=1): the trace-plane overhead bench (ISSUE 20,
+# obs/trace.py). The SAME closed-loop load runs twice through the tick
+# scheduler — trace propagation disabled vs enabled on one shared
+# registry — and the payload reports `trace_overhead_frac`
+# (1 - traced/untraced QPS, best-of-rounds per arm) plus the per-stage
+# (queue / tick / dispatch / response) p50/p99 wall decomposed from the
+# traced leg's own span stream. Headline `value` is the TRACED QPS
+# (req/sec — the number you actually serve at with the plane on);
+# overhead above BENCH_TRACE_BUDGET (default 2%) flips the metric to
+# *_trace_overhead_failed, the row the ledger refuses. Detail lands in
+# BENCH_TRACE.json.
+USE_SERVE_TRACE = os.environ.get("BENCH_SERVE_TRACE", "0") == "1"
+TRACE_CLIENTS = int(os.environ.get("BENCH_TRACE_CLIENTS", 4))
+TRACE_ROUNDS = int(os.environ.get("BENCH_TRACE_ROUNDS", 2))
+TRACE_BUDGET = float(os.environ.get("BENCH_TRACE_BUDGET", 0.02))
 # Multi-host mode (`python bench.py --serve --remote` or
 # BENCH_SERVE_REMOTE=1): the multi-host serving plane (ISSUE 17,
 # serve/remote.py + serve/autoscale.py). One local worker anchors the
@@ -497,6 +521,8 @@ def fail_metric() -> str:
         return "kernels_race_failed"
     if USE_MESH or os.environ.get("BENCH_MESH", "0") == "1":
         return "mesh_train_throughput_failed"
+    if USE_SERVE_TRACE or os.environ.get("BENCH_SERVE_TRACE", "0") == "1":
+        return "serve_traced_qps_failed"
     if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
         return "serve_qps_failed"
     if USE_CHAOS or os.environ.get("BENCH_CHAOS", "0") == "1":
@@ -513,7 +539,9 @@ def fail_unit() -> str:
              or USE_MESH or os.environ.get("BENCH_MESH", "0") == "1")
     if USE_HYPER or os.environ.get("BENCH_HYPER", "0") == "1":
         return "configs/sec/program"
-    if USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1":
+    if (USE_SERVE or os.environ.get("BENCH_SERVE", "0") == "1"
+            or USE_SERVE_TRACE
+            or os.environ.get("BENCH_SERVE_TRACE", "0") == "1"):
         return "req/sec"
     if USE_CHAOS or os.environ.get("BENCH_CHAOS", "0") == "1":
         return "recoveries/sec"
@@ -1714,6 +1742,185 @@ def run_serve_bench() -> dict:
     try:
         out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_SERVE.json")
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:  # pragma: no cover - read-only checkout
+        pass
+    return payload
+
+
+def run_serve_trace_bench() -> dict:
+    """Trace-plane overhead A/B (BENCH_SERVE_TRACE, ISSUE 20): the same
+    closed-loop load through the tick scheduler twice — trace
+    propagation OFF vs ON — over one shared registry, so the only
+    variable is the trace plane itself (context parsing, span-id
+    derivation, the extra span records on the stream). Requests carry a
+    `trace` field in BOTH legs: the off leg prices the daemon-side gate
+    (what an untraced fleet pays for traced clients), the on leg prices
+    the full plane. Headline `value` is the TRACED QPS; the payload
+    carries `trace_overhead_frac` and the per-stage p50/p99 breakdown
+    (obs.trace.stage_breakdown over the traced leg's own RUN streams).
+    Overhead above TRACE_BUDGET flips the metric to
+    *_trace_overhead_failed — the plane's whole pitch is "always on",
+    and an expensive always-on plane is a broken contract."""
+    import dataclasses
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from factorvae_tpu import plan as planlib
+
+    planlib.setup_compilation_cache(
+        tempfile.mkdtemp(prefix="bench_trace_cache_"))
+
+    from factorvae_tpu.models.factorvae import load_model
+    from factorvae_tpu.obs.trace import (
+        assemble_traces,
+        load_records,
+        stage_breakdown,
+    )
+    from factorvae_tpu.serve.daemon import ScoringDaemon, TickScheduler
+    from factorvae_tpu.serve.registry import ModelRegistry
+    from factorvae_tpu.utils.logging import (
+        MetricsLogger,
+        Timeline,
+        install_timeline,
+    )
+
+    platform, _ = detect_platform()
+    knobs, plan_block = resolve_plan(platform)
+    cfg, ds = bench_setup(knobs)
+    days = ds.split_days(None, None)
+
+    registry = ModelRegistry()
+    aliases = []
+    for i in range(SERVE_MODELS):
+        cfg_i = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, seed=i))
+        _, params = load_model(cfg_i, n_max=ds.n_max)
+        registry.register_params(params, cfg_i, n_stocks=N_STOCKS,
+                                 alias=f"m{i}")
+        aliases.append(f"m{i}")
+
+    work = tempfile.mkdtemp(prefix="bench_trace_")
+
+    def drive(traced: bool, run_path: str) -> dict:
+        """One leg: daemon + scheduler with the plane on/off, a
+        per-model warmup (compiles never land in the timed window —
+        the shared jit factory amortizes them across legs anyway),
+        then TRACE_CLIENTS closed-loop threads."""
+        lat: list = []
+        lock = threading.Lock()
+        per_client = max(1, SERVE_REQUESTS // max(1, TRACE_CLIENTS))
+        with MetricsLogger(jsonl_path=run_path, echo=False,
+                           run_name="bench_trace") as logger:
+            prev_tl = install_timeline(Timeline(logger))
+            try:
+                daemon = ScoringDaemon(registry, ds, stochastic=False,
+                                       trace=traced)
+                sched = TickScheduler(daemon, tick_ms=1.0,
+                                      max_tick_batch=16)
+                try:
+                    for i, alias in enumerate(aliases):
+                        resp = sched.submit([{
+                            "model": alias,
+                            "day": int(days[i % len(days)]),
+                            "trace": {"trace_id": f"w-{i:06d}",
+                                      "span_id": "in"}}])[0]
+                        assert resp["ok"], resp
+
+                    def client(tid: int) -> None:
+                        for i in range(per_client):
+                            req = {
+                                "model": aliases[(tid + i) % len(aliases)],
+                                "day": int(days[i % len(days)]),
+                                "trace": {
+                                    "trace_id": f"b-{tid:02d}-{i:06d}",
+                                    "span_id": "in"}}
+                            t0 = time.perf_counter()
+                            resp = sched.submit([req])[0]
+                            dt = time.perf_counter() - t0
+                            with lock:
+                                lat.append((dt, bool(resp.get("ok"))))
+
+                    threads = [threading.Thread(target=client, args=(t,),
+                                                name=f"trace-client-{t}")
+                               for t in range(max(1, TRACE_CLIENTS))]
+                    t_load = time.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    wall = time.perf_counter() - t_load
+                    sched_stats = sched.stats()
+                finally:
+                    sched.close()
+            finally:
+                install_timeline(prev_tl)
+        walls = sorted(d for d, _ in lat)
+        return {
+            "traced": traced,
+            "requests": len(lat),
+            "ok": bool(lat) and all(ok for _, ok in lat),
+            "qps": round(len(lat) / wall, 2),
+            "p50_ms": round(float(np.percentile(walls, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(walls, 99)) * 1e3, 3),
+            "ticks": sched_stats["ticks"],
+            "fused_ticks": sched_stats["fused_ticks"],
+        }
+
+    # Interleaved rounds (off, on, off, on): best-of per arm, so a GC
+    # pause or a noisy-neighbor burst in one round cannot masquerade as
+    # trace overhead (or hide it).
+    legs = {"off": [], "on": []}
+    on_paths = []
+    for rnd in range(max(1, TRACE_ROUNDS)):
+        for arm in ("off", "on"):
+            run_path = os.path.join(work, f"RUN_{arm}{rnd}.jsonl")
+            legs[arm].append(drive(arm == "on", run_path))
+            if arm == "on":
+                on_paths.append(run_path)
+    qps_off = max(leg["qps"] for leg in legs["off"])
+    qps_on = max(leg["qps"] for leg in legs["on"])
+    overhead = max(0.0, round(1.0 - qps_on / max(qps_off, 1e-9), 4))
+    stages = stage_breakdown(assemble_traces(load_records(on_paths)))
+
+    served_ok = all(leg["ok"] for arm in legs.values() for leg in arm)
+    overhead_ok = overhead <= TRACE_BUDGET
+    payload = {
+        "metric": (
+            f"serve_traced_qps_C{NUM_FEATURES}_T{SEQ_LEN}_H{HIDDEN}"
+            f"_K{FACTORS}_M{PORTFOLIOS}_N{N_STOCKS}"
+            f"_models{SERVE_MODELS}"
+            + ("_cpu_fallback" if FORCED_CPU else "")
+            + ("" if served_ok else "_failed")
+            + ("" if overhead_ok or not served_ok
+               else "_trace_overhead_failed")),
+        "value": round(qps_on, 2),
+        "unit": "req/sec",
+        "vs_baseline": round(
+            qps_on * N_STOCKS / REF_A100_WINDOWS_PER_SEC, 3),
+        "platform": platform,
+        "models": SERVE_MODELS,
+        "requests": SERVE_REQUESTS,
+        "clients": TRACE_CLIENTS,
+        "rounds": TRACE_ROUNDS,
+        "trace_overhead_frac": overhead,
+        "trace_overhead_budget": TRACE_BUDGET,
+        "qps_untraced": qps_off,
+        "qps_traced": qps_on,
+        # queue vs tick-hold vs dispatch vs response wall, from the
+        # traced leg's own span stream — the decomposition a p99
+        # complaint gets drilled into (obs/trace.py --stages).
+        "stages": stages,
+        "legs": legs,
+        "plan": plan_block,
+    }
+    try:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_TRACE.json")
         with open(out, "w") as f:
             json.dump(payload, f, indent=1)
             f.write("\n")
@@ -3231,6 +3438,9 @@ def bench_payload() -> dict:
         # curve through the router + worker-fleet tier (ISSUE 15).
         if USE_SERVE_REMOTE:
             payload = run_serve_remote_bench()
+        elif USE_SERVE_TRACE:
+            # --tracing: the trace-plane overhead A/B (ISSUE 20).
+            payload = run_serve_trace_bench()
         else:
             payload = (run_serve_scaleout_bench() if SERVE_WORKERS
                        else run_serve_bench())
@@ -3394,7 +3604,7 @@ def run_accel_child() -> tuple[bool, str]:
 def main() -> None:
     global USE_FLEET, USE_STREAM, USE_OBS, USE_MIXED, USE_MESH, \
         USE_SERVE, USE_CHAOS, USE_TRACK, USE_HYPER, USE_WALKFORWARD, \
-        SERVE_WORKERS, USE_SERVE_REMOTE, USE_KERNELS
+        SERVE_WORKERS, USE_SERVE_REMOTE, USE_KERNELS, USE_SERVE_TRACE
     if "--track" in sys.argv:
         # NOT propagated via env: only this top-level process appends
         # (emit() guards the accel child; the helpers strip the env).
@@ -3436,6 +3646,12 @@ def main() -> None:
             print("error: --workers wants a comma list (e.g. 1,2,4)",
                   file=sys.stderr)
             sys.exit(2)
+    if "--tracing" in sys.argv:
+        # `--serve --tracing`: the trace-plane overhead A/B (ISSUE 20).
+        # Propagated via env so the probe/fallback subprocesses keep
+        # the mode.
+        USE_SERVE_TRACE = True
+        os.environ["BENCH_SERVE_TRACE"] = "1"
     if "--remote" in sys.argv:
         # `--serve --remote`: the multi-host plane (ISSUE 17).
         # Propagated via env so the probe/fallback subprocesses keep

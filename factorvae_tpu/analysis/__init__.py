@@ -40,6 +40,10 @@ Rule catalog (docs/analysis.md has the long-form version):
 - JGL012  blocking network call (urlopen/create_connection/requests/
           HTTPConnection) without a timeout, or a zero-argument
           Event/Condition `.wait()` that cannot notice a dead waker.
+- JGL013  timeline_span_begin paired with timeline_span_end in the
+          same function (the token API is cross-thread handoff only;
+          same-function pairing either leaks the span on exceptions or
+          hand-rolls the timeline_span context manager).
 - JGL000  meta: unparseable file, a `graftlint: disable` suppression
           carrying no justification, or — in IR mode — a registry
           builder that raised / an unknown program name (the gate

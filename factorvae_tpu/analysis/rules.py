@@ -1,4 +1,4 @@
-"""graftlint rules JGL001–JGL008.
+"""graftlint rules JGL001–JGL008, JGL012 and JGL013.
 
 Each rule is a function `(ModuleModel) -> list[Finding]`. JGL002 (key
 reuse), JGL004 (read-after-donation) and the loop flavor of JGL001 share
@@ -1025,6 +1025,74 @@ def rule_jgl012(model: ModuleModel) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# JGL013 — same-function timeline_span_begin/_end pairing
+
+
+def _jgl013_finally_nodes(func_node: ast.AST) -> Set[int]:
+    """ids of every AST node lexically inside a `finally:` block of
+    `func_node` (nested Trys included)."""
+    protected: Set[int] = set()
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    protected.add(id(sub))
+    return protected
+
+
+def rule_jgl013(model: ModuleModel) -> List[Finding]:
+    """`timeline_span_begin` paired with `timeline_span_end` in the
+    SAME function in `factorvae_tpu/` library code. The begin/end token
+    API (utils/logging.py) exists for exactly one caller shape: a span
+    opened on one thread and closed on another (the tick scheduler's
+    queue-wait spans — submit() opens, the scheduler loop closes).
+    Pairing them inside one function re-implements the `timeline_span`
+    context manager by hand, and almost always wrong: without
+    try/finally an exception between the calls leaks an open span the
+    stream never sees the end of (the trace tree shows a request stuck
+    forever in a stage it left), and with try/finally it is just the
+    context manager, verbose. Cross-function begin/end — the sanctioned
+    handoff — produces no finding."""
+    norm = model.path.replace(os.sep, "/")
+    if "factorvae_tpu/" not in norm:
+        return []  # scripts/, tests/, bench.py own their instrumentation
+    begins: Dict[ast.AST, List[ast.Call]] = {}
+    ends: Dict[ast.AST, List[ast.Call]] = {}
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if name not in ("timeline_span_begin", "timeline_span_end"):
+            continue
+        info = model.enclosing_function(node)
+        if info is None:
+            continue
+        (begins if name == "timeline_span_begin" else ends).setdefault(
+            info.node, []).append(node)
+    findings: List[Finding] = []
+    for func_node, begin_calls in begins.items():
+        end_calls = ends.get(func_node)
+        if not end_calls:
+            continue  # begin-only: the cross-thread handoff, sanctioned
+        protected = _jgl013_finally_nodes(func_node)
+        if all(id(e) in protected for e in end_calls):
+            msg = ("timeline_span_begin/timeline_span_end paired in one "
+                   "function — this hand-rolls the timeline_span context "
+                   "manager; the token API is for cross-thread handoff "
+                   "only, use the context-manager form")
+        else:
+            msg = ("timeline_span_begin paired with timeline_span_end in "
+                   "the same function without try/finally — an exception "
+                   "between them leaks an open span (the trace tree shows "
+                   "the request stuck in that stage forever); use the "
+                   "timeline_span context-manager form")
+        findings.append(Finding(
+            "JGL013", model.path, min(b.lineno for b in begin_calls), msg,
+        ))
+    return findings
+
+
 ALL_RULES = (rule_jgl001, rule_jgl002, rule_jgl003, rule_jgl004,
              rule_jgl005, rule_jgl006, rule_jgl007, rule_jgl008,
-             rule_jgl012)
+             rule_jgl012, rule_jgl013)

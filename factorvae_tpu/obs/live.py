@@ -145,6 +145,32 @@ def iter_lines(path: str, follow: bool = True, poll_s: float = 0.2,
             time.sleep(poll_s)
 
 
+def tail_bytes(path: str, since: int = 0,
+               max_bytes: int = 4 << 20) -> Tuple[bytes, int]:
+    """Server side of ``GET /runstream?since=<offset>`` (pillar 6,
+    obs/collect.py): the byte range [since, next) of a growing JSONL
+    file, cut at the LAST newline so a torn final line — the writer
+    mid-record — is never served; the client re-requests from ``next``
+    and receives that line exactly once, complete. The same contract
+    `iter_lines` keeps locally, spoken over HTTP. Returns (payload,
+    next_offset); missing file or out-of-range offset yields an empty
+    payload with a resynced offset (streams are append-only, so a
+    too-large `since` only happens against a recreated file)."""
+    since = max(0, int(since))
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            if since >= size:
+                return b"", min(since, size)
+            fh.seek(since)
+            chunk = fh.read(max_bytes)
+    except OSError:
+        return b"", 0
+    cut = chunk.rfind(b"\n") + 1
+    return chunk[:cut], since + cut
+
+
 class LiveMonitor:
     """Flag state over a live stream. `update()` recomputes the full
     `obs.report` flag set over everything seen so far — the SAME

@@ -66,7 +66,16 @@ def metric_line(name: str, value, labels: Optional[dict] = None) -> str:
 
 class LatencyHistogram:
     """Fixed-bucket latency histogram (seconds). Thread-safe: observe
-    comes from the serving loop, render from the HTTP handler."""
+    comes from the serving loop, render from the HTTP handler.
+
+    Trace exemplars (ISSUE 20): `observe(dt, trace_id=...)` remembers
+    the LAST trace that landed in each bucket, and `render` emits one
+    `# exemplar` comment line per annotated bucket right after the
+    bucket's sample — so "what request was a p99?" is one grep from
+    the scrape to `obs.trace --trace <id>`. Comment lines are legal
+    exposition (every parser skips `#`), and the router's own
+    histogram rides `merge_expositions(extra_families=...)` verbatim,
+    so its exemplars survive the fleet merge."""
 
     DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                        0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -74,11 +83,14 @@ class LatencyHistogram:
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
         self.buckets = tuple(sorted(float(b) for b in buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # + the +Inf slot
+        self._exemplars: List[Optional[Tuple[str, float]]] = \
+            [None] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._n = 0
         self._lock = threading.Lock()
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float,
+                trace_id: Optional[str] = None) -> None:
         s = float(seconds)
         with self._lock:
             i = len(self.buckets)
@@ -87,6 +99,8 @@ class LatencyHistogram:
                     i = j
                     break
             self._counts[i] += 1
+            if trace_id is not None:
+                self._exemplars[i] = (str(trace_id), s)
             self._sum += s
             self._n += 1
 
@@ -99,17 +113,28 @@ class LatencyHistogram:
                ) -> List[str]:
         with self._lock:
             counts = list(self._counts)
+            exemplars = list(self._exemplars)
             total, n = self._sum, self._n
         lines = []
         cum = 0
-        for b, c in zip(self.buckets, counts):
+        for b, c, ex in zip(self.buckets, counts, exemplars):
             cum += c
             lab = dict(labels or {})
             lab["le"] = _fmt(b)
             lines.append(metric_line(f"{name}_bucket", cum, lab))
+            if ex is not None:
+                tid, s = ex
+                lines.append(f'# exemplar {name}_bucket '
+                             f'le="{_fmt(b)}" trace_id="{_escape(tid)}" '
+                             f'value={_fmt(round(s, 6))}')
         lab = dict(labels or {})
         lab["le"] = "+Inf"
         lines.append(metric_line(f"{name}_bucket", n, lab))
+        if exemplars[-1] is not None:
+            tid, s = exemplars[-1]
+            lines.append(f'# exemplar {name}_bucket le="+Inf" '
+                         f'trace_id="{_escape(tid)}" '
+                         f'value={_fmt(round(s, 6))}')
         lines.append(metric_line(f"{name}_sum", total, labels))
         lines.append(metric_line(f"{name}_count", n, labels))
         return lines

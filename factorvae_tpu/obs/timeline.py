@@ -21,6 +21,16 @@ compile-watchdog spans on "compile") and prints:
 Span names deliberately match `utils.profiling.step_annotation` names
 (`train_epoch_{e}`, ...), so a host span here can be located on the
 device lanes of a `--profile` trace (utils/trace_summary.py) by name.
+
+Serving-plane spans additionally carry `trace` / `span` / `parent`
+fields (the fleet trace plane, obs/trace.py); this renderer ignores
+them — they are additive annotations on the same `span` records, and
+the per-resource Gantt here stays the resource-utilization view while
+`python -m factorvae_tpu.obs.trace` renders the per-request causal
+tree. The per-process-section discipline below (span_sections) is the
+same lesson the trace collector solves properly: records from
+different processes share NO time base until clock probes align them
+(obs/collect.py).
 """
 
 from __future__ import annotations

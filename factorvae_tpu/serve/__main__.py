@@ -178,6 +178,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="RUN.jsonl stream for request spans + compile "
                         "records (render: python -m "
                         "factorvae_tpu.obs.timeline)")
+    p.add_argument("--trace_off", action="store_true",
+                   help="disable the distributed trace plane "
+                        "(docs/observability.md pillar 6): no trace "
+                        "contexts at router ingress, no "
+                        "X-Factorvae-Trace propagation, no trace "
+                        "fields on spans. Routing and scoring are "
+                        "otherwise identical — this is the bench "
+                        "A/B baseline (bench.py --serve reports "
+                        "trace_overhead_frac)")
     p.add_argument("--compile_cache", type=str, default=None,
                    metavar="DIR",
                    help="persistent XLA compilation cache dir (default: "
@@ -232,6 +241,8 @@ def run_pool(args) -> int:
     extra += ["--breaker_k", str(args.breaker_k),
               "--breaker_cooldown_s", str(args.breaker_cooldown_s),
               "--drift_threshold", str(args.drift_threshold)]
+    if args.trace_off:
+        extra += ["--trace_off"]
     logger = MetricsLogger(jsonl_path=args.metrics_jsonl, echo=False,
                            run_name="serve_router")
     prev_tl = install_timeline(Timeline(logger)) \
@@ -276,7 +287,8 @@ def run_pool(args) -> int:
         pool.router_url = f"http://127.0.0.1:{args.router_port}"
         router = Router(pool, max_inflight=args.max_inflight,
                         slo_ms=slo_ms, hedge_ms=hedge_ms,
-                        hedge=not args.no_hedge)
+                        hedge=not args.no_hedge,
+                        trace=not args.trace_off)
         scaler = None
         if args.autoscale and args.autoscale > args.workers:
             from factorvae_tpu.serve.autoscale import AutoScaler
@@ -483,7 +495,8 @@ def main(argv=None) -> int:
             seed=args.seed, deadline_ms=args.deadline_ms,
             breaker_k=args.breaker_k,
             breaker_cooldown_s=args.breaker_cooldown_s,
-            drift_threshold=args.drift_threshold)
+            drift_threshold=args.drift_threshold,
+            trace=not args.trace_off)
         if args.warmup:
             walls = registry.warmup(dataset,
                                     stochastic=daemon.stochastic)

@@ -60,6 +60,7 @@ import numpy as np
 
 from factorvae_tpu.obs.drift import ScoreDriftMonitor
 from factorvae_tpu.obs.metrics import LatencyHistogram
+from factorvae_tpu.obs.trace import TRACE_HEADER, parse_header, wire_ctx
 from factorvae_tpu.serve.registry import (
     Entry,
     ModelRegistry,
@@ -69,6 +70,8 @@ from factorvae_tpu.utils.logging import (
     run_meta,
     timeline_event,
     timeline_span,
+    timeline_span_begin,
+    timeline_span_end,
 )
 
 _CMDS = ("ping", "stats", "models", "shutdown", "admit")
@@ -93,6 +96,12 @@ class _Resolved:
     fast_failed: bool = False             # never dispatched (breaker open)
     server_fault: bool = False            # resolve failed on OUR side
     shared_outcome: bool = False          # copy of another request's dispatch
+    # Trace plane (obs/trace.py): {"trace_id", "base", "n"} — the
+    # ingress context this request's spans hang under plus the daemon's
+    # per-request sequence number that keeps span ids unique when many
+    # requests share one wire context (a wf judge stage).
+    trace: Optional[dict] = None
+    dispatch_span: Optional[str] = None   # span id of the dispatch leg
 
 
 class ScoringDaemon:
@@ -130,7 +139,8 @@ class ScoringDaemon:
                  health_window: int = 64, degraded_at: float = 0.1,
                  failing_at: float = 0.5,
                  drift_threshold: float = 0.5,
-                 drift_min_overlap: int = 8):
+                 drift_min_overlap: int = 8,
+                 trace: bool = True):
         self.registry = registry
         self.dataset = dataset
         self.stochastic = stochastic
@@ -149,6 +159,15 @@ class ScoringDaemon:
         # Walk-forward rollover surface (POST /admit, ISSUE 14)
         self.admits = 0
         self.promotions = 0
+        # Trace plane (ISSUE 20, obs/trace.py). `trace_enabled=False`
+        # drops every trace annotation — the bench A/B's "off" leg.
+        # `_trace_seq` uniquifies span ids when requests share a wire
+        # context; `_tick_span` is the in-flight tick's span id, the
+        # parent dispatch spans chain under. Both mutate under the tick
+        # lock only.
+        self.trace_enabled = bool(trace)
+        self._trace_seq = 0
+        self._tick_span: Optional[str] = None
         # Request-latency histogram for /metrics (obs/metrics.py):
         # tick arrival -> scores landing, the same clock latency_ms
         # reports. Host-side counters only — the scoring path and its
@@ -282,6 +301,23 @@ class ScoringDaemon:
                          deadline_from_request=from_req,
                          paid_compile=not entry.compiled)
 
+    def _ingress_ctx(self, req) -> Optional[dict]:
+        """The trace context one raw request enters the tick under:
+        the request's own `"trace"` field (router forward / scheduler
+        queue / wf stage) when present, else a deterministic
+        daemon-local root for scoring requests so router-less stdin/
+        batch traffic is traceable too. Called under the tick lock
+        (mutates `_trace_seq`)."""
+        if not self.trace_enabled or not isinstance(req, dict) \
+                or req.get("cmd") is not None:
+            return None
+        ctx = wire_ctx(req)
+        if ctx is None and "model" in req:
+            self._trace_seq += 1
+            ctx = {"trace_id": f"d-{self._trace_seq:06d}",
+                   "span_id": "in"}
+        return ctx
+
     # ---- circuit breaker -------------------------------------------------
 
     def _breaker_gate(self, r: _Resolved) -> bool:
@@ -369,7 +405,7 @@ class ScoringDaemon:
                 self._dispatch_serial(r)
                 continue
             buckets.setdefault(key, []).append(r)
-        for key, group in buckets.items():
+        for bi, (key, group) in enumerate(buckets.items()):
             distinct: dict = {}
             for r in group:
                 distinct.setdefault(r.entry.key, r.entry)
@@ -391,6 +427,8 @@ class ScoringDaemon:
                         r.done_t = first.done_t
                         r.error = first.error
                         r.shared_outcome = True
+                        if r.trace is not None:
+                            r.dispatch_span = first.dispatch_span
                 continue
             entries = list(distinct.values())
             days = group[0].days
@@ -413,10 +451,22 @@ class ScoringDaemon:
                         self._stack_cache.popitem(last=False)
                 else:
                     self._stack_cache.move_to_end(cache_key)
+                # Fused dispatch span: one span, many traces — it
+                # parents under the tick span and carries the member
+                # trace ids so each trace's tree grafts it in.
+                d_members = [r for r in group if r.trace is not None]
+                dfields: dict = {}
+                dspan = None
+                if d_members and self._tick_span:
+                    dspan = f"{self._tick_span}.d{bi}"
+                    dfields = dict(
+                        span=dspan, parent=self._tick_span,
+                        traces=sorted({r.trace["trace_id"]
+                                       for r in d_members})[:16])
                 with timeline_span("serve_dispatch", cat="serve",
                                    resource="device",
                                    models=len(entries),
-                                   n_days=int(len(days))):
+                                   n_days=int(len(days)), **dfields):
                     fleet = predict_panel_fleet(
                         stacked, entries[0].score_config, self.dataset,
                         days, stochastic=self.stochastic,
@@ -450,6 +500,8 @@ class ScoringDaemon:
                 r.scores = by_key[r.entry.key]
                 r.batched_with = len(entries)
                 r.done_t = t1
+                if r.trace is not None:
+                    r.dispatch_span = dspan
                 # the fleet program's compile is the fused path's
                 # one-time wall (entry.compiled only tracks the SERIAL
                 # program — see the NOTE above)
@@ -460,11 +512,25 @@ class ScoringDaemon:
                 self.fused_requests += 1
 
     def _dispatch_serial(self, r: _Resolved) -> None:
+        # A traced request's serial dispatch gets its own span so the
+        # per-trace tree shows the dispatch leg whether or not the
+        # request fused; untraced requests keep the pre-trace record
+        # stream exactly (no new spans).
+        dfields: dict = {}
+        if r.trace is not None:
+            r.dispatch_span = f"{r.trace['base']}.d{r.trace['n']}"
+            dfields = dict(trace=r.trace["trace_id"],
+                           span=r.dispatch_span,
+                           parent=self._tick_span or r.trace["base"])
+        cm = (timeline_span("serve_dispatch", cat="serve",
+                            resource="device", models=1, **dfields)
+              if r.trace is not None else contextlib.nullcontext())
         try:
-            r.scores = self.registry.score(
-                r.entry.key, self.dataset, r.days,
-                stochastic=self.stochastic, seed=self.seed,
-                entry=r.entry)
+            with cm:
+                r.scores = self.registry.score(
+                    r.entry.key, self.dataset, r.days,
+                    stochastic=self.stochastic, seed=self.seed,
+                    entry=r.entry)
             r.done_t = time.perf_counter()
             self.dispatches += 1
         except Exception as e:
@@ -669,7 +735,28 @@ class ScoringDaemon:
     def admit(self, path: str, alias: str,
               holdout_days=None, min_margin: float = 0.0,
               drift_threshold: Optional[float] = None,
-              precision: Optional[str] = None) -> dict:
+              precision: Optional[str] = None,
+              trace: Optional[dict] = None) -> dict:
+        """Trace-aware wrapper over `_admit_impl`: `trace` is a wire
+        context ({"trace_id", "span_id"} — a wf promote stage, or the
+        X-Factorvae-Trace header on `POST /admit`) under which the
+        whole admission renders as one `serve_admit` span in the
+        cycle's tree. Traceless admits are untouched."""
+        kw = dict(holdout_days=holdout_days, min_margin=min_margin,
+                  drift_threshold=drift_threshold, precision=precision)
+        ctx = wire_ctx({"trace": trace}) if trace is not None else None
+        if ctx is None or not self.trace_enabled:
+            return self._admit_impl(path, alias, **kw)
+        with timeline_span("serve_admit", cat="serve", resource="serve",
+                           alias=str(alias), trace=ctx["trace_id"],
+                           span=f"{ctx['span_id']}.a",
+                           parent=ctx["span_id"]):
+            return self._admit_impl(path, alias, **kw)
+
+    def _admit_impl(self, path: str, alias: str,
+                    holdout_days=None, min_margin: float = 0.0,
+                    drift_threshold: Optional[float] = None,
+                    precision: Optional[str] = None) -> dict:
         """The rollover control surface (`POST /admit` / cmd "admit"):
         admit a candidate checkpoint into the live registry under its
         config hash, judge it against the incumbent behind `alias`
@@ -812,7 +899,8 @@ class ScoringDaemon:
                 holdout_days=req.get("holdout_days"),
                 min_margin=float(req.get("min_margin", 0.0) or 0),
                 drift_threshold=req.get("drift_threshold"),
-                precision=req.get("precision"))}
+                precision=req.get("precision"),
+                trace=req.get("trace"))}
         except Exception as e:
             # Admission failures (bad path, manifest mismatch,
             # unresolvable config) answer THIS request — the
@@ -834,10 +922,32 @@ class ScoringDaemon:
         admits: list = []
         with self._lock:
             self.ticks += 1
+            # Trace plane: the tick span is SHARED by every traced
+            # request it fuses — it carries the member trace ids
+            # (`traces`) plus the member ingress span ids (`members`)
+            # the renderer grafts it under, and its own id parents the
+            # dispatch spans. Ids stay deterministic: ingress span id +
+            # the daemon's tick counter.
+            bases = [self._ingress_ctx(r) for r in requests]
+            traced = [b for b in bases if b is not None]
+            tick_fields: dict = {}
+            self._tick_span = None
+            if traced:
+                self._tick_span = f"{traced[0]['span_id']}.t{self.ticks}"
+                tick_fields = dict(
+                    span=self._tick_span,
+                    traces=sorted({b["trace_id"] for b in traced})[:16],
+                    members=[b["span_id"] for b in traced][:64])
             with timeline_span("serve_tick", cat="serve",
                                resource="serve",
-                               requests=len(requests)):
+                               requests=len(requests), **tick_fields):
                 resolved = [self._resolve(r) for r in requests]
+                for r, base in zip(resolved, bases):
+                    if base is not None:
+                        self._trace_seq += 1
+                        r.trace = {"trace_id": base["trace_id"],
+                                   "base": base["span_id"],
+                                   "n": self._trace_seq}
                 self._dispatch(resolved)
                 out = []
                 for r in resolved:
@@ -845,10 +955,17 @@ class ScoringDaemon:
                         admits.append((len(out), r))
                         out.append(None)
                         continue
+                    tf: dict = {}
+                    if r.trace is not None:
+                        tf = dict(
+                            trace=r.trace["trace_id"],
+                            span=f"{r.trace['base']}.r{r.trace['n']}",
+                            parent=(r.dispatch_span or self._tick_span
+                                    or r.trace["base"]))
                     with timeline_span("serve_request", cat="serve",
                                        resource="serve",
                                        model=(r.entry.key if r.entry
-                                              else None)):
+                                              else None), **tf):
                         out.append(self._respond(r, t0))
         for i, r in admits:
             out[i] = self._cmd_admit(r)
@@ -971,11 +1088,16 @@ class TickScheduler:
         # mutation below holds _lock.
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        # [request, result_list, slot_index, submission] pending items.
+        # [request, result_list, slot_index, submission, queue_token]
+        # pending items; the token is an open `serve_queue` span
+        # (timeline_span_begin) the scheduler loop closes when the
+        # request is pulled into a tick — the sanctioned cross-thread
+        # begin/end pairing (graftlint JGL013).
         self._q: deque = deque()
         self._closing = False
         self.ticks = 0
         self.scheduled = 0
+        self._qseq = 0             # queue-span id counter (under _lock)
         self.fused_ticks = 0       # ticks that carried > 1 request
         self.max_queue_depth = 0
         # Non-daemon thread, joined in close(): its handle_batch calls
@@ -1006,7 +1128,25 @@ class TickScheduler:
                     results[i] = {"id": None, "ok": False,
                                   "error": r["_parse_error"]}
                     continue
-                self._q.append([r, results, i, sub])
+                # Trace plane: a traced request's queue wait is its own
+                # span, opened here on the HTTP thread and closed by
+                # the scheduler loop when the tick picks it up. The
+                # request is re-parented under the queue span (a copy —
+                # the caller's dict is not mutated) so the daemon's
+                # tick/dispatch/response spans chain below it.
+                qtok = None
+                ctx = wire_ctx(r) if self.daemon.trace_enabled else None
+                if ctx is not None:
+                    self._qseq += 1
+                    qspan = f"{ctx['span_id']}.q{self._qseq}"
+                    r = dict(r)
+                    r["trace"] = {"trace_id": ctx["trace_id"],
+                                  "span_id": qspan}
+                    qtok = timeline_span_begin(
+                        "serve_queue", cat="serve", resource="scheduler",
+                        trace=ctx["trace_id"], span=qspan,
+                        parent=ctx["span_id"])
+                self._q.append([r, results, i, sub, qtok])
                 pending += 1
             sub["left"] = pending
             self.scheduled += pending
@@ -1061,7 +1201,8 @@ class TickScheduler:
     def _answer(self, batch, responses) -> None:
         finished = []
         with self._lock:
-            for (req, results, i, sub), resp in zip(batch, responses):
+            for (req, results, i, sub, _qtok), resp in zip(batch,
+                                                           responses):
                 results[i] = resp
                 sub["left"] -= 1
                 if sub["left"] == 0:
@@ -1074,6 +1215,12 @@ class TickScheduler:
             batch = self._next_batch()
             if batch is None:
                 return
+            # Close the queue-wait spans submit() opened: the wait ends
+            # the moment the tick claims the request (outside _lock —
+            # span emission writes the metrics stream).
+            for item in batch:
+                timeline_span_end(item[4])
+                item[4] = None
             try:
                 responses = self.daemon.handle_batch(
                     [item[0] for item in batch])
@@ -1115,6 +1262,11 @@ class TickScheduler:
             while self._q:
                 leftovers.append(self._q.popleft())
         if leftovers:
+            for item in leftovers:
+                # Never leak a queue span: requests the shutdown
+                # answered without a tick close as cancelled.
+                timeline_span_end(item[4], outcome="cancelled")
+                item[4] = None
             self._answer(leftovers,
                          [{"id": None, "ok": False,
                            "error": "daemon is shutting down"}
@@ -1288,6 +1440,35 @@ def serve_batch_file(daemon: ScoringDaemon, path: str, out,
     return answered
 
 
+def _serve_runstream(handler) -> None:
+    """`GET /runstream?since=<byte offset>` — the fleet collector's
+    transport (obs/collect.py), shared by the worker front here and the
+    router: serve this process's RUN.jsonl tail from `since`, cut at
+    the last newline (obs/live.py `tail_bytes` — a torn final line is
+    never served), with the resume offset in `X-Runstream-Next`. A
+    process with no metrics stream answers an empty payload rather than
+    erroring: collection degrades, requests don't."""
+    from urllib.parse import parse_qs, urlparse
+
+    from factorvae_tpu.obs.live import tail_bytes
+    from factorvae_tpu.utils.logging import current_timeline
+
+    q = parse_qs(urlparse(handler.path).query)
+    try:
+        since = int(q.get("since", ["0"])[0])
+    except ValueError:
+        since = 0
+    tl = current_timeline()
+    jsonl = getattr(getattr(tl, "logger", None), "jsonl_path", None)
+    payload, nxt = tail_bytes(jsonl, since) if jsonl else (b"", 0)
+    handler.send_response(200)
+    handler.send_header("Content-Type", "application/x-ndjson")
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.send_header("X-Runstream-Next", str(nxt))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
 def serve_http(daemon: ScoringDaemon, port: int,
                host: str = "127.0.0.1",
                scheduler: Optional[TickScheduler] = None):
@@ -1354,7 +1535,16 @@ def serve_http(daemon: ScoringDaemon, port: int,
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             if self.path == "/healthz":
                 health = daemon.health()
+                # Clock-alignment echo (obs/collect.py): this process's
+                # timeline clock, stamped as late as possible so the
+                # prober's RTT midpoint estimate is tight. None without
+                # a timeline — the prober just skips the sample.
+                from factorvae_tpu.utils.logging import timeline_now
+
+                health["mono"] = timeline_now()
                 self._send(200 if health["ok"] else 503, health)
+            elif self.path.startswith("/runstream"):
+                _serve_runstream(self)
             elif self.path == "/stats":
                 payload = daemon.stats()
                 if scheduler is not None:
@@ -1408,6 +1598,17 @@ def serve_http(daemon: ScoringDaemon, port: int,
                 return
             n = int(self.headers.get("Content-Length") or 0)
             requests = _parse_line(self.rfile.read(n).decode())
+            # Trace adoption (obs/trace.py): a fleet hop's context
+            # arrives in the X-Factorvae-Trace header; requests that
+            # don't already carry a `trace` field (the router injects
+            # per-request contexts into the body too) inherit it — the
+            # path remote-join workers join a trace through.
+            if daemon.trace_enabled:
+                hdr = parse_header(self.headers.get(TRACE_HEADER))
+                if hdr is not None:
+                    for r in requests:
+                        if isinstance(r, dict) and "trace" not in r:
+                            r["trace"] = hdr
             if self.path == "/profile":
                 req = requests[0] if requests else {}
                 self._profile(req if isinstance(req, dict) else {})
@@ -1433,7 +1634,8 @@ def serve_http(daemon: ScoringDaemon, port: int,
                         holdout_days=req.get("holdout_days"),
                         min_margin=float(req.get("min_margin", 0.0) or 0),
                         drift_threshold=req.get("drift_threshold"),
-                        precision=req.get("precision")))
+                        precision=req.get("precision"),
+                        trace=req.get("trace")))
                 except Exception as e:
                     # A failed admission never kills the daemon — the
                     # incumbent keeps serving and the caller gets the

@@ -80,7 +80,7 @@ import urllib.request
 from typing import List, Optional, Sequence
 
 from factorvae_tpu.chaos import fault as chaos_fault
-from factorvae_tpu.utils.logging import timeline_event
+from factorvae_tpu.utils.logging import timeline_event, timeline_now
 
 
 class PoolError(RuntimeError):
@@ -719,15 +719,21 @@ class WorkerPool:
                 self.remote_adopts += 1
         # Registration arrives from an agent that is already serving:
         # one immediate scrape makes it routable now instead of one
-        # watcher interval later.
+        # watcher interval later — and doubles as the remote join's
+        # FIRST clock probe, so its stream is alignable (obs/collect)
+        # as soon as it is routable.
         try:
+            probe_t0 = timeline_now()
             health = http_json(w.url + "/healthz", timeout=2.0)
+            probe_t1 = timeline_now()
         except (OSError, ValueError, PoolError):
             health = None
         with self._lock:
             if health is not None:
                 w.last_health = health
                 w.state = "ok" if health.get("ok") else "failing"
+        if health is not None:
+            self._log_clock_probe(w, health, probe_t0, probe_t1)
         timeline_event("remote_adopt", cat="serve", resource="pool",
                        worker=w.wid, host=host, port=int(port),
                        rejoin=rejoin, state=w.state)
@@ -1077,7 +1083,9 @@ class WorkerPool:
                                source=source)
                 return
         try:
+            probe_t0 = timeline_now()
             health = http_json(w.url + "/healthz", timeout=2.0)
+            probe_t1 = timeline_now()
         except (OSError, ValueError, PoolError):
             # unreachable/slow: strikes accrue toward "failing"; an
             # externally joined remote (no process to poll) is
@@ -1093,6 +1101,7 @@ class WorkerPool:
                     w.state = "dead"
                     w.last_health = None
             return
+        self._log_clock_probe(w, health, probe_t0, probe_t1)
         status = str(health.get("status", "failing"))
         with self._lock:
             w.fails = 0
@@ -1108,6 +1117,26 @@ class WorkerPool:
                            restarts=w.restarts)
         if needs_replay:
             self._replay_admits(w)
+
+    @staticmethod
+    def _log_clock_probe(w: Worker, health: dict,
+                         t0: Optional[float],
+                         t1: Optional[float]) -> None:
+        """One clock-alignment sample into THIS process's stream: the
+        worker's /healthz echoed its timeline clock (`mono`, seconds
+        on ITS origin) and `t0`/`t1` bracket the scrape on OURS. The
+        fleet collector (obs/collect.py) turns these `clock_probe`
+        marks into per-worker offsets NTP-style — the health watcher
+        is already polling every worker on an interval, so alignment
+        costs zero extra round trips."""
+        mono = health.get("mono") if isinstance(health, dict) else None
+        if (t0 is None or t1 is None
+                or not isinstance(mono, (int, float))
+                or isinstance(mono, bool)):
+            return
+        timeline_event("clock_probe", cat="serve", resource="pool",
+                       worker=w.wid, remote_mono=float(mono),
+                       local_t0=t0, local_t1=t1)
 
     # ---- scrapes for the router ------------------------------------------
 

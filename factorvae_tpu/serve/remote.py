@@ -49,7 +49,7 @@ import time
 from typing import Dict, Optional
 
 from factorvae_tpu.serve.pool import http_bytes, http_json
-from factorvae_tpu.utils.logging import timeline_event
+from factorvae_tpu.utils.logging import timeline_event, timeline_now
 
 
 class JoinError(RuntimeError):
@@ -211,14 +211,30 @@ def register_when_healthy(router_url: str, port: int,
         backoff = 0.2
         while time.monotonic() < deadline:
             try:
+                t0 = timeline_now()
                 out = http_json(
                     router_url.rstrip("/") + "/register",
                     payload={"host": host, "port": int(port),
                              "capability": capability},
                     timeout=10.0)
+                t1 = timeline_now()
             except (OSError, ValueError):
                 out = None
             if isinstance(out, dict) and out.get("ok"):
+                # Reverse clock probe: the register response echoes
+                # the ROUTER's timeline clock, logged into THIS
+                # worker's stream — the mirror of the pool watcher's
+                # forward probes, for cross-checking alignment from
+                # the agent side (obs/collect.py).
+                mono = out.get("mono")
+                if (t0 is not None and t1 is not None
+                        and isinstance(mono, (int, float))
+                        and not isinstance(mono, bool)):
+                    timeline_event("clock_probe", cat="serve",
+                                   resource="remote",
+                                   worker="router",
+                                   remote_mono=float(mono),
+                                   local_t0=t0, local_t1=t1)
                 timeline_event("join_registered", cat="serve",
                                resource="remote", host=host,
                                port=int(port))

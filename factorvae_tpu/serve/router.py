@@ -62,6 +62,18 @@ into ONE serving endpoint (ISSUE 15):
   requests are idempotent by construction, which is what makes the
   duplicate safe.
 
+- **Trace plane (ISSUE 20).** Every `/score` request gets a
+  deterministic root trace context (`r-<request counter>`, no RNG) at
+  ingress — or parents under an incoming `X-Factorvae-Trace` header —
+  and the context rides every forward leg as both the header and a
+  per-request `trace` body field. Hedged duplicates are sibling spans
+  (`h0`/`h1`) of ONE trace annotated winner/loser/cancelled; serial
+  failover attempts chain parent spans. `GET /runstream?since=` serves
+  the router's own RUN.jsonl tail to the fleet collector
+  (obs/collect.py), and the latency histogram carries per-bucket trace
+  exemplars. `trace=False` turns propagation off (the bench A/B
+  baseline).
+
 Requests the router cannot attribute to a model (`cmd` requests)
 route to the rendezvous owner of the literal key `#cmd` — stable, and
 shutdown-by-cmd is deliberately NOT fanned out (stopping the fleet is
@@ -84,8 +96,20 @@ import threading
 import time
 from typing import List, Optional
 
+from factorvae_tpu.obs.trace import (
+    TRACE_HEADER,
+    child,
+    format_header,
+    parse_header,
+    span_fields,
+)
 from factorvae_tpu.serve.pool import WorkerPool
-from factorvae_tpu.utils.logging import timeline_event
+from factorvae_tpu.utils.logging import (
+    timeline_event,
+    timeline_now,
+    timeline_span,
+    timeline_span_at,
+)
 
 
 class _Cancelled(Exception):
@@ -120,10 +144,16 @@ class Router:
                  forward_timeout_s: float = 600.0,
                  slo_ms: float = 0.0, hedge_ms: float = -1.0,
                  hedge: bool = True, hedge_quantile: float = 0.9,
-                 hedge_min_samples: int = 20):
+                 hedge_min_samples: int = 20, trace: bool = True):
         from factorvae_tpu.obs.metrics import LatencyHistogram
 
         self.pool = pool
+        # Trace plane (docs/observability.md pillar 6): when on, every
+        # /score request gets a deterministic root context derived from
+        # the request counter and the context propagates on every
+        # forward leg (header + per-request `trace` field). Off is the
+        # bench A/B baseline — routing behavior is identical.
+        self.trace_enabled = bool(trace)
         self.max_inflight = int(max_inflight)
         self.shed_retry_s = float(shed_retry_s)
         self.forward_timeout_s = float(forward_timeout_s)
@@ -210,12 +240,28 @@ class Router:
                          f"{self.shed_retry_s:g}s",
                 "retry_after_s": self.shed_retry_s}
 
-    def route_batch(self, requests: list) -> list:
+    def route_batch(self, requests: list,
+                    ctx: Optional[dict] = None) -> list:
         """Answer one client submission: group scoring requests by
         their sticky worker, forward each group, merge responses in
         request order. Per-request failures (no healthy candidate,
         every forward failed) answer in place — one sick model's
-        routing must not 503 the rest of the batch."""
+        routing must not 503 the rest of the batch.
+
+        `ctx` is the request's root trace context (built at HTTP
+        ingress from the request counter); when present the whole
+        routing decision runs under a `router_ingress` span and every
+        forward leg becomes a child span of it."""
+        if ctx is not None:
+            with timeline_span("router_ingress", cat="serve",
+                               resource="router",
+                               **span_fields(ctx,
+                                             requests=len(requests))):
+                return self._route_batch(requests, ctx)
+        return self._route_batch(requests, None)
+
+    def _route_batch(self, requests: list,
+                     ctx: Optional[dict]) -> list:
         healthy = self.pool.healthy_ids()
         groups: dict = {}
         responses: list = [None] * len(requests)
@@ -240,21 +286,22 @@ class Router:
         # thread; responses slots are disjoint per group).
         threads = [threading.Thread(
             target=self._forward_group,
-            args=(list(order), items, responses),
+            args=(list(order), items, responses, ctx, gi),
             name="router-forward")
-            for order, items in group_list[1:]]
+            for gi, (order, items) in enumerate(group_list[1:], 1)]
         for t in threads:
             t.start()
         if group_list:
             order, items = group_list[0]
-            self._forward_group(list(order), items, responses)
+            self._forward_group(list(order), items, responses, ctx, 0)
         for t in threads:
             t.join()
         return responses
 
     def _forward(self, wid: str, host: str, port: int, body: bytes,
                  cancel: Optional[threading.Event] = None,
-                 slot: Optional[list] = None):
+                 slot: Optional[list] = None,
+                 trace_hdr: Optional[str] = None):
         """POST one group to a worker over a pooled persistent
         connection (fresh one on first use or after any failure — a
         respawned worker keeps its port, so a stale socket heals on
@@ -279,9 +326,12 @@ class Router:
                     host, port, timeout=self.forward_timeout_s)
             if slot is not None:
                 slot[0] = conn
+            headers = {"Content-Type": "application/json"}
+            if trace_hdr is not None:
+                headers[TRACE_HEADER] = trace_hdr
             try:
-                conn.request("POST", "/score", body=body, headers={
-                    "Content-Type": "application/json"})
+                conn.request("POST", "/score", body=body,
+                             headers=headers)
                 resp = conn.getresponse()
                 out = json.loads(resp.read().decode() or "null")
             except (OSError, ValueError, http.client.HTTPException) \
@@ -313,7 +363,9 @@ class Router:
 
     def _try_forward(self, wid: str, body: bytes, n: int,
                      cancel: Optional[threading.Event] = None,
-                     slot: Optional[list] = None) -> Optional[list]:
+                     slot: Optional[list] = None,
+                     trace_hdr: Optional[str] = None
+                     ) -> Optional[list]:
         """One validated forward attempt: the worker's answers as a
         list of `n` responses, else None. Transport failures count a
         proxy_error and mark the worker for the watcher; a CANCELLED
@@ -325,7 +377,8 @@ class Router:
                 self._worker_inflight.get(wid, 0) + 1
         try:
             out = self._forward(wid, worker.host, worker.port, body,
-                                cancel=cancel, slot=slot)
+                                cancel=cancel, slot=slot,
+                                trace_hdr=trace_hdr)
         except _Cancelled:
             return None
         except Exception as e:
@@ -383,86 +436,168 @@ class Router:
                 conn.close()
 
     def _forward_hedged(self, primary: str, secondary: str,
-                        body: bytes, n: int, delay_s: float):
+                        body_for, n: int, delay_s: float,
+                        ctx: Optional[dict] = None,
+                        prefix: str = ""):
         """Forward to `primary`; past `delay_s` without an answer,
         duplicate to `secondary` — first validated answer wins, the
         loser's socket is shut down and its (eventual) response
         discarded. Returns `(out, wid, hedged)`; a FAST primary
         failure returns `(None, primary, False)` so the caller's
         serial failover takes over (an immediate failure is reroute
-        ground, not hedge ground)."""
+        ground, not hedge ground).
+
+        `body_for(leg_ctx)` serializes the group per leg — the two
+        legs of a hedged pair carry DIFFERENT span ids (`h0`/`h1`,
+        siblings under the ingress span of the SAME trace), so each
+        leg's worker-side spans parent under the leg that actually
+        reached it. Each leg emits its own `router_forward` span, but
+        only after the coordinator settles the race (the `settled`
+        event, set on every return path): the loser's span closes with
+        outcome loser/cancelled instead of leaking or lying."""
         import queue
 
         q: "queue.Queue" = queue.Queue()
         legs: dict = {}
+        verdict: dict = {}
+        settled = threading.Event()
 
-        def run(wid: str) -> None:
+        def run(wid: str, leg: str) -> None:
             cancel, slot = legs[wid]
-            q.put((wid, self._try_forward(wid, body, n,
-                                          cancel=cancel, slot=slot)))
+            leg_ctx = child(ctx, leg) if ctx is not None else None
+            hdr = (format_header(leg_ctx)
+                   if leg_ctx is not None else None)
+            t0 = time.perf_counter()
+            out = self._try_forward(wid, body_for(leg_ctx), n,
+                                    cancel=cancel, slot=slot,
+                                    trace_hdr=hdr)
+            t1 = time.perf_counter()
+            q.put((wid, out))
+            if leg_ctx is None:
+                return
+            settled.wait(timeout=30.0)
+            if out is None:
+                outcome = ("cancelled" if cancel.is_set()
+                           else "error")
+            else:
+                outcome = verdict.get(wid, "ok")
+            timeline_span_at("router_forward", t0, t1, cat="serve",
+                             resource="router", worker=wid,
+                             hedge=leg, outcome=outcome,
+                             **span_fields(leg_ctx))
 
-        def launch(wid: str) -> None:
+        def launch(wid: str, leg: str) -> None:
             legs[wid] = (threading.Event(), [None])
-            threading.Thread(target=run, args=(wid,),
+            threading.Thread(target=run, args=(wid, leg),
                              name="router-hedge").start()
 
-        launch(primary)
         try:
-            wid, out = q.get(timeout=delay_s)
-        except queue.Empty:  # primary is past the delay
-            with self._lock:
-                self.hedges += 1
-            timeline_event("router_hedge", cat="serve",
-                           resource="router", primary=primary,
-                           secondary=secondary,
-                           delay_ms=round(delay_s * 1e3, 3))
-            launch(secondary)
-            wid, out = q.get()
-            if out is None:
-                wid, out = q.get()  # first finisher failed; wait out
-        else:
-            return out, wid, False  # answered (or failed) pre-delay
-        if out is not None:
-            with self._lock:
-                if wid == secondary:
-                    self.hedge_wins += 1
-            for lw, (cancel, slot) in legs.items():
-                if lw != wid:
-                    self._cancel_leg(cancel, slot)
-        return out, wid, True
+            launch(primary, f"{prefix}h0")
+            try:
+                wid, out = q.get(timeout=delay_s)
+            except queue.Empty:  # primary is past the delay
+                with self._lock:
+                    self.hedges += 1
+                timeline_event("router_hedge", cat="serve",
+                               resource="router", primary=primary,
+                               secondary=secondary,
+                               delay_ms=round(delay_s * 1e3, 3),
+                               **({"trace": ctx["trace_id"]}
+                                  if ctx else {}))
+                launch(secondary, f"{prefix}h1")
+                wid, out = q.get()
+                if out is None:
+                    wid, out = q.get()  # first finisher failed
+            else:
+                return out, wid, False  # answered/failed pre-delay
+            if out is not None:
+                with self._lock:
+                    if wid == secondary:
+                        self.hedge_wins += 1
+                verdict[wid] = "winner"
+                for lw in legs:
+                    verdict.setdefault(lw, "loser")
+                for lw, (cancel, slot) in legs.items():
+                    if lw != wid:
+                        self._cancel_leg(cancel, slot)
+            return out, wid, True
+        finally:
+            settled.set()
 
     def _forward_group(self, order: List[str], items: list,
-                       responses: list) -> None:
-        body = json.dumps([req for _, req in items]).encode()
+                       responses: list, ctx: Optional[dict] = None,
+                       gi: int = 0) -> None:
+        # Per-leg serialization: each forward leg stamps ITS span id
+        # into every request's `trace` field, so the worker's queue
+        # span parents under the leg that actually delivered it (hedge
+        # siblings and failover retries carry distinct ids).
+        def body_for(leg_ctx: Optional[dict]) -> bytes:
+            if leg_ctx is None:
+                return json.dumps(
+                    [req for _, req in items]).encode()
+            reqs = []
+            for _, req in items:
+                if isinstance(req, dict):
+                    req = dict(req)
+                    req["trace"] = {
+                        "trace_id": leg_ctx["trace_id"],
+                        "span_id": leg_ctx["span_id"]}
+                reqs.append(req)
+            return json.dumps(reqs).encode()
+
+        prefix = f"g{gi}" if gi else ""
         n = len(items)
         t0 = time.monotonic()
         out, wid, start = None, None, 0
         delay = (self._hedge_delay_s() if len(order) >= 2 else None)
         if delay is not None:
             out, wid, hedged = self._forward_hedged(
-                order[0], order[1], body, n, delay)
+                order[0], order[1], body_for, n, delay,
+                ctx=ctx, prefix=prefix)
             # hand the serial loop whatever the hedge didn't consume
             start = 2 if hedged else 1
             if out is None and start < len(order):
                 with self._lock:
                     self.reroutes += 1
         if out is None:
+            # Serial failover: attempt k+1 is a CHILD of attempt k's
+            # span, so a reroute renders as a cause chain under the
+            # ingress span rather than an unordered fan.
+            parent_ctx = ctx
             for attempt in range(start, len(order)):
                 wid = order[attempt]
-                out = self._try_forward(wid, body, n)
+                leg_ctx = None
+                if parent_ctx is not None:
+                    leg_ctx = child(parent_ctx,
+                                    f"{prefix}f{attempt}")
+                hdr = (format_header(leg_ctx)
+                       if leg_ctx is not None else None)
+                lt0 = time.perf_counter()
+                out = self._try_forward(wid, body_for(leg_ctx), n,
+                                        trace_hdr=hdr)
+                lt1 = time.perf_counter()
+                if leg_ctx is not None:
+                    timeline_span_at(
+                        "router_forward", lt0, lt1, cat="serve",
+                        resource="router", worker=wid,
+                        outcome="ok" if out is not None
+                        else "error",
+                        **span_fields(leg_ctx))
                 if out is not None:
                     break
+                parent_ctx = leg_ctx or parent_ctx
                 if attempt + 1 < len(order):
                     with self._lock:
                         self.reroutes += 1
         if out is not None:
             dt = time.monotonic() - t0
+            tid = ctx["trace_id"] if ctx is not None else None
             with self._lock:
                 self.forwarded += n
                 for _ in range(n):
                     self._lat_window.append(dt)
             for _ in range(n):
-                self.lat_hist.observe(dt)
+                self.lat_hist.observe(dt, trace_id=tid)
             for (i, _), resp in zip(items, out):
                 if isinstance(resp, dict):
                     resp.setdefault("worker", wid)
@@ -676,6 +811,11 @@ class Router:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.startswith("/runstream"):
+                    from factorvae_tpu.serve.daemon import \
+                        _serve_runstream
+
+                    _serve_runstream(self)
                 elif self.path == "/artifacts":
                     self._send(200, router.pool.artifact_manifest())
                 elif self.path.startswith("/artifact/"):
@@ -703,9 +843,9 @@ class Router:
                         "ok": False,
                         "error": f"unknown path {self.path} (router "
                                  f"serves /score /admit /stats "
-                                 f"/metrics /healthz /artifacts "
-                                 f"/artifact/<sha256> /register "
-                                 f"/deregister /upgrade)"})
+                                 f"/metrics /healthz /runstream "
+                                 f"/artifacts /artifact/<sha256> "
+                                 f"/register /deregister /upgrade)"})
 
             def _control_body(self) -> Optional[dict]:
                 n = int(self.headers.get("Content-Length") or 0)
@@ -739,8 +879,13 @@ class Router:
                         self._send(400, {"ok": False,
                                          "error": str(e)})
                         return
+                    # `mono` echoes the router's timeline clock so the
+                    # joining agent can log a REVERSE clock probe into
+                    # its own stream (serve/remote.py) — the mirror of
+                    # the pool watcher's forward probes.
                     self._send(200, {"ok": True,
-                                     "worker": w.describe()})
+                                     "worker": w.describe(),
+                                     "mono": timeline_now()})
                     return
                 if self.path == "/deregister":
                     req = self._control_body()
@@ -798,11 +943,49 @@ class Router:
                                      "router fans it out to every "
                                      "worker"})
                         return
-                    self._send(200, router.pool.admit_fanout(req))
+                    actx = None
+                    if router.trace_enabled:
+                        up = parse_header(
+                            self.headers.get(TRACE_HEADER))
+                        if up is None:
+                            from factorvae_tpu.obs.trace import \
+                                wire_ctx
+
+                            up = wire_ctx(req)
+                        if up is not None:
+                            actx = child(up, "admit")
+                            req = dict(req)
+                            req["trace"] = {
+                                "trace_id": actx["trace_id"],
+                                "span_id": actx["span_id"]}
+                    if actx is not None:
+                        with timeline_span(
+                                "router_admit", cat="serve",
+                                resource="router",
+                                **span_fields(actx)):
+                            fanned = router.pool.admit_fanout(req)
+                    else:
+                        fanned = router.pool.admit_fanout(req)
+                    self._send(200, fanned)
                     return
                 single = (len(requests) == 1)
+                ingress = None
                 with router._lock:
                     router.requests += len(requests)
+                    # Deterministic trace root: the request counter,
+                    # stamped under the SAME lock hold that counts the
+                    # request — replayable, no host RNG. An incoming
+                    # X-Factorvae-Trace header (a wf operator's cycle
+                    # span, an upstream router) parents this hop
+                    # instead of starting a fresh trace.
+                    if router.trace_enabled:
+                        up = parse_header(
+                            self.headers.get(TRACE_HEADER))
+                        ingress = (
+                            child(up, "rt") if up is not None
+                            else {"trace_id":
+                                  f"r-{router.requests:06d}",
+                                  "span_id": "in"})
                     overloaded = (router.max_inflight > 0
                                   and router.inflight
                                   >= router.max_inflight)
@@ -816,7 +999,8 @@ class Router:
                                retry_after=router.shed_retry_s)
                     return
                 try:
-                    responses = router.route_batch(requests)
+                    responses = router.route_batch(requests,
+                                                   ctx=ingress)
                 finally:
                     with router._lock:
                         router.inflight -= 1

@@ -29,7 +29,16 @@ async Checkpointer, the jit watchdog) reach the run's timeline through
 the module-level `install_timeline` / `current_timeline` registry and
 the no-op-when-absent `timeline_span` / `timeline_event` /
 `timeline_span_at` helpers — zero overhead and zero behavior change
-when no timeline is installed (the default).
+when no timeline is installed (the default). Cross-thread spans (a
+queue wait that one thread opens and another closes) use the
+`timeline_span_begin`/`timeline_span_end` token pair; pairing them
+inside one function is a graftlint JGL013 finding — the context
+manager is the only form that cannot leak a span.
+
+Spans may additionally carry distributed-trace identity (`trace`,
+`span`, `parent` fields — obs/trace.py) passed through `**fields`;
+the record schema is additive and every pre-trace consumer
+(obs.report, obs.live, obs.timeline) ignores the extra keys.
 """
 
 from __future__ import annotations
@@ -294,3 +303,46 @@ def timeline_span_at(name: str, t0: float, t1: float, cat: str = "host",
     tl = _TIMELINE
     if tl is not None:
         tl.span_at(name, t0, t1, cat=cat, resource=resource, **fields)
+
+
+def timeline_now() -> Optional[float]:
+    """Current time on the installed timeline's base (seconds since its
+    origin), or None without one. This is the value /healthz echoes as
+    `mono` so the fleet collector (obs/collect.py) can estimate each
+    process's clock offset from handshake round trips."""
+    tl = _TIMELINE
+    if tl is None:
+        return None
+    return round(tl.rel(tl._clock()), 6)
+
+
+def timeline_span_begin(name: str, cat: str = "host", resource: str = "host",
+                        **fields: Any) -> Optional[dict]:
+    """Open a span that a DIFFERENT function (usually a different
+    thread) will close: returns an opaque token carrying the raw clock
+    start, or None when no timeline is installed. The only sanctioned
+    use is the cross-thread handoff — e.g. `TickScheduler.submit`
+    starts a queue-wait span that the scheduler loop closes once the
+    request is pulled into a tick. Pairing begin/end inside ONE
+    function is a graftlint JGL013 finding: use `timeline_span`
+    instead, which cannot leak the span on an exception path."""
+    tl = _TIMELINE
+    if tl is None:
+        return None
+    return {"name": name, "cat": cat, "resource": resource,
+            "t0": tl._clock(), "fields": dict(fields)}
+
+
+def timeline_span_end(token: Optional[dict], **extra: Any) -> None:
+    """Close a span opened by `timeline_span_begin`; no-op on a None
+    token. Extra fields (e.g. outcome annotations) merge over the
+    begin-time fields. Emits on the CURRENTLY installed timeline so the
+    token stays valid across an install/restore in tests."""
+    if token is None:
+        return
+    tl = _TIMELINE
+    if tl is None:
+        return
+    fields = {**token["fields"], **extra}
+    tl.span_at(token["name"], token["t0"], tl._clock(), cat=token["cat"],
+               resource=token["resource"], **fields)

@@ -61,6 +61,7 @@ import numpy as np
 from factorvae_tpu.config import Config
 from factorvae_tpu.data.append import PanelStore
 from factorvae_tpu.data.panel import Panel
+from factorvae_tpu.obs.trace import child, root_ctx, span_fields
 from factorvae_tpu.utils.logging import MetricsLogger, timeline_span
 from factorvae_tpu.wf.journal import CycleJournal
 
@@ -185,6 +186,11 @@ class WalkForwardOperator:
         self.window_days = max(0, int(window_days))
         self.keep_cycles = max(1, int(keep_cycles))
         self.logger = logger or MetricsLogger(echo=False)
+        # The in-flight stage's trace context (ISSUE 20): set by
+        # run_cycle's stage() wrapper, read by the stages that cross
+        # into the serving plane (judge/promote/verify) so daemon
+        # requests and admissions join the cycle's trace tree.
+        self._stage_ctx: Optional[dict] = None
 
     # ---- cycle identity / configs ----------------------------------------
 
@@ -269,6 +275,17 @@ class WalkForwardOperator:
 
     # ---- stages ----------------------------------------------------------
 
+    def _trace_field(self) -> Optional[dict]:
+        """The wire trace context of the in-flight stage ({"trace_id",
+        "span_id"}) — what a daemon request's `trace` field or an
+        admit's `trace=` carries so the serving plane's spans graft
+        under this stage in the cycle tree. None outside run_cycle."""
+        ctx = self._stage_ctx
+        if ctx is None:
+            return None
+        return {"trace_id": ctx["trace_id"],
+                "span_id": ctx["span_id"]}
+
     def _stage_append(self, incoming: Panel) -> dict:
         rec = self.store.append_panel(incoming)
         # Serving-side pickup, serialized with ticks; idempotent when
@@ -295,9 +312,13 @@ class WalkForwardOperator:
         days = [d for d in range(first_new - 1, len(dates))
                 if d >= 0]
         inc_key = self.daemon.registry.resolve_key(self.alias)
+        tf = self._trace_field()
         failures = 0
         for day in days:
-            resp = self.daemon.handle({"model": self.alias, "day": day})
+            req = {"model": self.alias, "day": day}
+            if tf is not None:
+                req["trace"] = tf
+            resp = self.daemon.handle(req)
             if not resp.get("ok"):
                 failures += 1
         drift = self.daemon.drift.stats().get(inc_key, {})
@@ -404,7 +425,8 @@ class WalkForwardOperator:
             refit["path"], self.alias,
             holdout_days=refit.get("holdout_days"),
             min_margin=self.min_margin,
-            drift_threshold=self.drift_threshold)
+            drift_threshold=self.drift_threshold,
+            trace=self._trace_field())
         if resp.get("promoted"):
             self.journal.set_meta("incumbent_path", refit["path"])
         keep = ("promoted", "model", "incumbent", "reason",
@@ -417,7 +439,11 @@ class WalkForwardOperator:
         alias — the cycle is closed by the SERVING plane answering,
         not by the operator believing its own bookkeeping."""
         day = int(self.dataset.split_days(None, None)[-1])
-        resp = self.daemon.handle({"model": self.alias, "day": day})
+        req = {"model": self.alias, "day": day}
+        tf = self._trace_field()
+        if tf is not None:
+            req["trace"] = tf
+        resp = self.daemon.handle(req)
         if not resp.get("ok"):
             raise WalkForwardError(
                 f"verify: serving the newest day failed "
@@ -435,6 +461,13 @@ class WalkForwardOperator:
         Returns a summary with per-stage results and walls; committed
         stages replay their journaled results without re-running."""
         cycle_id = self.next_cycle_id()
+        # Cycle-scoped trace root (ISSUE 20): trace id `wf-<cycle>` —
+        # derived from the journal's deterministic cycle counter, so a
+        # resumed cycle rejoins the SAME trace. Every stage span is a
+        # child, and the stages that cross into the serving plane
+        # carry the stage's context onto their requests/admissions —
+        # one cycle renders as ONE tree spanning operator and daemon.
+        trace_root = root_ctx(f"wf-{cycle_id}", "cycle")
         self.journal.begin_cycle(
             cycle_id, start=str(incoming.dates[0].date()),
             end=str(incoming.dates[-1].date()),
@@ -448,9 +481,14 @@ class WalkForwardOperator:
                 ran[name] = False
                 return done
             t0 = time.perf_counter()
-            with timeline_span(f"wf_{name}", cat="wf", resource="wf",
-                               cycle=cycle_id):
-                result = fn(*args)
+            self._stage_ctx = child(trace_root, name)
+            try:
+                with timeline_span(f"wf_{name}", cat="wf",
+                                   resource="wf", cycle=cycle_id,
+                                   **span_fields(self._stage_ctx)):
+                    result = fn(*args)
+            finally:
+                self._stage_ctx = None
             walls[name] = round(time.perf_counter() - t0, 4)
             ran[name] = True
             self.logger.log("wf_stage", cycle=cycle_id, stage=name,
@@ -461,16 +499,21 @@ class WalkForwardOperator:
             return self.journal.commit(name, dict(result,
                                                   wall_s=walls[name]))
 
-        append = stage("append", self._stage_append, incoming)
-        judge = stage("judge", self._stage_judge, incoming)
-        if judge["trigger"]:
-            refit = stage("refit", self._stage_refit, cycle_id)
-            promote = stage("promote", self._stage_promote, refit)
-        else:
-            refit = stage("refit", lambda: {"skipped": True})
-            promote = stage("promote", lambda: {"skipped": True,
-                                                "promoted": False})
-        verify = stage("verify", self._stage_verify)
+        with timeline_span("wf_cycle", cat="wf", resource="wf",
+                           cycle=cycle_id,
+                           **span_fields(trace_root)):
+            append = stage("append", self._stage_append, incoming)
+            judge = stage("judge", self._stage_judge, incoming)
+            if judge["trigger"]:
+                refit = stage("refit", self._stage_refit, cycle_id)
+                promote = stage("promote", self._stage_promote,
+                                refit)
+            else:
+                refit = stage("refit", lambda: {"skipped": True})
+                promote = stage("promote",
+                                lambda: {"skipped": True,
+                                         "promoted": False})
+            verify = stage("verify", self._stage_verify)
         self.journal.finish_cycle()
         self._cleanup_cycles()
         summary = {

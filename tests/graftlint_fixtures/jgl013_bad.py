@@ -1,0 +1,36 @@
+"""JGL013 seeded violations: same-function span begin/end pairing.
+
+Analyzed (tests/test_analysis.py) under a synthetic
+`factorvae_tpu/...` path — the rule keys on the module's location.
+Expected: 2 findings — one unprotected pairing (leaks the span on any
+exception between the calls) and one try/finally pairing (hand-rolled
+timeline_span). The cross-thread handoff in the companion fixture
+stays silent.
+"""
+
+from factorvae_tpu.utils.logging import (
+    timeline_span_begin,
+    timeline_span_end,
+)
+
+
+def score_once(daemon, req):
+    # BAD: begin/end in one function with no try/finally — if
+    # daemon.handle raises, the span never closes and the trace tree
+    # shows the request parked in this stage forever
+    tok = timeline_span_begin("serve_request", cat="serve",
+                              resource="daemon")
+    resp = daemon.handle(req)
+    timeline_span_end(tok, ok=bool(resp.get("ok")))
+    return resp
+
+
+def score_guarded(daemon, req):
+    # BAD even guarded: try/finally around a same-function pair is the
+    # timeline_span context manager re-implemented by hand
+    tok = timeline_span_begin("serve_request", cat="serve",
+                              resource="daemon")
+    try:
+        return daemon.handle(req)
+    finally:
+        timeline_span_end(tok)

@@ -1,0 +1,38 @@
+"""JGL013 corrected twin: the context-manager form for same-function
+spans, and the SANCTIONED cross-thread begin/end handoff (the token is
+opened on the submitting thread and closed by the worker loop — the
+one shape the token API exists for). Expected: 0 findings."""
+
+from factorvae_tpu.utils.logging import (
+    timeline_span,
+    timeline_span_begin,
+    timeline_span_end,
+)
+
+
+def score_once(daemon, req):
+    # same-function span: the context manager closes on every path
+    with timeline_span("serve_request", cat="serve", resource="daemon"):
+        return daemon.handle(req)
+
+
+class Queue:
+    """Cross-thread handoff: begin in submit() (client thread), end in
+    drain() (worker thread). Begin-only / end-only per function — no
+    finding."""
+
+    def __init__(self):
+        self._items = []
+
+    def submit(self, req):
+        tok = timeline_span_begin("serve_queue", cat="serve",
+                                  resource="scheduler")
+        self._items.append((req, tok))
+
+    def drain(self, daemon):
+        out = []
+        for req, tok in self._items:
+            timeline_span_end(tok)
+            out.append(daemon.handle(req))
+        self._items = []
+        return out

@@ -576,6 +576,62 @@ class TestJGL012:
         assert "done.wait(1.0)" in src
 
 
+class TestJGL013:
+    """Same-function timeline_span_begin/_end pairing (ISSUE 20
+    satellite; path-keyed like JGL006-8/JGL012): the token API is
+    cross-thread handoff only — same-function pairing either leaks the
+    span on exception paths or hand-rolls the timeline_span context
+    manager."""
+
+    def _analyze(self, fixture, path):
+        with open(_fixture(fixture)) as fh:
+            return analyze_source(fh.read(), path)
+
+    def test_fires_on_seeded_violations(self):
+        findings = _active(self._analyze(
+            "jgl013_bad.py", "factorvae_tpu/serve/newmod.py"))
+        hits = [f for f in findings if f.rule == "JGL013"]
+        assert len(hits) == 2, [(f.line, f.message) for f in findings]
+        assert _rules(findings) == ["JGL013"]  # no cross-rule noise
+        # the two failure shapes carry distinct diagnoses
+        unprotected = [f for f in hits if "without try/finally" in f.message]
+        handrolled = [f for f in hits if "hand-rolls" in f.message]
+        assert len(unprotected) == 1 and len(handrolled) == 1, (
+            [(f.line, f.message) for f in hits])
+
+    def test_silent_on_corrected_twin(self):
+        # context-manager form + the sanctioned cross-thread handoff
+        assert _active(self._analyze(
+            "jgl013_good.py", "factorvae_tpu/serve/newmod.py")) == []
+
+    def test_begin_only_handoff_is_exempt(self):
+        # the one shape the token API exists for: open here, close on
+        # another thread (in another function)
+        src = ("from factorvae_tpu.utils.logging import "
+               "timeline_span_begin, timeline_span_end\n"
+               "def submit(q, req):\n"
+               "    q.append((req, timeline_span_begin('serve_queue')))\n"
+               "def drain(q):\n"
+               "    for req, tok in q:\n"
+               "        timeline_span_end(tok)\n")
+        assert _active(analyze_source(
+            src, "factorvae_tpu/serve/newmod.py")) == []
+
+    def test_outside_library_paths_is_exempt(self):
+        # scripts/, tests/, bench.py own their instrumentation
+        assert _active(self._analyze(
+            "jgl013_bad.py", "scripts/some_driver.py")) == []
+
+    def test_scheduler_handoff_audits_clean(self):
+        """The audit half of the satellite: the tick scheduler's
+        queue-wait span (begin in submit, end in _loop/close) is the
+        sanctioned cross-function handoff — the serving plane carries
+        the token API with zero JGL013 findings."""
+        findings = _active(analyze_paths(
+            [os.path.join(REPO, "factorvae_tpu")]))
+        assert [f for f in findings if f.rule == "JGL013"] == []
+
+
 # ---------------------------------------------------------------------------
 # whole-program concurrency rules (JGL009-011) — ISSUE 11
 

@@ -331,6 +331,73 @@ class TestWorkerFleetE2E:
         ok = self._score(router, {"model": "prod", "day": 3})
         assert ok["ok"], ok
 
+    def test_traced_request_complete_tree(self, fleet, tmp_path):
+        """Pillar-6 acceptance (ISSUE 20): ONE traced request through
+        the real 2-worker fleet assembles into a COMPLETE span tree —
+        router ingress → forward leg → worker queue wait → tick fusion
+        → dispatch → response — from the collector-merged router +
+        worker /runstream tails, clock-aligned by the health-scrape
+        probes. Every stage must be reachable from the single
+        `router_ingress` root; a missing hop is a broken trace plane."""
+        from factorvae_tpu.obs import collect
+        from factorvae_tpu.obs.trace import (
+            STAGES, _tree_index, assemble_traces, render_tree)
+        from factorvae_tpu.utils.logging import (
+            MetricsLogger, Timeline, install_timeline)
+
+        pool, router = fleet
+        base = f"http://127.0.0.1:{router.port}"
+        logger = MetricsLogger(
+            jsonl_path=str(tmp_path / "RUN_router.jsonl"), echo=False,
+            run_name="trace_e2e")
+        prev = install_timeline(Timeline(logger))
+        try:
+            # The health watcher logs clock_probe marks into the
+            # (just-installed) router stream every 0.2s; both workers
+            # must be alignable before the merge is meaningful.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                records = collect.parse_lines(
+                    open(logger.jsonl_path).read())
+                offsets = collect.estimate_offsets(records)
+                if {"w0", "w1"} <= set(offsets):
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail(f"no clock probes for both workers: "
+                            f"{offsets}")
+            resp = self._score(router, {"model": "m0", "day": 2})
+            assert resp["ok"], resp
+            tid = f"r-{router.requests:06d}"
+            # Collect while the timeline is still installed — the
+            # router's /runstream serves the CURRENT timeline's file.
+            merged, since = collect.collect_fleet(base)
+        finally:
+            install_timeline(prev)
+        procs = {r.get("proc") for r in merged}
+        assert {"router", "w0", "w1"} <= procs, procs
+        # every worker record merged clock-aligned, never best-effort
+        assert not any(r.get("aligned") is False for r in merged)
+        traces = assemble_traces(merged)
+        assert tid in traces, (tid, sorted(traces))
+        children, roots = _tree_index(traces[tid])
+        ingress = [r for r in roots if r.get("name") == "router_ingress"]
+        assert len(ingress) == 1, [r.get("name") for r in roots]
+
+        names = set()
+        stack = [ingress[0]]
+        while stack:
+            rec = stack.pop()
+            names.add(rec.get("name"))
+            stack.extend(children.get(rec.get("span"), ()))
+        missing = set(STAGES) - names
+        assert not missing, (missing,
+                             render_tree(tid, traces[tid]))
+        # incremental follow: a second sweep from the returned offsets
+        # re-reads nothing already collected
+        merged2, _ = collect.collect_fleet(base, since=since)
+        assert not any(r.get("trace") == tid for r in merged2)
+
     def test_kill_reroute_respawn_from_store(self, fleet):
         """SIGKILL the owner of m0 mid-fleet: the router reroutes m0
         to the survivor immediately; the watcher respawns the worker

@@ -1,0 +1,416 @@
+"""Fleet trace plane (ISSUE 20): deterministic context propagation,
+hedged/failover span topology, collector clock alignment, assembly.
+
+The cross-process acceptance pin (one traced request through a REAL
+2-worker fleet assembling into a complete tree) lives with the fleet
+fixture in tests/test_pool.py::TestWorkerFleetE2E; everything here runs
+against stub workers or synthetic records, so it stays in tier-1's
+quick tail:
+
+- context/ids: replayable ids (no RNG), hierarchical child span ids,
+  header + JSONL wire round-trips, malformed carriers degrade to None;
+- hedged pair = ONE trace: two sibling legs `in.h0`/`in.h1` under the
+  same ingress, loser settles as cancelled/loser — never leaks open;
+- failover chain: attempt k+1 parents under attempt k's span, so a
+  reroute renders as a cause chain, not an unordered fan;
+- clock alignment: NTP-style min-RTT probe offsets rebase worker spans
+  onto the router base; probe-less workers merge tagged aligned=False;
+- assembly: shared (fused-tick) spans graft into each member trace,
+  orphans surface as roots, per-stage breakdown sums hedged legs.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from factorvae_tpu.obs import collect
+from factorvae_tpu.obs.trace import (
+    STAGES,
+    _tree_index,
+    assemble_traces,
+    child,
+    format_header,
+    parse_header,
+    render_tree,
+    root_ctx,
+    sample_keep,
+    span_fields,
+    stage_breakdown,
+    trace_wall,
+    wire_ctx,
+)
+from factorvae_tpu.serve.router import Router
+from factorvae_tpu.utils.logging import (
+    MetricsLogger,
+    Timeline,
+    install_timeline,
+)
+
+
+class TestTraceContext:
+    def test_ids_deterministic_and_hierarchical(self):
+        ctx = root_ctx("r-000042")
+        assert ctx == {"trace_id": "r-000042", "span_id": "in"}
+        leg = child(ctx, "f0")
+        assert leg["span_id"] == "in.f0" and leg["parent"] == "in"
+        q = child(leg, "q3")
+        assert q["span_id"] == "in.f0.q3"
+        # replayable: same inputs, same ids — no RNG anywhere
+        assert child(root_ctx("r-000042"), "f0") == leg
+
+    def test_header_roundtrip_and_malformed(self):
+        ctx = child(root_ctx("wf-c00003", "cycle"), "judge")
+        back = parse_header(format_header(ctx))
+        assert back == {"trace_id": "wf-c00003",
+                        "span_id": "cycle.judge"}
+        for bad in (None, "", "no-separator", ";", "tid;", ";sid"):
+            assert parse_header(bad) is None
+
+    def test_wire_ctx_validates(self):
+        ok = {"model": "m0", "trace": {"trace_id": "d-000007",
+                                       "span_id": "in"}}
+        assert wire_ctx(ok) == {"trace_id": "d-000007",
+                                "span_id": "in"}
+        assert wire_ctx({"model": "m0"}) is None
+        assert wire_ctx({"trace": {"trace_id": 7, "span_id": "in"}}) \
+            is None
+        assert wire_ctx("not-a-dict") is None
+
+    def test_span_fields_passthrough(self):
+        leg = child(root_ctx("r-1"), "f0")
+        f = span_fields(leg, worker="w0")
+        assert f == {"trace": "r-1", "span": "in.f0", "parent": "in",
+                     "worker": "w0"}
+        # None/invalid ctx: extras only, call sites stay unconditional
+        assert span_fields(None, worker="w0") == {"worker": "w0"}
+
+    def test_sample_keep_deterministic_tail_biased(self):
+        ids = [f"r-{i:06d}" for i in range(400)]
+        kept = [t for t in ids if sample_keep(t, 0.25)]
+        assert kept == [t for t in ids if sample_keep(t, 0.25)]
+        assert 0 < len(kept) < len(ids)
+        assert all(sample_keep(t, 1.0) for t in ids)
+        assert not any(sample_keep(t, 0.0) for t in ids)
+        # SLO breachers are ALWAYS kept, at any rate
+        assert sample_keep("r-000001", 0.0, breach=True)
+
+
+# ---------------------------------------------------------------------------
+# stub workers for router-leg tests (no jax, no subprocess)
+# ---------------------------------------------------------------------------
+
+
+class _StubWorker:
+    """Minimal /score HTTP worker: answers every request ok after a
+    fixed delay. Cancelled hedge legs shut the socket mid-write; the
+    handler swallows the resulting broken pipe."""
+
+    def __init__(self, delay_s: float = 0.0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                raw = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                reqs = json.loads(raw.decode() or "[]")
+                n = len(reqs) if isinstance(reqs, list) else 1
+                time.sleep(outer.delay_s)
+                body = json.dumps(
+                    [{"ok": True, "id": None}] * n).encode()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass    # loser leg's socket was shut down
+
+            def log_message(self, *a):
+                pass
+
+        self.delay_s = delay_s
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.host = "127.0.0.1"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class _StubPool:
+    def __init__(self, workers):
+        self._w = dict(workers)
+        self.failures = []
+
+    def healthy_ids(self):
+        return list(self._w)
+
+    def worker(self, wid):
+        return self._w[wid]
+
+    def note_failure(self, wid):
+        self.failures.append(wid)
+
+
+def _wait_spans(path, name, count, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        recs = [r for r in collect.parse_lines(open(path).read())
+                if r.get("event") == "span" and r.get("name") == name]
+        if len(recs) >= count:
+            return recs
+        time.sleep(0.05)
+    pytest.fail(f"never saw {count} {name} span(s) in {path}")
+
+
+@pytest.fixture()
+def timeline(tmp_path):
+    logger = MetricsLogger(jsonl_path=str(tmp_path / "RUN.jsonl"),
+                           echo=False, run_name="trace_unit")
+    prev = install_timeline(Timeline(logger))
+    try:
+        yield logger.jsonl_path
+    finally:
+        install_timeline(prev)
+
+
+class TestRouterLegTopology:
+    def test_hedged_pair_is_one_trace(self, timeline):
+        """A hedged forward duplicates the REQUEST, not the trace: both
+        legs are sibling spans `in.h0`/`in.h1` of the same trace under
+        the ingress span, the winner marked winner and the loser
+        settling as cancelled/loser with its span CLOSED (a leaked
+        open span would render the request parked forever)."""
+        slow, fast = _StubWorker(delay_s=2.0), _StubWorker()
+        pool = _StubPool({"slow": slow, "fast": fast})
+        router = Router(pool, hedge_ms=40.0)
+        ctx = root_ctx("r-000001")
+        responses = [None]
+        try:
+            router._forward_group(
+                ["slow", "fast"], [(0, {"model": "m0", "day": 1})],
+                responses, ctx, 0)
+            assert responses[0]["ok"], responses
+            assert router.hedges == 1 and router.hedge_wins == 1
+            legs = _wait_spans(timeline, "router_forward", 2)
+        finally:
+            slow.close()
+            fast.close()
+        by_span = {r["span"]: r for r in legs}
+        assert set(by_span) == {"in.h0", "in.h1"}
+        assert {r["trace"] for r in legs} == {"r-000001"}
+        assert all(r["parent"] == "in" for r in legs)
+        assert by_span["in.h1"]["outcome"] == "winner"
+        assert by_span["in.h0"]["outcome"] in ("cancelled", "loser")
+        for r in legs:                      # both legs CLOSED
+            assert r["t1"] >= r["t0"]
+        # losing the race says nothing about the worker's health
+        assert pool.failures == []
+        traces = assemble_traces(legs)
+        assert set(traces) == {"r-000001"}
+
+    def test_failover_chains_parent_spans(self, timeline):
+        """Serial failover: attempt k+1 is a CHILD of attempt k's span
+        — the reroute renders as a cause chain under the ingress, and
+        the failed leg closes with outcome=error."""
+        dead_sock = socket.socket()
+        dead_sock.bind(("127.0.0.1", 0))
+        dead_port = dead_sock.getsockname()[1]
+        dead_sock.close()     # connection refused, immediately
+        import types
+
+        live = _StubWorker()
+        pool = _StubPool({
+            "dead": types.SimpleNamespace(host="127.0.0.1",
+                                          port=dead_port),
+            "live": live})
+        router = Router(pool, hedge=False, forward_timeout_s=10.0)
+        ctx = root_ctx("r-000002")
+        responses = [None]
+        try:
+            router._forward_group(
+                ["dead", "live"], [(0, {"model": "m0", "day": 1})],
+                responses, ctx, 0)
+            assert responses[0]["ok"], responses
+            legs = _wait_spans(timeline, "router_forward", 2)
+        finally:
+            live.close()
+        by_span = {r["span"]: r for r in legs}
+        assert set(by_span) == {"in.f0", "in.f0.f1"}
+        assert by_span["in.f0"]["outcome"] == "error"
+        assert by_span["in.f0"]["parent"] == "in"
+        assert by_span["in.f0.f1"]["outcome"] == "ok"
+        assert by_span["in.f0.f1"]["parent"] == "in.f0"
+        assert router.reroutes == 1
+        assert pool.failures == ["dead"]
+
+
+# ---------------------------------------------------------------------------
+# collector: clock alignment + merge
+# ---------------------------------------------------------------------------
+
+
+def _probe(wid, t0, t1, remote):
+    return {"event": "mark", "name": "clock_probe", "worker": wid,
+            "local_t0": t0, "local_t1": t1, "remote_mono": remote}
+
+
+def _span(name, trace, span, t0, t1, parent=None, **extra):
+    rec = {"event": "span", "name": name, "trace": trace,
+           "span": span, "t0": t0, "t1": t1,
+           "dur": round(t1 - t0, 6)}
+    if parent is not None:
+        rec["parent"] = parent
+    rec.update(extra)
+    return rec
+
+
+class TestCollector:
+    def test_estimate_offsets_keeps_min_rtt_probe(self):
+        router_recs = [
+            _probe("w0", 10.0, 10.01, 15.005),   # rtt 10ms  -> kept
+            _probe("w0", 11.0, 11.50, 17.000),   # rtt 500ms -> ignored
+            _probe("w1", 20.0, 20.02, 3.010),
+            {"event": "mark", "name": "clock_probe"},      # malformed
+        ]
+        est = collect.estimate_offsets(router_recs)
+        assert est["w0"]["probes"] == 2
+        assert est["w0"]["offset"] == pytest.approx(-5.0)
+        assert est["w0"]["rtt"] == pytest.approx(0.01)
+        assert est["w1"]["offset"] == pytest.approx(17.0)
+
+    def test_merge_rebases_onto_router_clock(self):
+        """Worker spans whose clock runs 5s AHEAD of the router land
+        back inside the router span that caused them after the rebase;
+        a probe-less worker merges unshifted but tagged aligned=False
+        so a renderer can refuse to compare its times."""
+        router_recs = [
+            _probe("w0", 10.0, 10.01, 15.005),
+            _span("router_ingress", "r-000001", "in", 12.0, 12.4),
+        ]
+        worker_recs = {
+            "w0": [_span("serve_request", "r-000001", "in.f0.r0",
+                         17.1, 17.3, parent="in.f0")],
+            "w9": [_span("serve_request", "r-000009", "in.f0.r0",
+                         99.0, 99.1, parent="in.f0")],
+        }
+        merged = collect.merge_records(router_recs, worker_recs)
+        by_proc = {}
+        for r in merged:
+            by_proc.setdefault(r["proc"], []).append(r)
+        aligned = [r for r in by_proc["w0"]
+                   if r["event"] == "span"][0]
+        assert aligned["t0"] == pytest.approx(12.1)
+        assert aligned["t1"] == pytest.approx(12.3)
+        assert "aligned" not in aligned
+        ingress = [r for r in by_proc["router"]
+                   if r["event"] == "span"][0]
+        assert ingress["t0"] <= aligned["t0"] \
+            and aligned["t1"] <= ingress["t1"]
+        unaligned = [r for r in by_proc["w9"]
+                     if r["event"] == "span"][0]
+        assert unaligned["aligned"] is False
+        assert unaligned["t0"] == pytest.approx(99.0)   # unshifted
+        # sorted by rebased time: ingress first, w0 span inside it
+        spans = [r for r in merged if r["event"] == "span"]
+        assert [r["proc"] for r in spans] == ["router", "w0", "w9"]
+
+    def test_parse_lines_tolerates_torn_tail(self):
+        payload = ('{"event": "mark", "name": "x"}\n'
+                   '\n'
+                   'not json\n'
+                   '{"event": "span", "name": "y"')     # torn
+        recs = collect.parse_lines(payload)
+        assert [r["name"] for r in recs] == ["x"]
+
+
+# ---------------------------------------------------------------------------
+# assembly: records -> trees -> stage breakdown
+# ---------------------------------------------------------------------------
+
+
+def _serving_path_records(tid="r-000001", shift=0.0):
+    """One request's six-stage span set, the shapes the daemon/router
+    actually emit (fused tick + dispatch carry `traces`/`members`, not
+    a `trace` field)."""
+    leg, q = "in.f0", "in.f0.q0"
+    tick, disp = "in.f0.q0.t1", "in.f0.q0.t1.d0"
+    s = shift
+    return [
+        _span("router_ingress", tid, "in", s + 0.0, s + 0.9),
+        _span("router_forward", tid, leg, s + 0.1, s + 0.8,
+              parent="in", outcome="ok", worker="w0"),
+        _span("serve_queue", tid, q, s + 0.2, s + 0.3, parent=leg),
+        {"event": "span", "name": "serve_tick", "span": tick,
+         "traces": [tid], "members": [q], "t0": s + 0.3, "t1": s + 0.7,
+         "dur": 0.4},
+        {"event": "span", "name": "serve_dispatch", "span": disp,
+         "parent": tick, "traces": [tid], "t0": s + 0.3,
+         "t1": s + 0.6, "dur": 0.3},
+        _span("serve_request", tid, f"{q}.r0", s + 0.6, s + 0.7,
+              parent=disp),
+    ]
+
+
+class TestAssembly:
+    def test_complete_tree_from_fused_records(self):
+        traces = assemble_traces(_serving_path_records())
+        assert set(traces) == {"r-000001"}
+        tr = traces["r-000001"]
+        assert len(tr["spans"]) == 4 and len(tr["shared"]) == 2
+        children, roots = _tree_index(tr)
+        assert [r["name"] for r in roots] == ["router_ingress"]
+        names, stack = set(), [roots[0]]
+        while stack:
+            rec = stack.pop()
+            names.add(rec["name"])
+            stack.extend(children.get(rec.get("span"), ()))
+        assert names == set(STAGES)
+        out = render_tree("r-000001", tr)
+        for stage in STAGES:
+            assert stage in out
+        assert trace_wall(tr) == pytest.approx(0.9)
+
+    def test_fused_tick_grafts_into_every_member_trace(self):
+        recs = (_serving_path_records("r-000001")
+                + _serving_path_records("r-000002", shift=10.0))
+        # one tick serves BOTH requests: widen its membership
+        shared = [r for r in recs if r["name"] == "serve_tick"]
+        for r in shared:
+            r["traces"] = ["r-000001", "r-000002"]
+        traces = assemble_traces(recs)
+        for tid in ("r-000001", "r-000002"):
+            assert any(r["name"] == "serve_tick"
+                       for r in traces[tid]["shared"])
+
+    def test_orphan_span_surfaces_as_root(self):
+        recs = [_span("serve_request", "r-1", "in.f0.r0", 0.0, 0.1,
+                      parent="in.f0")]       # parent never collected
+        children, roots = _tree_index(assemble_traces(recs)["r-1"])
+        assert [r["name"] for r in roots] == ["serve_request"]
+
+    def test_stage_breakdown_sums_hedged_legs(self):
+        tid = "r-000001"
+        recs = [
+            _span("router_forward", tid, "in.h0", 0.0, 0.3,
+                  parent="in"),
+            _span("router_forward", tid, "in.h1", 0.1, 0.2,
+                  parent="in"),
+        ]
+        out = stage_breakdown(assemble_traces(recs))
+        # both waits were real: the trace contributes their SUM
+        assert out["router_forward"]["n"] == 1
+        assert out["router_forward"]["p50_ms"] == pytest.approx(400.0)
+        assert "serve_tick" not in out

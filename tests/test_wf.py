@@ -627,6 +627,50 @@ class TestWalkForwardCycle:
         all_days = op.dataset.split_days(None, None)
         assert days == [int(all_days[-2]), int(all_days[-1])]
 
+    def test_cycle_is_one_trace_tree(self, rig, tmp_path):
+        """ISSUE 20: a cycle run under an installed timeline opens ONE
+        deterministic trace (`wf-{cycle_id}`, replayable from the
+        journal's cycle counter — no RNG) whose tree holds every stage
+        span AND the serving-plane spans the stages cause (judge
+        scoring, the promote admission) — operator and daemon render
+        as one causal tree. Runs LAST in the class: it advances the
+        incumbent a second cycle."""
+        from factorvae_tpu.obs.trace import (
+            _tree_index, assemble_traces, load_records)
+        from factorvae_tpu.utils.logging import (
+            MetricsLogger, Timeline, install_timeline)
+
+        op, base = rig
+        jsonl = str(tmp_path / "RUN_wf.jsonl")
+        logger = MetricsLogger(jsonl_path=jsonl, echo=False,
+                               run_name="wf_trace")
+        prev = install_timeline(Timeline(logger))
+        try:
+            piece = continuation_panel(
+                op.store.instruments, op.store.end_date, 2,
+                TINY["num_features"], seed=22)
+            summary = op.run_cycle(piece)
+        finally:
+            install_timeline(prev)
+        assert summary["triggered"] and summary["promoted"], summary
+        cycle_id = CycleJournal(op.journal.path).cycles()[-1]["id"]
+        traces = assemble_traces(load_records([jsonl]))
+        tid = f"wf-{cycle_id}"
+        assert tid in traces, sorted(traces)
+        children, roots = _tree_index(traces[tid])
+        assert [r["name"] for r in roots] == ["wf_cycle"]
+        stages = {r["name"] for r in children["cycle"]}
+        assert {"wf_append", "wf_judge", "wf_refit", "wf_promote",
+                "wf_verify"} <= stages, stages
+        names, stack = set(), [roots[0]]
+        while stack:
+            rec = stack.pop()
+            names.add(rec.get("name"))
+            stack.extend(children.get(rec.get("span"), ()))
+        # the serving plane grafted under the cycle, not floating
+        assert "serve_request" in names, sorted(names)
+        assert "serve_admit" in names, sorted(names)
+
 
 # ---------------------------------------------------------------------------
 # subprocess crash-resume at every stage boundary (slow)
